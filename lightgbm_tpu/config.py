@@ -1,0 +1,408 @@
+"""Parameter schema for lightgbm_tpu.
+
+TPU-native re-design of the reference's config system: a single ``Config``
+dataclass-like object with defaults, ~180 aliases, and consistency checks
+(reference: include/LightGBM/config.h:39, src/io/config.cpp:286 ``Config::Set``,
+generated alias table in src/io/config_auto.cpp). Unlike the reference we keep the
+schema in one Python table (PARAMS below) from which aliases, defaults and docs are
+derived — same "schema as single source of truth" idea, no codegen step needed.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from .utils import log
+
+# ---------------------------------------------------------------------------
+# Schema: name -> (default, type, aliases)
+# Mirrors the parameter surface documented in the reference's
+# include/LightGBM/config.h doc-comments / docs/Parameters.rst.
+# ---------------------------------------------------------------------------
+PARAMS: Dict[str, Tuple[Any, type, Tuple[str, ...]]] = {
+    # core
+    "objective": ("regression", str, ("objective_type", "app", "application", "loss")),
+    "boosting": ("gbdt", str, ("boosting_type", "boost")),
+    "data_sample_strategy": ("bagging", str, ()),
+    "num_iterations": (100, int, (
+        "num_iteration", "n_iter", "num_tree", "num_trees", "num_round", "num_rounds",
+        "nrounds", "num_boost_round", "n_estimators", "max_iter")),
+    "learning_rate": (0.1, float, ("shrinkage_rate", "eta")),
+    "num_leaves": (31, int, ("num_leaf", "max_leaves", "max_leaf", "max_leaf_nodes")),
+    "tree_learner": ("serial", str, ("tree", "tree_type", "tree_learner_type")),
+    "num_threads": (0, int, ("num_thread", "nthread", "nthreads", "n_jobs")),
+    "device_type": ("tpu", str, ("device",)),
+    "seed": (None, int, ("random_seed", "random_state")),
+    "deterministic": (False, bool, ()),
+    # learning control
+    "force_col_wise": (False, bool, ()),
+    "force_row_wise": (False, bool, ()),
+    "max_depth": (-1, int, ()),
+    "min_data_in_leaf": (20, int, (
+        "min_data_per_leaf", "min_data", "min_child_samples", "min_samples_leaf")),
+    "min_sum_hessian_in_leaf": (1e-3, float, (
+        "min_sum_hessian_per_leaf", "min_sum_hessian", "min_hessian", "min_child_weight")),
+    "bagging_fraction": (1.0, float, ("sub_row", "subsample", "bagging")),
+    "pos_bagging_fraction": (1.0, float, ("pos_sub_row", "pos_subsample", "pos_bagging")),
+    "neg_bagging_fraction": (1.0, float, ("neg_sub_row", "neg_subsample", "neg_bagging")),
+    "bagging_freq": (0, int, ("subsample_freq",)),
+    "bagging_seed": (3, int, ("bagging_fraction_seed",)),
+    "bagging_by_query": (False, bool, ()),
+    "feature_fraction": (1.0, float, ("sub_feature", "colsample_bytree")),
+    "feature_fraction_bynode": (1.0, float, ("sub_feature_bynode", "colsample_bynode")),
+    "feature_fraction_seed": (2, int, ()),
+    "extra_trees": (False, bool, ("extra_tree",)),
+    "extra_seed": (6, int, ()),
+    "early_stopping_round": (0, int, (
+        "early_stopping_rounds", "early_stopping", "n_iter_no_change")),
+    "early_stopping_min_delta": (0.0, float, ()),
+    "first_metric_only": (False, bool, ()),
+    "max_delta_step": (0.0, float, ("max_tree_output", "max_leaf_output")),
+    "lambda_l1": (0.0, float, ("reg_alpha", "l1_regularization")),
+    "lambda_l2": (0.0, float, ("reg_lambda", "lambda", "l2_regularization")),
+    "linear_lambda": (0.0, float, ()),
+    "min_gain_to_split": (0.0, float, ("min_split_gain",)),
+    # dart
+    "drop_rate": (0.1, float, ("rate_drop",)),
+    "max_drop": (50, int, ()),
+    "skip_drop": (0.5, float, ()),
+    "xgboost_dart_mode": (False, bool, ()),
+    "uniform_drop": (False, bool, ()),
+    "drop_seed": (4, int, ()),
+    # goss
+    "top_rate": (0.2, float, ()),
+    "other_rate": (0.1, float, ()),
+    # cat
+    "min_data_per_group": (100, int, ()),
+    "max_cat_threshold": (32, int, ()),
+    "cat_l2": (10.0, float, ()),
+    "cat_smooth": (10.0, float, ()),
+    "max_cat_to_onehot": (4, int, ()),
+    # constraints
+    "monotone_constraints": (None, object, ("mc", "monotone_constraint")),
+    "monotone_constraints_method": ("basic", str, ("monotone_constraining_method", "mc_method")),
+    "monotone_penalty": (0.0, float, ("monotone_splits_penalty", "ms_penalty", "mc_penalty")),
+    "feature_contri": (None, object, ("feature_contrib", "fc", "fp", "feature_penalty")),
+    "interaction_constraints": (None, object, ()),
+    "forcedsplits_filename": ("", str, ("fs", "forced_splits_filename", "forced_splits_file", "forced_splits")),
+    "refit_decay_rate": (0.9, float, ()),
+    # cegb
+    "cegb_tradeoff": (1.0, float, ()),
+    "cegb_penalty_split": (0.0, float, ()),
+    "cegb_penalty_feature_lazy": (None, object, ()),
+    "cegb_penalty_feature_coupled": (None, object, ()),
+    # misc learning
+    "path_smooth": (0.0, float, ()),
+    "verbosity": (1, int, ("verbose",)),
+    "use_quantized_grad": (False, bool, ()),
+    "num_grad_quant_bins": (4, int, ()),
+    "quant_train_renew_leaf": (False, bool, ()),
+    "stochastic_rounding": (True, bool, ()),
+    # dataset
+    "linear_tree": (False, bool, ("linear_trees",)),
+    "max_bin": (255, int, ("max_bins",)),
+    "max_bin_by_feature": (None, object, ()),
+    "min_data_in_bin": (3, int, ()),
+    "bin_construct_sample_cnt": (200000, int, ("subsample_for_bin",)),
+    "data_random_seed": (1, int, ("data_seed",)),
+    "is_enable_sparse": (True, bool, ("is_sparse", "enable_sparse", "sparse")),
+    "enable_bundle": (True, bool, ("is_enable_bundle", "bundle")),
+    "use_missing": (True, bool, ()),
+    "zero_as_missing": (False, bool, ()),
+    "feature_pre_filter": (True, bool, ()),
+    "pre_partition": (False, bool, ("is_pre_partition",)),
+    "two_round": (False, bool, ("two_round_loading", "use_two_round_loading")),
+    "header": (False, bool, ("has_header",)),
+    "label_column": ("", str, ("label",)),
+    "weight_column": ("", str, ("weight",)),
+    "group_column": ("", str, ("group", "group_id", "query_column", "query", "query_id")),
+    "ignore_column": ("", str, ("ignore_feature", "blacklist")),
+    "categorical_feature": ("", object, ("cat_feature", "categorical_column", "cat_column", "categorical_features")),
+    "forcedbins_filename": ("", str, ()),
+    "save_binary": (False, bool, ("is_save_binary", "is_save_binary_file")),
+    "precise_float_parser": (False, bool, ()),
+    "parser_config_file": ("", str, ()),
+    # predict
+    "start_iteration_predict": (0, int, ()),
+    "num_iteration_predict": (-1, int, ()),
+    "predict_raw_score": (False, bool, ("is_predict_raw_score", "predict_rawscore", "raw_score")),
+    "predict_leaf_index": (False, bool, ("is_predict_leaf_index", "leaf_index")),
+    "predict_contrib": (False, bool, ("is_predict_contrib", "contrib")),
+    "predict_disable_shape_check": (False, bool, ()),
+    "pred_early_stop": (False, bool, ()),
+    "pred_early_stop_freq": (10, int, ()),
+    "pred_early_stop_margin": (10.0, float, ()),
+    # objective
+    "num_class": (1, int, ("num_classes",)),
+    "is_unbalance": (False, bool, ("unbalance", "unbalanced_sets")),
+    "scale_pos_weight": (1.0, float, ()),
+    "sigmoid": (1.0, float, ()),
+    "boost_from_average": (True, bool, ()),
+    "reg_sqrt": (False, bool, ()),
+    "alpha": (0.9, float, ()),
+    "fair_c": (1.0, float, ()),
+    "poisson_max_delta_step": (0.7, float, ()),
+    "tweedie_variance_power": (1.5, float, ()),
+    "lambdarank_truncation_level": (30, int, ()),
+    "lambdarank_norm": (True, bool, ()),
+    "label_gain": (None, object, ()),
+    "lambdarank_position_bias_regularization": (0.0, float, ()),
+    "objective_seed": (5, int, ()),
+    # metric
+    "metric": (None, object, ("metrics", "metric_types")),
+    "metric_freq": (1, int, ("output_freq",)),
+    "is_provide_training_metric": (False, bool, ("training_metric", "is_training_metric", "train_metric")),
+    "eval_at": ((1, 2, 3, 4, 5), object, ("ndcg_eval_at", "ndcg_at", "map_eval_at", "map_at")),
+    "multi_error_top_k": (1, int, ()),
+    "auc_mu_weights": (None, object, ()),
+    # network (reference: socket/MPI config; here: jax.distributed / mesh shape)
+    "num_machines": (1, int, ("num_machine",)),
+    "local_listen_port": (12400, int, ("local_port", "port")),
+    "time_out": (120, int, ()),
+    "machine_list_filename": ("", str, ("machine_list_file", "machine_list", "mlist")),
+    "machines": ("", str, ("workers", "nodes")),
+    # tpu-specific (new in this framework; no reference analogue)
+    "tpu_double_hist": (False, bool, ()),   # f64 histogram accumulation (CPU/testing)
+    "tpu_hist_impl": ("auto", str, ()),     # auto | xla | pallas
+    "num_shards": (0, int, ()),             # 0 = use all local devices when tree_learner != serial
+    # snapshot / continue
+    "snapshot_freq": (-1, int, ("save_period",)),
+    "input_model": ("", str, ("model_input", "model_in")),
+    "output_model": ("LightGBM_model.txt", str, ("model_output", "model_out")),
+    # gpu compat (accepted, ignored)
+    "gpu_platform_id": (-1, int, ()),
+    "gpu_device_id": (-1, int, ()),
+    "gpu_use_dp": (False, bool, ()),
+    "num_gpu": (1, int, ()),
+}
+
+OBJECTIVE_ALIASES: Dict[str, str] = {
+    "regression": "regression",
+    "regression_l2": "regression",
+    "l2": "regression",
+    "mean_squared_error": "regression",
+    "mse": "regression",
+    "l2_root": "regression",
+    "root_mean_squared_error": "regression",
+    "rmse": "regression",
+    "regression_l1": "regression_l1",
+    "l1": "regression_l1",
+    "mean_absolute_error": "regression_l1",
+    "mae": "regression_l1",
+    "huber": "huber",
+    "fair": "fair",
+    "poisson": "poisson",
+    "quantile": "quantile",
+    "mape": "mape",
+    "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma",
+    "tweedie": "tweedie",
+    "binary": "binary",
+    "multiclass": "multiclass",
+    "softmax": "multiclass",
+    "multiclassova": "multiclassova",
+    "multiclass_ova": "multiclassova",
+    "ova": "multiclassova",
+    "ovr": "multiclassova",
+    "xentropy": "xentropy",
+    "cross_entropy": "xentropy",
+    "xentlambda": "xentlambda",
+    "cross_entropy_lambda": "xentlambda",
+    "lambdarank": "lambdarank",
+    "rank_xendcg": "rank_xendcg",
+    "xendcg": "rank_xendcg",
+    "xe_ndcg": "rank_xendcg",
+    "xe_ndcg_mart": "rank_xendcg",
+    "xendcg_mart": "rank_xendcg",
+    "custom": "custom",
+    "none": "custom",
+    "null": "custom",
+    "na": "custom",
+}
+
+METRIC_ALIASES: Dict[str, str] = {
+    "l1": "l1", "mean_absolute_error": "l1", "mae": "l1", "regression_l1": "l1",
+    "l2": "l2", "mean_squared_error": "l2", "mse": "l2", "regression_l2": "l2",
+    "regression": "l2",
+    "rmse": "rmse", "root_mean_squared_error": "rmse", "l2_root": "rmse",
+    "quantile": "quantile",
+    "mape": "mape", "mean_absolute_percentage_error": "mape",
+    "huber": "huber",
+    "fair": "fair",
+    "poisson": "poisson",
+    "gamma": "gamma",
+    "gamma_deviance": "gamma_deviance",
+    "tweedie": "tweedie",
+    "ndcg": "ndcg", "lambdarank": "ndcg", "rank_xendcg": "ndcg",
+    "xendcg": "ndcg", "xe_ndcg": "ndcg", "xe_ndcg_mart": "ndcg", "xendcg_mart": "ndcg",
+    "map": "map", "mean_average_precision": "map",
+    "auc": "auc",
+    "average_precision": "average_precision",
+    "binary_logloss": "binary_logloss", "binary": "binary_logloss",
+    "binary_error": "binary_error",
+    "auc_mu": "auc_mu",
+    "multi_logloss": "multi_logloss", "multiclass": "multi_logloss",
+    "softmax": "multi_logloss", "multiclassova": "multi_logloss",
+    "multiclass_ova": "multi_logloss", "ova": "multi_logloss", "ovr": "multi_logloss",
+    "multi_error": "multi_error",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda", "xentlambda": "cross_entropy_lambda",
+    "kullback_leibler": "kldiv", "kldiv": "kldiv",
+    "none": "none", "na": "none", "null": "none", "custom": "none",
+}
+
+# alias -> canonical param name
+_ALIAS_TABLE: Dict[str, str] = {}
+for _name, (_d, _t, _aliases) in PARAMS.items():
+    _ALIAS_TABLE[_name] = _name
+    for _a in _aliases:
+        _ALIAS_TABLE[_a] = _name
+
+
+def alias_table() -> Dict[str, str]:
+    return dict(_ALIAS_TABLE)
+
+
+def _coerce(name: str, value: Any, typ: type) -> Any:
+    if value is None:
+        return None
+    if typ is bool:
+        if isinstance(value, str):
+            return value.lower() in ("true", "1", "+", "yes")
+        return bool(value)
+    if typ is int:
+        return int(value)
+    if typ is float:
+        return float(value)
+    if typ is str:
+        return str(value)
+    return value
+
+
+class Config:
+    """Resolved parameter set (reference: struct Config, include/LightGBM/config.h:39)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None):
+        self._explicit: set = set()
+        for name, (default, _typ, _aliases) in PARAMS.items():
+            setattr(self, name, copy.copy(default))
+        if params:
+            self.set(params)
+
+    def set(self, params: Dict[str, Any]) -> None:
+        # resolve aliases first: explicit canonical name wins over aliases
+        # (reference behavior: Config::KeepFirstValues in src/io/config.cpp)
+        resolved: Dict[str, Any] = {}
+        unknown: Dict[str, Any] = {}
+        for key, value in params.items():
+            canon = _ALIAS_TABLE.get(key)
+            if canon is None:
+                unknown[key] = value
+                continue
+            if canon in resolved and key != canon:
+                continue  # first occurrence / canonical wins
+            if canon in resolved and key == canon:
+                resolved[canon] = value
+                continue
+            resolved[canon] = value
+        for key, value in resolved.items():
+            default, typ, _ = PARAMS[key]
+            try:
+                setattr(self, key, _coerce(key, value, typ))
+            except (TypeError, ValueError) as e:
+                log.fatal(f"Bad value {value!r} for parameter {key}: {e}")
+            self._explicit.add(key)
+        for key in unknown:
+            log.warning(f"Unknown parameter: {key}")
+        self._check_consistency()
+
+    def is_explicit(self, name: str) -> bool:
+        return name in self._explicit
+
+    def _check_consistency(self) -> None:
+        # objective canonicalization (reference: ParseObjectiveAlias, config.h)
+        obj = self.objective
+        if obj is None or (isinstance(obj, str) and obj.lower() in OBJECTIVE_ALIASES):
+            if isinstance(obj, str):
+                self.objective = OBJECTIVE_ALIASES[obj.lower()]
+        elif callable(obj):
+            pass  # custom objective function
+        else:
+            log.fatal(f"Unknown objective: {obj!r}")
+        # boosting alias: goss as boosting type rewrites to sample strategy
+        # (reference: config.cpp:119-145)
+        if self.boosting == "goss":
+            self.boosting = "gbdt"
+            self.data_sample_strategy = "goss"
+        if self.boosting not in ("gbdt", "gbrt", "dart", "rf", "random_forest"):
+            log.fatal(f"Unknown boosting type: {self.boosting}")
+        if self.boosting == "gbrt":
+            self.boosting = "gbdt"
+        if self.boosting == "random_forest":
+            self.boosting = "rf"
+        if self.objective in ("multiclass", "multiclassova") and self.num_class <= 1:
+            log.fatal("Number of classes should be specified and greater than 1 for multiclass training")
+        if self.objective not in ("multiclass", "multiclassova") and self.is_explicit("num_class") and self.num_class != 1:
+            log.fatal("Number of classes must be 1 for non-multiclass training")
+        if self.bagging_freq > 0 and (self.bagging_fraction >= 1.0 or self.bagging_fraction <= 0.0) \
+                and self.data_sample_strategy == "bagging" and not self.bagging_by_query:
+            self.bagging_freq = 0
+        if self.early_stopping_round < 0:
+            self.early_stopping_round = 0
+        if self.num_leaves < 2:
+            self.num_leaves = 2
+        if self.max_bin < 2:
+            log.fatal("max_bin should be >= 2")
+        if self.verbosity is not None:
+            log.set_verbosity(self.verbosity)
+        # metric list resolution
+        self.metric = resolve_metrics(self.metric, self.objective)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {name: getattr(self, name) for name in PARAMS}
+
+
+def default_metric_for_objective(objective: Any) -> Optional[str]:
+    if not isinstance(objective, str):
+        return None
+    table = {
+        "regression": "l2",
+        "regression_l1": "l1",
+        "huber": "huber",
+        "fair": "fair",
+        "poisson": "poisson",
+        "quantile": "quantile",
+        "mape": "mape",
+        "gamma": "gamma",
+        "tweedie": "tweedie",
+        "binary": "binary_logloss",
+        "multiclass": "multi_logloss",
+        "multiclassova": "multi_logloss",
+        "xentropy": "cross_entropy",
+        "xentlambda": "cross_entropy_lambda",
+        "lambdarank": "ndcg",
+        "rank_xendcg": "ndcg",
+    }
+    return table.get(objective)
+
+
+def resolve_metrics(metric: Any, objective: Any) -> List[str]:
+    """Resolve the ``metric`` parameter into a canonical list."""
+    if metric is None or metric == "" or metric == []:
+        m = default_metric_for_objective(objective)
+        return [m] if m else []
+    if isinstance(metric, str):
+        metric = [m.strip() for m in metric.split(",") if m.strip()]
+    out: List[str] = []
+    for m in metric:
+        if not isinstance(m, str):
+            continue
+        canon = METRIC_ALIASES.get(m.lower())
+        if canon is None:
+            log.warning(f"Unknown metric: {m}")
+            continue
+        if canon == "none":
+            return []
+        if canon not in out:
+            out.append(canon)
+    return out

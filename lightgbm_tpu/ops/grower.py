@@ -1,0 +1,346 @@
+"""Leaf-wise (best-first) tree growth, fully on device.
+
+TPU-native re-design of the reference's device tree learner
+(reference: CUDASingleGPUTreeLearner::Train,
+src/treelearner/cuda/cuda_single_gpu_tree_learner.cpp:158-345 — the loop
+ConstructHistogramForLeaf -> SubtractHistogramForLeaf -> FindBestSplitsForLeaf ->
+FindBestFromAllSplits -> Split; CPU analogue SerialTreeLearner::Train,
+src/treelearner/serial_tree_learner.cpp:179).
+
+Design differences, by TPU constraints (static shapes, no atomics, no cheap
+host round-trips):
+
+  * The whole tree grows inside one ``jax.lax.fori_loop`` — zero host syncs per
+    tree (the CUDA learner ships one SplitInfo struct to host per split; we ship
+    none).
+  * Row->leaf assignment is a dense ``[N]`` int vector updated by masked where,
+    instead of the reference's index-partition scatter
+    (cuda_data_partition.cu:288 GenDataToLeftBitVectorKernel + prefix sums).
+  * Histograms of BOTH children of a split are built in one 6-channel masked
+    contraction over all rows (ops/histogram.py); with static shapes a masked
+    full pass costs the same as a "smaller child" pass, so the reference's
+    histogram-subtraction trick buys nothing here and is dropped.
+  * Early stop (no leaf with positive gain) becomes a ``done`` flag that turns
+    remaining iterations into no-ops via ``lax.cond`` (skipping the histogram
+    work), since ``fori_loop`` has a static trip count.
+
+The same function runs under ``shard_map`` for data-parallel training: rows are
+sharded, per-leaf histograms are ``psum``-ed over the mesh axis (replacing the
+reference's socket/MPI ReduceScatter in data_parallel_tree_learner.cpp:223-300),
+and every shard then takes identical split decisions.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .histogram import histogram
+from .split import SplitParams, SplitResult, best_split, leaf_output
+
+_NEG_INF = -1e30
+
+
+class GrowerParams(NamedTuple):
+    """Static tree-growth hyper-parameters (hashable; part of the jit key)."""
+    num_leaves: int = 31
+    max_depth: int = -1
+    num_bins: int = 256
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_data_in_leaf: float = 20.0
+    min_sum_hessian_in_leaf: float = 1e-3
+    min_gain_to_split: float = 0.0
+    max_delta_step: float = 0.0
+    axis_name: Optional[str] = None
+
+    def split_params(self) -> SplitParams:
+        return SplitParams(
+            lambda_l1=self.lambda_l1,
+            lambda_l2=self.lambda_l2,
+            min_data_in_leaf=self.min_data_in_leaf,
+            min_sum_hessian_in_leaf=self.min_sum_hessian_in_leaf,
+            min_gain_to_split=self.min_gain_to_split,
+            max_delta_step=self.max_delta_step,
+        )
+
+
+class TreeArrays(NamedTuple):
+    """Struct-of-arrays tree (reference: Tree, include/LightGBM/tree.h:26).
+
+    Nodes are indexed 0..num_leaves-2 in creation order; child pointers >= 0
+    reference internal nodes, negative values ~leaf (i.e. -(leaf_idx+1))
+    reference leaves — same convention as the reference's Tree arrays.
+    """
+    split_feature: jax.Array   # [L-1] i32 (-1 = unused node)
+    split_bin: jax.Array       # [L-1] i32 threshold bin (left: bin <= t; cat: == t)
+    split_gain: jax.Array      # [L-1] f32
+    default_left: jax.Array    # [L-1] bool
+    left_child: jax.Array      # [L-1] i32
+    right_child: jax.Array     # [L-1] i32
+    leaf_value: jax.Array      # [L] f32
+    leaf_weight: jax.Array     # [L] f32 (sum of hessians)
+    leaf_count: jax.Array      # [L] f32 (weighted row count)
+    leaf_parent: jax.Array     # [L] i32 node whose child the leaf is
+    leaf_depth: jax.Array      # [L] i32
+    num_leaves: jax.Array      # scalar i32: actual number of leaves
+    num_nodes: jax.Array       # scalar i32: actual number of internal nodes
+
+
+class GrowerState(NamedTuple):
+    done: jax.Array
+    num_nodes: jax.Array
+    row_leaf: jax.Array
+    # tree arrays under construction
+    split_feature: jax.Array
+    split_bin: jax.Array
+    split_gain: jax.Array
+    default_left: jax.Array
+    left_child: jax.Array
+    right_child: jax.Array
+    leaf_parent: jax.Array
+    leaf_parent_side: jax.Array
+    leaf_depth: jax.Array
+    # per-leaf aggregates
+    leaf_grad: jax.Array
+    leaf_hess: jax.Array
+    leaf_cnt: jax.Array
+    # per-leaf cached best splits
+    bs_gain: jax.Array
+    bs_feature: jax.Array
+    bs_bin: jax.Array
+    bs_default_left: jax.Array
+    bs_left_grad: jax.Array
+    bs_left_hess: jax.Array
+    bs_left_cnt: jax.Array
+
+
+def _leaf_best_split(hist3, pg, ph, pc, feat_info, feat_mask, depth, params: GrowerParams):
+    num_bins_arr, nan_bin_arr, has_nan_arr, is_cat_arr = feat_info
+    sp = best_split(
+        hist3, pg, ph, pc,
+        num_bins_arr, nan_bin_arr, has_nan_arr, is_cat_arr, feat_mask,
+        params.split_params(),
+    )
+    depth_ok = jnp.logical_or(params.max_depth <= 0, depth < params.max_depth)
+    return sp._replace(gain=jnp.where(depth_ok, sp.gain, _NEG_INF))
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def grow_tree(
+    binned: jax.Array,        # [N, F] uint8/uint16
+    grad: jax.Array,          # [N] f32 (already multiplied by sample weights/mask)
+    hess: jax.Array,          # [N] f32 (already multiplied by sample weights/mask)
+    cnt_weight: jax.Array,    # [N] f32 in {0,1}: bagging mask (row counts)
+    num_bins_arr: jax.Array,  # [F] i32
+    nan_bin_arr: jax.Array,   # [F] i32
+    has_nan_arr: jax.Array,   # [F] bool
+    is_cat_arr: jax.Array,    # [F] bool
+    feat_mask: jax.Array,     # [F] bool
+    params: GrowerParams,
+):
+    """Grow one tree; returns (TreeArrays, row_leaf [N] i32)."""
+    n, f = binned.shape
+    L = params.num_leaves
+    B = params.num_bins
+    ax = params.axis_name
+    feat_info = (num_bins_arr, nan_bin_arr, has_nan_arr, is_cat_arr)
+
+    grad = grad.astype(jnp.float32)
+    hess = hess.astype(jnp.float32)
+    cnt_weight = cnt_weight.astype(jnp.float32)
+
+    # ---- root ----
+    root_g = grad.sum()
+    root_h = hess.sum()
+    root_c = cnt_weight.sum()
+    if ax is not None:
+        root_g = lax.psum(root_g, ax)
+        root_h = lax.psum(root_h, ax)
+        root_c = lax.psum(root_c, ax)
+    chans3 = jnp.stack([grad, hess, cnt_weight], axis=1)
+    root_hist = histogram(binned, chans3, B, ax)
+    sp0 = _leaf_best_split(
+        root_hist, root_g, root_h, root_c, feat_info, feat_mask,
+        jnp.asarray(0, jnp.int32), params,
+    )
+
+    i32 = jnp.int32
+    st = GrowerState(
+        done=jnp.asarray(False),
+        num_nodes=jnp.asarray(0, i32),
+        row_leaf=jnp.zeros((n,), i32),
+        split_feature=jnp.full((L - 1,), -1, i32),
+        split_bin=jnp.zeros((L - 1,), i32),
+        split_gain=jnp.zeros((L - 1,), jnp.float32),
+        default_left=jnp.zeros((L - 1,), bool),
+        left_child=jnp.full((L - 1,), -1, i32),
+        right_child=jnp.full((L - 1,), -1, i32),
+        leaf_parent=jnp.full((L,), -1, i32),
+        leaf_parent_side=jnp.zeros((L,), i32),
+        leaf_depth=jnp.zeros((L,), i32),
+        leaf_grad=jnp.zeros((L,), jnp.float32).at[0].set(root_g),
+        leaf_hess=jnp.zeros((L,), jnp.float32).at[0].set(root_h),
+        leaf_cnt=jnp.zeros((L,), jnp.float32).at[0].set(root_c),
+        bs_gain=jnp.full((L,), _NEG_INF, jnp.float32).at[0].set(sp0.gain),
+        bs_feature=jnp.zeros((L,), i32).at[0].set(sp0.feature),
+        bs_bin=jnp.zeros((L,), i32).at[0].set(sp0.bin),
+        bs_default_left=jnp.zeros((L,), bool).at[0].set(sp0.default_left),
+        bs_left_grad=jnp.zeros((L,), jnp.float32).at[0].set(sp0.left_grad),
+        bs_left_hess=jnp.zeros((L,), jnp.float32).at[0].set(sp0.left_hess),
+        bs_left_cnt=jnp.zeros((L,), jnp.float32).at[0].set(sp0.left_count),
+    )
+
+    def body(k, st: GrowerState) -> GrowerState:
+        # ---- FindBestFromAllSplits (reference: cuda_best_split_finder.cu:2113) ----
+        leaf_alive = jnp.arange(L) <= k
+        gains = jnp.where(leaf_alive, st.bs_gain, _NEG_INF)
+        best_leaf = jnp.argmax(gains).astype(i32)
+        valid = gains[best_leaf] > 0.0
+        applied = jnp.logical_and(valid, jnp.logical_not(st.done))
+        done = jnp.logical_or(st.done, jnp.logical_not(valid))
+
+        node = k
+        new_leaf = jnp.asarray(k + 1, i32)
+
+        f_ = st.bs_feature[best_leaf]
+        b_ = st.bs_bin[best_leaf]
+        dl = st.bs_default_left[best_leaf]
+
+        # ---- record split; wire tree structure ----
+        split_feature = st.split_feature.at[node].set(jnp.where(applied, f_, -1))
+        split_bin = st.split_bin.at[node].set(jnp.where(applied, b_, 0))
+        split_gain = st.split_gain.at[node].set(
+            jnp.where(applied, st.bs_gain[best_leaf], 0.0))
+        default_left = st.default_left.at[node].set(jnp.where(applied, dl, False))
+        p = st.leaf_parent[best_leaf]
+        side = st.leaf_parent_side[best_leaf]
+        p_idx = jnp.maximum(p, 0)
+        left_child = st.left_child.at[p_idx].set(
+            jnp.where(applied & (p >= 0) & (side == 0), node, st.left_child[p_idx]))
+        right_child = st.right_child.at[p_idx].set(
+            jnp.where(applied & (p >= 0) & (side == 1), node, st.right_child[p_idx]))
+        left_child = left_child.at[node].set(
+            jnp.where(applied, -(best_leaf + 1), left_child[node]))
+        right_child = right_child.at[node].set(
+            jnp.where(applied, -(new_leaf + 1), right_child[node]))
+        leaf_parent = st.leaf_parent.at[best_leaf].set(
+            jnp.where(applied, node, st.leaf_parent[best_leaf]))
+        leaf_parent = leaf_parent.at[new_leaf].set(
+            jnp.where(applied, node, leaf_parent[new_leaf]))
+        leaf_parent_side = st.leaf_parent_side.at[best_leaf].set(
+            jnp.where(applied, 0, st.leaf_parent_side[best_leaf]))
+        leaf_parent_side = leaf_parent_side.at[new_leaf].set(
+            jnp.where(applied, 1, leaf_parent_side[new_leaf]))
+
+        # ---- partition rows (reference: CUDADataPartition::SplitInner) ----
+        fcol = jnp.take(binned, f_, axis=1).astype(i32)
+        nb = nan_bin_arr[f_]
+        iscat = is_cat_arr[f_]
+        go_left = jnp.where(
+            iscat,
+            fcol == b_,
+            (fcol <= b_) | (dl & (fcol == nb)),
+        )
+        row_leaf = jnp.where(
+            applied & (st.row_leaf == best_leaf) & jnp.logical_not(go_left),
+            new_leaf,
+            st.row_leaf,
+        )
+
+        # ---- per-leaf aggregates for the two children ----
+        lg, lh, lc = (st.bs_left_grad[best_leaf], st.bs_left_hess[best_leaf],
+                      st.bs_left_cnt[best_leaf])
+        pg, ph, pc = (st.leaf_grad[best_leaf], st.leaf_hess[best_leaf],
+                      st.leaf_cnt[best_leaf])
+        rg, rh, rc = pg - lg, ph - lh, pc - lc
+        d_child = st.leaf_depth[best_leaf] + 1
+        leaf_grad = st.leaf_grad.at[best_leaf].set(jnp.where(applied, lg, pg))
+        leaf_grad = leaf_grad.at[new_leaf].set(
+            jnp.where(applied, rg, leaf_grad[new_leaf]))
+        leaf_hess = st.leaf_hess.at[best_leaf].set(jnp.where(applied, lh, ph))
+        leaf_hess = leaf_hess.at[new_leaf].set(
+            jnp.where(applied, rh, leaf_hess[new_leaf]))
+        leaf_cnt = st.leaf_cnt.at[best_leaf].set(jnp.where(applied, lc, pc))
+        leaf_cnt = leaf_cnt.at[new_leaf].set(
+            jnp.where(applied, rc, leaf_cnt[new_leaf]))
+        leaf_depth = st.leaf_depth.at[best_leaf].set(
+            jnp.where(applied, d_child, st.leaf_depth[best_leaf]))
+        leaf_depth = leaf_depth.at[new_leaf].set(
+            jnp.where(applied, d_child, leaf_depth[new_leaf]))
+
+        # ---- children histograms + best splits (skipped when done) ----
+        bs_arrays = (st.bs_gain, st.bs_feature, st.bs_bin, st.bs_default_left,
+                     st.bs_left_grad, st.bs_left_hess, st.bs_left_cnt)
+
+        def compute_children(bs):
+            bs_gain, bs_feature, bs_bin, bs_dl, bs_lg, bs_lh, bs_lc = bs
+            ml = (row_leaf == best_leaf).astype(jnp.float32)
+            mr = (row_leaf == new_leaf).astype(jnp.float32)
+            chans6 = jnp.stack(
+                [grad * ml, hess * ml, cnt_weight * ml,
+                 grad * mr, hess * mr, cnt_weight * mr], axis=1)
+            hist6 = histogram(binned, chans6, B, ax)
+            sp_l = _leaf_best_split(hist6[:, :, :3], lg, lh, lc,
+                                    feat_info, feat_mask, d_child, params)
+            sp_r = _leaf_best_split(hist6[:, :, 3:], rg, rh, rc,
+                                    feat_info, feat_mask, d_child, params)
+            bs_gain = bs_gain.at[best_leaf].set(sp_l.gain).at[new_leaf].set(sp_r.gain)
+            bs_feature = bs_feature.at[best_leaf].set(sp_l.feature).at[new_leaf].set(sp_r.feature)
+            bs_bin = bs_bin.at[best_leaf].set(sp_l.bin).at[new_leaf].set(sp_r.bin)
+            bs_dl = bs_dl.at[best_leaf].set(sp_l.default_left).at[new_leaf].set(sp_r.default_left)
+            bs_lg = bs_lg.at[best_leaf].set(sp_l.left_grad).at[new_leaf].set(sp_r.left_grad)
+            bs_lh = bs_lh.at[best_leaf].set(sp_l.left_hess).at[new_leaf].set(sp_r.left_hess)
+            bs_lc = bs_lc.at[best_leaf].set(sp_l.left_count).at[new_leaf].set(sp_r.left_count)
+            return (bs_gain, bs_feature, bs_bin, bs_dl, bs_lg, bs_lh, bs_lc)
+
+        bs_arrays = lax.cond(applied, compute_children, lambda bs: bs, bs_arrays)
+        (bs_gain, bs_feature, bs_bin, bs_dl, bs_lg, bs_lh, bs_lc) = bs_arrays
+
+        return GrowerState(
+            done=done,
+            num_nodes=st.num_nodes + jnp.where(applied, 1, 0).astype(i32),
+            row_leaf=row_leaf,
+            split_feature=split_feature,
+            split_bin=split_bin,
+            split_gain=split_gain,
+            default_left=default_left,
+            left_child=left_child,
+            right_child=right_child,
+            leaf_parent=leaf_parent,
+            leaf_parent_side=leaf_parent_side,
+            leaf_depth=leaf_depth,
+            leaf_grad=leaf_grad,
+            leaf_hess=leaf_hess,
+            leaf_cnt=leaf_cnt,
+            bs_gain=bs_gain,
+            bs_feature=bs_feature,
+            bs_bin=bs_bin,
+            bs_default_left=bs_dl,
+            bs_left_grad=bs_lg,
+            bs_left_hess=bs_lh,
+            bs_left_cnt=bs_lc,
+        )
+
+    st = lax.fori_loop(0, L - 1, body, st)
+
+    leaf_value = leaf_output(st.leaf_grad, st.leaf_hess, params.split_params())
+    tree = TreeArrays(
+        split_feature=st.split_feature,
+        split_bin=st.split_bin,
+        split_gain=st.split_gain,
+        default_left=st.default_left,
+        left_child=st.left_child,
+        right_child=st.right_child,
+        leaf_value=leaf_value,
+        leaf_weight=st.leaf_hess,
+        leaf_count=st.leaf_cnt,
+        leaf_parent=st.leaf_parent,
+        leaf_depth=st.leaf_depth,
+        num_leaves=st.num_nodes + 1,
+        num_nodes=st.num_nodes,
+    )
+    return tree, st.row_leaf

@@ -1,0 +1,83 @@
+"""Histogram construction on TPU.
+
+TPU-native re-design of the reference's histogram kernels
+(reference: CUDA shared-memory atomicAdd kernels in
+src/treelearner/cuda/cuda_histogram_constructor.cu:17-68 and the CPU templated
+``Dataset::ConstructHistograms`` include/LightGBM/dataset.h:727).
+
+TPUs have no fast scatter/atomics, so the scatter-add is re-formulated as a
+one-hot contraction that XLA maps onto the MXU:
+
+    hist[f, b, k] = sum_r (binned[r, f] == b) * channels[r, k]
+
+``channels`` carries (grad, hess, count-weight) per row, already multiplied by
+the leaf-membership mask — so one contraction builds the histograms of both
+children of a split (6 channels) in a single pass, replacing the reference's
+per-leaf kernel launches + histogram subtraction
+(cuda_histogram_constructor.cu SubtractHistogramKernel :723).
+
+Rows are processed in chunks via ``lax.scan`` to bound the materialized one-hot
+to ``chunk * F * B`` elements. A Pallas kernel that keeps the one-hot entirely
+in VMEM is the planned fast path (ops/pallas_histogram.py).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# target elements for the materialized one-hot per scan step
+_CHUNK_ELEMS = 1 << 23
+
+
+def _chunk_rows(n: int, f: int, b: int) -> int:
+    per_row = max(1, f * b)
+    c = max(128, _CHUNK_ELEMS // per_row)
+    # round to a multiple of 128 rows for clean TPU tiling
+    c = (c // 128) * 128
+    return max(128, min(c, max(128, n)))
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "axis_name"))
+def histogram(
+    binned: jax.Array,      # [N, F] uint8/uint16/int32
+    channels: jax.Array,    # [N, K] f32
+    num_bins: int,          # B (static)
+    axis_name: Optional[str] = None,
+) -> jax.Array:             # [F, B, K] f32
+    """Accumulate per-(feature, bin) sums of ``channels`` columns."""
+    n, f = binned.shape
+    k = channels.shape[1]
+    b = num_bins
+    chunk = _chunk_rows(n, f, b)
+    iota = jnp.arange(b, dtype=jnp.int32)
+
+    if n <= chunk:
+        onehot = (binned.astype(jnp.int32)[:, :, None] == iota).astype(channels.dtype)
+        hist = jnp.einsum("rfb,rk->fbk", onehot, channels)
+    else:
+        n_chunks = -(-n // chunk)
+        pad = n_chunks * chunk - n
+        if pad:
+            binned = jnp.pad(binned, ((0, pad), (0, 0)))
+            channels = jnp.pad(channels, ((0, pad), (0, 0)))
+        binned_c = binned.reshape(n_chunks, chunk, f)
+        channels_c = channels.reshape(n_chunks, chunk, k)
+
+        def step(hist, inp):
+            bc, cc = inp
+            onehot = (bc.astype(jnp.int32)[:, :, None] == iota).astype(cc.dtype)
+            return hist + jnp.einsum("rfb,rk->fbk", onehot, cc), None
+
+        hist0 = jnp.zeros((f, b, k), dtype=channels.dtype)
+        hist, _ = lax.scan(step, hist0, (binned_c, channels_c))
+
+    if axis_name is not None:
+        # distributed data-parallel: the reference reduce-scatters histograms over
+        # its socket/MPI Network (src/treelearner/data_parallel_tree_learner.cpp:223-300);
+        # on TPU the equivalent is a psum over the ICI mesh axis.
+        hist = lax.psum(hist, axis_name)
+    return hist
