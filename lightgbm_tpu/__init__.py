@@ -1,0 +1,40 @@
+"""lightgbm_tpu: a TPU-native gradient boosting framework.
+
+A from-scratch re-design of LightGBM (reference: vnherdeiro/LightGBM) for TPUs:
+histograms, split finding, tree growth, objectives and scoring all run on
+device through JAX/XLA (with Pallas kernels for the hot paths), and the
+distributed tree learners use XLA collectives over the ICI mesh instead of the
+reference's socket/MPI network.
+
+Public surface mirrors the reference's Python package
+(python-package/lightgbm/__init__.py): ``Dataset``, ``Booster``, ``train``,
+``cv``, callbacks, and sklearn-style estimators.
+"""
+from .basic import Booster, Dataset
+from .callback import (
+    EarlyStopException,
+    early_stopping,
+    log_evaluation,
+    record_evaluation,
+    reset_parameter,
+)
+from .config import Config
+from .engine import CVBooster, cv, train
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Dataset", "Booster", "Config",
+    "train", "cv", "CVBooster",
+    "early_stopping", "log_evaluation", "record_evaluation", "reset_parameter",
+    "EarlyStopException",
+    "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
+]
+
+
+def __getattr__(name):
+    # sklearn wrappers import lazily to keep base import light
+    if name in ("LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"):
+        from . import sklearn as _sk
+        return getattr(_sk, name)
+    raise AttributeError(f"module 'lightgbm_tpu' has no attribute {name!r}")

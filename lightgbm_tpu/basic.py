@@ -1,0 +1,466 @@
+"""Public ``Dataset`` / ``Booster`` API.
+
+Mirror of the reference's Python binding surface
+(reference: python-package/lightgbm/basic.py — class Dataset :1900+
+[`construct` :2517, `_lazy_init` :2102, `create_valid` :2454], class Booster
+:3586 [`update` :4092, `predict` :4701, `rollback_one_iter`, `eval` family,
+`save_model`, `feature_importance`]).
+
+Unlike the reference there is no C API / ctypes boundary: the Booster drives the
+JAX GBDT directly (boosting/gbdt.py). The binned dataset and all scores live in
+TPU HBM; this layer only does host-side bookkeeping.
+"""
+from __future__ import annotations
+
+import copy
+import json
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .config import Config
+from .io.dataset import BinnedDataset, Metadata
+from .metrics import create_metrics
+from .objectives import create_objective
+from .utils import log
+
+_ArrayLike = Any
+
+
+class Dataset:
+    """Training/validation data container (reference: Dataset, basic.py:1900).
+
+    Lazily constructed: binning happens at ``construct()`` (first use by
+    ``train``), so parameters passed at Booster creation can still influence it
+    — same two-phase design as the reference.
+    """
+
+    def __init__(
+        self,
+        data: _ArrayLike,
+        label: Optional[_ArrayLike] = None,
+        reference: Optional["Dataset"] = None,
+        weight: Optional[_ArrayLike] = None,
+        group: Optional[_ArrayLike] = None,
+        init_score: Optional[_ArrayLike] = None,
+        feature_name: Union[str, Sequence[str]] = "auto",
+        categorical_feature: Union[str, Sequence] = "auto",
+        params: Optional[Dict[str, Any]] = None,
+        free_raw_data: bool = True,
+        position: Optional[_ArrayLike] = None,
+    ):
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = copy.deepcopy(params) if params else {}
+        self.free_raw_data = free_raw_data
+        self.position = position
+        self._inner: Optional[BinnedDataset] = None
+        self.used_indices: Optional[np.ndarray] = None
+
+    # -- construction --------------------------------------------------------
+    def construct(self) -> "Dataset":
+        """(reference: Dataset.construct, basic.py:2517)"""
+        if self._inner is not None:
+            return self
+        cfg = Config(self.params)
+        ref_inner = None
+        if self.reference is not None:
+            self.reference.construct()
+            ref_inner = self.reference._inner
+        feature_names = (
+            None if self.feature_name == "auto" else list(self.feature_name))
+        cat = (None if self.categorical_feature == "auto"
+               else self.categorical_feature)
+        self._inner = BinnedDataset.construct(
+            self.data,
+            max_bin=cfg.max_bin,
+            min_data_in_bin=cfg.min_data_in_bin,
+            bin_construct_sample_cnt=cfg.bin_construct_sample_cnt,
+            use_missing=cfg.use_missing,
+            zero_as_missing=cfg.zero_as_missing,
+            categorical_feature=cat,
+            feature_names=feature_names,
+            data_random_seed=cfg.get("data_random_seed", 1),
+            reference=ref_inner,
+            keep_raw=not self.free_raw_data,
+        )
+        md = self._inner.metadata
+        if self.label is not None:
+            md.set_label(_maybe_series(self.label))
+        md.set_weight(_maybe_series(self.weight))
+        if self.group is not None:
+            md.set_group(self.group)
+        md.set_init_score(self.init_score)
+        md.set_position(self.position)
+        if self.free_raw_data:
+            self.data = None
+        return self
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None, position=None) -> "Dataset":
+        """(reference: Dataset.create_valid, basic.py:2454)"""
+        return Dataset(
+            data, label=label, reference=self, weight=weight, group=group,
+            init_score=init_score, params=params or self.params,
+            free_raw_data=self.free_raw_data, position=position)
+
+    # -- setters (reference: set_field family) -------------------------------
+    def set_label(self, label) -> "Dataset":
+        self.label = label
+        if self._inner is not None:
+            self._inner.metadata.set_label(_maybe_series(label))
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = weight
+        if self._inner is not None:
+            self._inner.metadata.set_weight(_maybe_series(weight))
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = group
+        if self._inner is not None:
+            self._inner.metadata.set_group(group)
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = init_score
+        if self._inner is not None:
+            self._inner.metadata.set_init_score(init_score)
+        return self
+
+    def set_position(self, position) -> "Dataset":
+        self.position = position
+        if self._inner is not None:
+            self._inner.metadata.set_position(position)
+        return self
+
+    def get_label(self):
+        if self._inner is not None and self._inner.metadata.label is not None:
+            return self._inner.metadata.label
+        return self.label
+
+    def get_weight(self):
+        if self._inner is not None:
+            return self._inner.metadata.weight
+        return self.weight
+
+    def get_group(self):
+        if self._inner is not None:
+            return self._inner.metadata.group
+        return self.group
+
+    def get_init_score(self):
+        if self._inner is not None:
+            return self._inner.metadata.init_score
+        return self.init_score
+
+    def get_field(self, name):
+        getter = {"label": self.get_label, "weight": self.get_weight,
+                  "group": self.get_group, "init_score": self.get_init_score}
+        if name not in getter:
+            raise KeyError(name)
+        return getter[name]()
+
+    def set_field(self, name, value):
+        setter = {"label": self.set_label, "weight": self.set_weight,
+                  "group": self.set_group, "init_score": self.set_init_score,
+                  "position": self.set_position}
+        if name not in setter:
+            raise KeyError(name)
+        return setter[name](value)
+
+    def num_data(self) -> int:
+        if self._inner is not None:
+            return self._inner.num_data
+        arr = np.asarray(self.data if not hasattr(self.data, "values")
+                         else self.data.values)
+        return arr.shape[0]
+
+    def num_feature(self) -> int:
+        if self._inner is not None:
+            return self._inner.num_total_features
+        arr = np.asarray(self.data if not hasattr(self.data, "values")
+                         else self.data.values)
+        return arr.shape[1] if arr.ndim == 2 else 1
+
+    def get_feature_name(self) -> List[str]:
+        self.construct()
+        return list(self._inner.feature_names)
+
+
+def _maybe_series(x):
+    if x is None:
+        return None
+    if hasattr(x, "values"):
+        return np.asarray(x.values)
+    return np.asarray(x)
+
+
+class Booster:
+    """The trained/training model handle (reference: Booster, basic.py:3586)."""
+
+    def __init__(
+        self,
+        params: Optional[Dict[str, Any]] = None,
+        train_set: Optional[Dataset] = None,
+        model_file: Optional[str] = None,
+        model_str: Optional[str] = None,
+    ):
+        params = copy.deepcopy(params) if params else {}
+        self.params = params
+        self.best_iteration = -1
+        self.best_score: Dict = {}
+        self._train_data_name = "training"
+        self._custom_objective: Optional[Callable] = None
+
+        if train_set is not None:
+            if not isinstance(train_set, Dataset):
+                raise TypeError("Training data should be a Dataset instance")
+            train_set.construct()
+            self.config = Config(params)
+            objective = self.config.objective
+            if callable(objective):
+                self._custom_objective = objective
+                objective = None
+                obj = None
+            else:
+                obj = create_objective(objective, self.config)
+            from .boosting import create_boosting
+            self._gbdt = create_boosting(self.config, train_set._inner, obj)
+            self.train_set = train_set
+            self._gbdt.set_train_metrics(
+                create_metrics(self.config.metric, self.config))
+            self._valid_names: List[str] = []
+        elif model_file is not None or model_str is not None:
+            from .model_io import load_booster
+            if model_file is not None:
+                with open(model_file) as f:
+                    model_str = f.read()
+            load_booster(self, model_str, params)
+        else:
+            raise ValueError(
+                "need at least one of train_set, model_file and model_str")
+
+    # -- training ------------------------------------------------------------
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        """(reference: Booster.add_valid, basic.py:3963)"""
+        if not isinstance(data, Dataset):
+            raise TypeError("Validation data should be a Dataset instance")
+        data.construct()
+        metrics = create_metrics(self.config.metric, self.config)
+        self._gbdt.add_valid(data._inner, name, metrics)
+        self._valid_names.append(name)
+        return self
+
+    def update(self, train_set: Optional[Dataset] = None,
+               fobj: Optional[Callable] = None) -> bool:
+        """One boosting iteration; True if no further splits were possible
+        (reference: Booster.update, basic.py:4092)."""
+        if train_set is not None:
+            raise NotImplementedError(
+                "changing train_set on update is not supported")
+        fobj = fobj or self._custom_objective
+        if fobj is not None:
+            grad, hess = _call_custom_objective(fobj, self)
+            return self._gbdt.train_one_iter(grad, hess)
+        return self._gbdt.train_one_iter()
+
+    def rollback_one_iter(self) -> "Booster":
+        self._gbdt.rollback_one_iter()
+        return self
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        """(reference: Booster.reset_parameter → GBDT::ResetConfig gbdt.cpp:795)"""
+        self.params.update(params)
+        self.config.set(params)
+        gbdt = self._gbdt
+        gbdt.learning_rate = float(self.config.learning_rate)
+        gbdt.shrinkage_rate = gbdt.learning_rate
+        gbdt.grower_params = gbdt.grower_params._replace(
+            num_leaves=int(self.config.num_leaves),
+            max_depth=int(self.config.max_depth),
+            lambda_l1=float(self.config.lambda_l1),
+            lambda_l2=float(self.config.lambda_l2),
+            min_data_in_leaf=float(self.config.min_data_in_leaf),
+            min_sum_hessian_in_leaf=float(self.config.min_sum_hessian_in_leaf),
+            min_gain_to_split=float(self.config.min_gain_to_split),
+            max_delta_step=float(self.config.max_delta_step),
+        )
+        gbdt.max_leaves = int(self.config.num_leaves)
+        gbdt.feature_fraction = float(self.config.feature_fraction)
+        gbdt._step_fn = None  # step closes over grower_params; rebuild
+        return self
+
+    # -- evaluation ----------------------------------------------------------
+    def eval_train(self, feval=None):
+        out = self._gbdt.eval_train()
+        out = [(self._train_data_name, m, v, hb) for (_, m, v, hb) in out]
+        if feval is not None:
+            out.extend(self._eval_custom(feval, self._train_data_name, "train"))
+        return out
+
+    def eval_valid(self, feval=None):
+        out = self._gbdt.eval_valid()
+        if feval is not None:
+            for i, name in enumerate(self._valid_names):
+                out.extend(self._eval_custom(feval, name, i))
+        return out
+
+    def _eval_custom(self, feval, name, which):
+        fevals = feval if isinstance(feval, (list, tuple)) else [feval]
+        if which == "train":
+            raw = np.asarray(self._gbdt.train_score)
+            data = self.train_set
+        else:
+            vs = self._gbdt.valid_sets[which]
+            raw = np.asarray(vs.score)
+            data = _DatasetView(vs.dataset)
+        # multiclass preds are handed to custom metrics as [n, K], matching
+        # the reference's documented feval contract (sklearn.py/engine.py)
+        preds = raw[0] if raw.shape[0] == 1 else raw.T
+        out = []
+        for f in fevals:
+            res = f(preds, data)
+            if isinstance(res, list):
+                for metric, value, hb in res:
+                    out.append((name, metric, value, hb))
+            else:
+                metric, value, hb = res
+                out.append((name, metric, value, hb))
+        return out
+
+    # -- prediction ----------------------------------------------------------
+    def predict(
+        self,
+        data: _ArrayLike,
+        start_iteration: int = 0,
+        num_iteration: Optional[int] = None,
+        raw_score: bool = False,
+        pred_leaf: bool = False,
+        pred_contrib: bool = False,
+        validate_features: bool = False,
+        **kwargs,
+    ) -> np.ndarray:
+        """(reference: Booster.predict, basic.py:4701 → Predictor)"""
+        if start_iteration != 0:
+            raise NotImplementedError("start_iteration != 0 not supported yet")
+        inner = self._gbdt
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else None
+        arr = np.asarray(_maybe_series(data), dtype=np.float64)
+        if pred_leaf:
+            return inner.predict_leaf_matrix(arr, num_iteration)
+        if pred_contrib:
+            return self._predict_contrib(arr, num_iteration)
+        raw = inner.predict_raw_matrix(arr, num_iteration)   # [K, N]
+        k = raw.shape[0]
+        if raw_score or inner.objective is None:
+            return raw[0] if k == 1 else raw.T
+        conv = np.asarray(inner.objective.convert_output(
+            raw.T if k > 1 else raw[0]))
+        return conv
+
+    def _predict_contrib(self, binned, num_iteration):
+        """SHAP-style contributions via per-tree path attribution
+        (reference: PredictContrib → TreeSHAP, tree.cpp). Implemented as the
+        simpler Saabas attribution for now; full TreeSHAP is planned."""
+        raise NotImplementedError("pred_contrib is not implemented yet")
+
+    # -- model IO ------------------------------------------------------------
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0,
+                        importance_type: str = "split") -> str:
+        from .model_io import booster_to_string
+        if num_iteration is None and self.best_iteration > 0:
+            # reference behavior: default save cuts at best_iteration
+            # (basic.py save_model num_iteration doc)
+            num_iteration = self.best_iteration
+        return booster_to_string(self, num_iteration)
+
+    def save_model(self, filename: str, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0,
+                   importance_type: str = "split") -> "Booster":
+        with open(filename, "w") as f:
+            f.write(self.model_to_string(num_iteration))
+        return self
+
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0,
+                   importance_type: str = "split") -> Dict:
+        from .model_io import booster_to_dict
+        return booster_to_dict(self, num_iteration)
+
+    # -- introspection -------------------------------------------------------
+    def num_trees(self) -> int:
+        return len(self._gbdt.models)
+
+    def current_iteration(self) -> int:
+        return self._gbdt.current_iteration
+
+    def num_model_per_iteration(self) -> int:
+        return self._gbdt.num_tree_per_iteration
+
+    def num_feature(self) -> int:
+        ts = getattr(self._gbdt, "train_set", None)
+        if ts is not None:
+            return ts.num_total_features
+        return self._gbdt.max_feature_idx + 1  # loaded model
+
+    def feature_name(self) -> List[str]:
+        ts = getattr(self._gbdt, "train_set", None)
+        if ts is not None:
+            return list(ts.feature_names)
+        return list(self._gbdt.feature_names)  # loaded model
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        return self._gbdt.feature_importance(importance_type, iteration)
+
+    def lower_bound(self):
+        return min((m.leaf_value.min() for m in self._gbdt.models), default=0.0)
+
+    def upper_bound(self):
+        return max((m.leaf_value.max() for m in self._gbdt.models), default=0.0)
+
+
+class _DatasetView:
+    """Minimal Dataset-like view over an internal BinnedDataset (for feval)."""
+
+    def __init__(self, inner: BinnedDataset):
+        self._inner = inner
+
+    def get_label(self):
+        return self._inner.metadata.label
+
+    def get_weight(self):
+        return self._inner.metadata.weight
+
+    def get_group(self):
+        return self._inner.metadata.group
+
+
+def _call_custom_objective(fobj: Callable, booster: Booster):
+    """Custom objective protocol: fobj(preds, train_dataset) -> (grad, hess)
+    (reference: Booster.update fobj path, basic.py:4117-4132)."""
+    gbdt = booster._gbdt
+    raw = np.asarray(gbdt.train_score)
+    # multiclass: hand the custom objective [n, K] preds and accept [n, K]
+    # (or flat row-major) grads back — the reference's documented contract
+    preds = raw[0] if raw.shape[0] == 1 else raw.T
+    grad, hess = fobj(preds, booster.train_set)
+    grad = np.asarray(grad, np.float32)
+    hess = np.asarray(hess, np.float32)
+    k, n = gbdt.num_tree_per_iteration, gbdt.num_data
+    if grad.size != k * n:
+        raise ValueError(f"gradient size {grad.size} != num_class*num_data {k * n}")
+    if k > 1:
+        grad = grad.reshape(n, k).T
+        hess = hess.reshape(n, k).T
+    return grad, hess

@@ -1,0 +1,24 @@
+"""Boosting layer: GBDT / DART / RF drivers.
+
+Factory mirrors the reference's Boosting::CreateBoosting
+(reference: src/boosting/boosting.cpp:34).
+"""
+from __future__ import annotations
+
+from .dart import DART
+from .gbdt import GBDT, HostTree, stack_trees
+from .rf import RF
+
+
+def create_boosting(config, train_set=None, objective=None) -> GBDT:
+    boosting = str(config.get("boosting", "gbdt")).lower()
+    if boosting in ("gbdt", "gbrt", "goss"):
+        return GBDT(config, train_set, objective)
+    if boosting == "dart":
+        return DART(config, train_set, objective)
+    if boosting in ("rf", "random_forest"):
+        return RF(config, train_set, objective)
+    raise ValueError(f"Unknown boosting type: {boosting}")
+
+
+__all__ = ["GBDT", "DART", "RF", "HostTree", "create_boosting", "stack_trees"]

@@ -1,0 +1,115 @@
+"""DART boosting: dropout of trees per iteration.
+
+Mirror of the reference's DART (reference: src/boosting/dart.hpp:23 — the
+drop → train → normalize cycle: DroppingTrees :97, Normalize :158, shrinkage
+bookkeeping in TrainOneIter :58).
+
+The reference expresses drop/normalize as Shrinkage(-1)/Shrinkage(1/(k+1))/
+Shrinkage(-k) + AddScore sequences; here the algebra is collapsed: a dropped
+tree is subtracted from the train score before gradient computation, and after
+the new tree lands every dropped tree is rescaled to ``k/(k+1)`` of itself
+(``k+lr`` denominator in xgboost_dart_mode) in the model and in all cached
+scores — identical end state, two tree-routing passes per dropped tree.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..utils import log
+from .gbdt import GBDT
+
+
+class DART(GBDT):
+    boosting_type = "dart"
+
+    def __init__(self, config, train_set=None, objective=None):
+        super().__init__(config, train_set, objective)
+        self.drop_rate = float(config.get("drop_rate", 0.1))
+        self.max_drop = int(config.get("max_drop", 50))
+        self.skip_drop = float(config.get("skip_drop", 0.5))
+        self.uniform_drop = bool(config.get("uniform_drop", False))
+        self.xgboost_dart_mode = bool(config.get("xgboost_dart_mode", False))
+        self._rng = np.random.RandomState(int(config.get("drop_seed", 4)))
+        self.tree_weight: List[float] = []
+        self.sum_weight = 0.0
+
+    def _select_drop(self) -> List[int]:
+        """(reference: DART::DroppingTrees, dart.hpp:97)"""
+        drop: List[int] = []
+        if self._rng.rand() < self.skip_drop:
+            return drop
+        drop_rate = self.drop_rate
+        if not self.uniform_drop:
+            if self.sum_weight <= 0:
+                return drop
+            inv_avg = len(self.tree_weight) / self.sum_weight
+            if self.max_drop > 0:
+                drop_rate = min(drop_rate, self.max_drop * inv_avg / self.sum_weight)
+            for i in range(self.iter_):
+                if self._rng.rand() < drop_rate * self.tree_weight[i] * inv_avg:
+                    drop.append(i)
+                    if len(drop) >= self.max_drop:
+                        break
+        else:
+            if self.max_drop > 0 and self.iter_ > 0:
+                drop_rate = min(drop_rate, self.max_drop / float(self.iter_))
+            for i in range(self.iter_):
+                if self._rng.rand() < drop_rate:
+                    drop.append(i)
+                    if len(drop) >= self.max_drop:
+                        break
+        return drop
+
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        k_trees = self.num_tree_per_iteration
+        drop_index = self._select_drop()
+        k = float(len(drop_index))
+
+        # drop: remove the trees' contribution from the training score so the
+        # gradients see the reduced ensemble (reference: dart.hpp:131-137)
+        for i in drop_index:
+            for tid in range(k_trees):
+                host = self.models[i * k_trees + tid]
+                self.apply_tree_to_scores(host, tid, -1.0, valid=False)
+
+        # per-iteration shrinkage (reference: dart.hpp:138-147)
+        if not self.xgboost_dart_mode:
+            self.shrinkage_rate = self.learning_rate / (1.0 + k)
+        else:
+            self.shrinkage_rate = (
+                self.learning_rate if not drop_index
+                else self.learning_rate / (self.learning_rate + k))
+
+        ret = super().train_one_iter(gradients, hessians)
+        if ret:
+            # training stopped: restore dropped trees' score contribution
+            for i in drop_index:
+                for tid in range(k_trees):
+                    host = self.models[i * k_trees + tid]
+                    self.apply_tree_to_scores(host, tid, 1.0, valid=False)
+            return ret
+
+        # normalize (reference: DART::Normalize, dart.hpp:158): dropped trees
+        # end at factor*old where factor = k/(k+1) (or k/(k+lr) in xgb mode)
+        denom = (k + 1.0) if not self.xgboost_dart_mode \
+            else (k + self.learning_rate)
+        factor = k / denom
+        for i in drop_index:
+            for tid in range(k_trees):
+                host = self.models[i * k_trees + tid]
+                # valid scores still hold the full old tree: add (factor-1)*old
+                self.apply_tree_to_scores(host, tid, factor - 1.0, train=False)
+                # train score had it fully removed: add factor*old back
+                self.apply_tree_to_scores(host, tid, factor, valid=False)
+                host.scale(factor)
+            if not self.uniform_drop:
+                self.sum_weight -= self.tree_weight[i] * (1.0 / denom)
+                self.tree_weight[i] *= factor
+        self._device_trees_cache = None
+
+        if not self.uniform_drop:
+            self.tree_weight.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+        return False

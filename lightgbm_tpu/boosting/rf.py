@@ -1,0 +1,113 @@
+"""Random Forest mode: bagged trees without shrinkage, averaged outputs.
+
+Mirror of the reference's RF (reference: src/boosting/rf.hpp — gradients
+computed ONCE from the constant init score (Boosting() :110), per-iteration
+bagging, no shrinkage, running-average score maintenance in TrainOneIter
+(MultiplyScore bracketing :155-160), ``average_output_ = true``).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.grower import grow_tree
+from ..utils import log
+from .gbdt import GBDT, HostTree
+
+
+class RF(GBDT):
+    boosting_type = "rf"
+    average_output = True
+
+    def __init__(self, config, train_set=None, objective=None):
+        if config.get("bagging_freq", 0) <= 0 or \
+                not (0.0 < config.get("bagging_fraction", 1.0) < 1.0):
+            if not (0.0 < config.get("feature_fraction", 1.0) < 1.0):
+                raise ValueError(
+                    "Random forest needs bagging (bagging_freq > 0 and "
+                    "0 < bagging_fraction < 1) and/or feature_fraction < 1")
+        super().__init__(config, train_set, objective)
+        self.shrinkage_rate = 1.0
+        self._const_grad = None
+
+    def _rf_gradients(self):
+        """Gradients w.r.t. the constant init score (reference: RF::Boosting)."""
+        if self._const_grad is None:
+            if self.objective is None:
+                raise ValueError("RF mode does not support custom objectives")
+            for kk in range(self.num_tree_per_iteration):
+                self._init_scores[kk] = self.objective.boost_from_score(kk) \
+                    if bool(self.config.get("boost_from_average", True)) else 0.0
+            init = jnp.asarray(
+                np.asarray(self._init_scores, np.float32))[:, None]
+            const_score = jnp.zeros_like(self.train_score) + init
+            if self.num_tree_per_iteration == 1:
+                g, h = self.objective.get_gradients(const_score[0])
+                self._const_grad = (g[None, :], h[None, :])
+            else:
+                self._const_grad = self.objective.get_gradients(const_score)
+        return self._const_grad
+
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        if gradients is not None or hessians is not None:
+            raise ValueError("RF mode does not support custom objectives")
+        k, n = self.num_tree_per_iteration, self.num_data
+        grad, hess = self._rf_gradients()
+        mask = self.sample_strategy.bag_mask(self.iter_, grad, hess)
+        grad, hess = self.sample_strategy.scale_grad_hess(mask, grad, hess)
+        if mask is None:
+            mask = jnp.ones((n,), jnp.float32)
+        feat_mask = self._feature_mask()
+        n_prev = float(self.iter_)
+
+        for cur_tree_id in range(k):
+            g = grad[cur_tree_id] * mask
+            h = hess[cur_tree_id] * mask
+            tree, row_leaf = grow_tree(
+                self.binned, g, h, mask,
+                self.num_bins_arr, self.nan_bin_arr, self.has_nan_arr,
+                self.is_cat_arr, feat_mask, self.grower_params,
+            )
+            if int(tree.num_nodes) > 0:
+                tree = self._renew_tree_output(tree, row_leaf, mask, cur_tree_id)
+                # RF folds the init score into every tree (rf.hpp AddBias)
+                init = self._init_scores[cur_tree_id]
+                if abs(init) > 1e-10:
+                    tree = tree._replace(leaf_value=tree.leaf_value + init)
+                host = HostTree(tree, shrinkage=1.0)
+                # running average: score = (score*n_prev + tree) / (n_prev+1)
+                # (reference: MultiplyScore bracketing, rf.hpp:155-160)
+                self.train_score = self.train_score.at[cur_tree_id].multiply(n_prev)
+                for vs in self.valid_sets:
+                    vs.score = vs.score.at[cur_tree_id].multiply(n_prev)
+                self._update_score(host, tree, row_leaf, cur_tree_id)
+                self.train_score = self.train_score.at[cur_tree_id].multiply(
+                    1.0 / (n_prev + 1.0))
+                for vs in self.valid_sets:
+                    vs.score = vs.score.at[cur_tree_id].multiply(
+                        1.0 / (n_prev + 1.0))
+            else:
+                host = HostTree(tree, shrinkage=1.0)
+                host.num_leaves = 1
+                host.num_nodes = 0
+                const = self._init_scores[cur_tree_id] \
+                    if len(self.models) < k else 0.0
+                host.leaf_value = np.full_like(host.leaf_value, const)
+            self.models.append(host)
+            self._device_trees_cache = None
+        self.iter_ += 1
+        return False
+
+    def _renew_tree_output(self, tree, row_leaf, mask, cur_tree_id):
+        """RF renews against the constant init score, not the running score
+        (reference: rf.hpp residual_getter)."""
+        obj = self.objective
+        if obj is None or not obj.renew_leaves:
+            return tree
+        from ..ops.renew import renew_leaf_quantile
+        residual = obj.label - self._init_scores[cur_tree_id]
+        w = mask if self.row_weight is None else mask * self.row_weight
+        renewed = renew_leaf_quantile(
+            residual, w, row_leaf, self.max_leaves, float(obj.renew_alpha))
+        live = jnp.arange(self.max_leaves) < tree.num_leaves
+        return tree._replace(leaf_value=jnp.where(live, renewed, tree.leaf_value))

@@ -1,0 +1,173 @@
+"""Training callbacks.
+
+Mirror of the reference's callback system
+(reference: python-package/lightgbm/callback.py — early_stopping :454,
+log_evaluation :75, record_evaluation :183, reset_parameter :237,
+CallbackEnv namedtuple :60, EarlyStopException :28).
+
+Evaluation entries are ``(dataset_name, metric_name, value, is_higher_better)``
+tuples, same shape the reference passes to callbacks.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, List, Optional
+
+from .utils import log
+
+CallbackEnv = collections.namedtuple(
+    "CallbackEnv",
+    ["model", "params", "iteration", "begin_iteration", "end_iteration",
+     "evaluation_result_list"],
+)
+
+
+class EarlyStopException(Exception):
+    """(reference: callback.py:28)"""
+
+    def __init__(self, best_iteration: int, best_score):
+        super().__init__()
+        self.best_iteration = best_iteration
+        self.best_score = best_score
+
+
+def _fmt_eval(entry) -> str:
+    name, metric, value, _ = entry
+    return f"{name}'s {metric}: {value:g}"
+
+
+def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
+    """(reference: callback.py:75)"""
+
+    def _callback(env: CallbackEnv) -> None:
+        if period > 0 and env.evaluation_result_list \
+                and (env.iteration + 1) % period == 0:
+            result = "\t".join(_fmt_eval(e) for e in env.evaluation_result_list)
+            log.info(f"[{env.iteration + 1}]\t{result}")
+
+    _callback.order = 10
+    return _callback
+
+
+def record_evaluation(eval_result: Dict) -> Callable:
+    """(reference: callback.py:183)"""
+    if not isinstance(eval_result, dict):
+        raise TypeError("eval_result should be a dictionary")
+
+    def _init(env: CallbackEnv) -> None:
+        eval_result.clear()
+        for name, metric, _, _ in env.evaluation_result_list:
+            eval_result.setdefault(name, collections.OrderedDict())
+            eval_result[name].setdefault(metric, [])
+
+    def _callback(env: CallbackEnv) -> None:
+        if not eval_result:
+            _init(env)
+        for name, metric, value, _ in env.evaluation_result_list:
+            eval_result.setdefault(name, collections.OrderedDict())
+            eval_result[name].setdefault(metric, [])
+            eval_result[name][metric].append(value)
+
+    _callback.order = 20
+    return _callback
+
+
+def reset_parameter(**kwargs) -> Callable:
+    """Reset parameters on a schedule: each value is either a list (per
+    iteration) or a function iteration -> value (reference: callback.py:237)."""
+
+    def _callback(env: CallbackEnv) -> None:
+        new_params = {}
+        for key, value in kwargs.items():
+            if isinstance(value, list):
+                if len(value) != env.end_iteration - env.begin_iteration:
+                    raise ValueError(
+                        f"Length of list {key!r} has to equal to 'num_boost_round'.")
+                new_value = value[env.iteration - env.begin_iteration]
+            elif callable(value):
+                new_value = value(env.iteration - env.begin_iteration)
+            else:
+                raise ValueError("Only list and callable values are supported "
+                                 "as a mapping from boosting round index to new "
+                                 "parameter value.")
+            new_params[key] = new_value
+        if new_params:
+            env.model.reset_parameter(new_params)
+
+    _callback.before_iteration = True
+    _callback.order = 10
+    return _callback
+
+
+def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
+                   verbose: bool = True, min_delta: float = 0.0) -> Callable:
+    """(reference: callback.py:454 _EarlyStoppingCallback)"""
+    if stopping_rounds <= 0:
+        raise ValueError("stopping_rounds should be greater than zero.")
+
+    state = {"enabled": True, "inited": False}
+
+    def _init(env: CallbackEnv) -> None:
+        state["inited"] = True
+        state["enabled"] = bool(env.evaluation_result_list)
+        if not state["enabled"]:
+            log.warning("Early stopping is not available in dart mode or "
+                        "without validation data")
+            return
+        state["best_score"] = []
+        state["best_iter"] = []
+        state["best_list"] = []
+        state["cmp"] = []
+        for _, _, _, higher_better in env.evaluation_result_list:
+            if higher_better:
+                state["best_score"].append(float("-inf"))
+                state["cmp"].append(
+                    lambda cur, best: cur > best + min_delta)
+            else:
+                state["best_score"].append(float("inf"))
+                state["cmp"].append(
+                    lambda cur, best: cur < best - min_delta)
+            state["best_iter"].append(0)
+            state["best_list"].append(None)
+
+    def _callback(env: CallbackEnv) -> None:
+        if not state["inited"]:
+            _init(env)
+        if not state["enabled"]:
+            return
+        # skip the training-set entries (reference skips "train" dataset)
+        first_metric_seen = False
+        for i, entry in enumerate(env.evaluation_result_list):
+            name, metric, value, _ = entry
+            if name == "training":
+                continue
+            if first_metric_only and first_metric_seen and \
+                    metric != env.evaluation_result_list[0][1]:
+                continue
+            first_metric_seen = True
+            if state["cmp"][i](value, state["best_score"][i]):
+                state["best_score"][i] = value
+                state["best_iter"][i] = env.iteration
+                state["best_list"][i] = list(env.evaluation_result_list)
+            elif env.iteration - state["best_iter"][i] >= stopping_rounds:
+                if verbose:
+                    log.info(
+                        f"Early stopping, best iteration is:"
+                        f"\n[{state['best_iter'][i] + 1}]\t"
+                        + "\t".join(_fmt_eval(e) for e in state["best_list"][i]))
+                raise EarlyStopException(state["best_iter"][i],
+                                         state["best_list"][i])
+        if env.iteration == env.end_iteration - 1:
+            for i, entry in enumerate(env.evaluation_result_list):
+                if entry[0] == "training":
+                    continue
+                if verbose and state["best_list"][i] is not None:
+                    log.info(
+                        "Did not meet early stopping. Best iteration is:\n"
+                        f"[{state['best_iter'][i] + 1}]\t"
+                        + "\t".join(_fmt_eval(e) for e in state["best_list"][i]))
+                raise EarlyStopException(state["best_iter"][i],
+                                         state["best_list"][i])
+
+    _callback.order = 30
+    return _callback

@@ -266,6 +266,8 @@ def alias_table() -> Dict[str, str]:
 def _coerce(name: str, value: Any, typ: type) -> Any:
     if value is None:
         return None
+    if name == "objective" and callable(value):
+        return value  # custom objective function passes through untouched
     if typ is bool:
         if isinstance(value, str):
             return value.lower() in ("true", "1", "+", "yes")
@@ -318,6 +320,12 @@ class Config:
 
     def is_explicit(self, name: str) -> bool:
         return name in self._explicit
+
+    def get(self, name: str, default: Any = None) -> Any:
+        """Dict-style parameter access used across the objective/metric/boosting
+        layers; falls back to ``default`` when the value is unset (None)."""
+        value = getattr(self, name, None)
+        return default if value is None else value
 
     def _check_consistency(self) -> None:
         # objective canonicalization (reference: ParseObjectiveAlias, config.h)

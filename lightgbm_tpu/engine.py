@@ -1,0 +1,268 @@
+"""Training entry points: ``train()`` and ``cv()``.
+
+Mirror of the reference's engine
+(reference: python-package/lightgbm/engine.py — train :109 [callback loop +
+booster.update :309-345], cv :611, CVBooster :354, early-stop handling :342).
+"""
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset
+from .config import Config, alias_table
+from .utils import log
+
+
+def train(
+    params: Dict[str, Any],
+    train_set: Dataset,
+    num_boost_round: int = 100,
+    valid_sets: Optional[Sequence[Dataset]] = None,
+    valid_names: Optional[Sequence[str]] = None,
+    feval: Optional[Union[Callable, Sequence[Callable]]] = None,
+    init_model: Optional[Union[str, Booster]] = None,
+    keep_training_booster: bool = False,
+    callbacks: Optional[Sequence[Callable]] = None,
+) -> Booster:
+    """Train a booster (reference: engine.py:109)."""
+    params = copy.deepcopy(params) if params else {}
+    # num_boost_round may come via params aliases (reference: engine.py:139-160)
+    at = alias_table()
+    for key in list(params.keys()):
+        if at.get(key) == "num_iterations" and params[key] is not None:
+            num_boost_round = int(params.pop(key))
+    params["num_iterations"] = num_boost_round
+    first_metric_only = bool(params.get("first_metric_only", False))
+
+    if init_model is not None:
+        raise NotImplementedError(
+            "continue-training (init_model) is not implemented yet")
+
+    train_set.construct()
+    booster = Booster(params=params, train_set=train_set)
+    booster._train_data_name = "training"
+
+    is_valid_contain_train = False
+    name_valid_sets = []
+    if valid_sets is not None:
+        if isinstance(valid_sets, Dataset):
+            valid_sets = [valid_sets]
+        for i, valid_data in enumerate(valid_sets):
+            if valid_names is not None and len(valid_names) > i:
+                name = valid_names[i]
+            else:
+                name = f"valid_{i}"
+            if valid_data is train_set:
+                is_valid_contain_train = True
+                booster._train_data_name = name
+                continue
+            booster.add_valid(valid_data, name)
+
+    cbs = set(callbacks) if callbacks else set()
+    cb_early = None
+    cfg = Config(params)
+    early_round = int(cfg.early_stopping_round or 0)
+    # the reference disables auto early stopping in dart mode (tree
+    # renormalization invalidates best_iteration truncation)
+    if early_round > 0 and cfg.boosting != "dart":
+        cb_early = callback_mod.early_stopping(
+            early_round, first_metric_only,
+            min_delta=float(params.get("early_stopping_min_delta", 0.0)))
+        cbs.add(cb_early)
+    cbs_before = [cb for cb in cbs if getattr(cb, "before_iteration", False)]
+    cbs_after = [cb for cb in cbs if not getattr(cb, "before_iteration", False)]
+    order_key = lambda cb: getattr(cb, "order", 0)
+    cbs_before.sort(key=order_key)
+    cbs_after.sort(key=order_key)
+
+    evaluation_result_list: List = []
+    for i in range(num_boost_round):
+        for cb in cbs_before:
+            cb(callback_mod.CallbackEnv(
+                model=booster, params=params, iteration=i,
+                begin_iteration=0, end_iteration=num_boost_round,
+                evaluation_result_list=None))
+        finished = booster.update()
+
+        evaluation_result_list = []
+        if (valid_sets is not None and (booster._valid_names
+                                        or is_valid_contain_train)) or feval:
+            if is_valid_contain_train:
+                evaluation_result_list.extend(booster.eval_train(feval))
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in cbs_after:
+                cb(callback_mod.CallbackEnv(
+                    model=booster, params=params, iteration=i,
+                    begin_iteration=0, end_iteration=num_boost_round,
+                    evaluation_result_list=evaluation_result_list))
+        except callback_mod.EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            evaluation_result_list = e.best_score or []
+            break
+        if finished:
+            log.info("Finished training (no further splits possible)")
+            break
+
+    # record final scores (reference: engine.py:346-352)
+    if evaluation_result_list:
+        best: Dict[str, Dict[str, float]] = collections.OrderedDict()
+        for name, metric, value, _ in evaluation_result_list:
+            best.setdefault(name, collections.OrderedDict())[metric] = value
+        booster.best_score = best
+    return booster
+
+
+class CVBooster:
+    """Container of per-fold boosters (reference: engine.py:354)."""
+
+    def __init__(self, boosters: Optional[List[Booster]] = None):
+        self.boosters = boosters or []
+        self.best_iteration = -1
+
+    def append(self, booster: Booster) -> "CVBooster":
+        self.boosters.append(booster)
+        return self
+
+    def __getattr__(self, name):
+        def handler_function(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, nfold: int, params: Dict,
+                  seed: int, stratified: bool, shuffle: bool,
+                  group: Optional[np.ndarray]):
+    full_data.construct()
+    num_data = full_data.num_data()
+    rng = np.random.RandomState(seed)
+    if group is not None:
+        # group-aware folds: whole queries per fold (reference: engine.py:436)
+        ngroups = len(group)
+        gidx = np.arange(ngroups)
+        if shuffle:
+            rng.shuffle(gidx)
+        gfolds = np.array_split(gidx, nfold)
+        boundaries = np.concatenate([[0], np.cumsum(group)])
+        folds = []
+        for gf in gfolds:
+            rows = np.concatenate(
+                [np.arange(boundaries[g], boundaries[g + 1]) for g in gf]) \
+                if len(gf) else np.array([], dtype=np.int64)
+            folds.append(np.sort(rows))
+    elif stratified:
+        label = np.asarray(full_data.get_label())
+        folds = [[] for _ in range(nfold)]
+        for cls in np.unique(label):
+            idx = np.where(label == cls)[0]
+            if shuffle:
+                rng.shuffle(idx)
+            for i, part in enumerate(np.array_split(idx, nfold)):
+                folds[i].append(part)
+        folds = [np.sort(np.concatenate(f)) for f in folds]
+    else:
+        idx = np.arange(num_data)
+        if shuffle:
+            rng.shuffle(idx)
+        folds = [np.sort(f) for f in np.array_split(idx, nfold)]
+    return folds
+
+
+def cv(
+    params: Dict[str, Any],
+    train_set: Dataset,
+    num_boost_round: int = 100,
+    folds=None,
+    nfold: int = 5,
+    stratified: bool = True,
+    shuffle: bool = True,
+    metrics: Optional[Union[str, Sequence[str]]] = None,
+    feval: Optional[Callable] = None,
+    init_model=None,
+    seed: int = 0,
+    callbacks: Optional[Sequence[Callable]] = None,
+    eval_train_metric: bool = False,
+    return_cvbooster: bool = False,
+) -> Dict[str, List[float]]:
+    """K-fold cross-validation (reference: engine.py:611)."""
+    params = copy.deepcopy(params) if params else {}
+    if metrics is not None:
+        params["metric"] = metrics
+    at = alias_table()
+    for key in list(params.keys()):
+        if at.get(key) == "num_iterations" and params[key] is not None:
+            num_boost_round = int(params.pop(key))
+
+    train_set.construct()
+    objective = params.get("objective", "regression")
+    if stratified and (not isinstance(objective, str)
+                       or "binary" not in str(objective)
+                       and "multiclass" not in str(objective)):
+        stratified = False
+
+    data = train_set._inner
+    raw = None
+    if train_set.data is not None:
+        raw = np.asarray(train_set.data, dtype=np.float64)
+    else:
+        raise ValueError("cv() needs the raw data; construct the Dataset with "
+                         "free_raw_data=False or pass data directly")
+    label = np.asarray(train_set.get_label())
+    weight = train_set.get_weight()
+    group = train_set.get_group()
+
+    if folds is None:
+        folds_idx = _make_n_folds(train_set, nfold, params, seed, stratified,
+                                  shuffle, group)
+        folds = []
+        all_idx = np.arange(train_set.num_data())
+        for te in folds_idx:
+            tr = np.setdiff1d(all_idx, te, assume_unique=False)
+            folds.append((tr, te))
+    elif hasattr(folds, "split"):
+        folds = list(folds.split(raw, label, groups=None))
+
+    cvbooster = CVBooster()
+    results = collections.defaultdict(list)
+    fold_params = {k: v for k, v in params.items()}
+    for tr, te in folds:
+        def subset(idx):
+            w = None if weight is None else np.asarray(weight)[idx]
+            g = None
+            if group is not None:
+                # recompute group sizes from membership (queries kept whole)
+                boundaries = np.concatenate([[0], np.cumsum(group)])
+                qid = np.searchsorted(boundaries, idx, side="right") - 1
+                _, counts = np.unique(qid, return_counts=True)
+                g = counts
+            return Dataset(raw[idx], label=label[idx], weight=w, group=g,
+                           params=params, free_raw_data=False)
+        dtr = subset(tr)
+        dte = dtr.create_valid(raw[te], label=label[te],
+                               weight=None if weight is None
+                               else np.asarray(weight)[te])
+        if group is not None:
+            boundaries = np.concatenate([[0], np.cumsum(group)])
+            qid = np.searchsorted(boundaries, te, side="right") - 1
+            _, counts = np.unique(qid, return_counts=True)
+            dte.set_group(counts)
+        bst = train(fold_params, dtr, num_boost_round,
+                    valid_sets=[dte], valid_names=["valid"],
+                    feval=feval, callbacks=callbacks)
+        cvbooster.append(bst)
+        for name, metric, value, _ in bst.eval_valid(feval):
+            results[f"valid {metric}"].append(value)
+
+    out: Dict[str, Any] = {}
+    for key, values in results.items():
+        per_iter = values  # one value per fold at final iteration
+        out[f"{key}-mean"] = [float(np.mean(per_iter))]
+        out[f"{key}-stdv"] = [float(np.std(per_iter))]
+    if return_cvbooster:
+        out["cvbooster"] = cvbooster
+    return out

@@ -166,9 +166,10 @@ class BinMapper:
         raw-value prediction; reference stores both threshold_in_bin and threshold)."""
         if self.is_categorical:
             raise ValueError("categorical bins have no scalar threshold")
-        n_numeric_bins = self.num_bins - (1 if self.missing_type == MISSING_NAN else 0)
-        idx = min(bin_idx, n_numeric_bins - 2)
-        return float(self.bin_upper_bounds[idx])
+        thr = float(self.bin_upper_bounds[bin_idx])
+        # splitting at the last numeric bin separates NaN rows only; the
+        # reference clamps +inf thresholds (Common::AvoidInf) the same way
+        return min(thr, 1e308)
 
 
 def find_bin_numerical(

@@ -194,7 +194,9 @@ class BinnedDataset:
             ds.used_features = [j for j, m in enumerate(mappers) if not m.is_trivial]
             if not ds.used_features:
                 log.warning("all features are constant; no informative splits possible")
-            ds.max_num_bins = max([m.num_bins for m in mappers] + [2])
+            # pad the bin axis to a shape-stable max_bin+1 so the jitted tree
+            # grower's compile key doesn't depend on the realized bin counts
+            ds.max_num_bins = max(max_bin + 1, 2)
 
         # bin all columns
         dtype = np.uint8 if ds.max_num_bins <= 256 else np.uint16

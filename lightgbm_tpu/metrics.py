@@ -1,0 +1,486 @@
+"""Evaluation metrics.
+
+Mirror of the reference's metric layer (reference: include/LightGBM/metric.h,
+factory Metric::CreateMetric src/metric/metric.cpp, families in
+src/metric/{regression,binary,multiclass,rank,map,xentropy}_metric.hpp).
+
+Like the reference — where AUC/NDCG stay on CPU even in CUDA mode
+(src/metric/metric.cpp:39-56) — metrics are computed host-side in numpy from the
+device score vector: they run once per ``metric_freq`` iterations and are never
+on the training hot path.
+
+Each metric exposes ``eval(raw_score, convert) -> float`` where ``convert`` is
+the objective's ConvertOutput (objective_function.h:81) and ``higher_better``.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+_EPS = 1e-15
+
+
+class Metric:
+    name = "metric"
+    higher_better = False
+
+    def __init__(self, config):
+        self.config = config
+
+    def init(self, metadata, num_data: int) -> None:
+        self.num_data = num_data
+        self.label = np.asarray(metadata.label, dtype=np.float64)
+        self.weight = (
+            np.asarray(metadata.weight, dtype=np.float64)
+            if metadata.weight is not None else None
+        )
+        self.sum_weight = (
+            float(self.weight.sum()) if self.weight is not None else float(num_data)
+        )
+        self.metadata = metadata
+
+    def _avg(self, per_row: np.ndarray) -> float:
+        if self.weight is not None:
+            return float((per_row * self.weight).sum() / max(self.sum_weight, _EPS))
+        return float(per_row.mean())
+
+    def eval(self, raw_score: np.ndarray, convert: Optional[Callable]) -> float:
+        raise NotImplementedError
+
+
+# -- regression (reference: src/metric/regression_metric.hpp) ---------------
+class _PointwiseRegression(Metric):
+    def point_loss(self, pred, label):
+        raise NotImplementedError
+
+    def eval(self, raw_score, convert):
+        pred = np.asarray(convert(raw_score)) if convert else np.asarray(raw_score)
+        return self._avg(self.point_loss(pred.reshape(-1), self.label))
+
+
+class L2Metric(_PointwiseRegression):
+    name = "l2"
+
+    def point_loss(self, pred, label):
+        return (pred - label) ** 2
+
+
+class RMSEMetric(L2Metric):
+    name = "rmse"
+
+    def eval(self, raw_score, convert):
+        return float(np.sqrt(super().eval(raw_score, convert)))
+
+
+class L1Metric(_PointwiseRegression):
+    name = "l1"
+
+    def point_loss(self, pred, label):
+        return np.abs(pred - label)
+
+
+class QuantileMetric(_PointwiseRegression):
+    name = "quantile"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.alpha = float(config.get("alpha", 0.9))
+
+    def point_loss(self, pred, label):
+        d = label - pred
+        return np.where(d >= 0, self.alpha * d, (self.alpha - 1.0) * d)
+
+
+class HuberMetric(_PointwiseRegression):
+    name = "huber"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.alpha = float(config.get("alpha", 0.9))
+
+    def point_loss(self, pred, label):
+        d = np.abs(pred - label)
+        a = self.alpha
+        return np.where(d <= a, 0.5 * d * d, a * (d - 0.5 * a))
+
+
+class FairMetric(_PointwiseRegression):
+    name = "fair"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.c = float(config.get("fair_c", 1.0))
+
+    def point_loss(self, pred, label):
+        x = np.abs(pred - label)
+        c = self.c
+        return c * x - c * c * np.log1p(x / c)
+
+
+class PoissonMetric(_PointwiseRegression):
+    name = "poisson"
+
+    def point_loss(self, pred, label):
+        eps = 1e-10
+        return pred - label * np.log(np.maximum(pred, eps))
+
+
+class MAPEMetric(_PointwiseRegression):
+    name = "mape"
+
+    def point_loss(self, pred, label):
+        return np.abs((label - pred) / np.maximum(1.0, np.abs(label)))
+
+
+class GammaMetric(_PointwiseRegression):
+    name = "gamma"
+
+    def point_loss(self, pred, label):
+        eps = 1e-10
+        psafe = np.maximum(pred, eps)
+        return label / psafe + np.log(psafe)
+
+
+class GammaDevianceMetric(_PointwiseRegression):
+    name = "gamma_deviance"
+
+    def point_loss(self, pred, label):
+        eps = 1e-10
+        f = label / np.maximum(pred, eps)
+        return 2.0 * (f - np.log(np.maximum(f, eps)) - 1.0)
+
+
+class TweedieMetric(_PointwiseRegression):
+    name = "tweedie"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.rho = float(config.get("tweedie_variance_power", 1.5))
+
+    def point_loss(self, pred, label):
+        eps = 1e-10
+        p = np.maximum(pred, eps)
+        rho = self.rho
+        a = label * np.power(p, 1.0 - rho) / (1.0 - rho)
+        b = np.power(p, 2.0 - rho) / (2.0 - rho)
+        return -a + b
+
+
+# -- binary (reference: src/metric/binary_metric.hpp) -----------------------
+class BinaryLoglossMetric(Metric):
+    name = "binary_logloss"
+
+    def eval(self, raw_score, convert):
+        p = np.asarray(convert(raw_score)).reshape(-1) if convert \
+            else 1.0 / (1.0 + np.exp(-np.asarray(raw_score).reshape(-1)))
+        p = np.clip(p, _EPS, 1.0 - _EPS)
+        y = self.label
+        return self._avg(-(y * np.log(p) + (1.0 - y) * np.log(1.0 - p)))
+
+
+class BinaryErrorMetric(Metric):
+    name = "binary_error"
+
+    def eval(self, raw_score, convert):
+        p = np.asarray(convert(raw_score)).reshape(-1) if convert \
+            else np.asarray(raw_score).reshape(-1)
+        thresh = 0.5 if convert else 0.0
+        pred = (p > thresh).astype(np.float64)
+        return self._avg((pred != (self.label > 0)).astype(np.float64))
+
+
+def _auc(label01: np.ndarray, score: np.ndarray, weight) -> float:
+    """Weighted ROC-AUC via rank statistic (reference: binary_metric.hpp AUCMetric)."""
+    order = np.argsort(score, kind="mergesort")
+    s = score[order]
+    y = label01[order]
+    w = weight[order] if weight is not None else np.ones_like(s)
+    # tie-aware trapezoid accumulation
+    pos_w = w * (y > 0)
+    neg_w = w * (y <= 0)
+    total_pos = pos_w.sum()
+    total_neg = neg_w.sum()
+    if total_pos == 0 or total_neg == 0:
+        return 1.0
+    # group by unique score
+    _, starts = np.unique(s, return_index=True)
+    pos_per = np.add.reduceat(pos_w, starts)
+    neg_per = np.add.reduceat(neg_w, starts)
+    cum_neg_before = np.concatenate([[0.0], np.cumsum(neg_per)[:-1]])
+    auc = float((pos_per * (cum_neg_before + 0.5 * neg_per)).sum())
+    return auc / float(total_pos * total_neg)
+
+
+class AUCMetric(Metric):
+    name = "auc"
+    higher_better = True
+
+    def eval(self, raw_score, convert):
+        return _auc(
+            (self.label > 0).astype(np.float64),
+            np.asarray(raw_score).reshape(-1).astype(np.float64),
+            self.weight,
+        )
+
+
+class AveragePrecisionMetric(Metric):
+    """(reference: binary_metric.hpp AveragePrecisionMetric)"""
+    name = "average_precision"
+    higher_better = True
+
+    def eval(self, raw_score, convert):
+        score = np.asarray(raw_score).reshape(-1).astype(np.float64)
+        y = (self.label > 0).astype(np.float64)
+        w = self.weight if self.weight is not None else np.ones_like(y)
+        order = np.argsort(-score, kind="mergesort")
+        y, w = y[order], w[order]
+        tp = np.cumsum(w * y)
+        fp = np.cumsum(w * (1 - y))
+        total_pos = tp[-1]
+        if total_pos == 0:
+            return 1.0
+        precision = tp / np.maximum(tp + fp, _EPS)
+        recall_delta = np.diff(np.concatenate([[0.0], tp])) / total_pos
+        return float((precision * recall_delta).sum())
+
+
+# -- multiclass (reference: src/metric/multiclass_metric.hpp) ---------------
+class MultiLoglossMetric(Metric):
+    name = "multi_logloss"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.num_class = int(config.get("num_class", 1))
+
+    def eval(self, raw_score, convert):
+        # raw_score: [K, N]
+        raw = np.asarray(raw_score)
+        if convert:
+            p = np.asarray(convert(raw.T))                 # [N, K] probs
+        else:
+            e = np.exp(raw - raw.max(axis=0, keepdims=True))
+            p = (e / e.sum(axis=0, keepdims=True)).T
+        idx = self.label.astype(np.int64)
+        pt = np.clip(p[np.arange(len(idx)), idx], _EPS, None)
+        return self._avg(-np.log(pt))
+
+
+class MultiErrorMetric(Metric):
+    name = "multi_error"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.top_k = int(config.get("multi_error_top_k", 1))
+
+    def eval(self, raw_score, convert):
+        raw = np.asarray(raw_score)                        # [K, N]
+        idx = self.label.astype(np.int64)
+        if self.top_k <= 1:
+            err = (raw.argmax(axis=0) != idx).astype(np.float64)
+        else:
+            true_score = raw[idx, np.arange(raw.shape[1])]
+            rank = (raw > true_score[None, :]).sum(axis=0)
+            err = (rank >= self.top_k).astype(np.float64)
+        return self._avg(err)
+
+
+class AucMuMetric(Metric):
+    """Multiclass AUC-mu (reference: multiclass_metric.hpp auc_mu branch):
+    average pairwise-class AUC of the score difference direction."""
+    name = "auc_mu"
+    higher_better = True
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.num_class = int(config.get("num_class", 1))
+
+    def eval(self, raw_score, convert):
+        raw = np.asarray(raw_score)                        # [K, N]
+        idx = self.label.astype(np.int64)
+        k = self.num_class
+        aucs = []
+        for a in range(k):
+            for b in range(a + 1, k):
+                sel = (idx == a) | (idx == b)
+                if sel.sum() == 0 or (idx[sel] == a).all() or (idx[sel] == b).all():
+                    continue
+                s = raw[a, sel] - raw[b, sel]
+                y = (idx[sel] == a).astype(np.float64)
+                w = self.weight[sel] if self.weight is not None else None
+                aucs.append(_auc(y, s, w))
+        return float(np.mean(aucs)) if aucs else 1.0
+
+
+# -- ranking (reference: src/metric/rank_metric.hpp NDCG via dcg_calculator.cpp,
+#    src/metric/map_metric.hpp) ----------------------------------------------
+class NDCGMetric(Metric):
+    name = "ndcg"
+    higher_better = True
+
+    def __init__(self, config):
+        super().__init__(config)
+        ks = config.get("eval_at", None) or [1, 2, 3, 4, 5]
+        self.eval_at = [int(k) for k in ks]
+        self.label_gain = config.get("label_gain", None)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            raise ValueError("ndcg metric requires query groups")
+        self.qb = np.asarray(metadata.query_boundaries)
+        max_label = int(self.label.max()) if len(self.label) else 0
+        if self.label_gain is None:
+            self.gains = (2.0 ** np.arange(max(max_label + 1, 2))) - 1.0
+        else:
+            self.gains = np.asarray(self.label_gain, dtype=np.float64)
+
+    def eval(self, raw_score, convert):
+        return self.eval_all(raw_score)[0]
+
+    def eval_all(self, raw_score) -> List[float]:
+        score = np.asarray(raw_score).reshape(-1).astype(np.float64)
+        lbl = self.label.astype(np.int64)
+        out = []
+        for k in self.eval_at:
+            vals = []
+            for i in range(len(self.qb) - 1):
+                s, e = self.qb[i], self.qb[i + 1]
+                g = self.gains[lbl[s:e]]
+                kk = min(k, e - s)
+                order = np.argsort(-score[s:e], kind="mergesort")
+                disc = 1.0 / np.log2(np.arange(kk) + 2.0)
+                dcg = float((g[order[:kk]] * disc).sum())
+                ideal = float((np.sort(g)[::-1][:kk] * disc).sum())
+                vals.append(dcg / ideal if ideal > 0 else 1.0)
+            out.append(float(np.mean(vals)) if vals else 1.0)
+        return out
+
+
+class MapMetric(Metric):
+    name = "map"
+    higher_better = True
+
+    def __init__(self, config):
+        super().__init__(config)
+        ks = config.get("eval_at", None) or [1, 2, 3, 4, 5]
+        self.eval_at = [int(k) for k in ks]
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            raise ValueError("map metric requires query groups")
+        self.qb = np.asarray(metadata.query_boundaries)
+
+    def eval(self, raw_score, convert):
+        return self.eval_all(raw_score)[0]
+
+    def eval_all(self, raw_score) -> List[float]:
+        score = np.asarray(raw_score).reshape(-1).astype(np.float64)
+        rel = (self.label > 0).astype(np.float64)
+        out = []
+        for k in self.eval_at:
+            vals = []
+            for i in range(len(self.qb) - 1):
+                s, e = self.qb[i], self.qb[i + 1]
+                order = np.argsort(-score[s:e], kind="mergesort")
+                r = rel[s:e][order][:k]
+                if r.sum() == 0:
+                    vals.append(0.0)
+                    continue
+                prec = np.cumsum(r) / (np.arange(len(r)) + 1.0)
+                vals.append(float((prec * r).sum() / min(rel[s:e].sum(), k)))
+            out.append(float(np.mean(vals)) if vals else 1.0)
+        return out
+
+
+# -- cross-entropy (reference: src/metric/xentropy_metric.hpp) --------------
+class CrossEntropyMetric(Metric):
+    name = "cross_entropy"
+
+    def eval(self, raw_score, convert):
+        p = np.asarray(convert(raw_score)).reshape(-1) if convert \
+            else 1.0 / (1.0 + np.exp(-np.asarray(raw_score).reshape(-1)))
+        p = np.clip(p, _EPS, 1.0 - _EPS)
+        y = self.label
+        return self._avg(-(y * np.log(p) + (1 - y) * np.log(1 - p)))
+
+
+class CrossEntropyLambdaMetric(Metric):
+    name = "cross_entropy_lambda"
+
+    def eval(self, raw_score, convert):
+        raw = np.asarray(raw_score).reshape(-1)
+        hhat = np.log1p(np.exp(raw))
+        y = self.label
+        return self._avg(hhat - y * np.log(np.maximum(1.0 - np.exp(-hhat), _EPS)))
+
+
+class KLDivMetric(Metric):
+    """(reference: xentropy_metric.hpp KullbackLeiblerDivergence)"""
+    name = "kldiv"
+
+    def eval(self, raw_score, convert):
+        p = np.asarray(convert(raw_score)).reshape(-1) if convert \
+            else 1.0 / (1.0 + np.exp(-np.asarray(raw_score).reshape(-1)))
+        p = np.clip(p, _EPS, 1.0 - _EPS)
+        y = np.clip(self.label, 0.0, 1.0)
+        ent = np.where(
+            (y > 0) & (y < 1),
+            y * np.log(np.maximum(y, _EPS)) + (1 - y) * np.log(np.maximum(1 - y, _EPS)),
+            0.0,
+        )
+        ce = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+        return self._avg(ent + ce)
+
+
+_METRICS = {
+    "l2": L2Metric, "mse": L2Metric, "mean_squared_error": L2Metric,
+    "regression": L2Metric, "regression_l2": L2Metric,
+    "rmse": RMSEMetric, "root_mean_squared_error": RMSEMetric, "l2_root": RMSEMetric,
+    "l1": L1Metric, "mae": L1Metric, "mean_absolute_error": L1Metric,
+    "regression_l1": L1Metric,
+    "quantile": QuantileMetric,
+    "huber": HuberMetric,
+    "fair": FairMetric,
+    "poisson": PoissonMetric,
+    "mape": MAPEMetric, "mean_absolute_percentage_error": MAPEMetric,
+    "gamma": GammaMetric,
+    "gamma_deviance": GammaDevianceMetric,
+    "tweedie": TweedieMetric,
+    "binary_logloss": BinaryLoglossMetric, "binary": BinaryLoglossMetric,
+    "binary_error": BinaryErrorMetric,
+    "auc": AUCMetric,
+    "average_precision": AveragePrecisionMetric,
+    "multi_logloss": MultiLoglossMetric, "multiclass": MultiLoglossMetric,
+    "softmax": MultiLoglossMetric, "multiclassova": MultiLoglossMetric,
+    "multi_error": MultiErrorMetric,
+    "auc_mu": AucMuMetric,
+    "ndcg": NDCGMetric, "lambdarank": NDCGMetric, "rank_xendcg": NDCGMetric,
+    "xendcg": NDCGMetric,
+    "map": MapMetric, "mean_average_precision": MapMetric,
+    "cross_entropy": CrossEntropyMetric, "xentropy": CrossEntropyMetric,
+    "cross_entropy_lambda": CrossEntropyLambdaMetric,
+    "xentlambda": CrossEntropyLambdaMetric,
+    "kldiv": KLDivMetric, "kullback_leibler": KLDivMetric,
+}
+
+
+def create_metric(name: str, config) -> Optional[Metric]:
+    key = str(name).lower()
+    if key in ("", "none", "null", "na", "custom"):
+        return None
+    if key not in _METRICS:
+        raise ValueError(f"Unknown metric: {name}")
+    return _METRICS[key](config)
+
+
+def create_metrics(names: Sequence[str], config) -> List[Metric]:
+    out = []
+    seen = set()
+    for n in names:
+        m = create_metric(n, config)
+        if m is not None and m.name not in seen:
+            out.append(m)
+            seen.add(m.name)
+    return out

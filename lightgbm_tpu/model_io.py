@@ -1,0 +1,449 @@
+"""Model serialization: LightGBM-compatible model text, JSON dump, loading.
+
+Mirror of the reference's model IO
+(reference: src/boosting/gbdt_model_text.cpp — SaveModelToString, DumpModel,
+LoadModelFromString; per-tree text in src/io/tree.cpp Tree::ToString /
+Tree::ToJSON / Tree::Tree(const char*)).
+
+The emitted format is the reference's ``v4`` text format (``tree`` header,
+``Tree=<i>`` blocks, decision_type bit encoding kCategoricalMask=1 /
+kDefaultLeftMask=2 / missing_type<<2 — include/LightGBM/tree.h:20-21,262-282)
+so models interchange with the reference's Python/CLI tooling in both
+directions. Loaded models predict via exact float64 host routing
+(reference semantics: Tree::NumericalDecision tree.h:334-351).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .config import Config
+from .io.binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO
+from .objectives import create_objective
+from .utils import log
+
+_MISSING_NAMES = {MISSING_NONE: "none", MISSING_ZERO: "zero", MISSING_NAN: "nan"}
+_MISSING_CODES = {"none": 0, "zero": 1, "nan": 2}
+
+
+def _fmt(x: float) -> str:
+    return f"{float(x):.17g}"
+
+
+def _objective_string(gbdt) -> str:
+    obj = gbdt.objective
+    if obj is None:
+        return "custom"
+    name = obj.name
+    parts = [name]
+    if name in ("multiclass", "multiclassova"):
+        parts.append(f"num_class:{obj.num_class}")
+    if hasattr(obj, "sigmoid"):
+        parts.append(f"sigmoid:{obj.sigmoid:g}")
+    if name == "tweedie":
+        parts.append(f"tweedie_variance_power:{obj.rho:g}")
+    if name in ("quantile", "huber"):
+        parts.append(f"alpha:{obj.alpha:g}")
+    return " ".join(parts)
+
+
+def _tree_to_text(host, tree_idx: int, mappers) -> str:
+    """One ``Tree=i`` block (reference: Tree::ToString, src/io/tree.cpp)."""
+    nl = host.num_leaves
+    nn = host.num_nodes
+    lines = [f"Tree={tree_idx}", f"num_leaves={nl}"]
+
+    cat_boundaries: List[int] = [0]
+    cat_thresholds: List[int] = []
+    split_features = []
+    thresholds = []
+    decision_types = []
+    num_cat = 0
+    for i in range(nn):
+        f = int(host.split_feature[i])
+        b = int(host.split_bin[i])
+        m = mappers[f]
+        dt = 0
+        if m.is_categorical:
+            dt |= 1  # kCategoricalMask
+            # one-hot bin split: left == {category of bin b}
+            cat = int(m.bin_to_cat[b]) if b < len(m.bin_to_cat) else 0
+            # bitset of 32-bit words (reference: Common::ConstructBitset)
+            word_count = cat // 32 + 1
+            words = [0] * word_count
+            words[cat // 32] |= 1 << (cat % 32)
+            thresholds.append(str(num_cat))
+            cat_thresholds.extend(words)
+            cat_boundaries.append(len(cat_thresholds))
+            num_cat += 1
+        else:
+            if bool(host.default_left[i]):
+                dt |= 2  # kDefaultLeftMask
+            mt = 2 if m.missing_type == MISSING_NAN else 0
+            dt |= mt << 2
+            thresholds.append(_fmt(m.bin_to_threshold(b)))
+        split_features.append(str(f))
+        decision_types.append(str(dt))
+
+    def join(vals):
+        return " ".join(str(v) for v in vals)
+
+    lines.append(f"num_cat={num_cat}")
+    lines.append("split_feature=" + join(split_features))
+    lines.append("split_gain=" + join(_fmt(host.split_gain[i]) for i in range(nn)))
+    lines.append("threshold=" + join(thresholds))
+    lines.append("decision_type=" + join(decision_types))
+    lines.append("left_child=" + join(int(host.left_child[i]) for i in range(nn)))
+    lines.append("right_child=" + join(int(host.right_child[i]) for i in range(nn)))
+    lines.append("leaf_value=" + join(_fmt(host.leaf_value[i]) for i in range(nl)))
+    lines.append("leaf_weight=" + join(_fmt(host.leaf_weight[i]) for i in range(nl)))
+    lines.append("leaf_count=" + join(int(round(float(host.leaf_count[i])))
+                                      for i in range(nl)))
+    lines.append("internal_value=" + join(_fmt(host.internal_value[i])
+                                          for i in range(nn)))
+    lines.append("internal_weight=" + join(_fmt(host.internal_weight[i])
+                                           for i in range(nn)))
+    lines.append("internal_count=" + join(int(round(float(host.internal_count[i])))
+                                          for i in range(nn)))
+    if num_cat > 0:
+        lines.append("cat_boundaries=" + join(cat_boundaries))
+        lines.append("cat_threshold=" + join(cat_thresholds))
+    lines.append("is_linear=0")
+    lines.append(f"shrinkage={host.shrinkage:g}")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def booster_to_string(booster, num_iteration: Optional[int] = None) -> str:
+    """(reference: GBDT::SaveModelToString, gbdt_model_text.cpp)"""
+    gbdt = booster._gbdt
+    if hasattr(gbdt, "original_text") and gbdt.original_text is not None:
+        return gbdt.original_text
+    ds = gbdt.train_set
+    mappers = ds.mappers
+    models = gbdt.models
+    if num_iteration is not None and num_iteration > 0:
+        models = models[: num_iteration * gbdt.num_tree_per_iteration]
+
+    feature_infos = []
+    for m in mappers:
+        if m.is_trivial:
+            feature_infos.append("none")
+        elif m.is_categorical:
+            feature_infos.append(
+                ":".join(str(int(c)) for c in m.bin_to_cat[1:]))
+        else:
+            feature_infos.append(f"[{m.min_value:g}:{m.max_value:g}]")
+
+    header = [
+        "tree",
+        "version=v4",
+        f"num_class={gbdt.num_tree_per_iteration}",
+        f"num_tree_per_iteration={gbdt.num_tree_per_iteration}",
+        "label_index=0",
+        f"max_feature_idx={ds.num_total_features - 1}",
+        f"objective={_objective_string(gbdt)}",
+    ]
+    if gbdt.average_output:
+        header.append("average_output")
+    header.append("feature_names=" + " ".join(ds.feature_names))
+    header.append("feature_infos=" + " ".join(feature_infos))
+
+    tree_blocks = [_tree_to_text(m, i, mappers) for i, m in enumerate(models)]
+    tree_sizes = [len(b) + 1 for b in tree_blocks]
+    header.append("tree_sizes=" + " ".join(str(s) for s in tree_sizes))
+    header.append("")
+
+    body = "\n".join(tree_blocks)
+    footer = ["", "end of trees", ""]
+    imp = gbdt.feature_importance("split")
+    order = np.argsort(-imp, kind="stable")
+    footer.append("feature_importances:")
+    for j in order:
+        if imp[j] > 0:
+            footer.append(f"{ds.feature_names[j]}={int(imp[j])}")
+    footer.append("")
+    footer.append("parameters:")
+    for key, value in sorted(booster.params.items()):
+        footer.append(f"[{key}: {value}]")
+    footer.append("end of parameters")
+    footer.append("")
+    footer.append("pandas_categorical:null")
+    return "\n".join(header) + "\n" + body + "\n".join(footer) + "\n"
+
+
+def _node_to_json(host, mappers, node: int) -> Dict[str, Any]:
+    """(reference: Tree::ToJSON / NodeToJSON, src/io/tree.cpp)"""
+    if node < 0:
+        leaf = -(node + 1)
+        return {
+            "leaf_index": int(leaf),
+            "leaf_value": float(host.leaf_value[leaf]),
+            "leaf_weight": float(host.leaf_weight[leaf]),
+            "leaf_count": int(round(float(host.leaf_count[leaf]))),
+        }
+    f = int(host.split_feature[node])
+    m = mappers[f]
+    out = {
+        "split_index": int(node),
+        "split_feature": f,
+        "split_gain": float(host.split_gain[node]),
+        "internal_value": float(host.internal_value[node]),
+        "internal_weight": float(host.internal_weight[node]),
+        "internal_count": int(round(float(host.internal_count[node]))),
+    }
+    if m.is_categorical:
+        b = int(host.split_bin[node])
+        cat = int(m.bin_to_cat[b]) if b < len(m.bin_to_cat) else 0
+        out["decision_type"] = "=="
+        out["threshold"] = str(cat)
+        out["default_left"] = False
+        out["missing_type"] = "None"
+    else:
+        out["decision_type"] = "<="
+        out["threshold"] = float(m.bin_to_threshold(int(host.split_bin[node])))
+        out["default_left"] = bool(host.default_left[node])
+        out["missing_type"] = _MISSING_NAMES.get(m.missing_type, "none").capitalize()
+    out["left_child"] = _node_to_json(host, mappers, int(host.left_child[node]))
+    out["right_child"] = _node_to_json(host, mappers, int(host.right_child[node]))
+    return out
+
+
+def booster_to_dict(booster, num_iteration: Optional[int] = None) -> Dict[str, Any]:
+    """(reference: GBDT::DumpModel, gbdt_model_text.cpp)"""
+    gbdt = booster._gbdt
+    ds = gbdt.train_set
+    models = gbdt.models
+    if num_iteration is not None and num_iteration > 0:
+        models = models[: num_iteration * gbdt.num_tree_per_iteration]
+    trees = []
+    for i, host in enumerate(models):
+        root = _node_to_json(host, ds.mappers, 0 if host.num_nodes > 0 else -1)
+        trees.append({
+            "tree_index": i,
+            "num_leaves": host.num_leaves,
+            "num_cat": 0,
+            "shrinkage": host.shrinkage,
+            "tree_structure": root,
+        })
+    return {
+        "name": "tree",
+        "version": "v4",
+        "num_class": gbdt.num_tree_per_iteration,
+        "num_tree_per_iteration": gbdt.num_tree_per_iteration,
+        "label_index": 0,
+        "max_feature_idx": ds.num_total_features - 1,
+        "objective": _objective_string(gbdt),
+        "average_output": gbdt.average_output,
+        "feature_names": list(ds.feature_names),
+        "monotone_constraints": [],
+        "feature_infos": {},
+        "tree_info": trees,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Loading (reference: GBDT::LoadModelFromString, gbdt_model_text.cpp; per-tree
+# parser Tree::Tree(const char*), src/io/tree.cpp)
+# ---------------------------------------------------------------------------
+class LoadedTree:
+    __slots__ = ("num_leaves", "num_cat", "split_feature", "split_gain",
+                 "threshold", "decision_type", "left_child", "right_child",
+                 "leaf_value", "leaf_weight", "leaf_count", "internal_value",
+                 "cat_boundaries", "cat_threshold", "shrinkage", "num_nodes")
+
+    def route(self, x: np.ndarray) -> np.ndarray:
+        """Leaf index per row; float64-exact level-synchronous routing."""
+        n = x.shape[0]
+        if self.num_nodes == 0:
+            return np.zeros(n, np.int64)
+        cur = np.zeros(n, np.int64)
+        for k in range(self.num_nodes):
+            at = cur == k
+            if not at.any():
+                continue
+            f = self.split_feature[k]
+            v = x[at, f]
+            dt = self.decision_type[k]
+            if dt & 1:  # categorical
+                ci = int(self.threshold[k])
+                lo, hi = self.cat_boundaries[ci], self.cat_boundaries[ci + 1]
+                words = self.cat_threshold[lo:hi]
+                iv = np.where(np.isfinite(v), v, -1).astype(np.int64)
+                in_set = np.zeros(len(iv), bool)
+                ok = (iv >= 0) & (iv < 32 * len(words))
+                idx = iv[ok]
+                in_set[ok] = (words[idx // 32] >> (idx % 32)) & 1 > 0
+                go_left = in_set
+            else:
+                default_left = bool(dt & 2)
+                missing_type = (dt >> 2) & 3
+                isnan = np.isnan(v)
+                if missing_type != 2:
+                    v = np.where(isnan, 0.0, v)
+                if missing_type == 1:
+                    miss = np.abs(v) <= 1e-35
+                elif missing_type == 2:
+                    miss = isnan
+                else:
+                    miss = np.zeros(len(v), bool)
+                go_left = np.where(miss, default_left, v <= self.threshold[k])
+            nxt = np.where(go_left, self.left_child[k], self.right_child[k])
+            cur[at] = nxt
+        return -(cur + 1)
+
+
+def _parse_block(lines: List[str]) -> Dict[str, str]:
+    out = {}
+    for line in lines:
+        if "=" in line:
+            key, _, value = line.partition("=")
+            out[key.strip()] = value.strip()
+        elif line.strip():
+            out[line.strip()] = ""
+    return out
+
+
+def _arr(d: Dict[str, str], key: str, dtype, n: int):
+    s = d.get(key, "")
+    if not s:
+        return np.zeros(n, dtype)
+    return np.fromstring(s, dtype=dtype, sep=" ") if False else \
+        np.array(s.split(), dtype=dtype)
+
+
+class LoadedGBDT:
+    """Prediction-only model handle built from model text."""
+
+    def __init__(self, model_str: str):
+        if not model_str.lstrip().startswith("tree"):
+            raise ValueError(
+                "Model string is not a LightGBM model (missing 'tree' header)")
+        self.original_text = model_str
+        lines = model_str.split("\n")
+        # split into header / tree blocks / footer on 'Tree=' markers
+        header_lines: List[str] = []
+        tree_chunks: List[List[str]] = []
+        cur: Optional[List[str]] = None
+        for line in lines:
+            if line.startswith("Tree="):
+                if cur is not None:
+                    tree_chunks.append(cur)
+                cur = [line]
+            elif line.strip() == "end of trees":
+                if cur is not None:
+                    tree_chunks.append(cur)
+                cur = None
+                break
+            elif cur is not None:
+                cur.append(line)
+            else:
+                header_lines.append(line)
+        if cur is not None:
+            tree_chunks.append(cur)
+
+        hdr = _parse_block(header_lines)
+        self.num_class = int(hdr.get("num_class", 1))
+        self.num_tree_per_iteration = int(hdr.get("num_tree_per_iteration",
+                                                  self.num_class))
+        self.max_feature_idx = int(hdr.get("max_feature_idx", 0))
+        self.feature_names = hdr.get("feature_names", "").split()
+        self.average_output = "average_output" in hdr
+        obj_str = hdr.get("objective", "custom")
+        self.objective = _objective_from_string(obj_str)
+        self.objective_str = obj_str
+
+        self.models: List[LoadedTree] = []
+        for chunk in tree_chunks:
+            d = _parse_block(chunk)
+            t = LoadedTree()
+            nl = int(d.get("num_leaves", 1))
+            nn = max(nl - 1, 0)
+            t.num_leaves = nl
+            t.num_nodes = nn
+            t.num_cat = int(d.get("num_cat", 0))
+            t.split_feature = _arr(d, "split_feature", np.int32, nn)
+            t.split_gain = _arr(d, "split_gain", np.float64, nn)
+            t.threshold = _arr(d, "threshold", np.float64, nn)
+            t.decision_type = _arr(d, "decision_type", np.int32, nn)
+            t.left_child = _arr(d, "left_child", np.int32, nn)
+            t.right_child = _arr(d, "right_child", np.int32, nn)
+            t.leaf_value = _arr(d, "leaf_value", np.float64, nl)
+            t.leaf_weight = _arr(d, "leaf_weight", np.float64, nl)
+            t.leaf_count = _arr(d, "leaf_count", np.float64, nl)
+            t.internal_value = _arr(d, "internal_value", np.float64, nn)
+            t.cat_boundaries = _arr(d, "cat_boundaries", np.int64,
+                                    1 + t.num_cat) if t.num_cat else np.zeros(1, np.int64)
+            t.cat_threshold = _arr(d, "cat_threshold", np.uint32, 0) \
+                if t.num_cat else np.zeros(0, np.uint32)
+            t.shrinkage = float(d.get("shrinkage", 1.0))
+            self.models.append(t)
+
+    # Booster-compat surface -------------------------------------------------
+    @property
+    def current_iteration(self) -> int:
+        return len(self.models) // max(self.num_tree_per_iteration, 1)
+
+    def predict_raw_matrix(self, arr: np.ndarray,
+                           num_iteration: Optional[int] = None) -> np.ndarray:
+        arr = np.asarray(arr, np.float64)
+        if arr.ndim == 1:
+            arr = arr.reshape(1, -1)
+        models = self.models
+        if num_iteration is not None and num_iteration > 0:
+            models = models[: num_iteration * self.num_tree_per_iteration]
+        k = self.num_tree_per_iteration
+        out = np.zeros((k, arr.shape[0]), np.float64)
+        for i, t in enumerate(models):
+            leaf = t.route(arr)
+            out[i % k] += t.leaf_value[leaf]
+        if self.average_output:
+            out /= max(len(models) // k, 1)
+        return out.astype(np.float32)
+
+    def predict_leaf_matrix(self, arr: np.ndarray,
+                            num_iteration: Optional[int] = None) -> np.ndarray:
+        arr = np.asarray(arr, np.float64)
+        models = self.models
+        if num_iteration is not None and num_iteration > 0:
+            models = models[: num_iteration * self.num_tree_per_iteration]
+        return np.stack([t.route(arr) for t in models], axis=1)
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        out = np.zeros(self.max_feature_idx + 1, np.float64)
+        for t in self.models:
+            for i in range(t.num_nodes):
+                if importance_type == "split":
+                    out[t.split_feature[i]] += 1
+                else:
+                    out[t.split_feature[i]] += max(float(t.split_gain[i]), 0.0)
+        return out
+
+
+def _objective_from_string(obj_str: str):
+    parts = obj_str.split()
+    if not parts or parts[0] == "custom":
+        return None
+    name = parts[0]
+    params: Dict[str, Any] = {"objective": name}
+    for p in parts[1:]:
+        if ":" in p:
+            key, _, value = p.partition(":")
+            params[key] = value
+    cfg = Config(params)
+    try:
+        return create_objective(cfg.objective, cfg)
+    except ValueError:
+        log.warning(f"Unknown objective in model file: {name}")
+        return None
+
+
+def load_booster(booster, model_str: str, params) -> None:
+    gbdt = LoadedGBDT(model_str)
+    booster._gbdt = gbdt
+    booster.train_set = None
+    booster.config = None
+    booster._valid_names = []

@@ -1,0 +1,688 @@
+"""Objective functions (gradient/hessian kernels), computed on device.
+
+TPU-native re-design of the reference's objective layer
+(reference: include/LightGBM/objective_function.h, factory
+ObjectiveFunction::CreateObjectiveFunction src/objective/objective_function.cpp:12-130,
+families in src/objective/{regression,binary,multiclass,rank,xentropy}_objective.hpp
+and their CUDA mirrors src/objective/cuda/*).
+
+Where the reference launches per-row CUDA kernels, here every objective is a pure
+jnp function over the score vector — XLA fuses the elementwise math into the
+surrounding training step, and the same code runs under ``shard_map`` for
+data-parallel training (per-query ranking reductions become segment ops over
+padded query blocks).
+
+Interface mirrors the reference's (objective_function.h):
+  * ``get_gradients(score) -> (grad, hess)``     (GetGradients, :37)
+  * ``boost_from_score(class_id)``               (BoostFromScore)
+  * ``convert_output(raw)``                      (ConvertOutput, :81)
+  * ``renew_tree_output`` percentile/leaf renewal (RenewTreeOutput, :57)
+  * ``num_model_per_iteration``                  (multiclass: num_class trees/iter)
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_EPS = 1e-15
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+class Objective:
+    """Base objective (reference: ObjectiveFunction, objective_function.h)."""
+
+    name = "custom"
+    is_constant_hessian = False
+    num_model_per_iteration = 1
+    # leaves renewed after growth (reference: RegressionL1loss::RenewTreeOutput)
+    renew_leaves = False
+    is_ranking = False
+
+    def __init__(self, config):
+        self.config = config
+
+    def init(self, metadata, num_data: int) -> None:
+        self.num_data = num_data
+        self.label = jnp.asarray(metadata.label, jnp.float32)
+        self.weight = (
+            jnp.asarray(metadata.weight, jnp.float32)
+            if metadata.weight is not None else None
+        )
+        self.metadata = metadata
+
+    def _weighted(self, grad, hess):
+        if self.weight is not None:
+            return grad * self.weight, hess * self.weight
+        return grad, hess
+
+    def get_gradients(self, score: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        raise NotImplementedError
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return 0.0
+
+    def convert_output(self, raw: jax.Array) -> jax.Array:
+        return raw
+
+    def renew_tree_output(self, score, residual_fn=None):
+        raise NotImplementedError
+
+    def _avg_label(self) -> float:
+        lbl = _np(self.label).astype(np.float64)
+        if self.weight is not None:
+            w = _np(self.weight).astype(np.float64)
+            return float((lbl * w).sum() / max(w.sum(), _EPS))
+        return float(lbl.mean())
+
+
+# ---------------------------------------------------------------------------
+# Regression family (reference: src/objective/regression_objective.hpp)
+# ---------------------------------------------------------------------------
+class RegressionL2(Objective):
+    """L2 loss (reference: RegressionL2loss, regression_objective.hpp:93)."""
+
+    name = "regression"
+    is_constant_hessian = True
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sqrt = bool(config.get("reg_sqrt", False))
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self.sqrt:
+            lbl = self.label
+            self.label = jnp.sign(lbl) * jnp.sqrt(jnp.abs(lbl))
+
+    def get_gradients(self, score):
+        grad = score - self.label
+        hess = jnp.ones_like(score)
+        return self._weighted(grad, hess)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return self._avg_label()
+
+    def convert_output(self, raw):
+        if self.sqrt:
+            return jnp.sign(raw) * raw * raw
+        return raw
+
+
+class RegressionL1(RegressionL2):
+    """L1 loss; leaf outputs renewed to the per-leaf weighted median of residuals
+    (reference: RegressionL1loss, regression_objective.hpp:165)."""
+
+    name = "regression_l1"
+    is_constant_hessian = True
+    renew_leaves = True
+    renew_alpha = 0.5
+
+    def get_gradients(self, score):
+        diff = score - self.label
+        grad = jnp.sign(diff)
+        hess = jnp.ones_like(score)
+        return self._weighted(grad, hess)
+
+
+class RegressionHuber(RegressionL2):
+    """Huber loss (reference: RegressionHuberLoss, regression_objective.hpp:234)."""
+
+    name = "huber"
+    is_constant_hessian = True
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.alpha = float(config.get("alpha", 0.9))
+
+    def get_gradients(self, score):
+        diff = score - self.label
+        grad = jnp.where(jnp.abs(diff) <= self.alpha, diff,
+                         jnp.sign(diff) * self.alpha)
+        hess = jnp.ones_like(score)
+        return self._weighted(grad, hess)
+
+
+class RegressionFair(RegressionL2):
+    """Fair loss (reference: RegressionFairLoss, regression_objective.hpp:290)."""
+
+    name = "fair"
+    is_constant_hessian = False
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.c = float(config.get("fair_c", 1.0))
+
+    def get_gradients(self, score):
+        diff = score - self.label
+        c = self.c
+        grad = c * diff / (jnp.abs(diff) + c)
+        hess = c * c / ((jnp.abs(diff) + c) ** 2)
+        return self._weighted(grad, hess)
+
+
+class RegressionPoisson(RegressionL2):
+    """Poisson regression on log-link scores
+    (reference: RegressionPoissonLoss, regression_objective.hpp:341)."""
+
+    name = "poisson"
+    is_constant_hessian = False
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.max_delta = float(config.get("poisson_max_delta_step", 0.7))
+
+    def get_gradients(self, score):
+        ex = jnp.exp(score)
+        grad = ex - self.label
+        hess = jnp.exp(score + self.max_delta)
+        return self._weighted(grad, hess)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return float(np.log(max(self._avg_label(), _EPS)))
+
+    def convert_output(self, raw):
+        return jnp.exp(raw)
+
+
+class RegressionQuantile(RegressionL2):
+    """Quantile (pinball) loss with per-leaf quantile renewal
+    (reference: RegressionQuantileloss, regression_objective.hpp:417)."""
+
+    name = "quantile"
+    is_constant_hessian = True
+    renew_leaves = True
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.alpha = float(config.get("alpha", 0.9))
+        self.renew_alpha = self.alpha
+
+    def get_gradients(self, score):
+        diff = score - self.label
+        grad = jnp.where(diff >= 0, 1.0 - self.alpha, -self.alpha)
+        hess = jnp.ones_like(score)
+        return self._weighted(grad, hess)
+
+
+class RegressionMAPE(RegressionL2):
+    """MAPE loss (reference: RegressionMAPELOSS, regression_objective.hpp:498)."""
+
+    name = "mape"
+    is_constant_hessian = True
+    renew_leaves = True
+    renew_alpha = 0.5
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        # label_weight = 1 / max(1, |label|), folded into the row weight
+        lw = 1.0 / jnp.maximum(1.0, jnp.abs(self.label))
+        self.weight = lw if self.weight is None else self.weight * lw
+
+    def get_gradients(self, score):
+        diff = score - self.label
+        grad = jnp.sign(diff)
+        hess = jnp.ones_like(score)
+        return self._weighted(grad, hess)
+
+
+class RegressionGamma(RegressionPoisson):
+    """Gamma deviance on log-link scores
+    (reference: RegressionGammaLoss, regression_objective.hpp:578)."""
+
+    name = "gamma"
+
+    def get_gradients(self, score):
+        e = jnp.exp(-score)
+        grad = 1.0 - self.label * e
+        hess = self.label * e
+        return self._weighted(grad, hess)
+
+
+class RegressionTweedie(RegressionPoisson):
+    """Tweedie deviance on log-link scores
+    (reference: RegressionTweedieLoss, regression_objective.hpp:612)."""
+
+    name = "tweedie"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.rho = float(config.get("tweedie_variance_power", 1.5))
+
+    def get_gradients(self, score):
+        rho = self.rho
+        e1 = jnp.exp((1.0 - rho) * score)
+        e2 = jnp.exp((2.0 - rho) * score)
+        grad = -self.label * e1 + e2
+        hess = -self.label * (1.0 - rho) * e1 + (2.0 - rho) * e2
+        return self._weighted(grad, hess)
+
+
+# ---------------------------------------------------------------------------
+# Binary (reference: src/objective/binary_objective.hpp:21 BinaryLogloss)
+# ---------------------------------------------------------------------------
+class BinaryLogloss(Objective):
+    name = "binary"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sigmoid = float(config.get("sigmoid", 1.0))
+        self.is_unbalance = bool(config.get("is_unbalance", False))
+        self.scale_pos_weight = float(config.get("scale_pos_weight", 1.0))
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        lbl = _np(metadata.label)
+        uniq = np.unique(lbl)
+        if not np.all(np.isin(uniq, [0.0, 1.0])):
+            raise ValueError("binary objective requires labels in {0, 1}")
+        pos = float((lbl > 0).sum())
+        neg = float(len(lbl) - pos)
+        self.label01 = jnp.asarray(lbl > 0, jnp.float32)
+        # class weighting (reference: binary_objective.hpp:60-86)
+        if self.is_unbalance and pos > 0 and neg > 0:
+            if pos > neg:
+                self.label_weights = (1.0, neg / pos)   # (neg_w, pos_w)
+            else:
+                self.label_weights = (pos / neg, 1.0)
+        else:
+            self.label_weights = (1.0, self.scale_pos_weight)
+        self._pos, self._neg = pos, neg
+
+    def get_gradients(self, score):
+        sig = self.sigmoid
+        y = self.label01
+        p = jax.nn.sigmoid(sig * score)
+        neg_w, pos_w = self.label_weights
+        w = jnp.where(y > 0, pos_w, neg_w)
+        grad = (p - y) * sig * w
+        hess = p * (1.0 - p) * sig * sig * w
+        return self._weighted(grad, hess)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        # sigmoid^-1 of weighted positive rate (reference: binary_objective.hpp:94-108)
+        if self.weight is not None:
+            w = _np(self.weight).astype(np.float64)
+            lbl = _np(self.label01).astype(np.float64)
+            pavg = float((lbl * w).sum() / max(w.sum(), _EPS))
+        else:
+            pavg = self._pos / max(self._pos + self._neg, 1.0)
+        pavg = min(max(pavg, 1e-15), 1.0 - 1e-15)
+        return float(np.log(pavg / (1.0 - pavg)) / self.sigmoid)
+
+    def convert_output(self, raw):
+        return jax.nn.sigmoid(self.sigmoid * raw)
+
+
+# ---------------------------------------------------------------------------
+# Multiclass (reference: src/objective/multiclass_objective.hpp)
+# ---------------------------------------------------------------------------
+class MulticlassSoftmax(Objective):
+    """Softmax over num_class score rows (reference: MulticlassSoftmax,
+    multiclass_objective.hpp:24). One tree per class per iteration."""
+
+    name = "multiclass"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.num_class = int(config.get("num_class", 1))
+        if self.num_class <= 1:
+            raise ValueError("multiclass objective requires num_class > 1")
+        self.num_model_per_iteration = self.num_class
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        lbl = _np(metadata.label).astype(np.int32)
+        if lbl.min() < 0 or lbl.max() >= self.num_class:
+            raise ValueError(
+                f"multiclass labels must be in [0, {self.num_class}); "
+                f"got range [{lbl.min()}, {lbl.max()}]")
+        self.onehot = jnp.asarray(
+            np.eye(self.num_class, dtype=np.float32)[lbl])  # [N, K]
+        self._class_counts = np.bincount(lbl, minlength=self.num_class)
+
+    def get_gradients(self, score):
+        # score: [K, N]
+        p = jax.nn.softmax(score, axis=0)                   # [K, N]
+        y = self.onehot.T                                   # [K, N]
+        grad = p - y
+        factor = self.num_class / (self.num_class - 1.0)
+        hess = factor * p * (1.0 - p)
+        if self.weight is not None:
+            grad = grad * self.weight[None, :]
+            hess = hess * self.weight[None, :]
+        return grad, hess
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        # reference inits multiclass scores at 0 (softmax handles normalization)
+        return 0.0
+
+    def convert_output(self, raw):
+        # raw: [..., K] -> probabilities
+        return jax.nn.softmax(raw, axis=-1)
+
+
+class MulticlassOVA(Objective):
+    """One-vs-all: num_class independent sigmoid losses
+    (reference: MulticlassOVA, multiclass_objective.hpp:186)."""
+
+    name = "multiclassova"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.num_class = int(config.get("num_class", 1))
+        if self.num_class <= 1:
+            raise ValueError("multiclassova requires num_class > 1")
+        self.num_model_per_iteration = self.num_class
+        self.sigmoid = float(config.get("sigmoid", 1.0))
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        lbl = _np(metadata.label).astype(np.int32)
+        self.onehot = jnp.asarray(np.eye(self.num_class, dtype=np.float32)[lbl])
+        self._class_rates = (
+            np.bincount(lbl, minlength=self.num_class) / max(len(lbl), 1))
+
+    def get_gradients(self, score):
+        sig = self.sigmoid
+        y = self.onehot.T
+        p = jax.nn.sigmoid(sig * score)
+        grad = (p - y) * sig
+        hess = p * (1.0 - p) * sig * sig
+        if self.weight is not None:
+            grad = grad * self.weight[None, :]
+            hess = hess * self.weight[None, :]
+        return grad, hess
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        pavg = min(max(float(self._class_rates[class_id]), 1e-15), 1 - 1e-15)
+        return float(np.log(pavg / (1.0 - pavg)) / self.sigmoid)
+
+    def convert_output(self, raw):
+        return jax.nn.sigmoid(self.sigmoid * raw)
+
+
+# ---------------------------------------------------------------------------
+# Cross-entropy on continuous labels in [0,1]
+# (reference: src/objective/xentropy_objective.hpp:44,:185)
+# ---------------------------------------------------------------------------
+class CrossEntropy(Objective):
+    name = "cross_entropy"
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        lbl = _np(metadata.label)
+        if lbl.min() < 0 or lbl.max() > 1:
+            raise ValueError("cross_entropy labels must lie in [0, 1]")
+
+    def get_gradients(self, score):
+        p = jax.nn.sigmoid(score)
+        grad = p - self.label
+        hess = p * (1.0 - p)
+        return self._weighted(grad, hess)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        pavg = min(max(self._avg_label(), 1e-15), 1 - 1e-15)
+        return float(np.log(pavg / (1.0 - pavg)))
+
+    def convert_output(self, raw):
+        return jax.nn.sigmoid(raw)
+
+
+class CrossEntropyLambda(Objective):
+    """Alternative parametrization (reference: CrossEntropyLambda,
+    xentropy_objective.hpp:185)."""
+
+    name = "cross_entropy_lambda"
+
+    def get_gradients(self, score):
+        # z = log1p(exp(score)); loss = (1-y)*score ... reference parametrization
+        epf = jnp.exp(score)
+        hhat = jnp.log1p(epf)
+        z = 1.0 - jnp.exp(-hhat)
+        enf = jnp.exp(-score)
+        grad = (1.0 - self.label / jnp.maximum(z, _EPS)) / (1.0 + enf)
+        c = 1.0 / (1.0 - jnp.exp(-epf))
+        hess = epf / ((1.0 + epf) ** 2) * (
+            1.0 + self.label * (1.0 - c + epf * c * c) / jnp.maximum(z * z, _EPS) * z)
+        # guard numerical blowups near score -> -inf
+        grad = jnp.nan_to_num(grad, nan=0.0, posinf=0.0, neginf=0.0)
+        hess = jnp.clip(jnp.nan_to_num(hess, nan=1.0, posinf=1.0, neginf=_EPS),
+                        _EPS, None)
+        return self._weighted(grad, hess)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        avg = max(self._avg_label(), 1e-15)
+        return float(np.log(np.expm1(avg)) if avg < 30 else avg)
+
+    def convert_output(self, raw):
+        return jnp.log1p(jnp.exp(raw))
+
+
+# ---------------------------------------------------------------------------
+# Ranking (reference: src/objective/rank_objective.hpp — LambdarankNDCG :138,
+# RankXENDCG :378; CUDA mirror cuda_rank_objective.cu)
+# ---------------------------------------------------------------------------
+def _pad_queries(boundaries: np.ndarray) -> Tuple[np.ndarray, int]:
+    """Build a [Q, M] row-index matrix (padded with -1) from query boundaries."""
+    sizes = np.diff(boundaries)
+    q = len(sizes)
+    m = int(sizes.max()) if q else 1
+    idx = np.full((q, m), -1, dtype=np.int32)
+    for i in range(q):
+        s, e = boundaries[i], boundaries[i + 1]
+        idx[i, : e - s] = np.arange(s, e, dtype=np.int32)
+    return idx, m
+
+
+class LambdarankNDCG(Objective):
+    """LambdaRank with |ΔNDCG| weighting.
+
+    The reference computes per-query lambda gradients with a sorted-document scan
+    (rank_objective.hpp:138-320; on device via bitonic sort in
+    cuda_rank_objective.cu). Here queries are padded to a [Q, M] matrix, scores
+    are sorted per query with ``jnp.argsort`` (XLA sort), and the full M×M pair
+    matrix is evaluated with masks — MXU/VPU-friendly, no data-dependent shapes.
+    """
+
+    name = "lambdarank"
+    is_ranking = True
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sigmoid = float(config.get("sigmoid", 2.0))
+        self.norm = bool(config.get("lambdarank_norm", True))
+        trunc = int(config.get("lambdarank_truncation_level", 30))
+        self.truncation_level = trunc
+        self.label_gain = config.get("label_gain", None)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            raise ValueError("ranking objective requires query groups (set_group)")
+        qb = metadata.query_boundaries
+        idx, m = _pad_queries(qb)
+        self.query_index = jnp.asarray(idx)          # [Q, M]
+        self.query_mask = jnp.asarray(idx >= 0)      # [Q, M]
+        self.max_query = m
+        lbl = _np(metadata.label).astype(np.int32)
+        max_label = int(lbl.max()) if len(lbl) else 0
+        if self.label_gain is None:
+            gains = (2.0 ** np.arange(max(max_label + 1, 2))) - 1.0
+        else:
+            gains = np.asarray(self.label_gain, dtype=np.float64)
+            if len(gains) <= max_label:
+                raise ValueError("label_gain shorter than max label + 1")
+        self._label_gain_table = gains
+        # per-row gain values, padded gather-safe
+        row_gain = gains[lbl]
+        self.row_gain = jnp.asarray(row_gain, jnp.float32)
+        self.row_label = jnp.asarray(lbl, jnp.int32)
+        # inverse max DCG per query (reference: lambdarank_ndcg init)
+        inv_max_dcg = np.zeros(len(qb) - 1, dtype=np.float64)
+        for i in range(len(qb) - 1):
+            g = np.sort(row_gain[qb[i]: qb[i + 1]])[::-1]
+            k = min(len(g), self.truncation_level)
+            disc = 1.0 / np.log2(np.arange(k) + 2.0)
+            mdcg = float((g[:k] * disc).sum())
+            inv_max_dcg[i] = 1.0 / mdcg if mdcg > 0 else 0.0
+        self.inv_max_dcg = jnp.asarray(inv_max_dcg, jnp.float32)  # [Q]
+
+    def get_gradients(self, score):
+        idx = self.query_index                       # [Q, M]
+        mask = self.query_mask
+        safe_idx = jnp.maximum(idx, 0)
+        s = jnp.where(mask, score[safe_idx], -jnp.inf)        # [Q, M]
+        g = jnp.where(mask, self.row_gain[safe_idx], 0.0)     # gains
+        # rank each document by descending score (reference sorts per query)
+        order = jnp.argsort(-s, axis=1)                       # [Q, M]
+        rank_of = jnp.argsort(order, axis=1)                  # doc -> position
+        disc = 1.0 / jnp.log2(rank_of.astype(jnp.float32) + 2.0)  # [Q, M]
+        within_trunc = rank_of < self.truncation_level
+        disc = jnp.where(within_trunc, disc, 0.0)
+
+        sig = self.sigmoid
+        # pair matrices [Q, M, M]: i = higher-labeled doc, j = lower
+        s_i = s[:, :, None]
+        s_j = s[:, None, :]
+        g_i = g[:, :, None]
+        g_j = g[:, None, :]
+        d_i = disc[:, :, None]
+        d_j = disc[:, None, :]
+        pair_valid = (
+            mask[:, :, None] & mask[:, None, :] & (g_i > g_j)
+            & (within_trunc[:, :, None] | within_trunc[:, None, :])
+        )
+        delta_ndcg = jnp.abs((g_i - g_j) * (d_i - d_j)) \
+            * self.inv_max_dcg[:, None, None]
+        ds = s_i - s_j
+        p = jax.nn.sigmoid(sig * ds)          # P(i ranked above j)
+        lam = sig * (p - 1.0) * delta_ndcg    # d loss / d s_i  (negative)
+        hes = sig * sig * p * (1.0 - p) * delta_ndcg
+        lam = jnp.where(pair_valid, lam, 0.0)
+        hes = jnp.where(pair_valid, hes, 0.0)
+
+        grad_q = lam.sum(axis=2) - lam.sum(axis=1)   # [Q, M]
+        hess_q = hes.sum(axis=2) + hes.sum(axis=1)
+
+        if self.norm:
+            # reference norm_: scale by log2(1 + #pairs-ish); use per-query pair count
+            npairs = pair_valid.sum(axis=(1, 2)).astype(jnp.float32)
+            scale = jnp.where(npairs > 0, jnp.log2(1.0 + npairs), 1.0)
+            grad_q = grad_q / scale[:, None]
+            hess_q = hess_q / scale[:, None]
+
+        grad = jnp.zeros_like(score).at[safe_idx.reshape(-1)].add(
+            jnp.where(mask, grad_q, 0.0).reshape(-1))
+        hess = jnp.zeros_like(score).at[safe_idx.reshape(-1)].add(
+            jnp.where(mask, hess_q, 0.0).reshape(-1))
+        return self._weighted(grad, hess)
+
+
+class RankXENDCG(Objective):
+    """Listwise cross-entropy surrogate for NDCG
+    (reference: RankXENDCG, rank_objective.hpp:378)."""
+
+    name = "rank_xendcg"
+    is_ranking = True
+    # draws fresh gamma noise each iteration — must not be jit-frozen
+    is_stochastic = True
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.seed = int(config.get("objective_seed", 5) or 5)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            raise ValueError("ranking objective requires query groups (set_group)")
+        idx, m = _pad_queries(metadata.query_boundaries)
+        self.query_index = jnp.asarray(idx)
+        self.query_mask = jnp.asarray(idx >= 0)
+        lbl = _np(metadata.label).astype(np.float64)
+        phi = (2.0 ** lbl) - 1.0
+        self.row_phi = jnp.asarray(phi, jnp.float32)
+        self._key = jax.random.PRNGKey(self.seed)
+
+    def get_gradients(self, score):
+        idx = self.query_index
+        mask = self.query_mask
+        safe_idx = jnp.maximum(idx, 0)
+        s = jnp.where(mask, score[safe_idx], -jnp.inf)
+        phi = jnp.where(mask, self.row_phi[safe_idx], 0.0)
+        # gumbel-perturbed relevance target (reference draws per-doc gammas)
+        self._key, sub = jax.random.split(self._key)
+        gam = jax.random.gamma(sub, 1.0, shape=phi.shape)
+        rho_raw = phi / jnp.maximum(gam, _EPS)
+        denom = jnp.where(mask, rho_raw, 0.0).sum(axis=1, keepdims=True)
+        t = rho_raw / jnp.maximum(denom, _EPS)       # target distribution
+        p = jax.nn.softmax(s, axis=1)
+        p = jnp.where(mask, p, 0.0)
+        grad_q = p - jnp.where(mask, t, 0.0)
+        hess_q = p * (1.0 - p)
+        grad = jnp.zeros_like(score).at[safe_idx.reshape(-1)].add(
+            jnp.where(mask, grad_q, 0.0).reshape(-1))
+        hess = jnp.zeros_like(score).at[safe_idx.reshape(-1)].add(
+            jnp.where(mask, hess_q, 0.0).reshape(-1))
+        hess = jnp.maximum(hess, _EPS)
+        return self._weighted(grad, hess)
+
+
+# ---------------------------------------------------------------------------
+# Factory (reference: ObjectiveFunction::CreateObjectiveFunction,
+# src/objective/objective_function.cpp:12-130)
+# ---------------------------------------------------------------------------
+_OBJECTIVES = {
+    "regression": RegressionL2,
+    "regression_l2": RegressionL2,
+    "l2": RegressionL2,
+    "mean_squared_error": RegressionL2,
+    "mse": RegressionL2,
+    "l2_root": RegressionL2,
+    "root_mean_squared_error": RegressionL2,
+    "rmse": RegressionL2,
+    "regression_l1": RegressionL1,
+    "l1": RegressionL1,
+    "mean_absolute_error": RegressionL1,
+    "mae": RegressionL1,
+    "huber": RegressionHuber,
+    "fair": RegressionFair,
+    "poisson": RegressionPoisson,
+    "quantile": RegressionQuantile,
+    "mape": RegressionMAPE,
+    "mean_absolute_percentage_error": RegressionMAPE,
+    "gamma": RegressionGamma,
+    "tweedie": RegressionTweedie,
+    "binary": BinaryLogloss,
+    "multiclass": MulticlassSoftmax,
+    "softmax": MulticlassSoftmax,
+    "multiclassova": MulticlassOVA,
+    "multiclass_ova": MulticlassOVA,
+    "ova": MulticlassOVA,
+    "ovr": MulticlassOVA,
+    "cross_entropy": CrossEntropy,
+    "xentropy": CrossEntropy,
+    "cross_entropy_lambda": CrossEntropyLambda,
+    "xentlambda": CrossEntropyLambda,
+    "lambdarank": LambdarankNDCG,
+    "rank_xendcg": RankXENDCG,
+    "xendcg": RankXENDCG,
+    "xe_ndcg": RankXENDCG,
+    "xe_ndcg_mart": RankXENDCG,
+    "xendcg_mart": RankXENDCG,
+}
+
+
+def create_objective(name: str, config) -> Optional[Objective]:
+    """Create an objective by (aliased) name; None for 'custom'/'none'."""
+    if name is None or name in ("custom", "none", "null", "na"):
+        return None
+    key = str(name).lower()
+    if key not in _OBJECTIVES:
+        raise ValueError(f"Unknown objective: {name}")
+    return _OBJECTIVES[key](config)

@@ -86,6 +86,9 @@ class TreeArrays(NamedTuple):
     leaf_count: jax.Array      # [L] f32 (weighted row count)
     leaf_parent: jax.Array     # [L] i32 node whose child the leaf is
     leaf_depth: jax.Array      # [L] i32
+    internal_value: jax.Array  # [L-1] f32 output the node would emit as a leaf
+    internal_weight: jax.Array  # [L-1] f32 hessian sum at the node
+    internal_count: jax.Array  # [L-1] f32 row count at the node
     num_leaves: jax.Array      # scalar i32: actual number of leaves
     num_nodes: jax.Array       # scalar i32: actual number of internal nodes
 
@@ -104,6 +107,10 @@ class GrowerState(NamedTuple):
     leaf_parent: jax.Array
     leaf_parent_side: jax.Array
     leaf_depth: jax.Array
+    # per-internal-node aggregates (for model export / plotting)
+    node_grad: jax.Array
+    node_hess: jax.Array
+    node_cnt: jax.Array
     # per-leaf aggregates
     leaf_grad: jax.Array
     leaf_hess: jax.Array
@@ -182,6 +189,9 @@ def grow_tree(
         leaf_parent=jnp.full((L,), -1, i32),
         leaf_parent_side=jnp.zeros((L,), i32),
         leaf_depth=jnp.zeros((L,), i32),
+        node_grad=jnp.zeros((L - 1,), jnp.float32),
+        node_hess=jnp.zeros((L - 1,), jnp.float32),
+        node_cnt=jnp.zeros((L - 1,), jnp.float32),
         leaf_grad=jnp.zeros((L,), jnp.float32).at[0].set(root_g),
         leaf_hess=jnp.zeros((L,), jnp.float32).at[0].set(root_h),
         leaf_cnt=jnp.zeros((L,), jnp.float32).at[0].set(root_c),
@@ -257,6 +267,9 @@ def grow_tree(
         pg, ph, pc = (st.leaf_grad[best_leaf], st.leaf_hess[best_leaf],
                       st.leaf_cnt[best_leaf])
         rg, rh, rc = pg - lg, ph - lh, pc - lc
+        node_grad = st.node_grad.at[node].set(jnp.where(applied, pg, 0.0))
+        node_hess = st.node_hess.at[node].set(jnp.where(applied, ph, 0.0))
+        node_cnt = st.node_cnt.at[node].set(jnp.where(applied, pc, 0.0))
         d_child = st.leaf_depth[best_leaf] + 1
         leaf_grad = st.leaf_grad.at[best_leaf].set(jnp.where(applied, lg, pg))
         leaf_grad = leaf_grad.at[new_leaf].set(
@@ -313,6 +326,9 @@ def grow_tree(
             leaf_parent=leaf_parent,
             leaf_parent_side=leaf_parent_side,
             leaf_depth=leaf_depth,
+            node_grad=node_grad,
+            node_hess=node_hess,
+            node_cnt=node_cnt,
             leaf_grad=leaf_grad,
             leaf_hess=leaf_hess,
             leaf_cnt=leaf_cnt,
@@ -340,6 +356,10 @@ def grow_tree(
         leaf_count=st.leaf_cnt,
         leaf_parent=st.leaf_parent,
         leaf_depth=st.leaf_depth,
+        internal_value=leaf_output(st.node_grad, st.node_hess,
+                                   params.split_params()),
+        internal_weight=st.node_hess,
+        internal_count=st.node_cnt,
         num_leaves=st.num_nodes + 1,
         num_nodes=st.num_nodes,
     )

@@ -55,9 +55,14 @@ def histogram(
     chunk = _chunk_rows(n, f, b)
     iota = jnp.arange(b, dtype=jnp.int32)
 
+    # histogram sums need full f32 accuracy (hessian sums drive leaf outputs;
+    # SURVEY §7 "bf16 is out for hessian sums") — the TPU MXU's default bf16
+    # matmul precision is not enough, so force the fp32-accurate mode.
+    prec = lax.Precision.HIGHEST
+
     if n <= chunk:
         onehot = (binned.astype(jnp.int32)[:, :, None] == iota).astype(channels.dtype)
-        hist = jnp.einsum("rfb,rk->fbk", onehot, channels)
+        hist = jnp.einsum("rfb,rk->fbk", onehot, channels, precision=prec)
     else:
         n_chunks = -(-n // chunk)
         pad = n_chunks * chunk - n
@@ -70,7 +75,8 @@ def histogram(
         def step(hist, inp):
             bc, cc = inp
             onehot = (bc.astype(jnp.int32)[:, :, None] == iota).astype(cc.dtype)
-            return hist + jnp.einsum("rfb,rk->fbk", onehot, cc), None
+            return hist + jnp.einsum("rfb,rk->fbk", onehot, cc,
+                                     precision=prec), None
 
         hist0 = jnp.zeros((f, b, k), dtype=channels.dtype)
         hist, _ = lax.scan(step, hist0, (binned_c, channels_c))

@@ -1,0 +1,143 @@
+"""On-device prediction over struct-of-arrays trees.
+
+TPU-native re-design of the reference's prediction path
+(reference: Tree::Predict pointer-chasing threshold walk include/LightGBM/tree.h:134,
+GBDT::PredictRaw src/boosting/gbdt_prediction.cpp, OMP-over-rows Predictor
+src/application/predictor.hpp:244).
+
+Pointer-chasing is hostile to TPUs; instead rows are routed *level-synchronously*:
+internal nodes are created in monotonically increasing index order (children
+always have a larger node id than their parent — grower.py invariant), so a
+single in-order sweep ``k = 0..L-2`` over nodes routes every row with one
+feature-column gather per step. All rows move in lockstep; there is no
+data-dependent control flow, so the whole multi-tree prediction compiles to one
+XLA program (scan over trees) with zero host syncs.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class StackedTrees(NamedTuple):
+    """All trees of a model stacked along a leading T axis (pytree-of-arrays).
+
+    The reference keeps ``std::vector<std::unique_ptr<Tree>>`` (gbdt.h) and loops
+    trees serially per row; here the T axis is a ``lax.scan`` axis.
+    """
+    split_feature: jax.Array   # [T, L-1] i32
+    split_bin: jax.Array       # [T, L-1] i32
+    default_left: jax.Array    # [T, L-1] bool
+    left_child: jax.Array      # [T, L-1] i32
+    right_child: jax.Array     # [T, L-1] i32
+    leaf_value: jax.Array      # [T, L] f32
+    num_nodes: jax.Array       # [T] i32
+
+    @property
+    def num_trees(self) -> int:
+        return self.split_feature.shape[0]
+
+    @property
+    def max_nodes(self) -> int:
+        return self.split_feature.shape[1]
+
+
+@jax.jit
+def route_one_tree(
+    binned: jax.Array,        # [N, F] uint8/16
+    split_feature: jax.Array,  # [L-1]
+    split_bin: jax.Array,
+    default_left: jax.Array,
+    left_child: jax.Array,
+    right_child: jax.Array,
+    num_nodes: jax.Array,
+    nan_bin_arr: jax.Array,   # [F] i32
+    is_cat_arr: jax.Array,    # [F] bool
+) -> jax.Array:
+    """Return the leaf index [N] each row lands in for one tree."""
+    n = binned.shape[0]
+    max_nodes = split_feature.shape[0]
+    # rows start at node 0 when it exists, else directly at leaf 0 (~0 == -1)
+    start = jnp.where(num_nodes > 0, 0, -1)
+    cur = jnp.full((n,), start, jnp.int32)
+
+    def body(k, cur):
+        f = split_feature[k]
+        safe_f = jnp.maximum(f, 0)
+        t = split_bin[k]
+        dl = default_left[k]
+        fcol = jnp.take(binned, safe_f, axis=1).astype(jnp.int32)
+        nb = nan_bin_arr[safe_f]
+        iscat = is_cat_arr[safe_f]
+        go_left = jnp.where(iscat, fcol == t, (fcol <= t) | (dl & (fcol == nb)))
+        nxt = jnp.where(go_left, left_child[k], right_child[k])
+        return jnp.where(cur == k, nxt, cur)
+
+    cur = lax.fori_loop(0, max_nodes, body, cur)
+    # negative encoding: leaf = -(cur + 1)
+    return -(cur + 1)
+
+
+@functools.partial(jax.jit, static_argnames=("num_class",))
+def predict_raw(
+    binned: jax.Array,         # [N, F]
+    trees: StackedTrees,
+    nan_bin_arr: jax.Array,    # [F] i32
+    is_cat_arr: jax.Array,     # [F] bool
+    num_model_per_iteration: jax.Array,  # scalar i32 (K trees interleaved per iter)
+    num_class: int = 1,
+) -> jax.Array:
+    """Accumulate raw scores over all trees; returns [num_class, N].
+
+    Trees are stored iteration-major (reference: GBDT::models_ ordering — tree
+    ``t`` belongs to class ``t % num_class``), matching gbdt_prediction.cpp.
+    """
+    n = binned.shape[0]
+    t_total = trees.num_trees
+
+    def step(carry, tree_slice):
+        scores = carry
+        (sf, sb, dl, lc, rc, lv, nn, class_id) = tree_slice
+        leaf = route_one_tree(binned, sf, sb, dl, lc, rc, nn,
+                              nan_bin_arr, is_cat_arr)
+        add = lv[leaf]
+        scores = scores.at[class_id].add(add)
+        return scores, None
+
+    class_ids = (jnp.arange(t_total, dtype=jnp.int32)
+                 % jnp.maximum(num_model_per_iteration, 1))
+    scores0 = jnp.zeros((num_class, n), jnp.float32)
+    scores, _ = lax.scan(
+        step, scores0,
+        (trees.split_feature, trees.split_bin, trees.default_left,
+         trees.left_child, trees.right_child, trees.leaf_value,
+         trees.num_nodes, class_ids),
+    )
+    return scores
+
+
+@jax.jit
+def predict_leaf_index(
+    binned: jax.Array,
+    trees: StackedTrees,
+    nan_bin_arr: jax.Array,
+    is_cat_arr: jax.Array,
+) -> jax.Array:
+    """Per-tree leaf index for every row: [T, N] (reference: PredictLeafIndex)."""
+
+    def step(_, tree_slice):
+        (sf, sb, dl, lc, rc, nn) = tree_slice
+        leaf = route_one_tree(binned, sf, sb, dl, lc, rc, nn,
+                              nan_bin_arr, is_cat_arr)
+        return _, leaf
+
+    _, leaves = lax.scan(
+        step, 0,
+        (trees.split_feature, trees.split_bin, trees.default_left,
+         trees.left_child, trees.right_child, trees.num_nodes),
+    )
+    return leaves

@@ -1,0 +1,46 @@
+"""Per-leaf output renewal (quantile/median of residuals), on device.
+
+TPU-native re-design of the reference's RenewTreeOutput for L1/quantile/MAPE
+objectives (reference: RegressionL1loss::RenewTreeOutput
+src/objective/regression_objective.hpp:197-232, PercentileFun
+regression_objective.hpp:23-55; called from GBDT::TrainOneIter gbdt.cpp:409).
+
+The reference gathers each leaf's rows and nth-elements the residuals on CPU.
+Here: one global sort of residuals (XLA sort), then a sequential ``lax.map``
+over the (small, static) leaf axis computes each leaf's weighted quantile with a
+masked cumulative-sum scan — no per-leaf gather, no dynamic shapes.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.jit, static_argnames=("num_leaves", "alpha"))
+def renew_leaf_quantile(
+    residual: jax.Array,    # [N] f32 (label - current score)
+    weight: jax.Array,      # [N] f32: row weight * in-bag mask (0 excludes row)
+    row_leaf: jax.Array,    # [N] i32
+    num_leaves: int,
+    alpha: float,
+) -> jax.Array:             # [L] f32 renewed leaf outputs
+    order = jnp.argsort(residual)
+    r_s = residual[order]
+    leaf_s = row_leaf[order]
+    w_s = weight[order]
+
+    def one_leaf(l):
+        m = jnp.where(leaf_s == l, w_s, 0.0)
+        cw = jnp.cumsum(m)
+        total = cw[-1]
+        target = alpha * total
+        # first row (in residual order) where cumulative weight crosses target
+        ok = (cw >= target) & (m > 0.0)
+        idx = jnp.argmax(ok)
+        val = r_s[idx]
+        return jnp.where(total > 0.0, val, 0.0)
+
+    return lax.map(one_leaf, jnp.arange(num_leaves, dtype=jnp.int32))

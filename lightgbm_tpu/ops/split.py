@@ -125,7 +125,6 @@ def best_split(
         valid = (
             extra_valid
             & feat_mask[:, None]
-            & (t_iota < num_bins[:, None] - 1)
             & (lc >= p.min_data_in_leaf)
             & (rc >= p.min_data_in_leaf)
             & (lh >= p.min_sum_hessian_in_leaf)
@@ -134,14 +133,13 @@ def best_split(
         gain = leaf_gain(lg, lh, p) + leaf_gain(rg, rh, p) - gain_shift
         return jnp.where(valid, gain, _NEG_INF)
 
-    # categorical one-hot splits may use any bin (incl. last) as "left" category,
-    # but for numerical the last bin can never be a threshold (handled by the
-    # t < num_bins-1 mask; for cat we allow t <= num_bins-1).
-    cat_tmask = jnp.where(is_cat_b, t_iota < num_bins[:, None], t_iota < num_bins[:, None] - 1)
-    score1 = dir_score(left_g1, left_h1, left_c1, cat_tmask | (~is_cat_b))
-    # restrict direction-1 numerical mask properly
-    score1 = jnp.where(is_cat_b | (t_iota < num_bins[:, None] - 1), score1, _NEG_INF)
-    dir2_ok = (~is_cat_b) & has_nan_bin[:, None] & below
+    # categorical one-hot splits may use any bin (incl. last) as the "left"
+    # category; numerical thresholds must leave the last bin on the right
+    cat_tmask = jnp.where(is_cat_b, t_iota < num_bins[:, None],
+                          t_iota < num_bins[:, None] - 1)
+    score1 = dir_score(left_g1, left_h1, left_c1, cat_tmask)
+    dir2_ok = (~is_cat_b) & has_nan_bin[:, None] & below \
+        & (t_iota < num_bins[:, None] - 1)
     score2 = dir_score(left_g2, left_h2, left_c2, dir2_ok)
 
     scores = jnp.stack([score1, score2], axis=-1)            # [F, B, 2]

@@ -1,0 +1,36 @@
+"""Test configuration: force CPU backend with 8 virtual devices.
+
+Mirrors the reference's test strategy (SURVEY.md §4): correctness tests run
+against a host build; distributed tests simulate a cluster on one machine
+(reference: tests/distributed/_test_distributed.py spawns N local CLI
+processes). Here the 8 virtual XLA CPU devices stand in for an 8-chip TPU
+slice so sharding/collective paths compile and execute for real.
+"""
+import os
+
+# must happen before any backend initialization; override any ambient platform
+# (the dev box exposes the TPU via an "axon" platform whose sitecustomize sets
+# jax.config directly — the env var alone is not enough, so force the config)
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu"
+
+# persistent compile cache: the suite is compile-dominated on CPU
+_cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

@@ -1,0 +1,192 @@
+"""Dataset construction + Booster lifecycle + model IO tests.
+
+Mirrors the reference's tests/python_package_test/test_basic.py (Dataset
+paths, field get/set, save/load equality) and the C++ serialization
+round-trip test (tests/cpp_tests/test_serialize.cpp).
+"""
+import numpy as np
+import pytest
+from sklearn.metrics import roc_auc_score
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.binning import find_bin_numerical, find_bin_categorical
+
+from utils import FAST_PARAMS, binary_data, multiclass_data, \
+    train_test_split_simple
+
+
+def _params(**kw):
+    p = dict(FAST_PARAMS)
+    p.update(kw)
+    return p
+
+
+class TestBinning:
+    def test_simple_numerical(self):
+        vals = np.concatenate([np.zeros(50), np.arange(1, 101)])
+        m = find_bin_numerical(vals, len(vals), max_bin=16)
+        bins = m.value_to_bin(vals)
+        assert bins.max() < m.num_bins
+        # zero gets its own bin
+        zero_bin = m.value_to_bin(np.array([0.0]))[0]
+        small_bin = m.value_to_bin(np.array([1.0]))[0]
+        assert zero_bin != small_bin
+        # monotonic: larger values -> same or larger bins
+        v = np.sort(vals)
+        b = m.value_to_bin(v)
+        assert np.all(np.diff(b) >= 0)
+
+    def test_nan_gets_last_bin(self):
+        vals = np.concatenate([np.arange(100.0), [np.nan] * 10])
+        m = find_bin_numerical(vals, len(vals), max_bin=16)
+        assert m.missing_type == 2  # MISSING_NAN
+        nb = m.value_to_bin(np.array([np.nan]))[0]
+        assert nb == m.num_bins - 1
+
+    def test_low_cardinality_exact(self):
+        vals = np.repeat([1.0, 2.0, 3.0], 50)
+        m = find_bin_numerical(vals, len(vals), max_bin=16, min_data_in_bin=3)
+        b = m.value_to_bin(np.array([1.0, 2.0, 3.0]))
+        assert len(set(b.tolist())) == 3  # each value its own bin
+
+    def test_categorical(self):
+        vals = np.array([3.0] * 50 + [7.0] * 30 + [1.0] * 20)
+        m = find_bin_categorical(vals, max_bin=16)
+        b = m.value_to_bin(np.array([3.0, 7.0, 1.0, 99.0]))
+        assert b[0] == 1  # most frequent first
+        assert b[3] == 0  # unseen -> bin 0
+
+    def test_trivial_constant_feature(self):
+        m = find_bin_numerical(np.full(100, 5.0), 100, max_bin=16)
+        # one distinct value -> still has a real bin structure or is trivial;
+        # binning must not crash and must map consistently
+        b = m.value_to_bin(np.array([5.0, 5.0]))
+        assert b[0] == b[1]
+
+
+class TestDataset:
+    def test_fields(self):
+        X, y = binary_data()
+        w = np.random.RandomState(0).rand(len(y))
+        ds = lgb.Dataset(X, label=y, weight=w)
+        ds.construct()
+        np.testing.assert_allclose(ds.get_label(), y, rtol=1e-6)
+        np.testing.assert_allclose(ds.get_weight(), w, rtol=1e-6)
+        assert ds.num_data() == len(y)
+        assert ds.num_feature() == X.shape[1]
+
+    def test_valid_shares_mappers(self):
+        X, y = binary_data()
+        ds = lgb.Dataset(X[:200], label=y[:200])
+        dv = ds.create_valid(X[200:], label=y[200:])
+        dv.construct()
+        assert dv._inner.mappers is ds._inner.mappers
+
+    def test_feature_names(self):
+        X, y = binary_data()
+        names = [f"feat{i}" for i in range(X.shape[1])]
+        ds = lgb.Dataset(X, label=y, feature_name=names)
+        assert ds.get_feature_name() == names
+
+    def test_group_validation(self):
+        X, y = binary_data()
+        ds = lgb.Dataset(X, label=y, group=[300, 301])  # sums to 601 != 600
+        with pytest.raises(ValueError):
+            ds.construct()
+
+
+class TestModelIO:
+    def test_save_load_roundtrip(self, tmp_path):
+        X, y = binary_data()
+        Xtr, ytr, Xte, yte = train_test_split_simple(X, y)
+        bst = lgb.train(_params(objective="binary"),
+                        lgb.Dataset(Xtr, label=ytr), 20)
+        p1 = bst.predict(Xte)
+
+        path = tmp_path / "model.txt"
+        bst.save_model(str(path))
+        bst2 = lgb.Booster(model_file=str(path))
+        p2 = bst2.predict(Xte)
+        np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+
+    def test_roundtrip_with_nans(self, tmp_path):
+        rng = np.random.RandomState(7)
+        X, y = binary_data()
+        X = X.copy()
+        X[rng.rand(*X.shape) < 0.2] = np.nan
+        bst = lgb.train(_params(objective="binary"), lgb.Dataset(X, label=y), 15)
+        s = bst.model_to_string()
+        bst2 = lgb.Booster(model_str=s)
+        np.testing.assert_allclose(bst.predict(X), bst2.predict(X),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_roundtrip_multiclass(self):
+        X, y = multiclass_data()
+        bst = lgb.train(_params(objective="multiclass", num_class=3),
+                        lgb.Dataset(X, label=y), 10)
+        bst2 = lgb.Booster(model_str=bst.model_to_string())
+        np.testing.assert_allclose(bst.predict(X), bst2.predict(X),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_roundtrip_categorical(self):
+        rng = np.random.RandomState(3)
+        n = 400
+        cat = rng.randint(0, 6, n).astype(np.float64)
+        y = (cat >= 3).astype(np.float64)
+        X = np.stack([cat, rng.randn(n)], axis=1)
+        bst = lgb.train(_params(objective="binary", min_data_in_leaf=2),
+                        lgb.Dataset(X, label=y, categorical_feature=[0]), 10)
+        bst2 = lgb.Booster(model_str=bst.model_to_string())
+        np.testing.assert_allclose(bst.predict(X), bst2.predict(X),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_dump_model_json(self):
+        X, y = binary_data()
+        bst = lgb.train(_params(objective="binary"), lgb.Dataset(X, label=y), 5)
+        d = bst.dump_model()
+        assert d["num_class"] == 1
+        assert len(d["tree_info"]) == 5
+        root = d["tree_info"][0]["tree_structure"]
+        assert "split_feature" in root or "leaf_value" in root
+
+    def test_model_text_format_headers(self):
+        X, y = binary_data()
+        bst = lgb.train(_params(objective="binary"), lgb.Dataset(X, label=y), 3)
+        s = bst.model_to_string()
+        assert s.startswith("tree\n")
+        assert "version=v4" in s
+        assert "objective=binary" in s
+        assert "Tree=0" in s and "Tree=2" in s
+        assert "end of trees" in s
+
+
+class TestBooster:
+    def test_feature_importance(self):
+        X, y = binary_data()
+        bst = lgb.train(_params(objective="binary"), lgb.Dataset(X, label=y), 10)
+        imp_split = bst.feature_importance("split")
+        imp_gain = bst.feature_importance("gain")
+        assert imp_split.sum() > 0
+        assert imp_gain.sum() > 0
+        assert len(imp_split) == X.shape[1]
+
+    def test_pred_leaf(self):
+        X, y = binary_data()
+        bst = lgb.train(_params(objective="binary"), lgb.Dataset(X, label=y), 7)
+        leaves = bst.predict(X, pred_leaf=True)
+        assert leaves.shape == (len(y), 7)
+        assert leaves.min() >= 0
+
+    def test_raw_score(self):
+        X, y = binary_data()
+        bst = lgb.train(_params(objective="binary"), lgb.Dataset(X, label=y), 10)
+        raw = bst.predict(X, raw_score=True)
+        p = bst.predict(X)
+        np.testing.assert_allclose(1 / (1 + np.exp(-raw)), p, rtol=1e-5)
+
+    def test_num_trees(self):
+        X, y = binary_data()
+        bst = lgb.train(_params(objective="binary"), lgb.Dataset(X, label=y), 8)
+        assert bst.num_trees() == 8
+        assert bst.current_iteration() == 8
+        assert bst.num_model_per_iteration() == 1

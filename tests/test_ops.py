@@ -1,0 +1,142 @@
+"""Unit tests for the device ops: histogram, split finding, routing.
+
+Mirrors the reference's kernel-level checks (the CUDA learner is validated
+end-to-end in test_engine.py there; here the TPU ops get direct golden tests
+against numpy references).
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.ops.histogram import histogram
+from lightgbm_tpu.ops.split import SplitParams, best_split, leaf_output
+from lightgbm_tpu.ops.grower import GrowerParams, grow_tree
+from lightgbm_tpu.ops.predict import route_one_tree
+
+
+def _np_histogram(binned, channels, num_bins):
+    n, f = binned.shape
+    k = channels.shape[1]
+    out = np.zeros((f, num_bins, k), np.float64)
+    for j in range(f):
+        for b in range(num_bins):
+            m = binned[:, j] == b
+            out[j, b] = channels[m].sum(axis=0)
+    return out
+
+
+def test_histogram_matches_numpy(rng):
+    n, f, b = 500, 7, 16
+    binned = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+    channels = rng.randn(n, 3).astype(np.float32)
+    got = np.asarray(histogram(jnp.asarray(binned), jnp.asarray(channels), b))
+    want = _np_histogram(binned, channels, b)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_histogram_chunked_path(rng):
+    # force the lax.scan chunked path with a large-ish row count
+    n, f, b = 5000, 40, 64
+    binned = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+    channels = rng.randn(n, 2).astype(np.float32)
+    got = np.asarray(histogram(jnp.asarray(binned), jnp.asarray(channels), b))
+    want = _np_histogram(binned, channels, b)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def _np_best_split_numeric(hist, pg, ph, pc, p: SplitParams):
+    """Exhaustive scan over all (feature, bin) numeric thresholds (no NaN)."""
+    f, b, _ = hist.shape
+    best = (-1e30, -1, -1)
+    for j in range(f):
+        cg = ch = cc = 0.0
+        for t in range(b - 1):
+            cg += hist[j, t, 0]
+            ch += hist[j, t, 1]
+            cc += hist[j, t, 2]
+            rg, rh, rc = pg - cg, ph - ch, pc - cc
+            if cc < p.min_data_in_leaf or rc < p.min_data_in_leaf:
+                continue
+            if ch < p.min_sum_hessian_in_leaf or rh < p.min_sum_hessian_in_leaf:
+                continue
+            gain = cg * cg / (ch + p.lambda_l2 + 1e-15) \
+                + rg * rg / (rh + p.lambda_l2 + 1e-15) \
+                - pg * pg / (ph + p.lambda_l2 + 1e-15)
+            if gain > best[0]:
+                best = (gain, j, t)
+    return best
+
+
+def test_best_split_matches_exhaustive(rng):
+    f, b = 5, 16
+    hist = np.abs(rng.randn(f, b, 3)).astype(np.float32)
+    hist[:, :, 0] = rng.randn(f, b)  # gradients signed
+    hist[:, :, 2] = rng.randint(1, 20, size=(f, b))  # counts
+    pg = float(hist[0, :, 0].sum())
+    ph = float(hist[0, :, 1].sum())
+    pc = float(hist[0, :, 2].sum())
+    # make parent sums consistent: use feature 0 as the truth for all features
+    for j in range(1, f):
+        scale_g = pg / max(hist[j, :, 0].sum(), 1e-9)
+        hist[j, :, 0] *= scale_g
+        hist[j, :, 1] *= ph / max(hist[j, :, 1].sum(), 1e-9)
+        hist[j, :, 2] *= pc / max(hist[j, :, 2].sum(), 1e-9)
+
+    p = SplitParams(min_data_in_leaf=1.0, min_sum_hessian_in_leaf=1e-3)
+    num_bins = jnp.full((f,), b, jnp.int32)
+    nan_bin = jnp.full((f,), b - 1, jnp.int32)
+    has_nan = jnp.zeros((f,), bool)
+    is_cat = jnp.zeros((f,), bool)
+    mask = jnp.ones((f,), bool)
+    sp = best_split(jnp.asarray(hist), pg, ph, pc, num_bins, nan_bin,
+                    has_nan, is_cat, mask, p)
+    want_gain, want_f, want_t = _np_best_split_numeric(hist, pg, ph, pc, p)
+    got_gain = float(sp.gain)
+    # gains measured relative to different baselines (shift); compare choice
+    assert int(sp.feature) == want_f
+    assert int(sp.bin) == want_t
+
+
+def test_grow_tree_pure_feature(rng):
+    """A single perfectly separating feature should produce a one-split tree
+    routing rows exactly."""
+    n = 400
+    x = (np.arange(n) % 2).astype(np.uint8)  # bins 0/1
+    binned = np.stack([x, rng.randint(0, 4, n).astype(np.uint8)], axis=1)
+    grad = np.where(x == 0, 1.0, -1.0).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    params = GrowerParams(num_leaves=4, num_bins=8, min_data_in_leaf=1.0)
+    tree, row_leaf = grow_tree(
+        jnp.asarray(binned), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.ones(n, jnp.float32),
+        jnp.asarray([2, 4], jnp.int32), jnp.asarray([0, 0], jnp.int32),
+        jnp.zeros(2, bool), jnp.zeros(2, bool), jnp.ones(2, bool), params)
+    assert int(tree.num_nodes) >= 1
+    assert int(tree.split_feature[0]) == 0
+    # leaf values must have opposite signs matching -grad direction
+    rl = np.asarray(row_leaf)
+    lv = np.asarray(tree.leaf_value)
+    vals = lv[rl]
+    assert np.all(vals[x == 0] < 0)
+    assert np.all(vals[x == 1] > 0)
+
+
+def test_route_matches_training_partition(rng):
+    n, f, b = 600, 6, 16
+    binned = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+    grad = rng.randn(n).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    params = GrowerParams(num_leaves=8, num_bins=b, min_data_in_leaf=5.0)
+    num_bins = jnp.full((f,), b, jnp.int32)
+    nan_bin = jnp.full((f,), b - 1, jnp.int32)
+    has_nan = jnp.zeros((f,), bool)
+    is_cat = jnp.zeros((f,), bool)
+    tree, row_leaf = grow_tree(
+        jnp.asarray(binned), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.ones(n, jnp.float32), num_bins, nan_bin, has_nan, is_cat,
+        jnp.ones(f, bool), params)
+    routed = route_one_tree(
+        jnp.asarray(binned), tree.split_feature, tree.split_bin,
+        tree.default_left, tree.left_child, tree.right_child, tree.num_nodes,
+        nan_bin, is_cat)
+    np.testing.assert_array_equal(np.asarray(routed), np.asarray(row_leaf))

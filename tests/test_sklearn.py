@@ -1,0 +1,90 @@
+"""sklearn-wrapper tests (reference: tests/python_package_test/test_sklearn.py)."""
+import numpy as np
+from sklearn.metrics import accuracy_score, r2_score, roc_auc_score
+
+import lightgbm_tpu as lgb
+
+from utils import (FAST_PARAMS, binary_data, make_ranking, multiclass_data,
+                   regression_data, train_test_split_simple)
+
+
+def test_regressor():
+    X, y = regression_data()
+    Xtr, ytr, Xte, yte = train_test_split_simple(X, y)
+    model = lgb.LGBMRegressor(n_estimators=50, **FAST_PARAMS)
+    model.fit(Xtr, ytr)
+    assert r2_score(yte, model.predict(Xte)) > 0.7
+    assert model.n_features_ == X.shape[1]
+    assert model.feature_importances_.sum() > 0
+
+
+def test_classifier_binary():
+    X, y = binary_data()
+    Xtr, ytr, Xte, yte = train_test_split_simple(X, y)
+    model = lgb.LGBMClassifier(n_estimators=40, **FAST_PARAMS)
+    model.fit(Xtr, ytr)
+    proba = model.predict_proba(Xte)
+    assert proba.shape == (len(yte), 2)
+    assert roc_auc_score(yte, proba[:, 1]) > 0.93
+    pred = model.predict(Xte)
+    assert accuracy_score(yte, pred) > 0.85
+    assert set(model.classes_) == {0.0, 1.0}
+
+
+def test_classifier_multiclass():
+    X, y = multiclass_data()
+    Xtr, ytr, Xte, yte = train_test_split_simple(X, y)
+    model = lgb.LGBMClassifier(n_estimators=25, **FAST_PARAMS)
+    model.fit(Xtr, ytr)
+    proba = model.predict_proba(Xte)
+    assert proba.shape == (len(yte), 3)
+    assert accuracy_score(yte, model.predict(Xte)) > 0.85
+
+
+def test_classifier_string_labels():
+    X, y = binary_data()
+    ystr = np.where(y > 0, "pos", "neg")
+    model = lgb.LGBMClassifier(n_estimators=10, **FAST_PARAMS)
+    model.fit(X, ystr)
+    pred = model.predict(X)
+    assert set(np.unique(pred)) <= {"pos", "neg"}
+
+
+def test_ranker():
+    X, y, group = make_ranking()
+    model = lgb.LGBMRanker(n_estimators=20, min_child_samples=2, **FAST_PARAMS)
+    model.fit(X, y, group=group)
+    scores = model.predict(X)
+    assert scores.shape == (len(y),)
+    # higher-relevance docs should get higher scores on average
+    assert scores[y == 2].mean() > scores[y == 0].mean()
+
+
+def test_eval_set_and_early_stopping():
+    X, y = binary_data()
+    Xtr, ytr, Xte, yte = train_test_split_simple(X, y)
+    model = lgb.LGBMClassifier(n_estimators=100, **FAST_PARAMS)
+    model.fit(Xtr, ytr, eval_set=[(Xte, yte)],
+              callbacks=[lgb.early_stopping(5, verbose=False)])
+    assert model.best_iteration_ > 0
+    assert "valid_0" in model.evals_result_
+
+
+def test_get_set_params():
+    model = lgb.LGBMRegressor(n_estimators=5, num_leaves=7)
+    params = model.get_params()
+    assert params["n_estimators"] == 5
+    assert params["num_leaves"] == 7
+    model.set_params(num_leaves=15)
+    assert model.get_params()["num_leaves"] == 15
+
+
+def test_class_weight_balanced():
+    X, y = binary_data()
+    # unbalance the data
+    keep = np.where((y == 0) | (np.random.RandomState(0).rand(len(y)) < 0.2))[0]
+    Xu, yu = X[keep], y[keep]
+    model = lgb.LGBMClassifier(n_estimators=20, class_weight="balanced",
+                               **FAST_PARAMS)
+    model.fit(Xu, yu)
+    assert roc_auc_score(yu, model.predict_proba(Xu)[:, 1]) > 0.9
