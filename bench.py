@@ -1,0 +1,102 @@
+"""Benchmark: boosting iterations/sec on a Higgs-like binary problem, one chip.
+
+Reference baseline (BASELINE.md): LightGBM CPU trains Higgs (10.5M rows x 28
+features, num_leaves=255, 500 iters) at ~3.84 iters/s on 2x Xeon E5-2690v4
+(docs/Experiments.rst:113). This bench runs the same shape of problem —
+binary logloss, 28 dense float features — on the TPU chip the driver exposes.
+
+Round-1 scale: BENCH_ROWS=1e6, num_leaves=31, max_bin=63 (the GPU-doc speed
+setting, docs/GPU-Performance.rst). The scale knobs exist so later rounds can
+push to the full 10.5M x 255-leaf config as the kernel work lands.
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROWS = int(float(os.environ.get("BENCH_ROWS", 1_000_000)))
+FEATURES = int(os.environ.get("BENCH_FEATURES", 28))
+NUM_LEAVES = int(os.environ.get("BENCH_NUM_LEAVES", 31))
+MAX_BIN = int(os.environ.get("BENCH_MAX_BIN", 63))
+ITERS = int(os.environ.get("BENCH_ITERS", 30))
+WARMUP = int(os.environ.get("BENCH_WARMUP", 3))
+BASELINE_ITERS_PER_SEC = 3.84  # Higgs-10.5M CPU, docs/Experiments.rst:113
+
+
+def make_higgs_like(n, f, seed=7):
+    """Dense float features + nonlinear binary target (Higgs-shaped)."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    w1 = rng.randn(f) / np.sqrt(f)
+    w2 = rng.randn(f) / np.sqrt(f)
+    logits = X @ w1 + 0.7 * np.abs(X @ w2) - 0.4 + 0.5 * rng.randn(n)
+    y = (logits > 0).astype(np.float64)
+    return X, y
+
+
+def main():
+    import lightgbm_tpu as lgb
+    import jax
+
+    dev = jax.devices()[0]
+    X, y = make_higgs_like(ROWS, FEATURES)
+
+    params = {
+        "objective": "binary",
+        "metric": "auc",
+        "num_leaves": NUM_LEAVES,
+        "max_bin": MAX_BIN,
+        "learning_rate": 0.1,
+        "min_data_in_leaf": 100,
+        "verbosity": -1,
+        # bench runs sync-free; one stop check at the end
+        "stop_check_freq": 10_000,
+    }
+    t0 = time.time()
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    construct_s = time.time() - t0
+
+    bst = lgb.Booster(params, ds)
+    t0 = time.time()
+    for _ in range(WARMUP):
+        bst.update()
+    bst._gbdt._flush_trees()
+    warmup_s = time.time() - t0
+
+    t0 = time.time()
+    for _ in range(ITERS):
+        bst.update()
+    bst._gbdt._flush_trees()  # materialize: forces all device work to finish
+    train_s = time.time() - t0
+
+    iters_per_sec = ITERS / train_s
+    # AUC sanity on the training data (separability check, not a quality bench)
+    auc = None
+    try:
+        from sklearn.metrics import roc_auc_score
+        sample = slice(0, min(ROWS, 200_000))
+        auc = float(roc_auc_score(y[sample], bst.predict(X[sample])))
+    except Exception:
+        pass
+
+    sys.stderr.write(
+        f"[bench] device={dev} rows={ROWS} features={FEATURES} "
+        f"leaves={NUM_LEAVES} bins={MAX_BIN}\n"
+        f"[bench] construct={construct_s:.1f}s warmup({WARMUP})={warmup_s:.1f}s "
+        f"train({ITERS})={train_s:.1f}s auc={auc}\n")
+    print(json.dumps({
+        "metric": f"synthetic-higgs{ROWS // 1_000_000}M-"
+                  f"{NUM_LEAVES}leaf boosting throughput",
+        "value": round(iters_per_sec, 3),
+        "unit": "iters/sec/chip",
+        "vs_baseline": round(iters_per_sec / BASELINE_ITERS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

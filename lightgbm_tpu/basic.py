@@ -253,6 +253,22 @@ class Booster:
         """(reference: Booster.add_valid, basic.py:3963)"""
         if not isinstance(data, Dataset):
             raise TypeError("Validation data should be a Dataset instance")
+        # validation data MUST share the training BinMappers or tree split
+        # bins would be meaningless on it (reference: Dataset._set_reference,
+        # basic.py — train() rebinds valid sets to the train set silently)
+        if data.reference is not self.train_set:
+            if data._inner is not None \
+                    and data._inner.mappers is self.train_set._inner.mappers:
+                pass  # already constructed against the right mappers
+            elif data.data is None and data._inner is not None:
+                raise ValueError(
+                    "validation Dataset was constructed without "
+                    "reference=train_set and its raw data was freed; "
+                    "create it with train_set.create_valid(...) or "
+                    "free_raw_data=False")
+            else:
+                data.reference = self.train_set
+                data._inner = None  # force re-binning with train mappers
         data.construct()
         metrics = create_metrics(self.config.metric, self.config)
         self._gbdt.add_valid(data._inner, name, metrics)
@@ -399,7 +415,8 @@ class Booster:
 
     # -- introspection -------------------------------------------------------
     def num_trees(self) -> int:
-        return len(self._gbdt.models)
+        g = self._gbdt
+        return g.num_total_trees if hasattr(g, "num_total_trees") else len(g.models)
 
     def current_iteration(self) -> int:
         return self._gbdt.current_iteration

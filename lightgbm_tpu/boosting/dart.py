@@ -63,6 +63,10 @@ class DART(GBDT):
         return drop
 
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        # dropping needs host trees every iteration; a deferred no-split stop
+        # detected here must also end training
+        if self._flush_trees():
+            return True
         k_trees = self.num_tree_per_iteration
         drop_index = self._select_drop()
         k = float(len(drop_index))

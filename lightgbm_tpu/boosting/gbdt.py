@@ -98,6 +98,28 @@ def stack_trees(models: Sequence[HostTree], max_nodes: int, max_leaves: int
     )
 
 
+def _pad_metadata(md, n_padded: int):
+    """Shallow metadata clone with label/weight zero-padded to the sharded
+    row count (padding rows carry zero weight and are masked out of every
+    histogram/gradient by the valid-row mask)."""
+    from ..io.dataset import Metadata
+    out = Metadata(n_padded)
+    if md.label is not None:
+        out.label = np.pad(np.asarray(md.label), (0, n_padded - len(md.label)))
+    # padding rows get explicit zero weight so objective label statistics
+    # (boost_from_average, class balance) never count them
+    n_real = len(md.label) if md.label is not None else n_padded
+    w = np.ones(n_padded, np.float32) if md.weight is None \
+        else np.pad(np.asarray(md.weight, np.float32), (0, n_padded - n_real))
+    w[n_real:] = 0.0
+    out.weight = w
+    out.init_score = md.init_score
+    out.group = md.group
+    out.query_boundaries = md.query_boundaries
+    out.position = md.position
+    return out
+
+
 def _init_score_matrix(init_score, k: int, n: int) -> np.ndarray:
     """Normalize user init_score into [K, N] f32.
 
@@ -132,15 +154,31 @@ class _ValidSet:
     """Cached raw scores for one validation set
     (reference: ScoreUpdater per valid set, gbdt.cpp valid_score_updater_)."""
 
-    def __init__(self, dataset: BinnedDataset, num_class: int, name: str):
+    def __init__(self, dataset: BinnedDataset, num_class: int, name: str,
+                 mesh=None):
         self.dataset = dataset
         self.name = name
-        self.binned = jnp.asarray(dataset.binned)
-        n = dataset.num_data
-        self.score = jnp.zeros((num_class, n), jnp.float32)
+        self.n_real = dataset.num_data
+        binned_np = dataset.binned
+        pad = 0
+        if mesh is not None:
+            from ..parallel.mesh import (class_row_sharding, pad_rows,
+                                         row_sharding_2d)
+            pad = pad_rows(self.n_real, len(mesh.devices.ravel()))
+            if pad:
+                binned_np = np.pad(binned_np, ((0, pad), (0, 0)))
+            self.binned = jax.device_put(binned_np, row_sharding_2d(mesh))
+        else:
+            self.binned = jnp.asarray(binned_np)
+        n = self.n_real + pad
+        score0 = np.zeros((num_class, n), np.float32)
         if dataset.metadata is not None and dataset.metadata.init_score is not None:
-            self.score = self.score + _init_score_matrix(
-                dataset.metadata.init_score, num_class, n)
+            score0[:, : self.n_real] += _init_score_matrix(
+                dataset.metadata.init_score, num_class, self.n_real)
+        if mesh is not None:
+            self.score = jax.device_put(score0, class_row_sharding(mesh))
+        else:
+            self.score = jnp.asarray(score0)
         self.metrics: List[Metric] = []
 
 
@@ -161,6 +199,10 @@ class GBDT:
         self.objective = objective
         self.train_set = train_set
         self.models: List[HostTree] = []
+        self._dev_trees: List[Tuple[TreeArrays, float]] = []
+        # batched stop-check / host-materialization cadence (TPU extension;
+        # 1 == reference behavior of checking every iteration)
+        self.stop_check_freq = max(1, int(config.get("stop_check_freq", 1) or 1))
         self.iter_ = 0
         self.learning_rate = float(config.get("learning_rate", 0.1))
         # per-iteration shrinkage; DART re-computes this each iter
@@ -184,8 +226,33 @@ class GBDT:
     # -- training setup ------------------------------------------------------
     def _setup_train(self, train_set: BinnedDataset) -> None:
         cfg = self.config
-        self.num_data = train_set.num_data
-        self.binned = jnp.asarray(train_set.binned)
+        from ..parallel.mesh import (class_row_sharding, make_mesh, pad_rows,
+                                     row_sharding, row_sharding_2d)
+        tree_learner = str(cfg.get("tree_learner", "serial")).lower()
+        distributed = tree_learner in (
+            "data", "voting", "feature", "data_parallel", "voting_parallel",
+            "feature_parallel") and len(jax.devices()) > 1
+        self.mesh = make_mesh() if distributed else None
+        self._n_real = train_set.num_data
+        pad = pad_rows(self._n_real, len(self.mesh.devices.ravel())) \
+            if self.mesh else 0
+        self._pad = pad
+        self.num_data = self._n_real + pad
+
+        binned_np = train_set.binned
+        if pad:
+            binned_np = np.pad(binned_np, ((0, pad), (0, 0)))
+        if self.mesh is not None:
+            # rows sharded over the mesh: the reference's row partitioning
+            # across machines (data_parallel_tree_learner.cpp BeforeTrain)
+            self.binned = jax.device_put(binned_np, row_sharding_2d(self.mesh))
+            ones = np.ones(self.num_data, np.float32)
+            if pad:
+                ones[self._n_real:] = 0.0
+            self._valid_row_mask = jax.device_put(ones, row_sharding(self.mesh))
+        else:
+            self.binned = jnp.asarray(binned_np)
+            self._valid_row_mask = None
         self.num_bins_arr = jnp.asarray(train_set.feature_num_bins())
         self.nan_bin_arr = jnp.asarray(train_set.feature_nan_bins())
         self.has_nan_arr = jnp.asarray(
@@ -206,26 +273,33 @@ class GBDT:
             min_gain_to_split=float(cfg.get("min_gain_to_split", 0.0)),
             max_delta_step=float(cfg.get("max_delta_step", 0.0)),
         )
+        md = train_set.metadata if not pad else _pad_metadata(
+            train_set.metadata, self.num_data)
         if self.objective is not None:
-            self.objective.init(train_set.metadata, self.num_data)
+            self.objective.init(md, self.num_data)
 
         k, n = self.num_tree_per_iteration, self.num_data
-        self.train_score = jnp.zeros((k, n), jnp.float32)
+        score0 = np.zeros((k, n), np.float32)
         if train_set.metadata.init_score is not None:
-            init = _init_score_matrix(train_set.metadata.init_score, k, n)
-            self.train_score = self.train_score + init
+            init = _init_score_matrix(
+                train_set.metadata.init_score, k, self._n_real)
+            score0[:, : self._n_real] += init
             self._has_init_score = True
         else:
             self._has_init_score = False
+        if self.mesh is not None:
+            self.train_score = jax.device_put(
+                score0, class_row_sharding(self.mesh))
+        else:
+            self.train_score = jnp.asarray(score0)
 
-        self.sample_strategy = create_sample_strategy(
-            cfg, self.num_data, train_set.metadata)
+        self.sample_strategy = create_sample_strategy(cfg, self.num_data, md)
         self.feature_fraction = float(cfg.get("feature_fraction", 1.0))
         self._feat_rng = np.random.RandomState(
             int(cfg.get("feature_fraction_seed", 2)))
         self.row_weight = (
-            jnp.asarray(train_set.metadata.weight, jnp.float32)
-            if train_set.metadata.weight is not None else None)
+            jnp.asarray(md.weight, jnp.float32)
+            if md.weight is not None else None)
         self._grad_fn = None
         self._step_fn = None
 
@@ -258,8 +332,12 @@ class GBDT:
                 live = jnp.arange(max_leaves) < tree.num_leaves
                 tree = tree._replace(
                     leaf_value=jnp.where(live, renewed, tree.leaf_value))
+            # a no-split tree contributes nothing (reference: AsConstantTree 0,
+            # gbdt.cpp:433) — zeroing here lets the host defer its stop check
+            # without score corruption (no per-iteration device->host sync)
+            lv = jnp.where(tree.num_nodes > 0, tree.leaf_value, 0.0)
             tree = tree._replace(
-                leaf_value=tree.leaf_value * shrinkage,
+                leaf_value=lv * shrinkage,
                 internal_value=tree.internal_value * shrinkage)
             new_score = score_k + tree.leaf_value[row_leaf]
             return tree, row_leaf, new_score
@@ -268,7 +346,8 @@ class GBDT:
 
     def add_valid(self, valid_set: BinnedDataset, name: str,
                   metrics: Sequence[Metric]) -> None:
-        vs = _ValidSet(valid_set, self.num_tree_per_iteration, name)
+        vs = _ValidSet(valid_set, self.num_tree_per_iteration, name,
+                       mesh=self.mesh)
         for m in metrics:
             m.init(valid_set.metadata, valid_set.num_data)
         vs.metrics = list(metrics)
@@ -276,13 +355,13 @@ class GBDT:
 
     def set_train_metrics(self, metrics: Sequence[Metric]) -> None:
         for m in metrics:
-            m.init(self.train_set.metadata, self.num_data)
+            m.init(self.train_set.metadata, self._n_real)
         self.train_metrics = list(metrics)
 
     # -- one boosting iteration ---------------------------------------------
     def _boost_from_average(self) -> None:
         """(reference: GBDT::BoostFromAverage, gbdt.cpp:319)"""
-        if not self.models and not self._has_init_score \
+        if self.num_total_trees == 0 and not self._has_init_score \
                 and self.objective is not None \
                 and bool(self.config.get("boost_from_average", True)):
             for k in range(self.num_tree_per_iteration):
@@ -330,17 +409,26 @@ class GBDT:
             self._boost_from_average()
             grad, hess = self._gradients()
         else:
-            grad = jnp.asarray(np.asarray(gradients, np.float32)).reshape(k, n)
-            hess = jnp.asarray(np.asarray(hessians, np.float32)).reshape(k, n)
+            g_np = np.asarray(gradients, np.float32).reshape(k, self._n_real)
+            h_np = np.asarray(hessians, np.float32).reshape(k, self._n_real)
+            if self._pad:
+                g_np = np.pad(g_np, ((0, 0), (0, self._pad)))
+                h_np = np.pad(h_np, ((0, 0), (0, self._pad)))
+            grad, hess = jnp.asarray(g_np), jnp.asarray(h_np)
 
+        if self._valid_row_mask is not None:
+            # zero padding-row gradients before GOSS ranks them
+            grad = grad * self._valid_row_mask[None, :]
+            hess = hess * self._valid_row_mask[None, :]
         mask = self.sample_strategy.bag_mask(self.iter_, grad, hess)
         grad, hess = self.sample_strategy.scale_grad_hess(mask, grad, hess)
         if mask is None:
             mask = jnp.ones((n,), jnp.float32)
+        if self._valid_row_mask is not None:
+            mask = mask * self._valid_row_mask
 
         feat_mask = self._feature_mask()
-        should_continue = False
-        first_iter = len(self.models) < self.num_tree_per_iteration
+        first_iter = self.num_total_trees < self.num_tree_per_iteration
         if self._step_fn is None:
             self._step_fn = self._build_step_fn()
 
@@ -349,31 +437,60 @@ class GBDT:
                 self.train_score[cur_tree_id], grad[cur_tree_id],
                 hess[cur_tree_id], mask, feat_mask,
                 jnp.float32(self.shrinkage_rate))
-            num_nodes = int(tree.num_nodes)
-            if num_nodes > 0:
-                should_continue = True
-                host = HostTree(tree, shrinkage=self.shrinkage_rate)
-                self.train_score = self.train_score.at[cur_tree_id].set(new_score)
-                self._update_valid_scores(tree, cur_tree_id)
-                if first_iter and abs(self._init_scores[cur_tree_id]) > 1e-10:
-                    host.add_bias(self._init_scores[cur_tree_id])
-            else:
-                # constant tree (reference: AsConstantTree, gbdt.cpp:430)
-                host = HostTree(tree, shrinkage=1.0)
-                host.num_leaves = 1
-                host.num_nodes = 0
-                const = self._init_scores[cur_tree_id] if first_iter else 0.0
-                host.leaf_value = np.full_like(host.leaf_value, const)
-            self.models.append(host)
+            self.train_score = self.train_score.at[cur_tree_id].set(new_score)
+            # valid scores got the init at _boost_from_average already, so the
+            # tree must be pushed through them BEFORE the bias fold
+            self._update_valid_scores(tree, cur_tree_id)
+            if first_iter and abs(self._init_scores[cur_tree_id]) > 1e-10:
+                # fold the init score into the first tree's leaves, on device
+                # (reference: Tree::AddBias, gbdt.cpp:417; also covers the
+                # constant first tree, AsConstantTree(init), gbdt.cpp:430)
+                tree = tree._replace(
+                    leaf_value=tree.leaf_value + self._init_scores[cur_tree_id])
+            self._dev_trees.append((tree, self.shrinkage_rate))
             self._device_trees_cache = None
 
-        if not should_continue:
+        self.iter_ += 1
+        # stop-check + host materialization, batched to bound device->host
+        # round trips (reference checks every iter, gbdt.cpp:440; one sync per
+        # `stop_check_freq` iters here — the tunneled-TPU RTT is ~130ms)
+        if len(self._dev_trees) >= k * self.stop_check_freq:
+            return self._flush_trees()
+        return False
+
+    @property
+    def num_total_trees(self) -> int:
+        return len(self.models) + len(self._dev_trees)
+
+    def _flush_trees(self) -> bool:
+        """Materialize pending device trees to host in one batched transfer;
+        returns True if training should stop (an iteration produced no
+        splittable leaf — reference: gbdt.cpp:440-450)."""
+        if not self._dev_trees:
+            return False
+        k = self.num_tree_per_iteration
+        trees = [t for t, _ in self._dev_trees]
+        shrinks = [s for _, s in self._dev_trees]
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+        host = jax.device_get(stacked)
+        self._dev_trees = []
+        for i in range(len(trees)):
+            one = jax.tree.map(lambda x, i=i: x[i], host)
+            ht = HostTree(one, shrinkage=shrinks[i])
+            if ht.num_nodes == 0:
+                ht.num_leaves = 1
+            self.models.append(ht)
+        # stop if the last flushed iteration had no splits at all
+        # (reference: gbdt.cpp:440-450 — the failed iteration's trees are
+        # popped unless they are the very first, which stay as constant trees)
+        tail = self.models[-k:]
+        if len(tail) == k and all(m.num_nodes == 0 for m in tail):
+            if len(self.models) > k:
+                del self.models[-k:]
+            self.iter_ -= 1
             log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")
-            for _ in range(k):
-                self.models.pop()
             return True
-        self.iter_ += 1
         return False
 
     def _renew_tree_output(self, tree: TreeArrays, row_leaf, mask,
@@ -436,6 +553,7 @@ class GBDT:
 
     def rollback_one_iter(self) -> None:
         """(reference: GBDT::RollbackOneIter, gbdt.cpp:454)"""
+        self._flush_trees()
         if self.iter_ <= 0:
             return
         k = self.num_tree_per_iteration
@@ -454,11 +572,15 @@ class GBDT:
     def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
         out = []
         for vs in self.valid_sets:
-            out.extend(self._eval(vs.name, np.asarray(vs.score), vs.metrics))
+            out.extend(self._eval(vs.name, np.asarray(vs.score), vs.metrics,
+                                  n_real=vs.n_real))
         return out
 
-    def _eval(self, name, score, metrics):
+    def _eval(self, name, score, metrics, n_real: Optional[int] = None):
         convert = self.objective.convert_output if self.objective else None
+        if n_real is None:
+            n_real = self._n_real if hasattr(self, "_n_real") else score.shape[1]
+        score = score[:, :n_real]
         raw = score[0] if self.num_tree_per_iteration == 1 else score
         out = []
         for m in metrics:
@@ -471,6 +593,7 @@ class GBDT:
 
     # -- prediction ----------------------------------------------------------
     def device_trees(self, num_iteration: Optional[int] = None) -> StackedTrees:
+        self._flush_trees()
         models = self.models
         if num_iteration is not None and num_iteration > 0:
             models = models[: num_iteration * self.num_tree_per_iteration]
@@ -487,6 +610,7 @@ class GBDT:
     def predict_raw_binned(self, binned: jax.Array,
                            num_iteration: Optional[int] = None) -> np.ndarray:
         """Raw scores [K, N] for already-binned rows."""
+        self._flush_trees()
         if not self.models:
             n = binned.shape[0]
             return np.zeros((self.num_tree_per_iteration, n), np.float32)
@@ -535,7 +659,7 @@ class GBDT:
 
     @property
     def current_iteration(self) -> int:
-        return len(self.models) // max(self.num_tree_per_iteration, 1)
+        return self.num_total_trees // max(self.num_tree_per_iteration, 1)
 
     # -- feature importance (reference: GBDT::FeatureImportance, gbdt.cpp) ---
     def feature_importance(self, importance_type: str = "split",
@@ -544,6 +668,7 @@ class GBDT:
             else max((int(m.split_feature.max(initial=-1)) + 1)
                      for m in self.models) if self.models else 0
         out = np.zeros(num_features, np.float64)
+        self._flush_trees()
         models = self.models
         if iteration is not None and iteration > 0:
             models = models[: iteration * self.num_tree_per_iteration]

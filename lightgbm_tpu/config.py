@@ -35,6 +35,7 @@ PARAMS: Dict[str, Tuple[Any, type, Tuple[str, ...]]] = {
     "seed": (None, int, ("random_seed", "random_state")),
     "deterministic": (False, bool, ()),
     # learning control
+    "stop_check_freq": (1, int, ()),  # TPU extension: batched stop checks
     "force_col_wise": (False, bool, ()),
     "force_row_wise": (False, bool, ()),
     "max_depth": (-1, int, ()),

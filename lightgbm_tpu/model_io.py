@@ -121,6 +121,7 @@ def booster_to_string(booster, num_iteration: Optional[int] = None) -> str:
     gbdt = booster._gbdt
     if hasattr(gbdt, "original_text") and gbdt.original_text is not None:
         return gbdt.original_text
+    gbdt._flush_trees()
     ds = gbdt.train_set
     mappers = ds.mappers
     models = gbdt.models
@@ -214,6 +215,7 @@ def _node_to_json(host, mappers, node: int) -> Dict[str, Any]:
 def booster_to_dict(booster, num_iteration: Optional[int] = None) -> Dict[str, Any]:
     """(reference: GBDT::DumpModel, gbdt_model_text.cpp)"""
     gbdt = booster._gbdt
+    gbdt._flush_trees()
     ds = gbdt.train_set
     models = gbdt.models
     if num_iteration is not None and num_iteration > 0:

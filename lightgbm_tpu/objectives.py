@@ -281,8 +281,13 @@ class BinaryLogloss(Objective):
         uniq = np.unique(lbl)
         if not np.all(np.isin(uniq, [0.0, 1.0])):
             raise ValueError("binary objective requires labels in {0, 1}")
-        pos = float((lbl > 0).sum())
-        neg = float(len(lbl) - pos)
+        if metadata.weight is not None:
+            w = _np(metadata.weight).astype(np.float64)
+            pos = float(w[lbl > 0].sum())
+            neg = float(w.sum() - pos)
+        else:
+            pos = float((lbl > 0).sum())
+            neg = float(len(lbl) - pos)
         self.label01 = jnp.asarray(lbl > 0, jnp.float32)
         # class weighting (reference: binary_objective.hpp:60-86)
         if self.is_unbalance and pos > 0 and neg > 0:

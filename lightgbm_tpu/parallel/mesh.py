@@ -1,0 +1,65 @@
+"""Device mesh + sharding helpers for distributed training.
+
+TPU-native replacement for the reference's distributed tree learners and
+network layer (reference: src/treelearner/data_parallel_tree_learner.cpp —
+rows partitioned across machines, histograms ReduceScattered over the
+socket/MPI Network, src/network/network.cpp; topology maps linker_topo.cpp).
+
+Here rows are sharded over a ``jax.sharding.Mesh`` axis and the jitted tree
+grower runs under GSPMD: XLA partitions the histogram contraction over the row
+axis and inserts the AllReduce over ICI automatically — the explicit
+Bruck/recursive-halving machinery of the reference's network layer is subsumed
+by the XLA collective implementation (SURVEY §2.7). Multi-host extends the same
+mesh over DCN via ``jax.distributed.initialize`` (reference equivalent:
+machines/machine_list_file config + TCP mesh construction,
+linkers_socket.cpp:29-118).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def make_mesh(num_devices: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over the row (data) axis.
+
+    The reference's world is ``num_machines`` ranks in a flat TCP/MPI mesh
+    (network.h Init); ours is whatever devices JAX exposes (single host: all
+    local chips; multi-host: the global device set).
+    """
+    if devices is None:
+        devices = jax.devices()
+        if num_devices is not None:
+            devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """[N, ...] arrays sharded along rows."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def row_sharding_2d(mesh: Mesh) -> NamedSharding:
+    """[N, F] arrays sharded along rows, features replicated."""
+    return NamedSharding(mesh, P(DATA_AXIS, None))
+
+
+def class_row_sharding(mesh: Mesh) -> NamedSharding:
+    """[K, N] score arrays: classes replicated, rows sharded."""
+    return NamedSharding(mesh, P(None, DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_rows(n: int, num_shards: int) -> int:
+    """Rows must split evenly across shards; callers mask the tail
+    (reference analogue: pre_partition / CheckOrPartition, dataset.h:110)."""
+    return (-n) % num_shards

@@ -1,0 +1,100 @@
+"""Distributed (data-parallel) training tests on the virtual 8-device mesh.
+
+Mirrors the reference's distributed test strategy
+(reference: tests/distributed/_test_distributed.py — N local CLI processes with
+partitioned data, asserting accuracy and identical models across workers). Here
+the 8 XLA CPU devices form a real `jax.sharding.Mesh`; GSPMD partitions the
+histogram build over rows and inserts the ICI collectives the reference did
+with socket ReduceScatter (data_parallel_tree_learner.cpp:223-300).
+"""
+import jax
+import numpy as np
+import pytest
+from sklearn.metrics import roc_auc_score
+
+import lightgbm_tpu as lgb
+
+from utils import FAST_PARAMS, binary_data, train_test_split_simple
+
+
+def _params(**kw):
+    p = dict(FAST_PARAMS)
+    p.update(kw)
+    return p
+
+
+@pytest.fixture(autouse=True)
+def need_devices():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device backend")
+
+
+def test_data_parallel_quality():
+    X, y = binary_data()
+    Xtr, ytr, Xte, yte = train_test_split_simple(X, y)
+    bst = lgb.train(_params(objective="binary", tree_learner="data"),
+                    lgb.Dataset(Xtr, label=ytr), 30)
+    assert roc_auc_score(yte, bst.predict(Xte)) > 0.93
+    # the mesh really was used: training score is sharded over the data axis
+    g = bst._gbdt
+    assert g.mesh is not None
+    assert len(g.mesh.devices.ravel()) == len(jax.devices())
+
+
+def test_data_parallel_matches_serial_auc():
+    X, y = binary_data()
+    Xtr, ytr, Xte, yte = train_test_split_simple(X, y)
+    p_serial = lgb.train(_params(objective="binary"),
+                         lgb.Dataset(Xtr, label=ytr), 20).predict(Xte)
+    p_data = lgb.train(_params(objective="binary", tree_learner="data"),
+                       lgb.Dataset(Xtr, label=ytr), 20).predict(Xte)
+    # split decisions can differ on fp ties; model quality must match
+    assert abs(roc_auc_score(yte, p_serial) - roc_auc_score(yte, p_data)) < 0.01
+
+
+def test_data_parallel_uneven_rows():
+    # row count not divisible by the device count: padding path
+    X, y = binary_data()
+    n = len(y) - 5  # 595: not divisible by 8
+    X, y = X[:n], y[:n]
+    bst = lgb.train(_params(objective="binary", tree_learner="data"),
+                    lgb.Dataset(X, label=y), 10)
+    p = bst.predict(X)
+    assert len(p) == n
+    assert roc_auc_score(y, p) > 0.95
+
+
+def test_data_parallel_with_valid_and_weights():
+    X, y = binary_data()
+    Xtr, ytr, Xte, yte = train_test_split_simple(X, y)
+    w = np.where(ytr > 0, 2.0, 1.0)
+    ds = lgb.Dataset(Xtr, label=ytr, weight=w)
+    dv = ds.create_valid(Xte, label=yte)
+    hist = {}
+    bst = lgb.train(_params(objective="binary", tree_learner="data",
+                            metric="binary_logloss"),
+                    ds, 15, valid_sets=[dv],
+                    callbacks=[lgb.record_evaluation(hist)])
+    assert len(hist["valid_0"]["binary_logloss"]) == 15
+    assert hist["valid_0"]["binary_logloss"][-1] < \
+        hist["valid_0"]["binary_logloss"][0]
+
+
+def test_voting_parallel_alias_runs():
+    # voting-parallel currently shares the data-parallel path (full histogram
+    # psum; the top-k comm optimization is meaningless under GSPMD until the
+    # explicit shard_map learner lands)
+    X, y = binary_data()
+    bst = lgb.train(_params(objective="binary", tree_learner="voting"),
+                    lgb.Dataset(X, label=y), 8)
+    assert roc_auc_score(y, bst.predict(X)) > 0.95
+
+
+def test_multiclass_data_parallel():
+    from utils import multiclass_data
+    X, y = multiclass_data()
+    bst = lgb.train(
+        _params(objective="multiclass", num_class=3, tree_learner="data"),
+        lgb.Dataset(X, label=y), 10)
+    p = bst.predict(X)
+    assert (p.argmax(1) == y).mean() > 0.9

@@ -269,3 +269,29 @@ def test_cv(rng):
                  num_boost_round=10, nfold=3)
     assert "valid binary_logloss-mean" in res
     assert res["valid binary_logloss-mean"][0] < 0.69  # better than chance
+
+
+def test_valid_set_scores_match_predict(rng):
+    """Cached valid scores must equal fresh predictions — catches both the
+    missing set_reference rebinning and the double init-score application."""
+    X, y = binary_data()
+    Xtr, ytr, Xte, yte = train_test_split_simple(X, y)
+    ds = lgb.Dataset(Xtr, label=ytr)
+    # valid WITHOUT reference= (the reference API silently rebinds it)
+    dv = lgb.Dataset(Xte, label=yte)
+    bst = lgb.train(_params(objective="binary", metric="binary_logloss"),
+                    ds, 5, valid_sets=[dv])
+    vs = bst._gbdt.valid_sets[0]
+    cached_raw = np.asarray(vs.score)[0][: vs.n_real]
+    fresh_raw = bst.predict(Xte, raw_score=True)
+    np.testing.assert_allclose(cached_raw, fresh_raw, rtol=1e-5, atol=1e-5)
+
+
+def test_valid_constructed_and_freed_raises(rng):
+    X, y = binary_data()
+    Xtr, ytr, Xte, yte = train_test_split_simple(X, y)
+    dv = lgb.Dataset(Xte, label=yte)
+    dv.construct()  # binned with its own mappers, raw data freed
+    with pytest.raises(ValueError, match="reference"):
+        lgb.train(_params(objective="binary"), lgb.Dataset(Xtr, label=ytr),
+                  3, valid_sets=[dv])
