@@ -93,6 +93,17 @@ class RF(GBDT):
                 const = self._init_scores[cur_tree_id] \
                     if len(self.models) < k else 0.0
                 host.leaf_value = np.full_like(host.leaf_value, const)
+                # constant trees get the same running-average bracketing as
+                # split trees (reference: rf.hpp MultiplyScore around
+                # UpdateScore applies to every iteration) — otherwise cached
+                # scores average over the wrong denominator afterwards
+                self.train_score = self.train_score.at[cur_tree_id].multiply(
+                    n_prev).at[cur_tree_id].add(const) \
+                    .at[cur_tree_id].multiply(1.0 / (n_prev + 1.0))
+                for vs in self.valid_sets:
+                    vs.score = vs.score.at[cur_tree_id].multiply(n_prev) \
+                        .at[cur_tree_id].add(const) \
+                        .at[cur_tree_id].multiply(1.0 / (n_prev + 1.0))
             self.models.append(host)
             self._device_trees_cache = None
         self.iter_ += 1

@@ -105,10 +105,9 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
     if stopping_rounds <= 0:
         raise ValueError("stopping_rounds should be greater than zero.")
 
-    state = {"enabled": True, "inited": False}
+    state = {"enabled": True}
 
     def _init(env: CallbackEnv) -> None:
-        state["inited"] = True
         state["enabled"] = bool(env.evaluation_result_list)
         if not state["enabled"]:
             log.warning("Early stopping is not available in dart mode or "
@@ -131,15 +130,21 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
             state["best_list"].append(None)
 
     def _callback(env: CallbackEnv) -> None:
-        if not state["inited"]:
+        # re-init at the first iteration of every train() run so a callback
+        # object reused across calls (e.g. one early_stopping shared by all
+        # cv() folds) does not carry best_score/best_iter over
+        # (reference: callback.py _EarlyStoppingCallback.__call__)
+        if env.iteration == env.begin_iteration:
             _init(env)
         if not state["enabled"]:
             return
-        # skip the training-set entries (reference skips "train" dataset)
+        # skip the training-set entries (reference skips "train" dataset;
+        # cv aggregates arrive as ("cv_agg", "train <metric>", ...))
         first_metric_seen = False
         for i, entry in enumerate(env.evaluation_result_list):
             name, metric, value, _ = entry
-            if name == "training":
+            if name == "training" or (
+                    name == "cv_agg" and metric.split(" ")[0] == "train"):
                 continue
             if first_metric_only and first_metric_seen and \
                     metric != env.evaluation_result_list[0][1]:
@@ -159,7 +164,9 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                                          state["best_list"][i])
         if env.iteration == env.end_iteration - 1:
             for i, entry in enumerate(env.evaluation_result_list):
-                if entry[0] == "training":
+                if entry[0] == "training" or (
+                        entry[0] == "cv_agg"
+                        and entry[1].split(" ")[0] == "train"):
                     continue
                 if verbose and state["best_list"][i] is not None:
                     log.info(

@@ -252,6 +252,55 @@ METRIC_ALIASES: Dict[str, str] = {
     "none": "none", "na": "none", "null": "none", "custom": "none",
 }
 
+# Parameters accepted (for reference drop-in compatibility) but NOT implemented
+# yet. Setting one to a non-default value warns loudly so a user migrating from
+# the reference is never silently handed a different model (the reference
+# rejects inconsistent configs outright, src/io/config.cpp:286). Entries are
+# removed from this set as the corresponding feature lands.
+UNIMPLEMENTED_PARAMS: Dict[str, str] = {
+    "extra_trees": "extremely randomized trees",
+    "max_bin_by_feature": "per-feature bin caps",
+    "use_quantized_grad": "quantized-gradient training",
+    "linear_tree": "linear leaf models",
+    "cegb_penalty_split": "cost-effective gradient boosting",
+    "cegb_penalty_feature_lazy": "cost-effective gradient boosting",
+    "cegb_penalty_feature_coupled": "cost-effective gradient boosting",
+    "feature_contri": "per-feature split-gain scaling",
+    "forcedsplits_filename": "forced splits",
+    "forcedbins_filename": "forced bin boundaries",
+    "refit_decay_rate": "refit",
+    "pred_early_stop": "prediction early stopping",
+    "start_iteration_predict": "prediction start_iteration",
+    "num_iteration_predict": "prediction num_iteration",
+    "auc_mu_weights": "weighted auc_mu",
+    "lambdarank_position_bias_regularization": "position bias correction",
+    "num_machines": "multi-host (DCN) training",
+    "machines": "multi-host (DCN) training",
+    "machine_list_filename": "multi-host (DCN) training",
+    "snapshot_freq": "periodic model snapshots",
+    "input_model": "continue training from a model file",
+    "save_binary": "binary dataset files",
+    "two_round": "two-round file loading",
+    "header": "text-file loading",
+    "label_column": "text-file loading",
+    "weight_column": "text-file loading",
+    "group_column": "text-file loading",
+    "ignore_column": "text-file loading",
+    "parser_config_file": "custom parsers",
+    "precise_float_parser": "text-file loading",
+    "pre_partition": "pre-partitioned distributed data",
+    # tree-learner features scheduled this round; warn until wired
+    "monotone_constraints": "monotone constraints",
+    "interaction_constraints": "interaction constraints",
+    "feature_fraction_bynode": "per-node feature sampling",
+    "path_smooth": "path smoothing",
+    "min_data_per_group": "categorical split min group size",
+    "max_cat_threshold": "many-category splits",
+    "cat_l2": "many-category splits",
+    "cat_smooth": "many-category splits",
+    "max_cat_to_onehot": "many-category splits",
+}
+
 # alias -> canonical param name
 _ALIAS_TABLE: Dict[str, str] = {}
 for _name, (_d, _t, _aliases) in PARAMS.items():
@@ -317,6 +366,20 @@ class Config:
             self._explicit.add(key)
         for key in unknown:
             log.warning(f"Unknown parameter: {key}")
+        for key in resolved:
+            feature = UNIMPLEMENTED_PARAMS.get(key)
+            if feature is None:
+                continue
+            default = PARAMS[key][0]
+            value = getattr(self, key)
+            # 0/0.0 are meaningful values and must still warn (they compare
+            # equal to False), so use identity checks for the "unset" sentinels
+            unset = value is None or value == "" or value is False
+            if value != default and not unset:
+                log.warning(
+                    f"Parameter {key}={value!r} is accepted for compatibility "
+                    f"but {feature} is NOT implemented yet — it has no "
+                    "effect; results will differ from the reference LightGBM")
         self._check_consistency()
 
     def is_explicit(self, name: str) -> bool:

@@ -18,6 +18,29 @@ from .config import Config, alias_table
 from .utils import log
 
 
+def _setup_callbacks(params: Dict[str, Any],
+                     callbacks: Optional[Sequence[Callable]]):
+    """Resolve the callback set for a training run: inject auto early stopping
+    (disabled in dart mode, where tree renormalization invalidates
+    best_iteration truncation) and split/sort by before/after-iteration
+    (reference: engine.py:262-307 callback setup in train() and cv())."""
+    cbs = set(callbacks) if callbacks else set()
+    cfg = Config(params)
+    early_round = int(cfg.early_stopping_round or 0)
+    if early_round > 0 and cfg.boosting != "dart":
+        cbs.add(callback_mod.early_stopping(
+            early_round, bool(params.get("first_metric_only", False)),
+            min_delta=float(params.get("early_stopping_min_delta", 0.0))))
+    order_key = lambda cb: getattr(cb, "order", 0)
+    cbs_before = sorted(
+        (cb for cb in cbs if getattr(cb, "before_iteration", False)),
+        key=order_key)
+    cbs_after = sorted(
+        (cb for cb in cbs if not getattr(cb, "before_iteration", False)),
+        key=order_key)
+    return cbs_before, cbs_after
+
+
 def train(
     params: Dict[str, Any],
     train_set: Dataset,
@@ -37,7 +60,6 @@ def train(
         if at.get(key) == "num_iterations" and params[key] is not None:
             num_boost_round = int(params.pop(key))
     params["num_iterations"] = num_boost_round
-    first_metric_only = bool(params.get("first_metric_only", False))
 
     if init_model is not None:
         raise NotImplementedError(
@@ -63,22 +85,7 @@ def train(
                 continue
             booster.add_valid(valid_data, name)
 
-    cbs = set(callbacks) if callbacks else set()
-    cb_early = None
-    cfg = Config(params)
-    early_round = int(cfg.early_stopping_round or 0)
-    # the reference disables auto early stopping in dart mode (tree
-    # renormalization invalidates best_iteration truncation)
-    if early_round > 0 and cfg.boosting != "dart":
-        cb_early = callback_mod.early_stopping(
-            early_round, first_metric_only,
-            min_delta=float(params.get("early_stopping_min_delta", 0.0)))
-        cbs.add(cb_early)
-    cbs_before = [cb for cb in cbs if getattr(cb, "before_iteration", False)]
-    cbs_after = [cb for cb in cbs if not getattr(cb, "before_iteration", False)]
-    order_key = lambda cb: getattr(cb, "order", 0)
-    cbs_before.sort(key=order_key)
-    cbs_after.sort(key=order_key)
+    cbs_before, cbs_after = _setup_callbacks(params, callbacks)
 
     evaluation_result_list: List = []
     for i in range(num_boost_round):
@@ -228,7 +235,6 @@ def cv(
         folds = list(folds.split(raw, label, groups=None))
 
     cvbooster = CVBooster()
-    results = collections.defaultdict(list)
     fold_params = {k: v for k, v in params.items()}
     for tr, te in folds:
         def subset(idx):
@@ -251,18 +257,56 @@ def cv(
             qid = np.searchsorted(boundaries, te, side="right") - 1
             _, counts = np.unique(qid, return_counts=True)
             dte.set_group(counts)
-        bst = train(fold_params, dtr, num_boost_round,
-                    valid_sets=[dte], valid_names=["valid"],
-                    feval=feval, callbacks=callbacks)
+        dtr.construct()
+        bst = Booster(params=fold_params, train_set=dtr)
+        bst._train_data_name = "train"
+        bst.add_valid(dte, "valid")
         cvbooster.append(bst)
-        for name, metric, value, _ in bst.eval_valid(feval):
-            results[f"valid {metric}"].append(value)
 
-    out: Dict[str, Any] = {}
-    for key, values in results.items():
-        per_iter = values  # one value per fold at final iteration
-        out[f"{key}-mean"] = [float(np.mean(per_iter))]
-        out[f"{key}-stdv"] = [float(np.std(per_iter))]
+    # all folds advance together one iteration at a time so per-iteration
+    # fold means/stdvs are recorded and early stopping acts on the CV
+    # aggregate (reference: engine.py:611 cv loop + _agg_cv_result)
+    cbs_before, cbs_after = _setup_callbacks(params, callbacks)
+
+    results: Dict[str, List[float]] = collections.OrderedDict()
+    for i in range(num_boost_round):
+        for cb in cbs_before:
+            cb(callback_mod.CallbackEnv(
+                model=cvbooster, params=params, iteration=i,
+                begin_iteration=0, end_iteration=num_boost_round,
+                evaluation_result_list=None))
+        for bst in cvbooster.boosters:
+            bst.update()
+        merged: Dict = collections.OrderedDict()
+        for bst in cvbooster.boosters:
+            entries = []
+            if eval_train_metric:
+                entries.extend(bst.eval_train(feval))
+            entries.extend(bst.eval_valid(feval))
+            for name, metric, value, hib in entries:
+                merged.setdefault((name, metric, hib), []).append(value)
+        agg_list = []
+        for (name, metric, hib), vals in merged.items():
+            key = f"{name} {metric}"
+            results.setdefault(f"{key}-mean", []).append(float(np.mean(vals)))
+            results.setdefault(f"{key}-stdv", []).append(float(np.std(vals)))
+            # same shape the reference hands to callbacks: ("cv_agg", ...)
+            agg_list.append(("cv_agg", key, float(np.mean(vals)), hib))
+        try:
+            for cb in cbs_after:
+                cb(callback_mod.CallbackEnv(
+                    model=cvbooster, params=params, iteration=i,
+                    begin_iteration=0, end_iteration=num_boost_round,
+                    evaluation_result_list=agg_list))
+        except callback_mod.EarlyStopException as e:
+            cvbooster.best_iteration = e.best_iteration + 1
+            for bst in cvbooster.boosters:
+                bst.best_iteration = cvbooster.best_iteration
+            for key in list(results):
+                results[key] = results[key][:cvbooster.best_iteration]
+            break
+
+    out: Dict[str, Any] = dict(results)
     if return_cvbooster:
         out["cvbooster"] = cvbooster
     return out

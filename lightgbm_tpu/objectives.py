@@ -289,12 +289,13 @@ class BinaryLogloss(Objective):
             pos = float((lbl > 0).sum())
             neg = float(len(lbl) - pos)
         self.label01 = jnp.asarray(lbl > 0, jnp.float32)
-        # class weighting (reference: binary_objective.hpp:60-86)
+        # class weighting (reference: binary_objective.hpp:60-86 — the
+        # MINORITY class is upweighted to majority/minority, the other stays 1)
         if self.is_unbalance and pos > 0 and neg > 0:
             if pos > neg:
-                self.label_weights = (1.0, neg / pos)   # (neg_w, pos_w)
+                self.label_weights = (pos / neg, 1.0)   # (neg_w, pos_w)
             else:
-                self.label_weights = (pos / neg, 1.0)
+                self.label_weights = (1.0, neg / pos)
         else:
             self.label_weights = (1.0, self.scale_pos_weight)
         self._pos, self._neg = pos, neg
@@ -547,9 +548,12 @@ class LambdarankNDCG(Objective):
         # rank each document by descending score (reference sorts per query)
         order = jnp.argsort(-s, axis=1)                       # [Q, M]
         rank_of = jnp.argsort(order, axis=1)                  # doc -> position
+        # true positional discounts for ALL ranked positions; the truncation
+        # level only restricts which pairs are enumerated (reference:
+        # rank_objective.hpp:222-257 — the paired doc below truncation_level
+        # keeps its real discount)
         disc = 1.0 / jnp.log2(rank_of.astype(jnp.float32) + 2.0)  # [Q, M]
         within_trunc = rank_of < self.truncation_level
-        disc = jnp.where(within_trunc, disc, 0.0)
 
         sig = self.sigmoid
         # pair matrices [Q, M, M]: i = higher-labeled doc, j = lower
@@ -576,11 +580,16 @@ class LambdarankNDCG(Objective):
         hess_q = hes.sum(axis=2) + hes.sum(axis=1)
 
         if self.norm:
-            # reference norm_: scale by log2(1 + #pairs-ish); use per-query pair count
-            npairs = pair_valid.sum(axis=(1, 2)).astype(jnp.float32)
-            scale = jnp.where(npairs > 0, jnp.log2(1.0 + npairs), 1.0)
-            grad_q = grad_q / scale[:, None]
-            hess_q = hess_q / scale[:, None]
+            # reference norm_ (rank_objective.hpp:259-263): accumulate
+            # sum_lambdas = sum over pairs of 2*|lambda| and scale the query's
+            # grad/hess by log2(1 + sum_lambdas) / sum_lambdas
+            sum_lambdas = 2.0 * (-lam).sum(axis=(1, 2))   # lam <= 0 per pair
+            scale = jnp.where(
+                sum_lambdas > 0,
+                jnp.log2(1.0 + sum_lambdas) / jnp.maximum(sum_lambdas, _EPS),
+                1.0)
+            grad_q = grad_q * scale[:, None]
+            hess_q = hess_q * scale[:, None]
 
         grad = jnp.zeros_like(score).at[safe_idx.reshape(-1)].add(
             jnp.where(mask, grad_q, 0.0).reshape(-1))

@@ -190,3 +190,42 @@ class TestBooster:
         assert bst.num_trees() == 8
         assert bst.current_iteration() == 8
         assert bst.num_model_per_iteration() == 1
+
+
+class TestConfigWarnings:
+    """Accepted-but-unimplemented params must warn loudly, never be silent
+    (VERDICT: silent divergence from reference models; the reference instead
+    rejects inconsistent configs, src/io/config.cpp:286)."""
+
+    def test_unimplemented_param_warns(self, caplog):
+        import logging
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.utils import log as _log
+        _log.set_verbosity(1)  # earlier tests may have silenced warnings
+        with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
+            Config({"monotone_constraints": [1, -1, 0],
+                    "linear_tree": True,
+                    "use_quantized_grad": True})
+        text = caplog.text
+        for name in ("monotone_constraints", "linear_tree",
+                     "use_quantized_grad"):
+            assert f"{name}=" in text and "NOT implemented" in text, \
+                f"no warning for {name}: {text!r}"
+
+    def test_default_values_do_not_warn(self, caplog):
+        import logging
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.utils import log as _log
+        _log.set_verbosity(1)
+        with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
+            Config({"num_leaves": 31, "linear_tree": False,
+                    "snapshot_freq": -1})
+        assert "NOT implemented" not in caplog.text
+
+    def test_implemented_params_not_in_table(self):
+        """Anything the training path actually consumes must not be listed."""
+        from lightgbm_tpu.config import UNIMPLEMENTED_PARAMS
+        for implemented in ("num_leaves", "learning_rate", "bagging_fraction",
+                            "feature_fraction", "lambda_l1", "max_bin",
+                            "is_unbalance", "tree_learner", "max_depth"):
+            assert implemented not in UNIMPLEMENTED_PARAMS

@@ -268,7 +268,40 @@ def test_cv(rng):
                  lgb.Dataset(X, label=y, free_raw_data=False),
                  num_boost_round=10, nfold=3)
     assert "valid binary_logloss-mean" in res
-    assert res["valid binary_logloss-mean"][0] < 0.69  # better than chance
+    # per-iteration curves, one entry per boosting round (reference contract:
+    # engine.py:611 — len(results[...]) is used to pick num_boost_round)
+    assert len(res["valid binary_logloss-mean"]) == 10
+    assert len(res["valid binary_logloss-stdv"]) == 10
+    curve = res["valid binary_logloss-mean"]
+    assert curve[-1] < 0.69  # better than chance
+    assert curve[-1] < curve[0]  # loss decreases over iterations
+
+
+def test_cv_early_stopping_and_callback_reuse(rng):
+    """Early stopping acts on the CV aggregate and truncates curves; a single
+    early_stopping callback object shared across train() calls re-inits its
+    state each run (advisor finding: one-shot 'inited' flag)."""
+    X, y = binary_data(n=402)
+    res = lgb.cv(_params(objective="binary", metric="binary_logloss",
+                         early_stopping_round=3),
+                 lgb.Dataset(X, label=y, free_raw_data=False),
+                 num_boost_round=200, nfold=3, return_cvbooster=True)
+    cvb = res["cvbooster"]
+    n_iters = len(res["valid binary_logloss-mean"])
+    assert n_iters <= 200
+    if cvb.best_iteration > 0:  # stopped early: curves truncated to best
+        assert n_iters == cvb.best_iteration
+
+    # reuse one callback object across two train() runs
+    cb = lgb.early_stopping(2, verbose=False)
+    Xtr, ytr, Xte, yte = train_test_split_simple(X, y)
+    for _ in range(2):
+        ds = lgb.Dataset(Xtr, label=ytr)
+        bst = lgb.train(_params(objective="binary"), ds, 50,
+                        valid_sets=[ds.create_valid(Xte, label=yte)],
+                        callbacks=[cb])
+        # a stale fold-1 best_iter would make the second run stop instantly
+        assert bst.best_iteration == 0 or bst.best_iteration > 1
 
 
 def test_valid_set_scores_match_predict(rng):
