@@ -57,7 +57,7 @@ def main():
         "stop_check_freq": 10_000,
     }
     t0 = time.time()
-    ds = lgb.Dataset(X, label=y)
+    ds = lgb.Dataset(X, label=y, params=params)
     ds.construct()
     construct_s = time.time() - t0
 

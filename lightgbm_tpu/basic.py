@@ -18,7 +18,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Unio
 
 import numpy as np
 
-from .config import Config
+from .config import Config, alias_table
 from .io.dataset import BinnedDataset, Metadata
 from .metrics import create_metrics
 from .objectives import create_objective
@@ -62,6 +62,42 @@ class Dataset:
         self.position = position
         self._inner: Optional[BinnedDataset] = None
         self.used_indices: Optional[np.ndarray] = None
+
+    # binning-relevant parameters a Booster forwards into a not-yet-constructed
+    # Dataset (reference: Dataset._update_params, python-package basic.py —
+    # train()/Booster() push their params into the lazily-built Dataset)
+    _DATASET_PARAM_KEYS = (
+        "max_bin", "min_data_in_bin", "bin_construct_sample_cnt",
+        "use_missing", "zero_as_missing", "data_random_seed",
+        "feature_pre_filter", "max_bin_by_feature")
+
+    def _update_params(self, params: Optional[Dict[str, Any]]) -> "Dataset":
+        """Merge binning params from a Booster; Dataset-explicit keys win
+        (reference: Dataset._update_params)."""
+        if not params:
+            return self
+        at = alias_table()
+        incoming = {}
+        for key, value in params.items():
+            canon = at.get(key, key)
+            if canon in self._DATASET_PARAM_KEYS:
+                incoming[canon] = value
+        if not incoming:
+            return self
+        if self._inner is None:
+            own = {at.get(k, k) for k in self.params}
+            for key, value in incoming.items():
+                if key not in own:
+                    self.params[key] = value
+        else:
+            for key, value in incoming.items():
+                current = Config(self.params).get(key)
+                if Config({key: value}).get(key) != current:
+                    log.warning(
+                        f"Dataset was already constructed with {key}="
+                        f"{current!r}; training parameter {key}={value!r} is "
+                        "ignored (reconstruct the Dataset to change binning)")
+        return self
 
     # -- construction --------------------------------------------------------
     def construct(self) -> "Dataset":
@@ -223,6 +259,7 @@ class Booster:
         if train_set is not None:
             if not isinstance(train_set, Dataset):
                 raise TypeError("Training data should be a Dataset instance")
+            train_set._update_params(params)
             train_set.construct()
             self.config = Config(params)
             objective = self.config.objective

@@ -272,6 +272,7 @@ class GBDT:
             min_sum_hessian_in_leaf=float(cfg.get("min_sum_hessian_in_leaf", 1e-3)),
             min_gain_to_split=float(cfg.get("min_gain_to_split", 0.0)),
             max_delta_step=float(cfg.get("max_delta_step", 0.0)),
+            hist_impl=str(cfg.get("tpu_hist_impl", "auto")),
         )
         md = train_set.metadata if not pad else _pad_metadata(
             train_set.metadata, self.num_data)
@@ -471,11 +472,12 @@ class GBDT:
         k = self.num_tree_per_iteration
         trees = [t for t, _ in self._dev_trees]
         shrinks = [s for _, s in self._dev_trees]
-        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
-        host = jax.device_get(stacked)
+        # one batched device_get of all pending trees; deliberately NOT a
+        # jnp.stack program — its shape would depend on the pending count and
+        # recompile for every distinct flush size
+        host_trees = jax.device_get(trees)
         self._dev_trees = []
-        for i in range(len(trees)):
-            one = jax.tree.map(lambda x, i=i: x[i], host)
+        for i, one in enumerate(host_trees):
             ht = HostTree(one, shrinkage=shrinks[i])
             if ht.num_nodes == 0:
                 ht.num_leaves = 1

@@ -65,6 +65,7 @@ def train(
         raise NotImplementedError(
             "continue-training (init_model) is not implemented yet")
 
+    train_set._update_params(params)
     train_set.construct()
     booster = Booster(params=params, train_set=train_set)
     booster._train_data_name = "training"
@@ -257,6 +258,7 @@ def cv(
             qid = np.searchsorted(boundaries, te, side="right") - 1
             _, counts = np.unique(qid, return_counts=True)
             dte.set_group(counts)
+        dtr._update_params(fold_params)
         dtr.construct()
         bst = Booster(params=fold_params, train_set=dtr)
         bst._train_data_name = "train"

@@ -7,27 +7,31 @@ ConstructHistogramForLeaf -> SubtractHistogramForLeaf -> FindBestSplitsForLeaf -
 FindBestFromAllSplits -> Split; CPU analogue SerialTreeLearner::Train,
 src/treelearner/serial_tree_learner.cpp:179).
 
-Design differences, by TPU constraints (static shapes, no atomics, no cheap
-host round-trips):
+Design, by TPU constraints (static shapes, no atomics, no cheap host
+round-trips):
 
   * The whole tree grows inside one ``jax.lax.fori_loop`` — zero host syncs per
-    tree (the CUDA learner ships one SplitInfo struct to host per split; we ship
-    none).
+    tree (the CUDA learner ships one SplitInfo struct to host per split; we
+    ship none).
   * Row->leaf assignment is a dense ``[N]`` int vector updated by masked where,
     instead of the reference's index-partition scatter
     (cuda_data_partition.cu:288 GenDataToLeftBitVectorKernel + prefix sums).
-  * Histograms of BOTH children of a split are built in one 6-channel masked
-    contraction over all rows (ops/histogram.py); with static shapes a masked
-    full pass costs the same as a "smaller child" pass, so the reference's
-    histogram-subtraction trick buys nothing here and is dropped.
+    The split column is read from a transposed ``[F, N]`` bin matrix so the
+    per-split partition is one contiguous dynamic row slice, not a strided
+    gather over the whole ``[N, F]`` matrix.
+  * Per-leaf histograms stay resident in HBM (``[L, F, B, 3]``) and each split
+    builds only the SMALLER child's histogram with one masked pass; the larger
+    child is parent − smaller — the reference's histogram-subtraction trick
+    (serial_tree_learner.cpp:404, cuda_histogram_constructor.cu:723
+    SubtractHistogramKernel).
   * Early stop (no leaf with positive gain) becomes a ``done`` flag that turns
     remaining iterations into no-ops via ``lax.cond`` (skipping the histogram
     work), since ``fori_loop`` has a static trip count.
 
-The same function runs under ``shard_map`` for data-parallel training: rows are
-sharded, per-leaf histograms are ``psum``-ed over the mesh axis (replacing the
-reference's socket/MPI ReduceScatter in data_parallel_tree_learner.cpp:223-300),
-and every shard then takes identical split decisions.
+The same function runs under GSPMD sharding for data-parallel training: rows
+are sharded, per-leaf histograms are ``psum``-ed over the mesh axis (replacing
+the reference's socket/MPI ReduceScatter in data_parallel_tree_learner.cpp:
+223-300), and every shard then takes identical split decisions.
 """
 from __future__ import annotations
 
@@ -56,6 +60,7 @@ class GrowerParams(NamedTuple):
     min_gain_to_split: float = 0.0
     max_delta_step: float = 0.0
     axis_name: Optional[str] = None
+    hist_impl: str = "auto"  # auto | xla | pallas (ops/histogram.py dispatch)
 
     def split_params(self) -> SplitParams:
         return SplitParams(
@@ -97,6 +102,8 @@ class GrowerState(NamedTuple):
     done: jax.Array
     num_nodes: jax.Array
     row_leaf: jax.Array
+    # per-leaf histograms resident in HBM [L, F, B, 3]
+    leaf_hist: jax.Array
     # tree arrays under construction
     split_feature: jax.Array
     split_bin: jax.Array
@@ -125,7 +132,8 @@ class GrowerState(NamedTuple):
     bs_left_cnt: jax.Array
 
 
-def _leaf_best_split(hist3, pg, ph, pc, feat_info, feat_mask, depth, params: GrowerParams):
+def _leaf_best_split(hist3, pg, ph, pc, feat_info, feat_mask, depth,
+                     params: GrowerParams):
     num_bins_arr, nan_bin_arr, has_nan_arr, is_cat_arr = feat_info
     sp = best_split(
         hist3, pg, ph, pc,
@@ -159,6 +167,19 @@ def grow_tree(
     grad = grad.astype(jnp.float32)
     hess = hess.astype(jnp.float32)
     cnt_weight = cnt_weight.astype(jnp.float32)
+    # contiguous per-feature rows for the split partition (one dynamic row
+    # slice per split instead of a strided column gather from [N, F])
+    binned_t = binned.T
+
+    def hist3(mask):
+        chans = jnp.stack([grad * mask, hess * mask, cnt_weight * mask], axis=1)
+        return histogram(binned, chans, B, ax, impl=params.hist_impl)
+
+    # batched best-split over the two fresh children (one fused scan)
+    def two_best_splits(h2, pg2, ph2, pc2, feat_mask_, depth):
+        fn = lambda h, pg, ph, pc: _leaf_best_split(
+            h, pg, ph, pc, feat_info, feat_mask_, depth, params)
+        return jax.vmap(fn)(h2, pg2, ph2, pc2)
 
     # ---- root ----
     root_g = grad.sum()
@@ -168,18 +189,19 @@ def grow_tree(
         root_g = lax.psum(root_g, ax)
         root_h = lax.psum(root_h, ax)
         root_c = lax.psum(root_c, ax)
-    chans3 = jnp.stack([grad, hess, cnt_weight], axis=1)
-    root_hist = histogram(binned, chans3, B, ax)
+    root_hist = hist3(jnp.ones_like(cnt_weight))
     sp0 = _leaf_best_split(
         root_hist, root_g, root_h, root_c, feat_info, feat_mask,
         jnp.asarray(0, jnp.int32), params,
     )
 
     i32 = jnp.int32
+    leaf_hist0 = jnp.zeros((L, f, B, 3), jnp.float32).at[0].set(root_hist)
     st = GrowerState(
         done=jnp.asarray(False),
         num_nodes=jnp.asarray(0, i32),
         row_leaf=jnp.zeros((n,), i32),
+        leaf_hist=leaf_hist0,
         split_feature=jnp.full((L - 1,), -1, i32),
         split_bin=jnp.zeros((L - 1,), i32),
         split_gain=jnp.zeros((L - 1,), jnp.float32),
@@ -247,7 +269,7 @@ def grow_tree(
             jnp.where(applied, 1, leaf_parent_side[new_leaf]))
 
         # ---- partition rows (reference: CUDADataPartition::SplitInner) ----
-        fcol = jnp.take(binned, f_, axis=1).astype(i32)
+        fcol = lax.dynamic_slice_in_dim(binned_t, f_, 1, axis=0)[0].astype(i32)
         nb = nan_bin_arr[f_]
         iscat = is_cat_arr[f_]
         go_left = jnp.where(
@@ -286,37 +308,50 @@ def grow_tree(
             jnp.where(applied, d_child, leaf_depth[new_leaf]))
 
         # ---- children histograms + best splits (skipped when done) ----
-        bs_arrays = (st.bs_gain, st.bs_feature, st.bs_bin, st.bs_default_left,
-                     st.bs_left_grad, st.bs_left_hess, st.bs_left_cnt)
+        bs_arrays = (st.leaf_hist, st.bs_gain, st.bs_feature, st.bs_bin,
+                     st.bs_default_left, st.bs_left_grad, st.bs_left_hess,
+                     st.bs_left_cnt)
 
         def compute_children(bs):
-            bs_gain, bs_feature, bs_bin, bs_dl, bs_lg, bs_lh, bs_lc = bs
-            ml = (row_leaf == best_leaf).astype(jnp.float32)
-            mr = (row_leaf == new_leaf).astype(jnp.float32)
-            chans6 = jnp.stack(
-                [grad * ml, hess * ml, cnt_weight * ml,
-                 grad * mr, hess * mr, cnt_weight * mr], axis=1)
-            hist6 = histogram(binned, chans6, B, ax)
-            sp_l = _leaf_best_split(hist6[:, :, :3], lg, lh, lc,
-                                    feat_info, feat_mask, d_child, params)
-            sp_r = _leaf_best_split(hist6[:, :, 3:], rg, rh, rc,
-                                    feat_info, feat_mask, d_child, params)
-            bs_gain = bs_gain.at[best_leaf].set(sp_l.gain).at[new_leaf].set(sp_r.gain)
-            bs_feature = bs_feature.at[best_leaf].set(sp_l.feature).at[new_leaf].set(sp_r.feature)
-            bs_bin = bs_bin.at[best_leaf].set(sp_l.bin).at[new_leaf].set(sp_r.bin)
-            bs_dl = bs_dl.at[best_leaf].set(sp_l.default_left).at[new_leaf].set(sp_r.default_left)
-            bs_lg = bs_lg.at[best_leaf].set(sp_l.left_grad).at[new_leaf].set(sp_r.left_grad)
-            bs_lh = bs_lh.at[best_leaf].set(sp_l.left_hess).at[new_leaf].set(sp_r.left_hess)
-            bs_lc = bs_lc.at[best_leaf].set(sp_l.left_count).at[new_leaf].set(sp_r.left_count)
-            return (bs_gain, bs_feature, bs_bin, bs_dl, bs_lg, bs_lh, bs_lc)
+            (leaf_hist, bs_gain, bs_feature, bs_bin, bs_dl, bs_lg, bs_lh,
+             bs_lc) = bs
+            # one masked pass over the SMALLER child only; the larger child is
+            # parent − smaller (reference: SubtractHistogramForLeaf,
+            # cuda_histogram_constructor.cu:723)
+            parent_hist = leaf_hist[best_leaf]
+            left_smaller = lc <= rc
+            small_id = jnp.where(left_smaller, best_leaf, new_leaf)
+            m = (row_leaf == small_id).astype(jnp.float32)
+            hist_small = hist3(m)
+            hist_large = parent_hist - hist_small
+            hist_left = jnp.where(left_smaller, hist_small, hist_large)
+            hist_right = jnp.where(left_smaller, hist_large, hist_small)
+            leaf_hist = leaf_hist.at[best_leaf].set(hist_left)
+            leaf_hist = leaf_hist.at[new_leaf].set(hist_right)
+
+            h2 = jnp.stack([hist_left, hist_right])
+            sp = two_best_splits(
+                h2, jnp.stack([lg, rg]), jnp.stack([lh, rh]),
+                jnp.stack([lc, rc]), feat_mask, d_child)
+            bs_gain = bs_gain.at[best_leaf].set(sp.gain[0]).at[new_leaf].set(sp.gain[1])
+            bs_feature = bs_feature.at[best_leaf].set(sp.feature[0]).at[new_leaf].set(sp.feature[1])
+            bs_bin = bs_bin.at[best_leaf].set(sp.bin[0]).at[new_leaf].set(sp.bin[1])
+            bs_dl = bs_dl.at[best_leaf].set(sp.default_left[0]).at[new_leaf].set(sp.default_left[1])
+            bs_lg = bs_lg.at[best_leaf].set(sp.left_grad[0]).at[new_leaf].set(sp.left_grad[1])
+            bs_lh = bs_lh.at[best_leaf].set(sp.left_hess[0]).at[new_leaf].set(sp.left_hess[1])
+            bs_lc = bs_lc.at[best_leaf].set(sp.left_count[0]).at[new_leaf].set(sp.left_count[1])
+            return (leaf_hist, bs_gain, bs_feature, bs_bin, bs_dl, bs_lg,
+                    bs_lh, bs_lc)
 
         bs_arrays = lax.cond(applied, compute_children, lambda bs: bs, bs_arrays)
-        (bs_gain, bs_feature, bs_bin, bs_dl, bs_lg, bs_lh, bs_lc) = bs_arrays
+        (leaf_hist, bs_gain, bs_feature, bs_bin, bs_dl, bs_lg, bs_lh,
+         bs_lc) = bs_arrays
 
         return GrowerState(
             done=done,
             num_nodes=st.num_nodes + jnp.where(applied, 1, 0).astype(i32),
             row_leaf=row_leaf,
+            leaf_hist=leaf_hist,
             split_feature=split_feature,
             split_bin=split_bin,
             split_gain=split_gain,

