@@ -72,26 +72,31 @@ class Dataset:
         "feature_pre_filter", "max_bin_by_feature")
 
     def _update_params(self, params: Optional[Dict[str, Any]]) -> "Dataset":
-        """Merge binning params from a Booster; Dataset-explicit keys win
-        (reference: Dataset._update_params)."""
+        """Merge binning params from a Booster into a not-yet-constructed
+        Dataset — training params win, matching the reference's
+        ``self.params.update(params)`` (reference: Dataset._update_params,
+        python-package/lightgbm/basic.py). Once constructed, differing values
+        only warn."""
         if not params:
             return self
         at = alias_table()
+        # canonical names take priority over their aliases when both appear
+        # (reference: _ConfigAliases / Config::Set alias resolution)
         incoming = {}
-        for key, value in params.items():
-            canon = at.get(key, key)
-            if canon in self._DATASET_PARAM_KEYS:
-                incoming[canon] = value
+        for pass_aliases in (True, False):
+            for key, value in params.items():
+                canon = at.get(key, key)
+                is_alias = key != canon
+                if canon in self._DATASET_PARAM_KEYS and is_alias == pass_aliases:
+                    incoming[canon] = value
         if not incoming:
             return self
         if self._inner is None:
-            own = {at.get(k, k) for k in self.params}
-            for key, value in incoming.items():
-                if key not in own:
-                    self.params[key] = value
+            self.params.update(incoming)
         else:
+            cfg = Config(self.params)
             for key, value in incoming.items():
-                current = Config(self.params).get(key)
+                current = cfg.get(key)
                 if Config({key: value}).get(key) != current:
                     log.warning(
                         f"Dataset was already constructed with {key}="

@@ -61,6 +61,9 @@ class GrowerParams(NamedTuple):
     max_delta_step: float = 0.0
     axis_name: Optional[str] = None
     hist_impl: str = "auto"  # auto | xla | pallas (ops/histogram.py dispatch)
+    # compact-grower streaming block sizes (ops/grower_compact.py)
+    part_block: int = 2048
+    hist_block: int = 16384
 
     def split_params(self) -> SplitParams:
         return SplitParams(

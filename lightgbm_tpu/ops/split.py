@@ -47,7 +47,8 @@ class SplitResult(NamedTuple):
     default_left: jnp.ndarray  # bool
     left_grad: jnp.ndarray
     left_hess: jnp.ndarray
-    left_count: jnp.ndarray
+    left_count: jnp.ndarray    # weighted (in-bag) row count
+    left_rows: jnp.ndarray     # raw row count (drives the physical partition)
 
 
 def threshold_l1(s: jnp.ndarray, l1: float) -> jnp.ndarray:
@@ -77,7 +78,7 @@ def leaf_gain(sum_grad, sum_hess, p: SplitParams):
 
 
 def best_split(
-    hist: jnp.ndarray,        # [F, B, 3] (grad, hess, count-weight)
+    hist: jnp.ndarray,        # [F, B, K>=3] (grad, hess, count-weight[, raw-count])
     parent_grad: jnp.ndarray,
     parent_hess: jnp.ndarray,
     parent_count: jnp.ndarray,
@@ -89,13 +90,17 @@ def best_split(
     p: SplitParams,
 ) -> SplitResult:
     """Find the best (feature, threshold, direction) for one leaf."""
-    f, b, _ = hist.shape
+    f, b, k = hist.shape
     g = hist[:, :, 0]
     h = hist[:, :, 1]
     c = hist[:, :, 2]
+    # raw (unweighted) row counts drive the compact grower's physical
+    # partition; histograms without the channel fall back to the weighted one
+    r = hist[:, :, 3] if k > 3 else c
     cg = jnp.cumsum(g, axis=1)
     ch = jnp.cumsum(h, axis=1)
     cc = jnp.cumsum(c, axis=1)
+    cr = jnp.cumsum(r, axis=1)
 
     t_iota = jnp.arange(b, dtype=jnp.int32)[None, :]        # [1, B]
     is_cat_b = is_cat[:, None]
@@ -104,16 +109,19 @@ def best_split(
     left_g1 = jnp.where(is_cat_b, g, cg)
     left_h1 = jnp.where(is_cat_b, h, ch)
     left_c1 = jnp.where(is_cat_b, c, cc)
+    left_r1 = jnp.where(is_cat_b, r, cr)
 
     # direction 2 ("missing left"): move the NaN-bin mass to the left side for
     # thresholds strictly below the NaN bin. Only for numerical features with NaN.
     nan_g = jnp.take_along_axis(g, nan_bin[:, None], axis=1)
     nan_h = jnp.take_along_axis(h, nan_bin[:, None], axis=1)
     nan_c = jnp.take_along_axis(c, nan_bin[:, None], axis=1)
+    nan_r = jnp.take_along_axis(r, nan_bin[:, None], axis=1)
     below = t_iota < nan_bin[:, None]
     left_g2 = cg + jnp.where(below, nan_g, 0.0)
     left_h2 = ch + jnp.where(below, nan_h, 0.0)
     left_c2 = cc + jnp.where(below, nan_c, 0.0)
+    left_r2 = cr + jnp.where(below, nan_r, 0.0)
 
     parent_gain = leaf_gain(parent_grad, parent_hess, p)
     gain_shift = parent_gain + p.min_gain_to_split
@@ -153,6 +161,7 @@ def best_split(
     lg = jnp.where(best_dir2, left_g2[best_f, best_b], left_g1[best_f, best_b])
     lh = jnp.where(best_dir2, left_h2[best_f, best_b], left_h1[best_f, best_b])
     lc = jnp.where(best_dir2, left_c2[best_f, best_b], left_c1[best_f, best_b])
+    lr = jnp.where(best_dir2, left_r2[best_f, best_b], left_r1[best_f, best_b])
     return SplitResult(
         gain=best_gain,
         feature=best_f,
@@ -161,4 +170,5 @@ def best_split(
         left_grad=lg,
         left_hess=lh,
         left_count=lc,
+        left_rows=lr,
     )

@@ -1,0 +1,230 @@
+"""Compacted (physically partitioned) grower: unit + parity tests.
+
+Mirrors the reference's tree-learner coverage: the compact grower must make
+the same trees as the masked grower (both re-implement
+SerialTreeLearner::Train semantics), and the partition primitives must be
+stable and exact (reference: src/treelearner/data_partition.hpp).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.ops.compact import (RowLayout, go_left_pred, pack_rows,
+                                      partition_segment, segment_histogram,
+                                      segments_to_leaf_vectors, unpack_rows)
+from lightgbm_tpu.ops.grower import GrowerParams, grow_tree
+from lightgbm_tpu.ops.grower_compact import grow_tree_compact
+
+
+def _random_problem(rng, n=600, f=6, b=32, cat_feature=True, nans=True):
+    binned = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+    num_bins = np.full(f, b, np.int32)
+    nan_bin = np.full(f, b - 1, np.int32)
+    has_nan = np.zeros(f, bool)
+    if nans:
+        has_nan[1] = True
+    is_cat = np.zeros(f, bool)
+    if cat_feature:
+        is_cat[2] = True
+    # exactly-representable grad/hess (multiples of 1/32) so histogram sums
+    # are identical regardless of accumulation order -> bit-identical trees
+    grad = rng.randint(-64, 64, size=n).astype(np.float32) / 32.0
+    hess = rng.randint(1, 64, size=n).astype(np.float32) / 32.0
+    cnt = (rng.rand(n) > 0.25).astype(np.float32)
+    grad = grad * cnt
+    hess = hess * cnt
+    return binned, num_bins, nan_bin, has_nan, is_cat, grad, hess, cnt
+
+
+def _params(**kw):
+    defaults = dict(num_leaves=15, max_depth=-1, num_bins=32,
+                    min_data_in_leaf=5.0, min_sum_hessian_in_leaf=1e-3,
+                    hist_impl="xla", part_block=128, hist_block=128)
+    defaults.update(kw)
+    return GrowerParams(**defaults)
+
+
+class TestPartitionSegment:
+    def test_stable_partition_matches_numpy(self, rng):
+        n, f = 700, 4
+        layout = RowLayout(num_features=f, num_extra=1)
+        binned = rng.randint(0, 32, size=(n, f)).astype(np.uint8)
+        grad = rng.randn(n).astype(np.float32)
+        hess = rng.rand(n).astype(np.float32)
+        cnt = np.ones(n, np.float32)
+        row_id = np.arange(n, dtype=np.float32)
+        bs = 128
+        work = pack_rows(jnp.asarray(binned), jnp.asarray(grad),
+                         jnp.asarray(hess), jnp.asarray(cnt),
+                         jnp.asarray(row_id)[None, :], layout, pad_rows=bs)
+        scratch = jnp.zeros_like(work)
+
+        s, m = 100, 460           # partition an interior segment
+        feat, thr = 2, 11
+        pred = binned[s:s + m, feat] <= thr
+        n_left = int(pred.sum())
+
+        work2, _ = jax.jit(
+            partition_segment, static_argnames=("block_size",))(
+            work, scratch, jnp.int32(s), jnp.int32(m), jnp.int32(n_left),
+            jnp.int32(feat), jnp.int32(thr), jnp.asarray(False),
+            jnp.int32(31), jnp.asarray(False), block_size=bs)
+
+        got_b, got_g, got_h, got_c, got_e = unpack_rows(work2, n, layout)
+        got_ids = np.asarray(got_e[0]).astype(np.int64)
+        seg_ids = np.arange(s, s + m)
+        exp_left = seg_ids[pred]
+        exp_right = seg_ids[~pred]
+        # stable: relative order preserved within each side
+        np.testing.assert_array_equal(got_ids[s:s + n_left], exp_left)
+        np.testing.assert_array_equal(got_ids[s + n_left:s + m], exp_right)
+        # outside the segment untouched
+        np.testing.assert_array_equal(got_ids[:s], np.arange(s))
+        np.testing.assert_array_equal(got_ids[s + m:], np.arange(s + m, n))
+        # payload moved with its rows (check grad against permuted original)
+        np.testing.assert_array_equal(np.asarray(got_g), grad[got_ids])
+        np.testing.assert_array_equal(np.asarray(got_b), binned[got_ids])
+
+    def test_nan_default_left_and_categorical(self, rng):
+        n, f = 300, 3
+        layout = RowLayout(num_features=f, num_extra=1)
+        b = 16
+        binned = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+        ids = np.arange(n, dtype=np.float32)
+        bs = 64
+        work = pack_rows(jnp.asarray(binned), jnp.zeros(n, jnp.float32),
+                         jnp.ones(n, jnp.float32), jnp.ones(n, jnp.float32),
+                         jnp.asarray(ids)[None, :], layout, pad_rows=bs)
+        part = jax.jit(partition_segment, static_argnames=("block_size",))
+
+        # numerical with default-left NaN routing
+        pred = (binned[:, 0] <= 3) | (binned[:, 0] == b - 1)
+        nl = int(pred.sum())
+        w2, _ = part(work, jnp.zeros_like(work), jnp.int32(0), jnp.int32(n),
+                     jnp.int32(nl), jnp.int32(0), jnp.int32(3),
+                     jnp.asarray(True), jnp.int32(b - 1), jnp.asarray(False),
+                     block_size=bs)
+        got = np.asarray(unpack_rows(w2, n, layout)[4][0]).astype(int)
+        np.testing.assert_array_equal(got[:nl], np.arange(n)[pred])
+
+        # categorical: left == bin
+        pred = binned[:, 1] == 7
+        nl = int(pred.sum())
+        w2, _ = part(work, jnp.zeros_like(work), jnp.int32(0), jnp.int32(n),
+                     jnp.int32(nl), jnp.int32(1), jnp.int32(7),
+                     jnp.asarray(False), jnp.int32(b - 1), jnp.asarray(True),
+                     block_size=bs)
+        got = np.asarray(unpack_rows(w2, n, layout)[4][0]).astype(int)
+        np.testing.assert_array_equal(got[:nl], np.arange(n)[pred])
+
+
+class TestSegmentHistogram:
+    def test_matches_dense_histogram(self, rng):
+        n, f, b = 500, 4, 16
+        layout = RowLayout(num_features=f, num_extra=0)
+        binned = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+        grad = (rng.randint(-64, 64, size=n) / 32.0).astype(np.float32)
+        hess = (rng.randint(1, 64, size=n) / 32.0).astype(np.float32)
+        cnt = (rng.rand(n) > 0.3).astype(np.float32)
+        work = pack_rows(jnp.asarray(binned), jnp.asarray(grad),
+                         jnp.asarray(hess), jnp.asarray(cnt),
+                         jnp.zeros((0, n), jnp.float32), layout, pad_rows=128)
+        s, m = 37, 401
+        hist = jax.jit(segment_histogram,
+                       static_argnames=("layout", "num_bins", "block_size",
+                                        "impl"))(
+            work, jnp.int32(s), jnp.int32(m), layout, b, 128, "xla")
+        hist = np.asarray(hist)
+        exp = np.zeros((f, b, 4), np.float32)
+        for i in range(s, s + m):
+            for j in range(f):
+                exp[j, binned[i, j]] += [grad[i], hess[i], cnt[i], 1.0]
+        np.testing.assert_allclose(hist, exp, rtol=0, atol=0)
+
+    def test_leaf_vectors_exact(self):
+        starts = jnp.asarray([0, 10, 4, 17], jnp.int32)
+        rows = jnp.asarray([4, 7, 6, 3], jnp.int32)
+        vals = jnp.asarray([0.125, -3.5, 7.75, 1e-30], jnp.float32)
+        row_leaf, row_val = segments_to_leaf_vectors(starts, rows, vals, 20)
+        exp_leaf = np.empty(20, np.int32)
+        exp_val = np.empty(20, np.float32)
+        for l, (s, r, v) in enumerate(zip([0, 10, 4, 17], [4, 7, 6, 3],
+                                          np.asarray(vals))):
+            exp_leaf[s:s + r] = l
+            exp_val[s:s + r] = v
+        np.testing.assert_array_equal(np.asarray(row_leaf), exp_leaf)
+        np.testing.assert_array_equal(np.asarray(row_val), exp_val)
+
+
+class TestCompactGrowerParity:
+    @pytest.mark.parametrize("num_leaves,max_depth", [(15, -1), (8, 3)])
+    def test_same_tree_as_masked(self, rng, num_leaves, max_depth):
+        (binned, num_bins, nan_bin, has_nan, is_cat, grad, hess,
+         cnt) = _random_problem(rng)
+        n, f = binned.shape
+        params = _params(num_leaves=num_leaves, max_depth=max_depth)
+        feat_mask = np.ones(f, bool)
+
+        args = (jnp.asarray(binned), jnp.asarray(grad), jnp.asarray(hess),
+                jnp.asarray(cnt), jnp.asarray(num_bins), jnp.asarray(nan_bin),
+                jnp.asarray(has_nan), jnp.asarray(is_cat),
+                jnp.asarray(feat_mask))
+        tree_m, row_leaf_m = grow_tree(*args, params)
+
+        layout = RowLayout(num_features=f, num_extra=1)
+        pad = max(params.part_block, params.hist_block)
+        row_id = jnp.arange(n, dtype=jnp.float32)
+        work = pack_rows(jnp.asarray(binned), jnp.asarray(grad),
+                         jnp.asarray(hess), jnp.asarray(cnt),
+                         row_id[None, :], layout, pad_rows=pad)
+        tree_c, row_leaf_c, row_val_c, work2, _ = grow_tree_compact(
+            work, jnp.zeros_like(work), jnp.asarray(num_bins),
+            jnp.asarray(nan_bin), jnp.asarray(has_nan), jnp.asarray(is_cat),
+            jnp.asarray(feat_mask), layout, params, n)
+
+        assert int(tree_c.num_nodes) == int(tree_m.num_nodes)
+        nn = int(tree_m.num_nodes)
+        for field in ("split_feature", "split_bin", "default_left",
+                      "left_child", "right_child"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(tree_c, field))[:nn],
+                np.asarray(getattr(tree_m, field))[:nn], err_msg=field)
+        np.testing.assert_allclose(
+            np.asarray(tree_c.leaf_value), np.asarray(tree_m.leaf_value),
+            rtol=1e-6, atol=1e-7)
+
+        # row->leaf assignment matches through the permutation
+        ids = np.asarray(unpack_rows(work2, n, layout)[4][0]).astype(np.int64)
+        assert sorted(ids.tolist()) == list(range(n))  # a real permutation
+        got_leaf = np.empty(n, np.int64)
+        got_leaf[ids] = np.asarray(row_leaf_c)
+        np.testing.assert_array_equal(got_leaf, np.asarray(row_leaf_m))
+        # per-row leaf values match leaf_value[row_leaf]
+        np.testing.assert_array_equal(
+            np.asarray(row_val_c),
+            np.asarray(tree_c.leaf_value)[np.asarray(row_leaf_c)])
+
+    def test_extras_follow_permutation(self, rng):
+        (binned, num_bins, nan_bin, has_nan, is_cat, grad, hess,
+         cnt) = _random_problem(rng, n=400)
+        n, f = binned.shape
+        params = _params(num_leaves=7)
+        layout = RowLayout(num_features=f, num_extra=3)
+        pad = max(params.part_block, params.hist_block)
+        extras = np.stack([np.arange(n, dtype=np.float32),
+                           rng.randn(n).astype(np.float32),
+                           rng.randn(n).astype(np.float32)])
+        work = pack_rows(jnp.asarray(binned), jnp.asarray(grad),
+                         jnp.asarray(hess), jnp.asarray(cnt),
+                         jnp.asarray(extras), layout, pad_rows=pad)
+        _, _, _, work2, _ = grow_tree_compact(
+            work, jnp.zeros_like(work), jnp.asarray(num_bins),
+            jnp.asarray(nan_bin), jnp.asarray(has_nan), jnp.asarray(is_cat),
+            jnp.ones(f, dtype=bool), layout, params, n)
+        got = np.asarray(unpack_rows(work2, n, layout)[4])
+        ids = got[0].astype(np.int64)
+        assert sorted(ids.tolist()) == list(range(n))
+        # every extra column permuted identically (bit-exact)
+        np.testing.assert_array_equal(got[1], extras[1][ids])
+        np.testing.assert_array_equal(got[2], extras[2][ids])
