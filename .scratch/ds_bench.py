@@ -1,0 +1,38 @@
+"""dynamic_slice + dynamic_update_slice + small einsum loop cost (partition body shape)."""
+import time
+import jax, jax.numpy as jnp, numpy as np
+from jax import lax
+
+N, C, BS = 10_500_000, 64, 2048
+R = 2000
+rng = np.random.RandomState(0)
+work = jnp.asarray(rng.randint(0, 255, size=(N, C), dtype=np.uint8))
+offs = jnp.asarray(rng.randint(0, N - 2 * BS, size=R, dtype=np.int32))
+
+@jax.jit
+def run(work, offs):
+    iota2 = jnp.arange(2 * BS, dtype=jnp.int32)
+    def body(i, carry):
+        work, acc = carry
+        o = offs[i]
+        blk = lax.dynamic_slice(work, (o, 0), (BS, C))          # read
+        colv = blk[:, 0].astype(jnp.int32)
+        pred = colv < 128
+        rl = jnp.cumsum(pred.astype(jnp.int32)) - pred
+        rr = jnp.cumsum((~pred).astype(jnp.int32)) - (~pred)
+        dest = jnp.where(pred, rl, BS + rr)
+        oh = (dest[None, :] == iota2[:, None]).astype(jnp.bfloat16)   # [2BS, BS]
+        comp = lax.dot_general(oh, blk.astype(jnp.bfloat16),
+                               (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+        comp8 = comp.astype(jnp.uint8)
+        work = lax.dynamic_update_slice(work, comp8[:BS], (o, 0))     # write
+        return work, acc + comp[0, 0]
+    work, acc = lax.fori_loop(0, R, body, (work, jnp.float32(0)))
+    return acc
+
+s = run(work, offs); float(s)
+t0 = time.perf_counter()
+s = run(work, offs); float(s)
+dt = (time.perf_counter() - t0 - 0.13) / R
+print(f"partition-body step BS={BS} C={C}: {dt*1e6:.1f} us/block -> {BS/dt/1e6:.1f} Mrows/s")

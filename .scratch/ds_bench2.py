@@ -1,0 +1,39 @@
+import time
+import jax, jax.numpy as jnp, numpy as np
+from jax import lax
+
+N, C = 10_500_000, 64
+R = 2000
+rng = np.random.RandomState(0)
+work0 = jnp.asarray(rng.randint(0, 255, size=(N, C), dtype=np.uint8))
+offs = jnp.asarray(rng.randint(0, N - 8192, size=R, dtype=np.int32))
+
+def make(BS):
+    @jax.jit
+    def run(work, offs):
+        iota2 = jnp.arange(2 * BS, dtype=jnp.int32)
+        def body(i, work):
+            o = offs[i]
+            blk = lax.dynamic_slice(work, (o, 0), (BS, C))
+            colv = blk[:, 0].astype(jnp.int32)
+            pred = colv < 128
+            rl = jnp.cumsum(pred.astype(jnp.int32)) - pred
+            rr = jnp.cumsum((~pred).astype(jnp.int32)) - (~pred)
+            dest = jnp.where(pred, rl, BS + rr)
+            oh = (dest[None, :] == iota2[:, None]).astype(jnp.bfloat16)
+            comp = lax.dot_general(oh, blk.astype(jnp.bfloat16),
+                                   (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+            work = lax.dynamic_update_slice(work, comp[:BS].astype(jnp.uint8), (o, 0))
+            return work
+        work = lax.fori_loop(0, R, body, work)
+        return work[0, 0].astype(jnp.float32) + work[N - 1, 0].astype(jnp.float32)
+    return run
+
+for BS in (512, 1024, 2048, 4096):
+    run = make(BS)
+    s = run(work0, offs); float(s)
+    t0 = time.perf_counter()
+    s = run(work0, offs); float(s)
+    dt = (time.perf_counter() - t0 - 0.13) / R
+    print(f"BS={BS:5d}: {dt*1e6:8.1f} us/block  {BS/dt/1e6:8.1f} Mrows/s")

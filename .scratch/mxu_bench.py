@@ -1,0 +1,51 @@
+"""One-hot histogram contraction throughput: orientation x dtype."""
+import time
+import jax, jax.numpy as jnp, numpy as np
+from jax import lax
+
+BS = 131072      # rows per block
+F, B, K = 28, 256, 8
+FB = F * B
+R = 20
+rng = np.random.RandomState(0)
+bins = jnp.asarray(rng.randint(0, B, size=(BS, F), dtype=np.uint8))
+ch = jnp.asarray(rng.randn(BS, K).astype(np.float32))
+
+def bench(name, fn, *args, oh_elems=BS*FB):
+    s = fn(*args); jax.block_until_ready(s); float(jnp.sum(s))
+    t0 = time.perf_counter()
+    s = fn(*args)
+    float(jnp.sum(s))
+    dt = (time.perf_counter() - t0 - 0.13) / R
+    print(f"{name:46s} {dt*1e3:8.2f} ms  {oh_elems/dt/1e12:7.2f} Telem/s")
+
+def loopy(body):
+    @jax.jit
+    def run(*args):
+        def step(i, acc):
+            return acc + body(i, *args)
+        return lax.fori_loop(0, R, step, jnp.zeros((FB, K), jnp.float32))
+    return run
+
+iota = jnp.arange(B, dtype=jnp.int32)
+
+def make(dtype, prec, transpose=False):
+    def body(i, bins, ch):
+        b32 = (bins + (i % 2).astype(jnp.uint8)).astype(jnp.int32)
+        oh = (b32[:, :, None] == iota).astype(dtype).reshape(BS, FB)
+        c = ch.astype(dtype)
+        if transpose:
+            out = lax.dot_general(c, oh, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)  # [K, FB]
+            return out.T
+        return lax.dot_general(oh, c, (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32,
+                               precision=prec)
+    return loopy(body)
+
+print(f"BS={BS} F={F} B={B} K={K}")
+bench("oh[BS,FB]^T @ ch[BS,8]  f32 HIGHEST", make(jnp.float32, lax.Precision.HIGHEST), bins, ch)
+bench("oh[BS,FB]^T @ ch[BS,8]  f32 DEFAULT", make(jnp.float32, lax.Precision.DEFAULT), bins, ch)
+bench("oh[BS,FB]^T @ ch[BS,8]  bf16", make(jnp.bfloat16, lax.Precision.DEFAULT), bins, ch)
+bench("ch.T[8,BS] @ oh[BS,FB]  bf16 (K-major)", make(jnp.bfloat16, None, transpose=True), bins, ch)
+bench("oh^T @ ch  int8->int32", make(jnp.int8, lax.Precision.DEFAULT), bins, jnp.ones((BS, K), jnp.float32))

@@ -1,0 +1,35 @@
+"""Tune pallas_histogram vs XLA at bench shapes."""
+import time, itertools
+import jax, jax.numpy as jnp, numpy as np
+from jax import lax
+import sys
+sys.path.insert(0, "/root/repo")
+from lightgbm_tpu.ops.pallas_histogram import pallas_histogram
+from lightgbm_tpu.ops.histogram import _xla_histogram
+
+N = 1 << 20   # 1M rows per call
+F, B, K = 28, 256, 3
+rng = np.random.RandomState(0)
+bins = jnp.asarray(rng.randint(0, B, size=(N, F), dtype=np.uint8))
+ch = jnp.asarray(rng.randn(N, K).astype(np.float32))
+oh_elems = N * F * B
+
+def bench(name, fn, reps=5):
+    try:
+        out = fn()
+        jax.block_until_ready(out); float(jnp.sum(out))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn()
+        float(jnp.sum(out))
+        dt = (time.perf_counter() - t0 - 0.13) / reps
+        print(f"{name:52s} {dt*1e3:8.2f} ms  {oh_elems/dt/1e12:7.3f} Telem/s")
+    except Exception as e:
+        print(f"{name:52s} FAIL {type(e).__name__}: {str(e)[:120]}")
+
+bench("xla one-hot einsum (HIGHEST)", lambda: _xla_histogram(bins, ch, B))
+for rb, fc, fast in itertools.product([1024, 2048, 4096, 8192], [2, 4, 7, 14, 28], [True]):
+    bench(f"pallas rb={rb} fc={fc} fast={fast}",
+          lambda rb=rb, fc=fc, fast=fast: pallas_histogram(bins, ch, B, row_block=rb, f_chunk=fc, fast=fast))
+bench("pallas rb=2048 fc=4 fast=False",
+      lambda: pallas_histogram(bins, ch, B, row_block=2048, f_chunk=4, fast=False))

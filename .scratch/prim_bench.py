@@ -1,0 +1,72 @@
+"""Microbench of TPU primitives that decide the compacted-grower design."""
+import time
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = 10_500_000
+F = 28
+rng = np.random.RandomState(0)
+
+binned = jnp.asarray(rng.randint(0, 255, size=(N, F), dtype=np.uint8))
+idx = jnp.asarray(rng.permutation(N).astype(np.int32))
+vals = jnp.asarray(rng.randn(N).astype(np.float32))
+keys = jnp.asarray(rng.randint(0, 1 << 30, size=N, dtype=np.int32))
+
+
+def bench(name, fn, *args, reps=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:40s} {dt*1e3:9.2f} ms   {N/dt/1e9:8.2f} Gelem/s")
+    return dt
+
+
+@jax.jit
+def gather_rows(b, i):
+    return jnp.take(b, i, axis=0)
+
+
+@jax.jit
+def gather_1d(v, i):
+    return jnp.take(v, i)
+
+
+@jax.jit
+def scatter_1d(v, i, x):
+    return v.at[i].set(x, unique_indices=True, mode="drop")
+
+
+@jax.jit
+def scatter_add_1d(v, i, x):
+    return v.at[i].add(x, mode="drop")
+
+
+@jax.jit
+def cumsum_1d(v):
+    return jnp.cumsum(v)
+
+
+@jax.jit
+def sort_kv(k, v):
+    return jax.lax.sort((k, v), num_keys=1)
+
+
+@jax.jit
+def argsort_1bit(k):
+    # stable partition via argsort of a 0/1 key
+    return jnp.argsort(k & 1, stable=True)
+
+
+print(f"N={N} F={F} device={jax.devices()[0]}")
+bench("gather rows [N,28] u8", gather_rows, binned, idx)
+bench("gather 1d f32", gather_1d, vals, idx)
+bench("scatter 1d set f32 (unique)", scatter_1d, vals, idx, vals)
+bench("scatter 1d add f32", scatter_add_1d, vals, idx, vals)
+bench("cumsum 1d f32", cumsum_1d, vals)
+bench("sort 1d i32 key + i32 payload", sort_kv, keys, idx)
+bench("argsort 1-bit stable (partition)", argsort_1bit, keys)

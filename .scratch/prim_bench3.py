@@ -1,0 +1,49 @@
+"""Microbench: chain R reps on-device in one dispatch (data-dependent)."""
+import time
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+N = 10_500_000
+F = 28
+R = 20
+rng = np.random.RandomState(0)
+
+binned = jnp.asarray(rng.randint(0, 255, size=(N, F), dtype=np.uint8))
+idx = jnp.asarray(rng.permutation(N).astype(np.int32))
+vals = jnp.asarray(rng.randn(N).astype(np.float32))
+keys = jnp.asarray(rng.randint(0, 1 << 30, size=N, dtype=np.int32))
+
+
+def bench(name, fn, *args, elems=N):
+    s = fn(*args); float(s)
+    t0 = time.perf_counter()
+    s = fn(*args); float(s)
+    dt = (time.perf_counter() - t0 - 0.13) / R   # subtract ~RTT
+    print(f"{name:40s} {dt*1e3:9.2f} ms   {elems/dt/1e9:8.2f} Gelem/s")
+
+
+def loopy(body):
+    @jax.jit
+    def run(*args):
+        def step(i, carry):
+            return body(i, carry, *args)
+        out = lax.fori_loop(0, R, step, jnp.float32(0))
+        return out
+    return run
+
+g_rows = loopy(lambda i, c, b, ix: c + jnp.take(b, (ix + i) % N, axis=0).sum(dtype=jnp.int32).astype(jnp.float32))
+g_1d   = loopy(lambda i, c, v, ix: c + jnp.take(v, (ix + i) % N).sum())
+s_set  = loopy(lambda i, c, v, ix: c + (v + c).at[(ix + i) % N].set(v, unique_indices=True, mode="drop").sum())
+s_add  = loopy(lambda i, c, v, ix: c + (v + c).at[(ix + i) % N].add(v, mode="drop").sum())
+csum   = loopy(lambda i, c, v: c + jnp.cumsum(v + c)[-1] * 1e-9)
+srt    = loopy(lambda i, c, k, v: c + lax.sort(((k + i.astype(jnp.int32)), v), num_keys=1)[1][-1].astype(jnp.float32) * 1e-9)
+
+print(f"N={N} F={F} R={R} device={jax.devices()[0]}")
+bench("gather rows [N,28] u8", g_rows, binned, idx, elems=N)
+bench("gather 1d f32", g_1d, vals, idx)
+bench("scatter 1d set f32 (unique)", s_set, vals, idx)
+bench("scatter 1d add f32", s_add, vals, idx)
+bench("cumsum 1d f32", csum, vals)
+bench("sort 1d i32 key + i32 payload", srt, keys, idx)

@@ -1,0 +1,44 @@
+"""Sorted vs random gather; row width variants."""
+import time
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+N = 10_500_000
+R = 10
+rng = np.random.RandomState(0)
+
+binned28 = jnp.asarray(rng.randint(0, 255, size=(N, 28), dtype=np.uint8))
+binned32 = jnp.asarray(rng.randint(0, 255, size=(N, 32), dtype=np.uint8))
+packed8  = jnp.asarray(rng.randint(0, 2**31, size=(N, 8), dtype=np.int32))
+vals = jnp.asarray(rng.randn(N).astype(np.float32))
+
+M = N // 2
+sub_sorted = jnp.asarray(np.sort(rng.choice(N, size=M, replace=False)).astype(np.int32))
+sub_rand = jnp.asarray(rng.choice(N, size=M, replace=False).astype(np.int32))
+
+
+def bench(name, fn, *args, elems):
+    s = fn(*args); float(s)
+    t0 = time.perf_counter()
+    s = fn(*args); float(s)
+    dt = (time.perf_counter() - t0 - 0.13) / R
+    print(f"{name:44s} {dt*1e3:9.2f} ms   {elems/dt/1e9:8.3f} Grows/s")
+
+
+def loopy(body):
+    @jax.jit
+    def run(*args):
+        return lax.fori_loop(0, R, lambda i, c: body(i, c, *args), jnp.float32(0))
+    return run
+
+g28 = loopy(lambda i, c, b, ix: c + jnp.take(b, jnp.minimum(ix + i, N - 1), axis=0).sum(dtype=jnp.int32).astype(jnp.float32))
+g1d = loopy(lambda i, c, v, ix: c + jnp.take(v, jnp.minimum(ix + i, N - 1)).sum())
+
+print(f"N={N} M={M} device={jax.devices()[0]}")
+bench("gather rows u8[.,28] SORTED idx", g28, binned28, sub_sorted, elems=M)
+bench("gather rows u8[.,28] RANDOM idx", g28, binned28, sub_rand, elems=M)
+bench("gather rows u8[.,32] SORTED idx", g28, binned32, sub_sorted, elems=M)
+bench("gather rows i32[.,8] SORTED idx", g28, packed8, sub_sorted, elems=M)
+bench("gather 1d f32 SORTED idx", g1d, vals, sub_sorted, elems=M)

@@ -27,13 +27,22 @@ import numpy as np
 from ..io.dataset import BinnedDataset
 from ..metrics import Metric
 from ..objectives import Objective
+from ..ops.compact import RowLayout, pack_rows, segments_to_leaf_vectors
 from ..ops.grower import GrowerParams, TreeArrays, grow_tree
+from ..ops.grower_compact import grow_tree_compact
 from ..ops.predict import StackedTrees, predict_raw, route_one_tree
 from ..ops.renew import renew_leaf_quantile
 from ..utils import log
 from .sample_strategy import create_sample_strategy
 
 _EPS = 1e-35
+
+
+def _clamp_block(block: int, n: int, floor: int = 128) -> int:
+    """Shrink a streaming block size toward the data size (power-of-two)."""
+    while block // 2 >= max(n, floor) and block > floor:
+        block //= 2
+    return max(block, floor)
 
 
 class HostTree:
@@ -219,6 +228,8 @@ class GBDT:
         self.train_metrics: List[Metric] = []
         self.best_iteration = -1
         self._device_trees_cache: Optional[StackedTrees] = None
+        self._use_compact = False
+        self._compact = None
 
         if train_set is not None:
             self._setup_train(train_set)
@@ -273,7 +284,39 @@ class GBDT:
             min_gain_to_split=float(cfg.get("min_gain_to_split", 0.0)),
             max_delta_step=float(cfg.get("max_delta_step", 0.0)),
             hist_impl=str(cfg.get("tpu_hist_impl", "auto")),
+            part_block=_clamp_block(
+                int(cfg.get("tpu_part_block", 2048)), self._n_real),
+            hist_block=_clamp_block(
+                int(cfg.get("tpu_hist_block", 16384)), self._n_real),
         )
+
+        # serial-learner row storage: the compact grower physically
+        # partitions rows into per-leaf segments — O(N*depth) per tree
+        # instead of the masked grower's O(N*num_leaves) (see
+        # ops/grower_compact.py). It requires row-elementwise gradients
+        # (the rows live in a per-tree permuted order).
+        grower = str(cfg.get("tpu_grower", "auto")).lower()
+        can_compact = (
+            self.mesh is None
+            and self.objective is not None
+            and getattr(self.objective, "row_elementwise", True)
+            and not getattr(self.objective, "is_stochastic", False)
+            and int(train_set.max_num_bins) <= 256
+            and self._n_real < (1 << 24)
+            # balanced / by-query bagging and query-structured train metrics
+            # index rows in the original order
+            and float(cfg.get("pos_bagging_fraction", 1.0)) >= 1.0
+            and float(cfg.get("neg_bagging_fraction", 1.0)) >= 1.0
+            and not bool(cfg.get("bagging_by_query", False))
+            and train_set.metadata.query_boundaries is None
+        )
+        if grower == "compact" and not can_compact:
+            log.warning("tpu_grower=compact requires a serial learner and a "
+                        "row-elementwise objective; using masked grower")
+        self._use_compact = can_compact and (
+            grower == "compact"
+            or (grower == "auto" and self._n_real >= 65536))
+        self._compact = None          # lazy _CompactTrainState
         md = train_set.metadata if not pad else _pad_metadata(
             train_set.metadata, self.num_data)
         if self.objective is not None:
@@ -345,6 +388,264 @@ class GBDT:
 
         return jax.jit(step)
 
+    # -- compact (physically partitioned) serial path ------------------------
+    def _setup_compact_state(self) -> None:
+        """Build the packed row-record arrays for the compact grower
+        (ops/grower_compact.py). Extras carried through every partition:
+        [scores(K), objective label, objective weight?, original row id]."""
+        obj = self.objective
+        n = self._n_real
+        if n >= (1 << 24):
+            # f32 raw-count histograms drive the partition offsets and f32
+            # row ids drive the metric permutation; both are exact only
+            # below 2^24 rows (ops/compact.py)
+            raise RuntimeError(
+                "tpu_grower=compact supports up to 2^24 rows per chip; use "
+                "tree_learner=data to shard rows or tpu_grower=masked")
+        k = self.num_tree_per_iteration
+        has_w = obj.weight is not None
+        # extras: [scores(K), grads(K-1 extra pairs for multiclass), label,
+        # weight?, rowid]. For K>1 the per-class gradients are computed once
+        # per iteration (reference: GBDT::Boosting before the class-tree
+        # loop, gbdt.cpp:220) and must ride the permutations of earlier
+        # same-iteration trees, so they live in carried columns.
+        self._cx_grads = k if k > 1 else None
+        gcols = 2 * k if k > 1 else 0
+        e = k + gcols + 1 + (1 if has_w else 0) + 1
+        layout = RowLayout(num_features=int(self.binned.shape[1]), num_extra=e)
+        self._cx_label = k + gcols
+        self._cx_weight = k + gcols + 1 if has_w else None
+        self._cx_rowid = e - 1
+        gp = self.grower_params
+        pad = max(gp.part_block, gp.hist_block)
+        parts = [self.train_score]
+        if gcols:
+            parts.append(jnp.zeros((gcols, n), jnp.float32))
+        parts.append(obj.label[None, :])
+        if has_w:
+            parts.append(obj.weight[None, :])
+        parts.append(jnp.arange(n, dtype=jnp.float32)[None, :])
+        extras = jnp.concatenate(parts, axis=0)
+        zeros = jnp.zeros((n,), jnp.float32)
+        work = pack_rows(self.binned, zeros, zeros, jnp.ones((n,), jnp.float32),
+                         extras, layout, pad_rows=pad)
+        self._compact = {
+            "layout": layout,
+            "work": work,
+            "scratch": jnp.zeros_like(work),
+            "step": None,
+            "epoch": 0,        # bumped per grown tree; keys the perm cache
+            "perm_epoch": -1,
+            "perm": None,
+        }
+
+    def _compact_cols(self, work, *extra_idx):
+        """Unpack selected extra f32 columns from the work array."""
+        from ..ops.compact import _u8_to_f32
+        layout = self._compact["layout"]
+        n = self._n_real
+        out = []
+        for i in extra_idx:
+            off = layout.extra_off + 4 * i
+            out.append(_u8_to_f32(work[:n, off:off + 4]))
+        return out
+
+    def _build_compact_step_fn(self):
+        """One fused jitted step per tree on the compact path: recompute
+        gradients in the current row order, write the per-tree columns, grow
+        (partitioning rows), renew/shrink leaves, and update scores — a
+        single XLA program, zero host syncs. The work/scratch buffers are
+        donated (updated in place)."""
+        from jax import lax
+        from ..ops.compact import _f32_to_u8, _u8_to_f32
+
+        obj = self.objective
+        renew = obj.renew_leaves
+        layout = self._compact["layout"]
+        gp = self.grower_params
+        k_total = self.num_tree_per_iteration
+        n = self._n_real
+        max_leaves = self.max_leaves
+        num_bins_arr = self.num_bins_arr
+        nan_bin_arr = self.nan_bin_arr
+        has_nan_arr = self.has_nan_arr
+        is_cat_arr = self.is_cat_arr
+        sc_off = layout.extra_off            # K score columns live first
+        lbl_off = layout.extra_off + 4 * self._cx_label
+        w_off = (layout.extra_off + 4 * self._cx_weight
+                 if self._cx_weight is not None else None)
+
+        def col(work, off):                  # [n] f32 from 4 u8 columns
+            return _u8_to_f32(work[:n, off:off + 4])
+
+        def scores_of(work):                 # [K, n] f32
+            raw = work[:n, sc_off:sc_off + 4 * k_total]
+            return _u8_to_f32(raw.reshape(n, k_total, 4)).T
+
+        def bound_gradients(scores, label, weight):
+            old_l, old_w = obj.label, obj.weight
+            obj.label, obj.weight = label, weight
+            try:
+                if k_total == 1:
+                    g, h = obj.get_gradients(scores[0])
+                    return g[None, :], h[None, :]
+                return obj.get_gradients(scores)
+            finally:
+                obj.label, obj.weight = old_l, old_w
+
+        gx_off = (layout.extra_off + 4 * self._cx_grads
+                  if self._cx_grads is not None else None)
+
+        def step(work, scratch, scores, bag_w, use_stored_bag, feat_mask,
+                 shrinkage, k):
+            pad_n = work.shape[0] - n
+
+            def set_col(work, off, vec):     # vec: [n] f32
+                return work.at[:, off:off + 4].set(
+                    _f32_to_u8(jnp.pad(vec, (0, pad_n))))
+
+            w_col = jnp.where(use_stored_bag, col(work, layout.cnt_off),
+                              bag_w)
+            label = col(work, lbl_off)
+            weight = col(work, w_off) if w_off is not None else None
+            if k_total == 1:
+                g, h = bound_gradients(scores, label, weight)
+                g_k, h_k = g[0], h[0]
+            elif k == 0:
+                # all K class gradients once per iteration, from the
+                # iteration-start scores (reference: GBDT::Boosting runs
+                # before the per-class tree loop, gbdt.cpp:220); stored in
+                # carried columns so later trees see them permutation-aligned
+                g, h = bound_gradients(scores, label, weight)
+                for j in range(k_total):
+                    work = set_col(work, gx_off + 4 * j, g[j])
+                    work = set_col(work, gx_off + 4 * (k_total + j), h[j])
+                g_k, h_k = g[0], h[0]
+            else:
+                g_k = col(work, gx_off + 4 * k)
+                h_k = col(work, gx_off + 4 * (k_total + k))
+            work = set_col(work, layout.grad_off, g_k * w_col)
+            work = set_col(work, layout.hess_off, h_k * w_col)
+            work = set_col(work, layout.cnt_off, w_col)
+            # scores are authoritative outside the work array; write all K
+            # columns fresh so they ride the partition correctly
+            for j in range(k_total):
+                work = set_col(work, sc_off + 4 * j, scores[j])
+
+            (tree, row_leaf, _row_value, work, scratch, leaf_start,
+             leaf_nrows) = grow_tree_compact(
+                work, scratch, num_bins_arr, nan_bin_arr, has_nan_arr,
+                is_cat_arr, feat_mask, layout, gp, n)
+
+            leaf_value = tree.leaf_value
+            if renew:
+                residual = col(work, lbl_off) - scores_of(work)[k]
+                wts = (col(work, layout.cnt_off) != 0.0).astype(jnp.float32)
+                if w_off is not None:
+                    wts = wts * col(work, w_off)
+                renewed = renew_leaf_quantile(
+                    residual, wts, row_leaf, max_leaves,
+                    float(obj.renew_alpha))
+                live = jnp.arange(max_leaves) < tree.num_leaves
+                leaf_value = jnp.where(live, renewed, leaf_value)
+
+            lv = jnp.where(tree.num_nodes > 0, leaf_value, 0.0) * shrinkage
+            tree = tree._replace(
+                leaf_value=lv,
+                internal_value=tree.internal_value * shrinkage)
+            _, row_delta = segments_to_leaf_vectors(
+                leaf_start, leaf_nrows, lv, n)
+            sc = scores_of(work).at[k].add(row_delta)
+            return tree, work, scratch, sc
+
+        return jax.jit(step, donate_argnums=(0, 1), static_argnames=("k",))
+
+    def _compact_perm(self) -> np.ndarray:
+        """Current row permutation (original index per position), cached per
+        grown tree — used to reorder host-side metric arrays."""
+        c = self._compact
+        if c["perm_epoch"] != c["epoch"]:
+            (rid,) = self._compact_cols(c["work"], self._cx_rowid)
+            c["perm"] = np.asarray(rid).astype(np.int64)
+            c["perm_epoch"] = c["epoch"]
+        return c["perm"]
+
+    def _compact_gradients(self):
+        """Gradients in the current (permuted) row order, for GOSS ranking."""
+        c = self._compact
+        if c.get("grad_fn") is None:
+            obj = self.objective
+            k_total = self.num_tree_per_iteration
+
+            def fn(scores, label, weight):
+                old_l, old_w = obj.label, obj.weight
+                obj.label, obj.weight = label, weight
+                try:
+                    if k_total == 1:
+                        g, h = obj.get_gradients(scores[0])
+                        return g[None, :], h[None, :]
+                    return obj.get_gradients(scores)
+                finally:
+                    obj.label, obj.weight = old_l, old_w
+
+            c["grad_fn"] = jax.jit(fn) \
+                if not getattr(self.objective, "is_stochastic", False) else fn
+        label, = self._compact_cols(c["work"], self._cx_label)
+        weight = (self._compact_cols(c["work"], self._cx_weight)[0]
+                  if self._cx_weight is not None else None)
+        return c["grad_fn"](self.train_score, label, weight)
+
+    def _train_one_iter_compact(self) -> bool:
+        """Compact-path iteration (same contract as train_one_iter)."""
+        self._boost_from_average()
+        c = self._compact
+        if c["step"] is None:
+            c["step"] = self._build_compact_step_fn()
+        strat = self.sample_strategy
+        n = self._n_real
+
+        # GOSS ranks rows by gradient magnitude; compute in current order
+        g = h = None
+        if strat.is_hessian_change:
+            g, h = self._compact_gradients()
+        mask = strat.bag_mask(self.iter_, g, h)
+        # fresh == the strategy actually drew a new bag this iteration; a
+        # reused (cached) bag must come from the stored sample-weight column,
+        # which rode the partitions and is in the current row order — the
+        # host-cached vector is not
+        fresh = getattr(strat, "last_fresh", mask is not None)
+        if mask is None:
+            mask = jnp.ones((n,), jnp.float32)
+            fresh = self.iter_ == 0 or fresh
+        if getattr(strat, "_amplify", None) is not None:
+            mask = mask * strat._amplify
+
+        feat_mask = self._feature_mask()
+        first_iter = self.num_total_trees < self.num_tree_per_iteration
+        k_total = self.num_tree_per_iteration
+        for k in range(k_total):
+            # trees after the first in an iteration reuse the stored bag
+            # (same bag for all trees of one iteration, like the reference)
+            use_stored = not (fresh and k == 0)
+            tree, work, scratch, scores = c["step"](
+                c["work"], c["scratch"], self.train_score, mask,
+                jnp.asarray(use_stored), feat_mask,
+                jnp.float32(self.shrinkage_rate), k=k)
+            c["work"], c["scratch"] = work, scratch
+            c["epoch"] += 1
+            self.train_score = scores
+            self._update_valid_scores(tree, k)
+            if first_iter and abs(self._init_scores[k]) > 1e-10:
+                tree = tree._replace(
+                    leaf_value=tree.leaf_value + self._init_scores[k])
+            self._dev_trees.append((tree, self.shrinkage_rate))
+            self._device_trees_cache = None
+
+        self.iter_ += 1
+        if len(self._dev_trees) >= k_total * self.stop_check_freq:
+            return self._flush_trees()
+        return False
+
     def add_valid(self, valid_set: BinnedDataset, name: str,
                   metrics: Sequence[Metric]) -> None:
         vs = _ValidSet(valid_set, self.num_tree_per_iteration, name,
@@ -406,6 +707,18 @@ class GBDT:
         """Train trees for one iteration; True when training should stop
         (reference: GBDT::TrainOneIter, gbdt.cpp:344)."""
         k, n = self.num_tree_per_iteration, self.num_data
+        if self._use_compact:
+            if gradients is not None or hessians is not None:
+                if self._compact is not None:
+                    raise RuntimeError(
+                        "cannot switch to caller-supplied gradients after "
+                        "compact training started; set tpu_grower=masked")
+                # caller-supplied gradients arrive in the original row order
+                self._use_compact = False
+            else:
+                if self._compact is None:
+                    self._setup_compact_state()
+                return self._train_one_iter_compact()
         if gradients is None or hessians is None:
             self._boost_from_average()
             grad, hess = self._gradients()
@@ -542,8 +855,8 @@ class GBDT:
         nn = jnp.asarray(host.num_nodes)
         lv = jnp.asarray(host.leaf_value * factor)
         if train:
-            leaf = route_one_tree(self.binned, sf, sb, dl, lc, rc, nn,
-                                  self.nan_bin_arr, self.is_cat_arr)
+            leaf = route_one_tree(self._routing_binned(), sf, sb, dl, lc, rc,
+                                  nn, self.nan_bin_arr, self.is_cat_arr)
             self.train_score = self.train_score.at[cur_tree_id].set(
                 _add_leaf_outputs(self.train_score[cur_tree_id], lv, leaf))
         if valid:
@@ -566,8 +879,39 @@ class GBDT:
         self._device_trees_cache = None
         self.iter_ -= 1
 
+    def _routing_binned(self) -> jax.Array:
+        """Binned rows in the same order as the cached train scores (the
+        compact grower permutes rows; DART drops / rollback route through
+        the current work order)."""
+        if self._compact is not None:
+            f = self._compact["layout"].num_features
+            return self._compact["work"][: self._n_real, :f]
+        return self.binned
+
     # -- evaluation ----------------------------------------------------------
     def eval_train(self) -> List[Tuple[str, str, float, bool]]:
+        if self._compact is not None and self.train_metrics:
+            # train scores live in the compact grower's permuted row order;
+            # give the metrics matching label/weight views
+            perm = self._compact_perm()
+            swaps = []
+            for m in self.train_metrics:
+                lbl = getattr(m, "label", None)
+                wgt = getattr(m, "weight", None)
+                swaps.append((m, lbl, wgt))
+                if lbl is not None:
+                    m.label = np.asarray(lbl)[perm]
+                if wgt is not None:
+                    m.weight = np.asarray(wgt)[perm]
+            try:
+                return self._eval("training", np.asarray(self.train_score),
+                                  self.train_metrics)
+            finally:
+                for m, lbl, wgt in swaps:
+                    if lbl is not None:
+                        m.label = lbl
+                    if wgt is not None:
+                        m.weight = wgt
         return self._eval("training", np.asarray(self.train_score),
                           self.train_metrics)
 

@@ -27,6 +27,9 @@ class SampleStrategy:
     """Produces the per-iteration in-bag mask [N] (float {0,1})."""
 
     is_hessian_change = False
+    # True when the last bag_mask() call drew a NEW bag (vs reusing a cached
+    # one) — the compact grower stores reused bags in its permuted row records
+    last_fresh = False
 
     def __init__(self, config, num_data: int, metadata=None):
         self.config = config
@@ -72,10 +75,12 @@ class BaggingStrategy(SampleStrategy):
             self._num_queries = len(qb) - 1
 
     def bag_mask(self, iter_num, grad, hess):
+        self.last_fresh = False
         if not self.enabled:
             return None
         if iter_num % self.freq != 0 and self._cached is not None:
             return self._cached
+        self.last_fresh = True
         key = jax.random.PRNGKey(self.seed + iter_num // max(self.freq, 1))
         if self.by_query and self._row_query is not None:
             qkeep = jax.random.uniform(key, (self._num_queries,)) < self.fraction
@@ -110,9 +115,11 @@ class GOSSStrategy(SampleStrategy):
     def bag_mask(self, iter_num, grad, hess):
         # warm-up: no sampling for the first 1/learning_rate iterations
         # (reference: goss.hpp Bagging's early return)
+        self.last_fresh = False
         if iter_num < int(1.0 / max(self.learning_rate, 1e-12)):
             self._amplify = None
             return None
+        self.last_fresh = True
         # multiclass: magnitude summed over class rows (reference sums |g|*h)
         mag = jnp.sum(jnp.abs(grad) * hess, axis=0)
         thresh = jnp.quantile(mag, 1.0 - self.top_rate)

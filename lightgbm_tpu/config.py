@@ -165,6 +165,12 @@ PARAMS: Dict[str, Tuple[Any, type, Tuple[str, ...]]] = {
     # tpu-specific (new in this framework; no reference analogue)
     "tpu_double_hist": (False, bool, ()),   # f64 histogram accumulation (CPU/testing)
     "tpu_hist_impl": ("auto", str, ()),     # auto | xla | pallas
+    # serial-learner row storage: 'compact' physically partitions rows into
+    # per-leaf segments (O(N*depth)/tree), 'masked' streams all rows per
+    # split (O(N*num_leaves)/tree); 'auto' picks compact for large data
+    "tpu_grower": ("auto", str, ()),        # auto | compact | masked
+    "tpu_part_block": (2048, int, ()),      # compact partition stream block
+    "tpu_hist_block": (16384, int, ()),     # compact histogram stream block
     "num_shards": (0, int, ()),             # 0 = use all local devices when tree_learner != serial
     # snapshot / continue
     "snapshot_freq": (-1, int, ("save_period",)),

@@ -43,6 +43,9 @@ class Objective:
     # leaves renewed after growth (reference: RegressionL1loss::RenewTreeOutput)
     renew_leaves = False
     is_ranking = False
+    # gradients depend only on this row's (label, weight, scores) — required
+    # by the compact grower, whose rows live in a per-tree permuted order
+    row_elementwise = True
 
     def __init__(self, config):
         self.config = config
@@ -302,7 +305,10 @@ class BinaryLogloss(Objective):
 
     def get_gradients(self, score):
         sig = self.sigmoid
-        y = self.label01
+        # derived inline from self.label: the compact grower rebinds label
+        # per-tree (rows live in a permuted order), so gradients may depend
+        # only on self.label / self.weight (see Objective.row_elementwise)
+        y = (self.label > 0).astype(jnp.float32)
         p = jax.nn.sigmoid(sig * score)
         neg_w, pos_w = self.label_weights
         w = jnp.where(y > 0, pos_w, neg_w)
@@ -348,14 +354,14 @@ class MulticlassSoftmax(Objective):
             raise ValueError(
                 f"multiclass labels must be in [0, {self.num_class}); "
                 f"got range [{lbl.min()}, {lbl.max()}]")
-        self.onehot = jnp.asarray(
-            np.eye(self.num_class, dtype=np.float32)[lbl])  # [N, K]
         self._class_counts = np.bincount(lbl, minlength=self.num_class)
 
     def get_gradients(self, score):
-        # score: [K, N]
+        # score: [K, N]; one-hot derived inline from self.label (see
+        # Objective.row_elementwise — the compact grower rebinds label)
         p = jax.nn.softmax(score, axis=0)                   # [K, N]
-        y = self.onehot.T                                   # [K, N]
+        classes = jnp.arange(self.num_class, dtype=jnp.float32)
+        y = (self.label[None, :] == classes[:, None]).astype(jnp.float32)
         grad = p - y
         factor = self.num_class / (self.num_class - 1.0)
         hess = factor * p * (1.0 - p)
@@ -390,13 +396,13 @@ class MulticlassOVA(Objective):
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
         lbl = _np(metadata.label).astype(np.int32)
-        self.onehot = jnp.asarray(np.eye(self.num_class, dtype=np.float32)[lbl])
         self._class_rates = (
             np.bincount(lbl, minlength=self.num_class) / max(len(lbl), 1))
 
     def get_gradients(self, score):
         sig = self.sigmoid
-        y = self.onehot.T
+        classes = jnp.arange(self.num_class, dtype=jnp.float32)
+        y = (self.label[None, :] == classes[:, None]).astype(jnp.float32)
         p = jax.nn.sigmoid(sig * score)
         grad = (p - y) * sig
         hess = p * (1.0 - p) * sig * sig
@@ -487,6 +493,7 @@ def _pad_queries(boundaries: np.ndarray) -> Tuple[np.ndarray, int]:
 
 
 class LambdarankNDCG(Objective):
+    row_elementwise = False
     """LambdaRank with |ΔNDCG| weighting.
 
     The reference computes per-query lambda gradients with a sorted-document scan
@@ -604,6 +611,7 @@ class RankXENDCG(Objective):
 
     name = "rank_xendcg"
     is_ranking = True
+    row_elementwise = False
     # draws fresh gamma noise each iteration — must not be jit-frozen
     is_stochastic = True
 

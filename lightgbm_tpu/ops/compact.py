@@ -19,10 +19,13 @@ one-hot MXU matmuls — no gather/scatter anywhere.
 Row records pack into a single uint8 matrix ``[N, C]``:
 
     [0, F)          binned features (uint8)
-    [F, F+4)        grad   (f32 bytes)
-    [F+4, F+8)      hess   (f32 bytes)
-    [F+8]           in-bag count weight (uint8 {0,1})
-    [F+9, F+9+4E)   E extra f32 columns carried through the permutation
+    [F, F+4)        grad   (f32 bytes, pre-multiplied by the sample weight)
+    [F+4, F+8)      hess   (f32 bytes, pre-multiplied by the sample weight)
+    [F+8, F+12)     sample weight (f32 bytes: 0 = out of bag, GOSS rows carry
+                    their amplification — persists across trees so a bag
+                    drawn in one row order stays the same *set of rows* after
+                    later permutations, like the reference's bag_data_indices)
+    [F+12, ..+4E)   E extra f32 columns carried through the permutation
                     (scores, label, weight — anything that must stay
                     row-aligned across trees)
 
@@ -65,11 +68,11 @@ class RowLayout(NamedTuple):
 
     @property
     def extra_off(self) -> int:
-        return self.num_features + 9
+        return self.num_features + 12
 
     @property
     def num_cols(self) -> int:
-        c = self.num_features + 9 + 4 * self.num_extra
+        c = self.num_features + 12 + 4 * self.num_extra
         # round lanes up for clean VMEM tiling
         return -(-c // 32) * 32
 
@@ -100,7 +103,7 @@ def pack_rows(
         binned.astype(jnp.uint8),
         _f32_to_u8(grad),
         _f32_to_u8(hess),
-        cnt.astype(jnp.uint8)[:, None],
+        _f32_to_u8(cnt.astype(jnp.float32)),
     ]
     if layout.num_extra:
         e = _f32_to_u8(extras.T.astype(jnp.float32))  # [N, E, 4]
@@ -117,7 +120,7 @@ def unpack_rows(work: jnp.ndarray, n: int, layout: RowLayout):
     binned = work[:n, :f]
     grad = _u8_to_f32(work[:n, layout.grad_off:layout.grad_off + 4])
     hess = _u8_to_f32(work[:n, layout.hess_off:layout.hess_off + 4])
-    cnt = work[:n, layout.cnt_off].astype(jnp.float32)
+    cnt = _u8_to_f32(work[:n, layout.cnt_off:layout.cnt_off + 4])
     if layout.num_extra:
         e = work[:n, layout.extra_off:layout.extra_off + 4 * layout.num_extra]
         extras = _u8_to_f32(e.reshape(n, layout.num_extra, 4)).T
@@ -127,10 +130,10 @@ def unpack_rows(work: jnp.ndarray, n: int, layout: RowLayout):
 
 
 def block_grad_hess_cnt(block: jnp.ndarray, layout: RowLayout):
-    """Extract (grad, hess, cnt) from a row-record block [BS, C]."""
+    """Extract (grad, hess, sample weight) from a row-record block [BS, C]."""
     g = _u8_to_f32(block[:, layout.grad_off:layout.grad_off + 4])
     h = _u8_to_f32(block[:, layout.hess_off:layout.hess_off + 4])
-    c = block[:, layout.cnt_off].astype(jnp.float32)
+    c = _u8_to_f32(block[:, layout.cnt_off:layout.cnt_off + 4])
     return g, h, c
 
 
@@ -290,9 +293,11 @@ def segment_histogram(
 ) -> jnp.ndarray:            # [F, B, 4] f32
     """Histogram of one contiguous leaf segment, streamed in fixed blocks.
 
-    Channels: (grad, hess, in-bag count, raw count). Counts accumulate in f32
-    and stay exact below 2^24 rows — the raw-count channel drives the
-    physical partition offsets, so exactness is required, not a nicety.
+    Channels: (grad, hess, in-bag count, raw count). The in-bag count is the
+    {0,1} indicator of a nonzero sample weight (reference: cnt_ counts bagged
+    rows, not their weights). Counts accumulate in f32 and stay exact below
+    2^24 rows — the raw-count channel drives the physical partition offsets,
+    so exactness is required, not a nicety.
     """
     from .histogram import histogram_block
 
@@ -308,6 +313,7 @@ def segment_histogram(
         blk = lax.dynamic_slice(work, (start + j * bs, 0), (bs, c))
         valid = (iota < (count - j * bs)).astype(jnp.float32)
         g, h, cw = block_grad_hess_cnt(blk, layout)
+        cw = (cw != 0.0).astype(jnp.float32)
         chans = jnp.stack([g * valid, h * valid, cw * valid, valid], axis=1)
         acc = acc + histogram_block(blk[:, :f], chans, b, impl=impl)
         return j + 1, acc

@@ -96,7 +96,8 @@ def grow_tree_compact(
     n_real: int,
 ):
     """Grow one tree; returns (TreeArrays, row_leaf [N], row_value [N],
-    work', scratch') — all in the post-tree permuted row order."""
+    work', scratch', leaf_start [L], leaf_nrows [L]) — per-row outputs in the
+    post-tree permuted row order."""
     n = n_real
     L = params.num_leaves
     B = params.num_bins
@@ -340,4 +341,5 @@ def grow_tree_compact(
     )
     row_leaf, row_value = segments_to_leaf_vectors(
         st.leaf_start, st.leaf_nrows, leaf_value, n)
-    return tree, row_leaf, row_value, st.work, st.scratch
+    return (tree, row_leaf, row_value, st.work, st.scratch,
+            st.leaf_start, st.leaf_nrows)

@@ -178,7 +178,7 @@ class TestCompactGrowerParity:
         work = pack_rows(jnp.asarray(binned), jnp.asarray(grad),
                          jnp.asarray(hess), jnp.asarray(cnt),
                          row_id[None, :], layout, pad_rows=pad)
-        tree_c, row_leaf_c, row_val_c, work2, _ = grow_tree_compact(
+        tree_c, row_leaf_c, row_val_c, work2, _, _, _ = grow_tree_compact(
             work, jnp.zeros_like(work), jnp.asarray(num_bins),
             jnp.asarray(nan_bin), jnp.asarray(has_nan), jnp.asarray(is_cat),
             jnp.asarray(feat_mask), layout, params, n)
@@ -218,7 +218,7 @@ class TestCompactGrowerParity:
         work = pack_rows(jnp.asarray(binned), jnp.asarray(grad),
                          jnp.asarray(hess), jnp.asarray(cnt),
                          jnp.asarray(extras), layout, pad_rows=pad)
-        _, _, _, work2, _ = grow_tree_compact(
+        _, _, _, work2, _, _, _ = grow_tree_compact(
             work, jnp.zeros_like(work), jnp.asarray(num_bins),
             jnp.asarray(nan_bin), jnp.asarray(has_nan), jnp.asarray(is_cat),
             jnp.ones(f, dtype=bool), layout, params, n)
@@ -228,3 +228,57 @@ class TestCompactGrowerParity:
         # every extra column permuted identically (bit-exact)
         np.testing.assert_array_equal(got[1], extras[1][ids])
         np.testing.assert_array_equal(got[2], extras[2][ids])
+
+
+class TestCompactTraining:
+    """Full Booster training through the compact path vs the masked path
+    (mirrors the reference's engine-level determinism checks)."""
+
+    def _train(self, X, y, params, num_round=12, **train_kw):
+        import lightgbm_tpu as lgb
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train(params, ds, num_round, **train_kw)
+        return bst
+
+    @pytest.mark.parametrize("objective", ["binary", "regression", "regression_l1"])
+    def test_matches_masked_training(self, objective):
+        import lightgbm_tpu as lgb
+        from tests.utils import FAST_PARAMS, binary_data, regression_data
+        X, y = binary_data() if objective == "binary" else regression_data()
+        base = dict(FAST_PARAMS, objective=objective, tpu_part_block=128,
+                    tpu_hist_block=256)
+        pm = self._train(X, y, dict(base, tpu_grower="masked"))
+        pc = self._train(X, y, dict(base, tpu_grower="compact"))
+        # same data, same binning, same split algebra -> near-identical models
+        np.testing.assert_allclose(pc.predict(X), pm.predict(X),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_bagging_and_multiclass(self):
+        import lightgbm_tpu as lgb
+        from tests.utils import FAST_PARAMS, multiclass_data
+        X, y = multiclass_data()
+        params = dict(FAST_PARAMS, objective="multiclass", num_class=3,
+                      bagging_fraction=0.7, bagging_freq=2,
+                      tpu_grower="compact", tpu_part_block=128,
+                      tpu_hist_block=256)
+        bst = self._train(X, y, params)
+        pred = bst.predict(X)
+        assert pred.shape == (len(y), 3)
+        acc = (pred.argmax(1) == y).mean()
+        assert acc > 0.8
+
+    def test_goss_and_early_stopping(self):
+        import lightgbm_tpu as lgb
+        from tests.utils import FAST_PARAMS, binary_data, train_test_split_simple
+        X, y = binary_data()
+        Xtr, ytr, Xte, yte = train_test_split_simple(X, y)
+        ds = lgb.Dataset(Xtr, label=ytr)
+        dv = ds.create_valid(Xte, label=yte)
+        params = dict(FAST_PARAMS, objective="binary", metric="auc",
+                      boosting="goss", learning_rate=0.3,
+                      tpu_grower="compact", tpu_part_block=128,
+                      tpu_hist_block=256)
+        bst = lgb.train(params, ds, 25, valid_sets=[dv],
+                        callbacks=[lgb.early_stopping(5, verbose=False)])
+        from sklearn.metrics import roc_auc_score
+        assert roc_auc_score(yte, bst.predict(Xte)) > 0.85
