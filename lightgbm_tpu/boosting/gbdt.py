@@ -38,6 +38,21 @@ from .sample_strategy import create_sample_strategy
 _EPS = 1e-35
 
 
+def _bound_gradients(obj, k_total: int, scores, label, weight):
+    """Objective gradients with label/weight rebound to the compact grower's
+    current row order (the objective's stored arrays are in the original
+    order; see Objective.row_elementwise)."""
+    old_l, old_w = obj.label, obj.weight
+    obj.label, obj.weight = label, weight
+    try:
+        if k_total == 1:
+            g, h = obj.get_gradients(scores[0])
+            return g[None, :], h[None, :]
+        return obj.get_gradients(scores)
+    finally:
+        obj.label, obj.weight = old_l, old_w
+
+
 def _clamp_block(block: int, n: int, floor: int = 128) -> int:
     """Shrink a streaming block size toward the data size (power-of-two)."""
     while block // 2 >= max(n, floor) and block > floor:
@@ -48,15 +63,16 @@ def _clamp_block(block: int, n: int, floor: int = 128) -> int:
 class HostTree:
     """Host-side copy of one grown tree (numpy struct-of-arrays)."""
 
-    __slots__ = ("split_feature", "split_bin", "split_gain", "default_left",
-                 "left_child", "right_child", "leaf_value", "leaf_weight",
-                 "leaf_count", "leaf_parent", "leaf_depth", "internal_value",
-                 "internal_weight", "internal_count", "num_leaves",
-                 "num_nodes", "shrinkage")
+    __slots__ = ("split_feature", "split_bin", "cat_bitset", "split_gain",
+                 "default_left", "left_child", "right_child", "leaf_value",
+                 "leaf_weight", "leaf_count", "leaf_parent", "leaf_depth",
+                 "internal_value", "internal_weight", "internal_count",
+                 "num_leaves", "num_nodes", "shrinkage")
 
     def __init__(self, tree: TreeArrays, shrinkage: float = 1.0):
         self.split_feature = np.asarray(tree.split_feature)
         self.split_bin = np.asarray(tree.split_bin)
+        self.cat_bitset = np.asarray(tree.cat_bitset)
         self.split_gain = np.asarray(tree.split_gain)
         self.default_left = np.asarray(tree.default_left)
         self.left_child = np.asarray(tree.left_child)
@@ -96,9 +112,15 @@ def stack_trees(models: Sequence[HostTree], max_nodes: int, max_leaves: int
             out[i, : len(a)] = a
         return jnp.asarray(out)
 
+    cat_w = max((m.cat_bitset.shape[1] for m in models), default=1)
+    cat = np.zeros((t, max_nodes, cat_w), np.uint32)
+    for i, m in enumerate(models):
+        cb = m.cat_bitset
+        cat[i, : cb.shape[0], : cb.shape[1]] = cb
     return StackedTrees(
         split_feature=pad2(lambda m: m.split_feature, -1, np.int32, max_nodes),
         split_bin=pad2(lambda m: m.split_bin, 0, np.int32, max_nodes),
+        cat_bitset=jnp.asarray(cat),
         default_left=pad2(lambda m: m.default_left, False, bool, max_nodes),
         left_child=pad2(lambda m: m.left_child, -1, np.int32, max_nodes),
         right_child=pad2(lambda m: m.right_child, -1, np.int32, max_nodes),
@@ -283,6 +305,12 @@ class GBDT:
             min_sum_hessian_in_leaf=float(cfg.get("min_sum_hessian_in_leaf", 1e-3)),
             min_gain_to_split=float(cfg.get("min_gain_to_split", 0.0)),
             max_delta_step=float(cfg.get("max_delta_step", 0.0)),
+            max_cat_threshold=int(cfg.get("max_cat_threshold", 32)),
+            cat_l2=float(cfg.get("cat_l2", 10.0)),
+            cat_smooth=float(cfg.get("cat_smooth", 10.0)),
+            max_cat_to_onehot=int(cfg.get("max_cat_to_onehot", 4)),
+            min_data_per_group=float(cfg.get("min_data_per_group", 100)),
+            any_cat=bool(np.any(train_set.feature_is_categorical())),
             hist_impl=str(cfg.get("tpu_hist_impl", "auto")),
             part_block=_clamp_block(
                 int(cfg.get("tpu_part_block", 2048)), self._n_real),
@@ -482,17 +510,6 @@ class GBDT:
             raw = work[:n, sc_off:sc_off + 4 * k_total]
             return _u8_to_f32(raw.reshape(n, k_total, 4)).T
 
-        def bound_gradients(scores, label, weight):
-            old_l, old_w = obj.label, obj.weight
-            obj.label, obj.weight = label, weight
-            try:
-                if k_total == 1:
-                    g, h = obj.get_gradients(scores[0])
-                    return g[None, :], h[None, :]
-                return obj.get_gradients(scores)
-            finally:
-                obj.label, obj.weight = old_l, old_w
-
         gx_off = (layout.extra_off + 4 * self._cx_grads
                   if self._cx_grads is not None else None)
 
@@ -509,14 +526,14 @@ class GBDT:
             label = col(work, lbl_off)
             weight = col(work, w_off) if w_off is not None else None
             if k_total == 1:
-                g, h = bound_gradients(scores, label, weight)
+                g, h = _bound_gradients(obj, k_total, scores, label, weight)
                 g_k, h_k = g[0], h[0]
             elif k == 0:
                 # all K class gradients once per iteration, from the
                 # iteration-start scores (reference: GBDT::Boosting runs
                 # before the per-class tree loop, gbdt.cpp:220); stored in
                 # carried columns so later trees see them permutation-aligned
-                g, h = bound_gradients(scores, label, weight)
+                g, h = _bound_gradients(obj, k_total, scores, label, weight)
                 for j in range(k_total):
                     work = set_col(work, gx_off + 4 * j, g[j])
                     work = set_col(work, gx_off + 4 * (k_total + j), h[j])
@@ -532,7 +549,7 @@ class GBDT:
             for j in range(k_total):
                 work = set_col(work, sc_off + 4 * j, scores[j])
 
-            (tree, row_leaf, _row_value, work, scratch, leaf_start,
+            (tree, row_leaf, work, scratch, leaf_start,
              leaf_nrows) = grow_tree_compact(
                 work, scratch, num_bins_arr, nan_bin_arr, has_nan_arr,
                 is_cat_arr, feat_mask, layout, gp, n)
@@ -578,15 +595,7 @@ class GBDT:
             k_total = self.num_tree_per_iteration
 
             def fn(scores, label, weight):
-                old_l, old_w = obj.label, obj.weight
-                obj.label, obj.weight = label, weight
-                try:
-                    if k_total == 1:
-                        g, h = obj.get_gradients(scores[0])
-                        return g[None, :], h[None, :]
-                    return obj.get_gradients(scores)
-                finally:
-                    obj.label, obj.weight = old_l, old_w
+                return _bound_gradients(obj, k_total, scores, label, weight)
 
             c["grad_fn"] = jax.jit(fn) \
                 if not getattr(self.objective, "is_stochastic", False) else fn
@@ -836,8 +845,9 @@ class GBDT:
         for vs in self.valid_sets:
             leaf = route_one_tree(
                 vs.binned, tree.split_feature, tree.split_bin,
-                tree.default_left, tree.left_child, tree.right_child,
-                tree.num_nodes, self.nan_bin_arr, self.is_cat_arr)
+                tree.cat_bitset, tree.default_left, tree.left_child,
+                tree.right_child, tree.num_nodes, self.nan_bin_arr,
+                self.is_cat_arr)
             vs.score = vs.score.at[cur_tree_id].set(
                 _add_leaf_outputs(vs.score[cur_tree_id], tree.leaf_value, leaf))
 
@@ -849,19 +859,20 @@ class GBDT:
         ScoreUpdater::AddScore combos in gbdt.cpp:454 / dart.hpp:131-198)."""
         sf = jnp.asarray(host.split_feature)
         sb = jnp.asarray(host.split_bin)
+        cb = jnp.asarray(host.cat_bitset)
         dl = jnp.asarray(host.default_left)
         lc = jnp.asarray(host.left_child)
         rc = jnp.asarray(host.right_child)
         nn = jnp.asarray(host.num_nodes)
         lv = jnp.asarray(host.leaf_value * factor)
         if train:
-            leaf = route_one_tree(self._routing_binned(), sf, sb, dl, lc, rc,
-                                  nn, self.nan_bin_arr, self.is_cat_arr)
+            leaf = route_one_tree(self._routing_binned(), sf, sb, cb, dl, lc,
+                                  rc, nn, self.nan_bin_arr, self.is_cat_arr)
             self.train_score = self.train_score.at[cur_tree_id].set(
                 _add_leaf_outputs(self.train_score[cur_tree_id], lv, leaf))
         if valid:
             for vs in self.valid_sets:
-                vleaf = route_one_tree(vs.binned, sf, sb, dl, lc, rc, nn,
+                vleaf = route_one_tree(vs.binned, sf, sb, cb, dl, lc, rc, nn,
                                        self.nan_bin_arr, self.is_cat_arr)
                 vs.score = vs.score.at[cur_tree_id].set(
                     _add_leaf_outputs(vs.score[cur_tree_id], lv, vleaf))

@@ -300,11 +300,6 @@ UNIMPLEMENTED_PARAMS: Dict[str, str] = {
     "interaction_constraints": "interaction constraints",
     "feature_fraction_bynode": "per-node feature sampling",
     "path_smooth": "path smoothing",
-    "min_data_per_group": "categorical split min group size",
-    "max_cat_threshold": "many-category splits",
-    "cat_l2": "many-category splits",
-    "cat_smooth": "many-category splits",
-    "max_cat_to_onehot": "many-category splits",
 }
 
 # alias -> canonical param name

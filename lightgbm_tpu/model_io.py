@@ -49,6 +49,16 @@ def _objective_string(gbdt) -> str:
     return " ".join(parts)
 
 
+def _bitset_cats(host, node: int, mapper) -> List[int]:
+    """Category values whose bins are set in a node's bin bitset."""
+    words = host.cat_bitset[node]
+    cats = []
+    for b, cat in enumerate(mapper.bin_to_cat):
+        if b // 32 < len(words) and (int(words[b // 32]) >> (b % 32)) & 1:
+            cats.append(int(cat))
+    return sorted(cats)
+
+
 def _tree_to_text(host, tree_idx: int, mappers) -> str:
     """One ``Tree=i`` block (reference: Tree::ToString, src/io/tree.cpp)."""
     nl = host.num_leaves
@@ -68,12 +78,13 @@ def _tree_to_text(host, tree_idx: int, mappers) -> str:
         dt = 0
         if m.is_categorical:
             dt |= 1  # kCategoricalMask
-            # one-hot bin split: left == {category of bin b}
-            cat = int(m.bin_to_cat[b]) if b < len(m.bin_to_cat) else 0
-            # bitset of 32-bit words (reference: Common::ConstructBitset)
-            word_count = cat // 32 + 1
+            # bin bitset -> category-value bitset (reference:
+            # Common::ConstructBitset over SplitInfo::cat_threshold)
+            cats = _bitset_cats(host, i, m)
+            word_count = (max(cats) // 32 + 1) if cats else 1
             words = [0] * word_count
-            words[cat // 32] |= 1 << (cat % 32)
+            for cat in cats:
+                words[cat // 32] |= 1 << (cat % 32)
             thresholds.append(str(num_cat))
             cat_thresholds.extend(words)
             cat_boundaries.append(len(cat_thresholds))
@@ -196,10 +207,9 @@ def _node_to_json(host, mappers, node: int) -> Dict[str, Any]:
         "internal_count": int(round(float(host.internal_count[node]))),
     }
     if m.is_categorical:
-        b = int(host.split_bin[node])
-        cat = int(m.bin_to_cat[b]) if b < len(m.bin_to_cat) else 0
+        cats = _bitset_cats(host, node, m)
         out["decision_type"] = "=="
-        out["threshold"] = str(cat)
+        out["threshold"] = "||".join(str(c) for c in cats)
         out["default_left"] = False
         out["missing_type"] = "None"
     else:

@@ -48,6 +48,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .split import go_left_pred
+
 
 class RowLayout(NamedTuple):
     """Static description of the packed row record (part of the jit key)."""
@@ -137,18 +139,6 @@ def block_grad_hess_cnt(block: jnp.ndarray, layout: RowLayout):
     return g, h, c
 
 
-def go_left_pred(col: jnp.ndarray, bin_: jnp.ndarray, default_left: jnp.ndarray,
-                 nan_bin: jnp.ndarray, is_cat: jnp.ndarray) -> jnp.ndarray:
-    """Left-child routing predicate for binned values (must agree bit-for-bit
-    with the histogram cumulative-count semantics in ops/split.py)."""
-    col = col.astype(jnp.int32)
-    return jnp.where(
-        is_cat,
-        col == bin_,
-        (col <= bin_) | (default_left & (col == nan_bin)),
-    )
-
-
 def _compact_block(block: jnp.ndarray, go_left: jnp.ndarray, valid: jnp.ndarray
                    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Stable-partition one block: returns ([2*BS, C] u8 with lefts compacted
@@ -223,6 +213,7 @@ def partition_segment(
     default_left: jnp.ndarray,
     nan_bin: jnp.ndarray,    # i32 NaN bin of the split feature
     is_cat: jnp.ndarray,     # bool
+    cat_bitset: jnp.ndarray,  # [W] u32 bin bitset (categorical splits)
     block_size: int,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Stably partition ``work[start:start+count]`` so left-child rows occupy
@@ -245,7 +236,8 @@ def partition_segment(
         blk = lax.dynamic_slice(work, (start + i * bs, 0), (bs, c))
         col = lax.dynamic_slice_in_dim(blk, feature, 1, axis=1)[:, 0]
         valid = iota < (count - i * bs)
-        gl = go_left_pred(col, bin_, default_left, nan_bin, is_cat)
+        gl = go_left_pred(col, bin_, default_left, nan_bin, is_cat,
+                          cat_bitset)
         comp, n_l, n_r = _compact_block(blk, gl, valid)
         lbuf, lcnt = _append_buf(lbuf, lcnt, comp[:bs], n_l)
         rbuf, rcnt = _append_buf(rbuf, rcnt, comp[bs:], n_r)

@@ -43,7 +43,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from .histogram import histogram
-from .split import SplitParams, SplitResult, best_split, leaf_output
+from .split import (SplitParams, SplitResult, best_split, go_left_pred,
+                    leaf_output)
 
 _NEG_INF = -1e30
 
@@ -59,6 +60,13 @@ class GrowerParams(NamedTuple):
     min_sum_hessian_in_leaf: float = 1e-3
     min_gain_to_split: float = 0.0
     max_delta_step: float = 0.0
+    # categorical-split knobs (reference: config.h:480-501)
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_to_onehot: int = 4
+    min_data_per_group: float = 100.0
+    any_cat: bool = True     # static: dataset has categorical features
     axis_name: Optional[str] = None
     hist_impl: str = "auto"  # auto | xla | pallas (ops/histogram.py dispatch)
     # compact-grower streaming block sizes (ops/grower_compact.py)
@@ -73,7 +81,17 @@ class GrowerParams(NamedTuple):
             min_sum_hessian_in_leaf=self.min_sum_hessian_in_leaf,
             min_gain_to_split=self.min_gain_to_split,
             max_delta_step=self.max_delta_step,
+            max_cat_threshold=self.max_cat_threshold,
+            cat_l2=self.cat_l2,
+            cat_smooth=self.cat_smooth,
+            max_cat_to_onehot=self.max_cat_to_onehot,
+            min_data_per_group=self.min_data_per_group,
+            enable_sorted_cat=self.any_cat,
         )
+
+    @property
+    def bitset_words(self) -> int:
+        return -(-self.num_bins // 32)
 
 
 class TreeArrays(NamedTuple):
@@ -84,7 +102,8 @@ class TreeArrays(NamedTuple):
     reference leaves — same convention as the reference's Tree arrays.
     """
     split_feature: jax.Array   # [L-1] i32 (-1 = unused node)
-    split_bin: jax.Array       # [L-1] i32 threshold bin (left: bin <= t; cat: == t)
+    split_bin: jax.Array       # [L-1] i32 threshold bin (numerical: left is bin <= t)
+    cat_bitset: jax.Array      # [L-1, W] u32 bin bitset for categorical splits
     split_gain: jax.Array      # [L-1] f32
     default_left: jax.Array    # [L-1] bool
     left_child: jax.Array      # [L-1] i32
@@ -105,11 +124,12 @@ class GrowerState(NamedTuple):
     done: jax.Array
     num_nodes: jax.Array
     row_leaf: jax.Array
-    # per-leaf histograms resident in HBM [L, F, B, 3]
+    # per-leaf histograms resident in HBM [L, F, B, K]
     leaf_hist: jax.Array
     # tree arrays under construction
     split_feature: jax.Array
     split_bin: jax.Array
+    cat_bitset: jax.Array      # [L-1, W] u32
     split_gain: jax.Array
     default_left: jax.Array
     left_child: jax.Array
@@ -133,6 +153,11 @@ class GrowerState(NamedTuple):
     bs_left_grad: jax.Array
     bs_left_hess: jax.Array
     bs_left_cnt: jax.Array
+    bs_bitset: jax.Array       # [L, W] u32 cached categorical bitsets
+    bs_cat_l2: jax.Array       # [L] bool: cached split uses lambda_l2+cat_l2
+    # per-leaf outputs fixed at split time (reference stores left_output/
+    # right_output in SplitInfo; sorted-categorical splits use l2+cat_l2)
+    leaf_out: jax.Array        # [L] f32
 
 
 def _leaf_best_split(hist3, pg, ph, pc, feat_info, feat_mask, depth,
@@ -199,6 +224,7 @@ def grow_tree(
     )
 
     i32 = jnp.int32
+    W = params.bitset_words
     leaf_hist0 = jnp.zeros((L, f, B, 3), jnp.float32).at[0].set(root_hist)
     st = GrowerState(
         done=jnp.asarray(False),
@@ -207,6 +233,7 @@ def grow_tree(
         leaf_hist=leaf_hist0,
         split_feature=jnp.full((L - 1,), -1, i32),
         split_bin=jnp.zeros((L - 1,), i32),
+        cat_bitset=jnp.zeros((L - 1, W), jnp.uint32),
         split_gain=jnp.zeros((L - 1,), jnp.float32),
         default_left=jnp.zeros((L - 1,), bool),
         left_child=jnp.full((L - 1,), -1, i32),
@@ -227,6 +254,10 @@ def grow_tree(
         bs_left_grad=jnp.zeros((L,), jnp.float32).at[0].set(sp0.left_grad),
         bs_left_hess=jnp.zeros((L,), jnp.float32).at[0].set(sp0.left_hess),
         bs_left_cnt=jnp.zeros((L,), jnp.float32).at[0].set(sp0.left_count),
+        bs_bitset=jnp.zeros((L, W), jnp.uint32).at[0].set(sp0.cat_bitset),
+        bs_cat_l2=jnp.zeros((L,), bool).at[0].set(sp0.is_cat_l2),
+        leaf_out=jnp.zeros((L,), jnp.float32).at[0].set(
+            leaf_output(root_g, root_h, params.split_params())),
     )
 
     def body(k, st: GrowerState) -> GrowerState:
@@ -244,10 +275,13 @@ def grow_tree(
         f_ = st.bs_feature[best_leaf]
         b_ = st.bs_bin[best_leaf]
         dl = st.bs_default_left[best_leaf]
+        bits = st.bs_bitset[best_leaf]
+        catl2 = st.bs_cat_l2[best_leaf]
 
         # ---- record split; wire tree structure ----
         split_feature = st.split_feature.at[node].set(jnp.where(applied, f_, -1))
         split_bin = st.split_bin.at[node].set(jnp.where(applied, b_, 0))
+        cat_bitset = st.cat_bitset.at[node].set(jnp.where(applied, bits, 0))
         split_gain = st.split_gain.at[node].set(
             jnp.where(applied, st.bs_gain[best_leaf], 0.0))
         default_left = st.default_left.at[node].set(jnp.where(applied, dl, False))
@@ -275,11 +309,7 @@ def grow_tree(
         fcol = lax.dynamic_slice_in_dim(binned_t, f_, 1, axis=0)[0].astype(i32)
         nb = nan_bin_arr[f_]
         iscat = is_cat_arr[f_]
-        go_left = jnp.where(
-            iscat,
-            fcol == b_,
-            (fcol <= b_) | (dl & (fcol == nb)),
-        )
+        go_left = go_left_pred(fcol, b_, dl, nb, iscat, bits)
         row_leaf = jnp.where(
             applied & (st.row_leaf == best_leaf) & jnp.logical_not(go_left),
             new_leaf,
@@ -309,15 +339,22 @@ def grow_tree(
             jnp.where(applied, d_child, st.leaf_depth[best_leaf]))
         leaf_depth = leaf_depth.at[new_leaf].set(
             jnp.where(applied, d_child, leaf_depth[new_leaf]))
+        l2_used = params.lambda_l2 + params.cat_l2 * catl2.astype(jnp.float32)
+        leaf_out = st.leaf_out.at[best_leaf].set(jnp.where(
+            applied, leaf_output(lg, lh, params.split_params(), l2_used),
+            st.leaf_out[best_leaf]))
+        leaf_out = leaf_out.at[new_leaf].set(jnp.where(
+            applied, leaf_output(rg, rh, params.split_params(), l2_used),
+            leaf_out[new_leaf]))
 
         # ---- children histograms + best splits (skipped when done) ----
         bs_arrays = (st.leaf_hist, st.bs_gain, st.bs_feature, st.bs_bin,
                      st.bs_default_left, st.bs_left_grad, st.bs_left_hess,
-                     st.bs_left_cnt)
+                     st.bs_left_cnt, st.bs_bitset, st.bs_cat_l2)
 
         def compute_children(bs):
             (leaf_hist, bs_gain, bs_feature, bs_bin, bs_dl, bs_lg, bs_lh,
-             bs_lc) = bs
+             bs_lc, bs_bits, bs_catl2) = bs
             # one masked pass over the SMALLER child only; the larger child is
             # parent − smaller (reference: SubtractHistogramForLeaf,
             # cuda_histogram_constructor.cu:723)
@@ -343,12 +380,16 @@ def grow_tree(
             bs_lg = bs_lg.at[best_leaf].set(sp.left_grad[0]).at[new_leaf].set(sp.left_grad[1])
             bs_lh = bs_lh.at[best_leaf].set(sp.left_hess[0]).at[new_leaf].set(sp.left_hess[1])
             bs_lc = bs_lc.at[best_leaf].set(sp.left_count[0]).at[new_leaf].set(sp.left_count[1])
+            bs_bits = bs_bits.at[best_leaf].set(sp.cat_bitset[0]) \
+                .at[new_leaf].set(sp.cat_bitset[1])
+            bs_catl2 = bs_catl2.at[best_leaf].set(sp.is_cat_l2[0]) \
+                .at[new_leaf].set(sp.is_cat_l2[1])
             return (leaf_hist, bs_gain, bs_feature, bs_bin, bs_dl, bs_lg,
-                    bs_lh, bs_lc)
+                    bs_lh, bs_lc, bs_bits, bs_catl2)
 
         bs_arrays = lax.cond(applied, compute_children, lambda bs: bs, bs_arrays)
         (leaf_hist, bs_gain, bs_feature, bs_bin, bs_dl, bs_lg, bs_lh,
-         bs_lc) = bs_arrays
+         bs_lc, bs_bits, bs_catl2) = bs_arrays
 
         return GrowerState(
             done=done,
@@ -357,6 +398,7 @@ def grow_tree(
             leaf_hist=leaf_hist,
             split_feature=split_feature,
             split_bin=split_bin,
+            cat_bitset=cat_bitset,
             split_gain=split_gain,
             default_left=default_left,
             left_child=left_child,
@@ -377,14 +419,18 @@ def grow_tree(
             bs_left_grad=bs_lg,
             bs_left_hess=bs_lh,
             bs_left_cnt=bs_lc,
+            bs_bitset=bs_bits,
+            bs_cat_l2=bs_catl2,
+            leaf_out=leaf_out,
         )
 
     st = lax.fori_loop(0, L - 1, body, st)
 
-    leaf_value = leaf_output(st.leaf_grad, st.leaf_hess, params.split_params())
+    leaf_value = st.leaf_out
     tree = TreeArrays(
         split_feature=st.split_feature,
         split_bin=st.split_bin,
+        cat_bitset=st.cat_bitset,
         split_gain=st.split_gain,
         default_left=st.default_left,
         left_child=st.left_child,

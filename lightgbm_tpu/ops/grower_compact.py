@@ -55,6 +55,7 @@ class CompactState(NamedTuple):
     # tree arrays under construction
     split_feature: jnp.ndarray
     split_bin: jnp.ndarray
+    cat_bitset: jnp.ndarray    # [L-1, W] u32
     split_gain: jnp.ndarray
     default_left: jnp.ndarray
     left_child: jnp.ndarray
@@ -79,6 +80,9 @@ class CompactState(NamedTuple):
     bs_left_hess: jnp.ndarray
     bs_left_cnt: jnp.ndarray
     bs_left_rows: jnp.ndarray
+    bs_bitset: jnp.ndarray     # [L, W] u32 cached categorical bitsets
+    bs_cat_l2: jnp.ndarray     # [L] bool (sorted-cat split: l2 += cat_l2)
+    leaf_out: jnp.ndarray      # [L] f32 outputs fixed at split time
 
 
 @functools.partial(jax.jit,
@@ -95,9 +99,10 @@ def grow_tree_compact(
     params: GrowerParams,
     n_real: int,
 ):
-    """Grow one tree; returns (TreeArrays, row_leaf [N], row_value [N],
-    work', scratch', leaf_start [L], leaf_nrows [L]) — per-row outputs in the
-    post-tree permuted row order."""
+    """Grow one tree; returns (TreeArrays, row_leaf [N], work', scratch',
+    leaf_start [L], leaf_nrows [L]) — per-row outputs in the post-tree
+    permuted row order. (Callers expand per-row leaf values themselves via
+    segments_to_leaf_vectors once shrinkage/renewal are applied.)"""
     n = n_real
     L = params.num_leaves
     B = params.num_bins
@@ -125,6 +130,7 @@ def grow_tree_compact(
     root_c = root_hist[0, :, 2].sum()
     sp0 = leaf_best(root_hist, root_g, root_h, root_c, jnp.asarray(0, i32))
 
+    W = params.bitset_words
     st = CompactState(
         done=jnp.asarray(False),
         num_nodes=jnp.asarray(0, i32),
@@ -135,6 +141,7 @@ def grow_tree_compact(
         leaf_nrows=jnp.zeros((L,), i32).at[0].set(n),
         split_feature=jnp.full((L - 1,), -1, i32),
         split_bin=jnp.zeros((L - 1,), i32),
+        cat_bitset=jnp.zeros((L - 1, W), jnp.uint32),
         split_gain=jnp.zeros((L - 1,), jnp.float32),
         default_left=jnp.zeros((L - 1,), bool),
         left_child=jnp.full((L - 1,), -1, i32),
@@ -157,6 +164,10 @@ def grow_tree_compact(
         bs_left_cnt=jnp.zeros((L,), jnp.float32).at[0].set(sp0.left_count),
         bs_left_rows=jnp.zeros((L,), i32).at[0].set(
             sp0.left_rows.astype(i32)),
+        bs_bitset=jnp.zeros((L, W), jnp.uint32).at[0].set(sp0.cat_bitset),
+        bs_cat_l2=jnp.zeros((L,), bool).at[0].set(sp0.is_cat_l2),
+        leaf_out=jnp.zeros((L,), jnp.float32).at[0].set(
+            leaf_output(root_g, root_h, sp_params)),
     )
 
     def body(k, st: CompactState) -> CompactState:
@@ -175,10 +186,13 @@ def grow_tree_compact(
         b_ = st.bs_bin[best_leaf]
         dl = st.bs_default_left[best_leaf]
         n_left = st.bs_left_rows[best_leaf]
+        bits = st.bs_bitset[best_leaf]
+        catl2 = st.bs_cat_l2[best_leaf]
 
         # ---- record split; wire tree structure ----
         split_feature = st.split_feature.at[node].set(jnp.where(applied, f_, -1))
         split_bin = st.split_bin.at[node].set(jnp.where(applied, b_, 0))
+        cat_bitset = st.cat_bitset.at[node].set(jnp.where(applied, bits, 0))
         split_gain = st.split_gain.at[node].set(
             jnp.where(applied, st.bs_gain[best_leaf], 0.0))
         default_left = st.default_left.at[node].set(jnp.where(applied, dl, False))
@@ -227,6 +241,13 @@ def grow_tree_compact(
             jnp.where(applied, d_child, st.leaf_depth[best_leaf]))
         leaf_depth = leaf_depth.at[new_leaf].set(
             jnp.where(applied, d_child, leaf_depth[new_leaf]))
+        l2_used = params.lambda_l2 + params.cat_l2 * catl2.astype(jnp.float32)
+        leaf_out = st.leaf_out.at[best_leaf].set(jnp.where(
+            applied, leaf_output(lg, lh, sp_params, l2_used),
+            st.leaf_out[best_leaf]))
+        leaf_out = leaf_out.at[new_leaf].set(jnp.where(
+            applied, leaf_output(rg, rh, sp_params, l2_used),
+            leaf_out[new_leaf]))
 
         # ---- physical partition + children histograms + best splits ----
         s_ = st.leaf_start[best_leaf]
@@ -236,17 +257,17 @@ def grow_tree_compact(
         mut = (st.work, st.scratch, st.leaf_hist, st.leaf_start, st.leaf_nrows,
                st.bs_gain, st.bs_feature, st.bs_bin, st.bs_default_left,
                st.bs_left_grad, st.bs_left_hess, st.bs_left_cnt,
-               st.bs_left_rows)
+               st.bs_left_rows, st.bs_bitset, st.bs_cat_l2)
 
         def apply_split(mut):
             (work, scratch, leaf_hist, leaf_start, leaf_nrows,
              bs_gain, bs_feature, bs_bin, bs_dl, bs_lg, bs_lh, bs_lc,
-             bs_lr) = mut
+             bs_lr, bs_bits, bs_catl2) = mut
             # stable partition of the parent's contiguous segment
             # (reference: DataPartition::Split / cuda_data_partition.cu:907)
             work, scratch = partition_segment(
                 work, scratch, s_, m_, n_left, f_, b_, dl,
-                nan_bin_arr[f_], is_cat_arr[f_], params.part_block)
+                nan_bin_arr[f_], is_cat_arr[f_], bits, params.part_block)
             leaf_start = leaf_start.at[best_leaf].set(s_)
             leaf_start = leaf_start.at[new_leaf].set(s_ + n_left)
             leaf_nrows = leaf_nrows.at[best_leaf].set(n_left)
@@ -277,13 +298,16 @@ def grow_tree_compact(
                 bs_lh = bs_lh.at[leaf].set(sp.left_hess)
                 bs_lc = bs_lc.at[leaf].set(sp.left_count)
                 bs_lr = bs_lr.at[leaf].set(sp.left_rows.astype(i32))
+                bs_bits = bs_bits.at[leaf].set(sp.cat_bitset)
+                bs_catl2 = bs_catl2.at[leaf].set(sp.is_cat_l2)
             return (work, scratch, leaf_hist, leaf_start, leaf_nrows,
                     bs_gain, bs_feature, bs_bin, bs_dl, bs_lg, bs_lh, bs_lc,
-                    bs_lr)
+                    bs_lr, bs_bits, bs_catl2)
 
         mut = lax.cond(applied, apply_split, lambda m: m, mut)
         (work, scratch, leaf_hist, leaf_start, leaf_nrows, bs_gain,
-         bs_feature, bs_bin, bs_dl, bs_lg, bs_lh, bs_lc, bs_lr) = mut
+         bs_feature, bs_bin, bs_dl, bs_lg, bs_lh, bs_lc, bs_lr, bs_bits,
+         bs_catl2) = mut
 
         return CompactState(
             done=done,
@@ -295,6 +319,7 @@ def grow_tree_compact(
             leaf_nrows=leaf_nrows,
             split_feature=split_feature,
             split_bin=split_bin,
+            cat_bitset=cat_bitset,
             split_gain=split_gain,
             default_left=default_left,
             left_child=left_child,
@@ -316,14 +341,18 @@ def grow_tree_compact(
             bs_left_hess=bs_lh,
             bs_left_cnt=bs_lc,
             bs_left_rows=bs_lr,
+            bs_bitset=bs_bits,
+            bs_cat_l2=bs_catl2,
+            leaf_out=leaf_out,
         )
 
     st = lax.fori_loop(0, L - 1, body, st)
 
-    leaf_value = leaf_output(st.leaf_grad, st.leaf_hess, sp_params)
+    leaf_value = st.leaf_out
     tree = TreeArrays(
         split_feature=st.split_feature,
         split_bin=st.split_bin,
+        cat_bitset=st.cat_bitset,
         split_gain=st.split_gain,
         default_left=st.default_left,
         left_child=st.left_child,
@@ -339,7 +368,7 @@ def grow_tree_compact(
         num_leaves=st.num_nodes + 1,
         num_nodes=st.num_nodes,
     )
-    row_leaf, row_value = segments_to_leaf_vectors(
+    row_leaf, _ = segments_to_leaf_vectors(
         st.leaf_start, st.leaf_nrows, leaf_value, n)
-    return (tree, row_leaf, row_value, st.work, st.scratch,
-            st.leaf_start, st.leaf_nrows)
+    return (tree, row_leaf, st.work, st.scratch, st.leaf_start,
+            st.leaf_nrows)

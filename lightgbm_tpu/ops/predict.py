@@ -31,6 +31,7 @@ class StackedTrees(NamedTuple):
     """
     split_feature: jax.Array   # [T, L-1] i32
     split_bin: jax.Array       # [T, L-1] i32
+    cat_bitset: jax.Array      # [T, L-1, W] u32 (categorical splits)
     default_left: jax.Array    # [T, L-1] bool
     left_child: jax.Array      # [T, L-1] i32
     right_child: jax.Array     # [T, L-1] i32
@@ -51,6 +52,7 @@ def route_one_tree(
     binned: jax.Array,        # [N, F] uint8/16
     split_feature: jax.Array,  # [L-1]
     split_bin: jax.Array,
+    cat_bitset: jax.Array,    # [L-1, W] u32
     default_left: jax.Array,
     left_child: jax.Array,
     right_child: jax.Array,
@@ -59,6 +61,8 @@ def route_one_tree(
     is_cat_arr: jax.Array,    # [F] bool
 ) -> jax.Array:
     """Return the leaf index [N] each row lands in for one tree."""
+    from .split import go_left_pred
+
     n = binned.shape[0]
     max_nodes = split_feature.shape[0]
     # rows start at node 0 when it exists, else directly at leaf 0 (~0 == -1)
@@ -73,7 +77,7 @@ def route_one_tree(
         fcol = jnp.take(binned, safe_f, axis=1).astype(jnp.int32)
         nb = nan_bin_arr[safe_f]
         iscat = is_cat_arr[safe_f]
-        go_left = jnp.where(iscat, fcol == t, (fcol <= t) | (dl & (fcol == nb)))
+        go_left = go_left_pred(fcol, t, dl, nb, iscat, cat_bitset[k])
         nxt = jnp.where(go_left, left_child[k], right_child[k])
         return jnp.where(cur == k, nxt, cur)
 
@@ -101,8 +105,8 @@ def predict_raw(
 
     def step(carry, tree_slice):
         scores = carry
-        (sf, sb, dl, lc, rc, lv, nn, class_id) = tree_slice
-        leaf = route_one_tree(binned, sf, sb, dl, lc, rc, nn,
+        (sf, sb, cb, dl, lc, rc, lv, nn, class_id) = tree_slice
+        leaf = route_one_tree(binned, sf, sb, cb, dl, lc, rc, nn,
                               nan_bin_arr, is_cat_arr)
         add = lv[leaf]
         scores = scores.at[class_id].add(add)
@@ -113,9 +117,9 @@ def predict_raw(
     scores0 = jnp.zeros((num_class, n), jnp.float32)
     scores, _ = lax.scan(
         step, scores0,
-        (trees.split_feature, trees.split_bin, trees.default_left,
-         trees.left_child, trees.right_child, trees.leaf_value,
-         trees.num_nodes, class_ids),
+        (trees.split_feature, trees.split_bin, trees.cat_bitset,
+         trees.default_left, trees.left_child, trees.right_child,
+         trees.leaf_value, trees.num_nodes, class_ids),
     )
     return scores
 
@@ -130,14 +134,15 @@ def predict_leaf_index(
     """Per-tree leaf index for every row: [T, N] (reference: PredictLeafIndex)."""
 
     def step(_, tree_slice):
-        (sf, sb, dl, lc, rc, nn) = tree_slice
-        leaf = route_one_tree(binned, sf, sb, dl, lc, rc, nn,
+        (sf, sb, cb, dl, lc, rc, nn) = tree_slice
+        leaf = route_one_tree(binned, sf, sb, cb, dl, lc, rc, nn,
                               nan_bin_arr, is_cat_arr)
         return _, leaf
 
     _, leaves = lax.scan(
         step, 0,
-        (trees.split_feature, trees.split_bin, trees.default_left,
-         trees.left_child, trees.right_child, trees.num_nodes),
+        (trees.split_feature, trees.split_bin, trees.cat_bitset,
+         trees.default_left, trees.left_child, trees.right_child,
+         trees.num_nodes),
     )
     return leaves

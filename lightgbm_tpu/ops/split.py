@@ -21,9 +21,10 @@ addition.
 """
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax.numpy as jnp
+from jax import lax
 
 _NEG_INF = -1e30
 _EPS = 1e-15
@@ -37,18 +38,33 @@ class SplitParams(NamedTuple):
     min_sum_hessian_in_leaf: float = 1e-3
     min_gain_to_split: float = 0.0
     max_delta_step: float = 0.0
+    # categorical-split knobs (reference: config.h:480-501)
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_to_onehot: int = 4
+    min_data_per_group: float = 100.0
+    # static gate: skip the sorted-categorical machinery entirely when the
+    # dataset has no categorical features (set from the dataset by the GBDT)
+    enable_sorted_cat: bool = True
 
 
 class SplitResult(NamedTuple):
     """Best split of one leaf (reference: SplitInfo, src/treelearner/split_info.hpp)."""
     gain: jnp.ndarray          # shifted gain; > 0 means valid split
     feature: jnp.ndarray       # i32
-    bin: jnp.ndarray           # i32 threshold bin (left: bin <= t); cat: left == t
+    bin: jnp.ndarray           # i32 threshold bin (numerical: left is bin <= t)
     default_left: jnp.ndarray  # bool
     left_grad: jnp.ndarray
     left_hess: jnp.ndarray
     left_count: jnp.ndarray    # weighted (in-bag) row count
     left_rows: jnp.ndarray     # raw row count (drives the physical partition)
+    # categorical splits: left = {bins whose bit is set}; [W] u32 with
+    # W = ceil(B/32) (reference: SplitInfo::cat_threshold bitset)
+    cat_bitset: jnp.ndarray
+    # True when the winning split is a sorted-many-category split (leaf
+    # outputs then use lambda_l2 + cat_l2 — reference: l2 += cat_l2)
+    is_cat_l2: jnp.ndarray
 
 
 def threshold_l1(s: jnp.ndarray, l1: float) -> jnp.ndarray:
@@ -57,24 +73,67 @@ def threshold_l1(s: jnp.ndarray, l1: float) -> jnp.ndarray:
     return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
 
 
-def leaf_output(sum_grad, sum_hess, p: SplitParams):
+def leaf_output(sum_grad, sum_hess, p: SplitParams, l2: Optional[float] = None):
     """Optimal leaf value -ThL1(G)/(H + l2), clipped by max_delta_step
-    (reference: FeatureHistogram::CalculateSplittedLeafOutput)."""
-    out = -threshold_l1(sum_grad, p.lambda_l1) / (sum_hess + p.lambda_l2 + _EPS)
+    (reference: FeatureHistogram::CalculateSplittedLeafOutput). ``l2``
+    overrides lambda_l2 (sorted-categorical splits add cat_l2)."""
+    if l2 is None:
+        l2 = p.lambda_l2
+    out = -threshold_l1(sum_grad, p.lambda_l1) / (sum_hess + l2 + _EPS)
     if p.max_delta_step > 0.0:
         out = jnp.clip(out, -p.max_delta_step, p.max_delta_step)
     return out
 
-def leaf_gain(sum_grad, sum_hess, p: SplitParams):
+def leaf_gain(sum_grad, sum_hess, p: SplitParams, l2: Optional[float] = None):
     """Gain contribution of a leaf: ThL1(G)^2 / (H + l2)
     (reference: FeatureHistogram::GetLeafGain)."""
+    if l2 is None:
+        l2 = p.lambda_l2
     if p.max_delta_step > 0.0:
         # with clipped output the gain is -(2*G*w + (H+l2)*w^2)... evaluated at w
-        w = leaf_output(sum_grad, sum_hess, p)
-        return -(2.0 * sum_grad * w + (sum_hess + p.lambda_l2) * w * w) \
+        w = leaf_output(sum_grad, sum_hess, p, l2)
+        return -(2.0 * sum_grad * w + (sum_hess + l2) * w * w) \
             - 2.0 * p.lambda_l1 * jnp.abs(w)
     t = threshold_l1(sum_grad, p.lambda_l1)
-    return (t * t) / (sum_hess + p.lambda_l2 + _EPS)
+    return (t * t) / (sum_hess + l2 + _EPS)
+
+
+def pack_bin_bitset(mask: jnp.ndarray) -> jnp.ndarray:
+    """[B] bool bin-membership -> [ceil(B/32)] u32 bitset words."""
+    b = mask.shape[0]
+    w = -(-b // 32)
+    pad = w * 32 - b
+    m = jnp.pad(mask.astype(jnp.uint32), (0, pad)).reshape(w, 32)
+    return (m << jnp.arange(32, dtype=jnp.uint32)[None, :]).sum(
+        axis=1, dtype=jnp.uint32)
+
+
+def bitset_contains(words: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Vectorized membership test: is bit ``idx`` set in the [W] u32 bitset?
+
+    Avoids a table gather (slow on TPU): the word is selected with W
+    compare+select lanes, then shifted — all elementwise.
+    """
+    w = words.shape[0]
+    word_id = (idx // 32).astype(jnp.uint32)
+    sel = jnp.zeros_like(idx, dtype=jnp.uint32)
+    for j in range(w):
+        sel = jnp.where(word_id == j, words[j].astype(jnp.uint32), sel)
+    return ((sel >> (idx.astype(jnp.uint32) % 32)) & 1) != 0
+
+
+def go_left_pred(col: jnp.ndarray, bin_: jnp.ndarray, default_left,
+                 nan_bin, is_cat, cat_bitset: jnp.ndarray) -> jnp.ndarray:
+    """THE left-child routing predicate, shared by the masked grower, the
+    compact partition, and prediction routing — it must agree bit-for-bit
+    with the histogram cumulative semantics above (reference: Tree::Decision/
+    Tree::CategoricalDecision, include/LightGBM/tree.h)."""
+    col = col.astype(jnp.int32)
+    return jnp.where(
+        is_cat,
+        bitset_contains(cat_bitset, col),
+        (col <= bin_) | (default_left & (col == nan_bin)),
+    )
 
 
 def best_split(
@@ -141,9 +200,12 @@ def best_split(
         gain = leaf_gain(lg, lh, p) + leaf_gain(rg, rh, p) - gain_shift
         return jnp.where(valid, gain, _NEG_INF)
 
-    # categorical one-hot splits may use any bin (incl. last) as the "left"
-    # category; numerical thresholds must leave the last bin on the right
-    cat_tmask = jnp.where(is_cat_b, t_iota < num_bins[:, None],
+    # categorical one-hot splits (only for low-cardinality features,
+    # reference: use_onehot = num_bin <= max_cat_to_onehot) may use any bin
+    # (incl. last) as the "left" category; numerical thresholds must leave
+    # the last bin on the right
+    onehot_ok = is_cat_b & (num_bins[:, None] <= p.max_cat_to_onehot)
+    cat_tmask = jnp.where(is_cat_b, onehot_ok & (t_iota < num_bins[:, None]),
                           t_iota < num_bins[:, None] - 1)
     score1 = dir_score(left_g1, left_h1, left_c1, cat_tmask)
     dir2_ok = (~is_cat_b) & has_nan_bin[:, None] & below \
@@ -162,13 +224,166 @@ def best_split(
     lh = jnp.where(best_dir2, left_h2[best_f, best_b], left_h1[best_f, best_b])
     lc = jnp.where(best_dir2, left_c2[best_f, best_b], left_c1[best_f, best_b])
     lr = jnp.where(best_dir2, left_r2[best_f, best_b], left_r1[best_f, best_b])
+
+    # ---- sorted many-category splits -------------------------------------
+    # (reference: FindBestThresholdCategoricalInner's sorted branch,
+    # src/treelearner/feature_histogram.cpp:243-339 — categories sorted by
+    # grad/(hess+cat_smooth), prefix scans from both ends, l2 += cat_l2.)
+    # Vectorized over features; the stateful min_data_per_group gating runs
+    # as a lax.scan over the <= max_cat_threshold prefix positions. The
+    # reference estimates per-bin counts from hessians (cnt_factor); exact
+    # counts from the histogram's count channel are used here instead.
+    sorted_any = bool(b > 1) and p.enable_sorted_cat
+    cs, cbest = _sorted_cat_split(
+        g, h, c, r, is_cat, num_bins, feat_mask, parent_grad, parent_hess,
+        parent_count, gain_shift, p) if sorted_any else (None, None)
+    if cs is not None:
+        use_sorted = cbest["gain"] > best_gain
+    else:
+        use_sorted = jnp.asarray(False)
+
+    w = -(-b // 32)
+    # bitset for the numerical/one-hot winner: one-hot cat -> single bin bit
+    best_is_cat = is_cat[best_f]
+    onehot_mask = (jnp.arange(b) == best_b) & best_is_cat
+    bitset_a = pack_bin_bitset(onehot_mask)
+
+    if cs is not None:
+        gain_ = jnp.where(use_sorted, cbest["gain"], best_gain)
+        feat_ = jnp.where(use_sorted, cbest["feature"], best_f)
+        bin_ = jnp.where(use_sorted, 0, best_b)
+        dl_ = jnp.where(use_sorted, False, best_dir2)
+        lg = jnp.where(use_sorted, cbest["left_grad"], lg)
+        lh = jnp.where(use_sorted, cbest["left_hess"], lh)
+        lc = jnp.where(use_sorted, cbest["left_count"], lc)
+        lr = jnp.where(use_sorted, cbest["left_rows"], lr)
+        bitset = jnp.where(use_sorted, cbest["bitset"], bitset_a)
+    else:
+        gain_, feat_, bin_, dl_ = best_gain, best_f, best_b, best_dir2
+        bitset = bitset_a
+
     return SplitResult(
-        gain=best_gain,
-        feature=best_f,
-        bin=best_b,
-        default_left=best_dir2,
+        gain=gain_,
+        feature=feat_,
+        bin=bin_,
+        default_left=dl_,
         left_grad=lg,
         left_hess=lh,
         left_count=lc,
         left_rows=lr,
+        cat_bitset=bitset,
+        is_cat_l2=use_sorted,
     )
+
+
+def _sorted_cat_split(g, h, c, r, is_cat, num_bins, feat_mask, parent_grad,
+                      parent_hess, parent_count, gain_shift, p: SplitParams):
+    """Best sorted-many-category split over all features; returns
+    (True, dict) or (None, None) when no feature qualifies statically."""
+    f, b = g.shape
+    if not bool(is_cat.shape):  # pragma: no cover - shape guard
+        return None, None
+    mct = int(min(p.max_cat_threshold, b))
+    if mct <= 0:
+        return None, None
+    l2c = p.lambda_l2 + p.cat_l2
+
+    sort_mode = is_cat & (num_bins > p.max_cat_to_onehot) & feat_mask  # [F]
+    elig = sort_mode[:, None] & (c >= p.cat_smooth)                    # [F, B]
+    used_bin = elig.sum(axis=1).astype(jnp.int32)                      # [F]
+    ratio = jnp.where(elig, g / (h + p.cat_smooth), jnp.inf)
+    order = jnp.argsort(ratio, axis=1, stable=True)                    # [F, B]
+    sg = jnp.take_along_axis(g, order, axis=1)
+    sh = jnp.take_along_axis(h, order, axis=1)
+    sc = jnp.take_along_axis(c, order, axis=1)
+    sr = jnp.take_along_axis(r, order, axis=1)
+    zpad = jnp.zeros((f, 1), jnp.float32)
+    cg = jnp.concatenate([zpad, jnp.cumsum(sg, axis=1)], axis=1)  # [F, B+1]
+    ch = jnp.concatenate([zpad, jnp.cumsum(sh, axis=1)], axis=1)
+    cc = jnp.concatenate([zpad, jnp.cumsum(sc, axis=1)], axis=1)
+    cr = jnp.concatenate([zpad, jnp.cumsum(sr, axis=1)], axis=1)
+
+    tot_idx = used_bin[:, None]                                       # [F, 1]
+    max_num_cat = jnp.minimum(mct, (used_bin + 1) // 2)               # [F]
+
+    # prefix tensors for all candidate set sizes t in 1..mct at once:
+    # forward = first t sorted categories; reverse = last t eligible ones
+    ts = jnp.arange(1, mct + 1, dtype=jnp.int32)                      # [T]
+    idx_fwd = jnp.minimum(ts[None, :], b)                             # [F?,T]
+    idx_fwd = jnp.broadcast_to(idx_fwd, (f, mct))
+    idx_rev = jnp.maximum(tot_idx - ts[None, :], 0)                   # [F, T]
+
+    def pref(csum):
+        top = jnp.take_along_axis(csum, tot_idx, axis=1)              # [F, 1]
+        fwd = jnp.take_along_axis(csum, idx_fwd, axis=1)              # [F, T]
+        rev = top - jnp.take_along_axis(csum, idx_rev, axis=1)        # [F, T]
+        return jnp.stack([fwd, rev], axis=2)                          # [F, T, 2]
+
+    lg_t = pref(cg)
+    lh_t = pref(ch)
+    lc_t = pref(cc)
+    lr_t = pref(cr)
+    in_range = ((ts[None, :] <= used_bin[:, None])
+                & (ts[None, :] <= max_num_cat[:, None])
+                & sort_mode[:, None])                                 # [F, T]
+    step_cnt = jnp.diff(lc_t, axis=1, prepend=0.0)                    # [F, T, 2]
+
+    # stateful gating scan over t (cnt_cur_group accumulation + break flags)
+    def gate(state, inputs):
+        grp, dead = state                                             # [F, 2]
+        sc_t, lct, lht, ok_t = inputs
+        grp = grp + sc_t
+        left_ok = (lct >= p.min_data_in_leaf) & \
+            (lht >= p.min_sum_hessian_in_leaf)
+        rc = parent_count - lct
+        rh = parent_hess - lht
+        brk = (rc < p.min_data_in_leaf) | (rc < p.min_data_per_group) | \
+            (rh < p.min_sum_hessian_in_leaf)
+        alive = jnp.logical_not(dead) & ok_t[:, None]
+        evald = alive & left_ok & jnp.logical_not(brk) & \
+            (grp >= p.min_data_per_group)
+        grp = jnp.where(evald, 0.0, grp)
+        dead = dead | (alive & brk)
+        return (grp, dead), evald
+
+    state0 = (jnp.zeros((f, 2), jnp.float32), jnp.zeros((f, 2), bool))
+    _, evald = lax.scan(
+        gate, state0,
+        (jnp.moveaxis(step_cnt, 1, 0), jnp.moveaxis(lc_t, 1, 0),
+         jnp.moveaxis(lh_t, 1, 0), jnp.moveaxis(in_range, 1, 0)))
+    evald = jnp.moveaxis(evald, 0, 1)                                 # [F, T, 2]
+
+    rg_t = parent_grad - lg_t
+    rh_t = parent_hess - lh_t
+    gains = leaf_gain(lg_t, lh_t, p, l2c) + leaf_gain(rg_t, rh_t, p, l2c) \
+        - gain_shift
+    gains = jnp.where(evald, gains, _NEG_INF)
+
+    flatc = gains.reshape(-1)
+    cb = jnp.argmax(flatc)
+    cgain = flatc[cb]
+    cf = (cb // (mct * 2)).astype(jnp.int32)
+    ct = ((cb // 2) % mct).astype(jnp.int32)          # t-1
+    cdir_rev = (cb % 2).astype(bool)
+
+    # chosen category set -> bin bitset
+    pos = jnp.arange(b, dtype=jnp.int32)
+    t_best = ct + 1
+    ub = used_bin[cf]
+    pos_mask = jnp.where(cdir_rev,
+                         (pos >= ub - t_best) & (pos < ub),
+                         pos < t_best)
+    bin_mask = jnp.zeros((b,), bool).at[order[cf]].set(pos_mask)
+    bitset = pack_bin_bitset(bin_mask)
+
+    sel = (cf, ct, jnp.where(cdir_rev, 1, 0))
+    cbest = {
+        "gain": cgain,
+        "feature": cf,
+        "left_grad": lg_t[sel],
+        "left_hess": lh_t[sel],
+        "left_count": lc_t[sel],
+        "left_rows": lr_t[sel],
+        "bitset": bitset,
+    }
+    return True, cbest

@@ -69,7 +69,8 @@ class TestPartitionSegment:
             partition_segment, static_argnames=("block_size",))(
             work, scratch, jnp.int32(s), jnp.int32(m), jnp.int32(n_left),
             jnp.int32(feat), jnp.int32(thr), jnp.asarray(False),
-            jnp.int32(31), jnp.asarray(False), block_size=bs)
+            jnp.int32(31), jnp.asarray(False), jnp.zeros((1,), jnp.uint32),
+            block_size=bs)
 
         got_b, got_g, got_h, got_c, got_e = unpack_rows(work2, n, layout)
         got_ids = np.asarray(got_e[0]).astype(np.int64)
@@ -104,17 +105,18 @@ class TestPartitionSegment:
         w2, _ = part(work, jnp.zeros_like(work), jnp.int32(0), jnp.int32(n),
                      jnp.int32(nl), jnp.int32(0), jnp.int32(3),
                      jnp.asarray(True), jnp.int32(b - 1), jnp.asarray(False),
-                     block_size=bs)
+                     jnp.zeros((1,), jnp.uint32), block_size=bs)
         got = np.asarray(unpack_rows(w2, n, layout)[4][0]).astype(int)
         np.testing.assert_array_equal(got[:nl], np.arange(n)[pred])
 
-        # categorical: left == bin
-        pred = binned[:, 1] == 7
+        # categorical via bitset: left = {3, 7, 12}
+        pred = np.isin(binned[:, 1], [3, 7, 12])
         nl = int(pred.sum())
+        bits = jnp.asarray([(1 << 3) | (1 << 7) | (1 << 12)], jnp.uint32)
         w2, _ = part(work, jnp.zeros_like(work), jnp.int32(0), jnp.int32(n),
                      jnp.int32(nl), jnp.int32(1), jnp.int32(7),
                      jnp.asarray(False), jnp.int32(b - 1), jnp.asarray(True),
-                     block_size=bs)
+                     bits, block_size=bs)
         got = np.asarray(unpack_rows(w2, n, layout)[4][0]).astype(int)
         np.testing.assert_array_equal(got[:nl], np.arange(n)[pred])
 
@@ -178,7 +180,7 @@ class TestCompactGrowerParity:
         work = pack_rows(jnp.asarray(binned), jnp.asarray(grad),
                          jnp.asarray(hess), jnp.asarray(cnt),
                          row_id[None, :], layout, pad_rows=pad)
-        tree_c, row_leaf_c, row_val_c, work2, _, _, _ = grow_tree_compact(
+        tree_c, row_leaf_c, work2, _, starts_c, rows_c = grow_tree_compact(
             work, jnp.zeros_like(work), jnp.asarray(num_bins),
             jnp.asarray(nan_bin), jnp.asarray(has_nan), jnp.asarray(is_cat),
             jnp.asarray(feat_mask), layout, params, n)
@@ -200,7 +202,10 @@ class TestCompactGrowerParity:
         got_leaf = np.empty(n, np.int64)
         got_leaf[ids] = np.asarray(row_leaf_c)
         np.testing.assert_array_equal(got_leaf, np.asarray(row_leaf_m))
-        # per-row leaf values match leaf_value[row_leaf]
+        # segment expansion reproduces leaf_value[row_leaf] exactly
+        from lightgbm_tpu.ops.compact import segments_to_leaf_vectors
+        _, row_val_c = segments_to_leaf_vectors(
+            starts_c, rows_c, tree_c.leaf_value, n)
         np.testing.assert_array_equal(
             np.asarray(row_val_c),
             np.asarray(tree_c.leaf_value)[np.asarray(row_leaf_c)])
@@ -218,7 +223,7 @@ class TestCompactGrowerParity:
         work = pack_rows(jnp.asarray(binned), jnp.asarray(grad),
                          jnp.asarray(hess), jnp.asarray(cnt),
                          jnp.asarray(extras), layout, pad_rows=pad)
-        _, _, _, work2, _, _, _ = grow_tree_compact(
+        _, _, work2, _, _, _ = grow_tree_compact(
             work, jnp.zeros_like(work), jnp.asarray(num_bins),
             jnp.asarray(nan_bin), jnp.asarray(has_nan), jnp.asarray(is_cat),
             jnp.ones(f, dtype=bool), layout, params, n)

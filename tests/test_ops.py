@@ -137,6 +137,6 @@ def test_route_matches_training_partition(rng):
         jnp.ones(f, bool), params)
     routed = route_one_tree(
         jnp.asarray(binned), tree.split_feature, tree.split_bin,
-        tree.default_left, tree.left_child, tree.right_child, tree.num_nodes,
-        nan_bin, is_cat)
+        tree.cat_bitset, tree.default_left, tree.left_child,
+        tree.right_child, tree.num_nodes, nan_bin, is_cat)
     np.testing.assert_array_equal(np.asarray(routed), np.asarray(row_leaf))
