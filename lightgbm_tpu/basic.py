@@ -375,6 +375,13 @@ class Booster:
         fevals = feval if isinstance(feval, (list, tuple)) else [feval]
         if which == "train":
             raw = np.asarray(self._gbdt.train_score)
+            if getattr(self._gbdt, "_compact", None) is not None:
+                # compact grower keeps train scores in a permuted row order;
+                # user fevals see the dataset's original order
+                perm = self._gbdt._compact_perm()
+                unperm = np.empty_like(raw)
+                unperm[:, perm] = raw
+                raw = unperm
             data = self.train_set
         else:
             vs = self._gbdt.valid_sets[which]

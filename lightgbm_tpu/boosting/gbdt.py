@@ -53,6 +53,40 @@ def _bound_gradients(obj, k_total: int, scores, label, weight):
         obj.label, obj.weight = old_l, old_w
 
 
+def _parse_monotone(value, num_features: int, feature_names) -> Optional[np.ndarray]:
+    """monotone_constraints -> [F] int8 (list, comma string, or name dict)."""
+    if value is None:
+        return None
+    if isinstance(value, str):
+        value = [int(v) for v in value.replace("(", "").replace(")", "")
+                 .split(",") if v.strip()]
+    if isinstance(value, dict):
+        out = np.zeros(num_features, np.int8)
+        for name, v in value.items():
+            out[list(feature_names).index(name)] = int(v)
+        return out if out.any() else None
+    arr = np.asarray(list(value), np.int8)
+    if arr.size != num_features:
+        raise ValueError(
+            f"monotone_constraints has {arr.size} entries for "
+            f"{num_features} features")
+    return arr if arr.any() else None
+
+
+def _parse_interactions(value, num_features: int) -> Optional[np.ndarray]:
+    """interaction_constraints -> [S, F] bool masks (list of index lists or
+    the reference's "[0,1],[2,3]" string form)."""
+    if value in (None, "", []):
+        return None
+    if isinstance(value, str):
+        import json as _json
+        value = _json.loads("[" + value + "]")
+    sets = np.zeros((len(value), num_features), bool)
+    for i, group in enumerate(value):
+        sets[i, np.asarray(list(group), np.int64)] = True
+    return sets
+
+
 def _clamp_block(block: int, n: int, floor: int = 128) -> int:
     """Shrink a streaming block size toward the data size (power-of-two)."""
     while block // 2 >= max(n, floor) and block > floor:
@@ -295,6 +329,23 @@ class GBDT:
         self.base_feat_mask = np.array(
             [not m.is_trivial for m in train_set.mappers], dtype=bool)
 
+        nf = train_set.num_total_features
+        mono_np = _parse_monotone(cfg.get("monotone_constraints"), nf,
+                                  train_set.feature_names)
+        inter_np = _parse_interactions(
+            cfg.get("interaction_constraints"), nf)
+        self._mono_types = (jnp.asarray(mono_np) if mono_np is not None
+                            else None)
+        if mono_np is not None and \
+                str(cfg.get("monotone_constraints_method", "basic")) != "basic":
+            log.warning(
+                "monotone_constraints_method="
+                f"{cfg.get('monotone_constraints_method')!r} is not "
+                "implemented; using the 'basic' method")
+        self._inter_sets = (jnp.asarray(inter_np) if inter_np is not None
+                            else None)
+        self._bynode_key = jax.random.PRNGKey(
+            int(cfg.get("feature_fraction_seed", 2)))
         self.grower_params = GrowerParams(
             num_leaves=self.max_leaves,
             max_depth=int(cfg.get("max_depth", -1)),
@@ -311,6 +362,11 @@ class GBDT:
             max_cat_to_onehot=int(cfg.get("max_cat_to_onehot", 4)),
             min_data_per_group=float(cfg.get("min_data_per_group", 100)),
             any_cat=bool(np.any(train_set.feature_is_categorical())),
+            use_monotone=mono_np is not None,
+            monotone_penalty=float(cfg.get("monotone_penalty", 0.0)),
+            path_smooth=float(cfg.get("path_smooth", 0.0)),
+            use_interaction=inter_np is not None,
+            bynode_fraction=float(cfg.get("feature_fraction_bynode", 1.0)),
             hist_impl=str(cfg.get("tpu_hist_impl", "auto")),
             part_block=_clamp_block(
                 int(cfg.get("tpu_part_block", 2048)), self._n_real),
@@ -390,12 +446,17 @@ class GBDT:
         binned = self.binned
         max_leaves = self.max_leaves
 
-        def step(score_k, grad_k, hess_k, mask, feat_mask, shrinkage):
+        mono_types = self._mono_types
+        inter_sets = self._inter_sets
+
+        def step(score_k, grad_k, hess_k, mask, feat_mask, shrinkage,
+                 bynode_key):
             g = grad_k * mask
             h = hess_k * mask
             tree, row_leaf = grow_tree(
                 binned, g, h, mask, num_bins_arr, nan_bin_arr, has_nan_arr,
-                is_cat_arr, feat_mask, grower_params)
+                is_cat_arr, feat_mask, grower_params, mono_types,
+                inter_sets, bynode_key)
             if renew:
                 residual = obj.label - score_k
                 w = mask if row_weight is None else mask * row_weight
@@ -498,6 +559,8 @@ class GBDT:
         nan_bin_arr = self.nan_bin_arr
         has_nan_arr = self.has_nan_arr
         is_cat_arr = self.is_cat_arr
+        mono_types = self._mono_types
+        inter_sets = self._inter_sets
         sc_off = layout.extra_off            # K score columns live first
         lbl_off = layout.extra_off + 4 * self._cx_label
         w_off = (layout.extra_off + 4 * self._cx_weight
@@ -514,7 +577,7 @@ class GBDT:
                   if self._cx_grads is not None else None)
 
         def step(work, scratch, scores, bag_w, use_stored_bag, feat_mask,
-                 shrinkage, k):
+                 shrinkage, bynode_key, k):
             pad_n = work.shape[0] - n
 
             def set_col(work, off, vec):     # vec: [n] f32
@@ -552,7 +615,8 @@ class GBDT:
             (tree, row_leaf, work, scratch, leaf_start,
              leaf_nrows) = grow_tree_compact(
                 work, scratch, num_bins_arr, nan_bin_arr, has_nan_arr,
-                is_cat_arr, feat_mask, layout, gp, n)
+                is_cat_arr, feat_mask, layout, gp, n,
+                mono_types, inter_sets, bynode_key)
 
             leaf_value = tree.leaf_value
             if renew:
@@ -639,7 +703,9 @@ class GBDT:
             tree, work, scratch, scores = c["step"](
                 c["work"], c["scratch"], self.train_score, mask,
                 jnp.asarray(use_stored), feat_mask,
-                jnp.float32(self.shrinkage_rate), k=k)
+                jnp.float32(self.shrinkage_rate),
+                jax.random.fold_in(self._bynode_key, self.num_total_trees),
+                k=k)
             c["work"], c["scratch"] = work, scratch
             c["epoch"] += 1
             self.train_score = scores
@@ -759,7 +825,8 @@ class GBDT:
             tree, row_leaf, new_score = self._step_fn(
                 self.train_score[cur_tree_id], grad[cur_tree_id],
                 hess[cur_tree_id], mask, feat_mask,
-                jnp.float32(self.shrinkage_rate))
+                jnp.float32(self.shrinkage_rate),
+                jax.random.fold_in(self._bynode_key, self.num_total_trees))
             self.train_score = self.train_score.at[cur_tree_id].set(new_score)
             # valid scores got the init at _boost_from_average already, so the
             # tree must be pushed through them BEFORE the bias fold

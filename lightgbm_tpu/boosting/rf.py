@@ -63,10 +63,13 @@ class RF(GBDT):
         for cur_tree_id in range(k):
             g = grad[cur_tree_id] * mask
             h = hess[cur_tree_id] * mask
+            import jax as _jax
             tree, row_leaf = grow_tree(
                 self.binned, g, h, mask,
                 self.num_bins_arr, self.nan_bin_arr, self.has_nan_arr,
                 self.is_cat_arr, feat_mask, self.grower_params,
+                self._mono_types, self._inter_sets,
+                _jax.random.fold_in(self._bynode_key, self.num_total_trees),
             )
             if int(tree.num_nodes) > 0:
                 tree = self._renew_tree_output(tree, row_leaf, mask, cur_tree_id)

@@ -296,10 +296,6 @@ UNIMPLEMENTED_PARAMS: Dict[str, str] = {
     "precise_float_parser": "text-file loading",
     "pre_partition": "pre-partitioned distributed data",
     # tree-learner features scheduled this round; warn until wired
-    "monotone_constraints": "monotone constraints",
-    "interaction_constraints": "interaction constraints",
-    "feature_fraction_bynode": "per-node feature sampling",
-    "path_smooth": "path smoothing",
 }
 
 # alias -> canonical param name

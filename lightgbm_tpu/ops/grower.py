@@ -43,8 +43,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from .histogram import histogram
-from .split import (SplitParams, SplitResult, best_split, go_left_pred,
-                    leaf_output)
+from .split import (SplitParams, SplitResult, best_split, child_output,
+                    go_left_pred, leaf_output)
 
 _NEG_INF = -1e30
 
@@ -67,6 +67,12 @@ class GrowerParams(NamedTuple):
     max_cat_to_onehot: int = 4
     min_data_per_group: float = 100.0
     any_cat: bool = True     # static: dataset has categorical features
+    # constraints / per-node sampling (statics; defaults compile away)
+    use_monotone: bool = False
+    monotone_penalty: float = 0.0
+    path_smooth: float = 0.0
+    use_interaction: bool = False
+    bynode_fraction: float = 1.0
     axis_name: Optional[str] = None
     hist_impl: str = "auto"  # auto | xla | pallas (ops/histogram.py dispatch)
     # compact-grower streaming block sizes (ops/grower_compact.py)
@@ -87,6 +93,9 @@ class GrowerParams(NamedTuple):
             max_cat_to_onehot=self.max_cat_to_onehot,
             min_data_per_group=self.min_data_per_group,
             enable_sorted_cat=self.any_cat,
+            use_monotone=self.use_monotone,
+            monotone_penalty=self.monotone_penalty,
+            path_smooth=self.path_smooth,
         )
 
     @property
@@ -158,18 +167,45 @@ class GrowerState(NamedTuple):
     # per-leaf outputs fixed at split time (reference stores left_output/
     # right_output in SplitInfo; sorted-categorical splits use l2+cat_l2)
     leaf_out: jax.Array        # [L] f32
+    # monotone output bounds per leaf (reference: BasicConstraintEntry)
+    leaf_cmin: jax.Array       # [L] f32
+    leaf_cmax: jax.Array       # [L] f32
+    # features used on the path to each leaf (interaction constraints)
+    leaf_used: jax.Array       # [L, F] bool
+    # output of the parent at leaf creation (path smoothing context)
+    leaf_pout: jax.Array       # [L] f32
 
 
 def _leaf_best_split(hist3, pg, ph, pc, feat_info, feat_mask, depth,
-                     params: GrowerParams):
+                     params: GrowerParams, mono_types=None, cmin=None,
+                     cmax=None, pout=0.0):
     num_bins_arr, nan_bin_arr, has_nan_arr, is_cat_arr = feat_info
     sp = best_split(
         hist3, pg, ph, pc,
         num_bins_arr, nan_bin_arr, has_nan_arr, is_cat_arr, feat_mask,
-        params.split_params(),
+        params.split_params(), mono_types, cmin, cmax, pout, depth,
     )
     depth_ok = jnp.logical_or(params.max_depth <= 0, depth < params.max_depth)
     return sp._replace(gain=jnp.where(depth_ok, sp.gain, _NEG_INF))
+
+
+def node_feature_mask(feat_mask, used, inter_sets, key, params):
+    """Per-node allowed features: interaction constraints restrict to the
+    union of constraint sets containing every feature already used on the
+    path (reference: ColSampler::GetByNode, col_sampler.hpp), then
+    feature_fraction_bynode Bernoulli-samples the survivors (documented
+    deviation: the reference draws an exact-count sample)."""
+    fm = feat_mask
+    if params.use_interaction:
+        subset = jnp.logical_not(
+            jnp.any(used[None, :] & jnp.logical_not(inter_sets), axis=1))
+        allowed = jnp.any(subset[:, None] & inter_sets, axis=0)
+        fm = fm & allowed
+    if params.bynode_fraction < 1.0:
+        keep = jax.random.uniform(key, fm.shape) < params.bynode_fraction
+        keep = jnp.where(jnp.any(keep & fm), keep, True)
+        fm = fm & keep
+    return fm
 
 
 @functools.partial(jax.jit, static_argnames=("params",))
@@ -184,6 +220,9 @@ def grow_tree(
     is_cat_arr: jax.Array,    # [F] bool
     feat_mask: jax.Array,     # [F] bool
     params: GrowerParams,
+    mono_types: Optional[jax.Array] = None,   # [F] i8 (use_monotone)
+    inter_sets: Optional[jax.Array] = None,   # [S, F] bool (use_interaction)
+    bynode_key: Optional[jax.Array] = None,   # PRNG key (bynode_fraction<1)
 ):
     """Grow one tree; returns (TreeArrays, row_leaf [N] i32)."""
     n, f = binned.shape
@@ -203,11 +242,20 @@ def grow_tree(
         chans = jnp.stack([grad * mask, hess * mask, cnt_weight * mask], axis=1)
         return histogram(binned, chans, B, ax, impl=params.hist_impl)
 
+    if mono_types is None:
+        mono_types = jnp.zeros((f,), jnp.int8)
+    if inter_sets is None:
+        inter_sets = jnp.zeros((0, f), bool)
+    if bynode_key is None:
+        bynode_key = jax.random.PRNGKey(0)
+    big = jnp.float32(3.4e38)
+
     # batched best-split over the two fresh children (one fused scan)
-    def two_best_splits(h2, pg2, ph2, pc2, feat_mask_, depth):
-        fn = lambda h, pg, ph, pc: _leaf_best_split(
-            h, pg, ph, pc, feat_info, feat_mask_, depth, params)
-        return jax.vmap(fn)(h2, pg2, ph2, pc2)
+    def two_best_splits(h2, pg2, ph2, pc2, fm2, depth, cmin2, cmax2, pout2):
+        fn = lambda h, pg, ph, pc, fm, cmn, cmx, po: _leaf_best_split(
+            h, pg, ph, pc, feat_info, fm, depth, params, mono_types,
+            cmn, cmx, po)
+        return jax.vmap(fn)(h2, pg2, ph2, pc2, fm2, cmin2, cmax2, pout2)
 
     # ---- root ----
     root_g = grad.sum()
@@ -218,9 +266,13 @@ def grow_tree(
         root_h = lax.psum(root_h, ax)
         root_c = lax.psum(root_c, ax)
     root_hist = hist3(jnp.ones_like(cnt_weight))
+    root_fm = node_feature_mask(
+        feat_mask, jnp.zeros((f,), bool), inter_sets,
+        jax.random.fold_in(bynode_key, 0), params)
     sp0 = _leaf_best_split(
-        root_hist, root_g, root_h, root_c, feat_info, feat_mask,
-        jnp.asarray(0, jnp.int32), params,
+        root_hist, root_g, root_h, root_c, feat_info, root_fm,
+        jnp.asarray(0, jnp.int32), params, mono_types,
+        -big, big, 0.0,
     )
 
     i32 = jnp.int32
@@ -258,6 +310,10 @@ def grow_tree(
         bs_cat_l2=jnp.zeros((L,), bool).at[0].set(sp0.is_cat_l2),
         leaf_out=jnp.zeros((L,), jnp.float32).at[0].set(
             leaf_output(root_g, root_h, params.split_params())),
+        leaf_cmin=jnp.full((L,), -3.4e38, jnp.float32),
+        leaf_cmax=jnp.full((L,), 3.4e38, jnp.float32),
+        leaf_used=jnp.zeros((L, f), bool),
+        leaf_pout=jnp.zeros((L,), jnp.float32),
     )
 
     def body(k, st: GrowerState) -> GrowerState:
@@ -339,13 +395,52 @@ def grow_tree(
             jnp.where(applied, d_child, st.leaf_depth[best_leaf]))
         leaf_depth = leaf_depth.at[new_leaf].set(
             jnp.where(applied, d_child, leaf_depth[new_leaf]))
+        # child outputs fixed now, under the parent leaf's monotone bounds
+        # and smoothing context (reference: SplitInfo left/right_output)
+        sp_ = params.split_params()
         l2_used = params.lambda_l2 + params.cat_l2 * catl2.astype(jnp.float32)
-        leaf_out = st.leaf_out.at[best_leaf].set(jnp.where(
-            applied, leaf_output(lg, lh, params.split_params(), l2_used),
-            st.leaf_out[best_leaf]))
-        leaf_out = leaf_out.at[new_leaf].set(jnp.where(
-            applied, leaf_output(rg, rh, params.split_params(), l2_used),
-            leaf_out[new_leaf]))
+        cminp = st.leaf_cmin[best_leaf]
+        cmaxp = st.leaf_cmax[best_leaf]
+        poutp = st.leaf_pout[best_leaf]
+        lw = child_output(lg, lh, lc, sp_, l2_used, poutp, cminp, cmaxp)
+        rw = child_output(rg, rh, rc, sp_, l2_used, poutp, cminp, cmaxp)
+        leaf_out = st.leaf_out.at[best_leaf].set(
+            jnp.where(applied, lw, st.leaf_out[best_leaf]))
+        leaf_out = leaf_out.at[new_leaf].set(
+            jnp.where(applied, rw, leaf_out[new_leaf]))
+        leaf_pout = st.leaf_pout.at[best_leaf].set(
+            jnp.where(applied, lw, poutp))
+        leaf_pout = leaf_pout.at[new_leaf].set(
+            jnp.where(applied, rw, leaf_pout[new_leaf]))
+
+        # monotone bound propagation, basic method (reference:
+        # BasicLeafConstraints::Update — children bounded by the midpoint)
+        iscat_split = is_cat_arr[f_]
+        if params.use_monotone:
+            mt = mono_types[f_].astype(jnp.int32)
+            mid = 0.5 * (lw + rw)
+            act = applied & jnp.logical_not(iscat_split)
+            cmax_l = jnp.where(act & (mt > 0), jnp.minimum(cmaxp, mid), cmaxp)
+            cmin_l = jnp.where(act & (mt < 0), jnp.maximum(cminp, mid), cminp)
+            cmin_r = jnp.where(act & (mt > 0), jnp.maximum(cminp, mid), cminp)
+            cmax_r = jnp.where(act & (mt < 0), jnp.minimum(cmaxp, mid), cmaxp)
+        else:
+            cmax_l = cmax_r = cmaxp
+            cmin_l = cmin_r = cminp
+        leaf_cmin = st.leaf_cmin.at[best_leaf].set(
+            jnp.where(applied, cmin_l, cminp))
+        leaf_cmin = leaf_cmin.at[new_leaf].set(
+            jnp.where(applied, cmin_r, leaf_cmin[new_leaf]))
+        leaf_cmax = st.leaf_cmax.at[best_leaf].set(
+            jnp.where(applied, cmax_l, cmaxp))
+        leaf_cmax = leaf_cmax.at[new_leaf].set(
+            jnp.where(applied, cmax_r, leaf_cmax[new_leaf]))
+
+        used_child = st.leaf_used[best_leaf] | (jnp.arange(f) == f_)
+        leaf_used = st.leaf_used.at[best_leaf].set(
+            jnp.where(applied, used_child, st.leaf_used[best_leaf]))
+        leaf_used = leaf_used.at[new_leaf].set(
+            jnp.where(applied, used_child, leaf_used[new_leaf]))
 
         # ---- children histograms + best splits (skipped when done) ----
         bs_arrays = (st.leaf_hist, st.bs_gain, st.bs_feature, st.bs_bin,
@@ -370,9 +465,17 @@ def grow_tree(
             leaf_hist = leaf_hist.at[new_leaf].set(hist_right)
 
             h2 = jnp.stack([hist_left, hist_right])
+            fm_l = node_feature_mask(
+                feat_mask, used_child, inter_sets,
+                jax.random.fold_in(bynode_key, 2 * k + 1), params)
+            fm_r = node_feature_mask(
+                feat_mask, used_child, inter_sets,
+                jax.random.fold_in(bynode_key, 2 * k + 2), params)
             sp = two_best_splits(
                 h2, jnp.stack([lg, rg]), jnp.stack([lh, rh]),
-                jnp.stack([lc, rc]), feat_mask, d_child)
+                jnp.stack([lc, rc]), jnp.stack([fm_l, fm_r]), d_child,
+                jnp.stack([cmin_l, cmin_r]), jnp.stack([cmax_l, cmax_r]),
+                jnp.stack([lw, rw]))
             bs_gain = bs_gain.at[best_leaf].set(sp.gain[0]).at[new_leaf].set(sp.gain[1])
             bs_feature = bs_feature.at[best_leaf].set(sp.feature[0]).at[new_leaf].set(sp.feature[1])
             bs_bin = bs_bin.at[best_leaf].set(sp.bin[0]).at[new_leaf].set(sp.bin[1])
@@ -422,6 +525,10 @@ def grow_tree(
             bs_bitset=bs_bits,
             bs_cat_l2=bs_catl2,
             leaf_out=leaf_out,
+            leaf_cmin=leaf_cmin,
+            leaf_cmax=leaf_cmax,
+            leaf_used=leaf_used,
+            leaf_pout=leaf_pout,
         )
 
     st = lax.fori_loop(0, L - 1, body, st)

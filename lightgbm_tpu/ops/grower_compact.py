@@ -41,7 +41,7 @@ from jax import lax
 from .compact import (RowLayout, partition_segment, segment_histogram,
                       segments_to_leaf_vectors)
 from .grower import GrowerParams, TreeArrays, _NEG_INF
-from .split import best_split, leaf_output
+from .split import best_split, child_output, leaf_output
 
 
 class CompactState(NamedTuple):
@@ -83,6 +83,10 @@ class CompactState(NamedTuple):
     bs_bitset: jnp.ndarray     # [L, W] u32 cached categorical bitsets
     bs_cat_l2: jnp.ndarray     # [L] bool (sorted-cat split: l2 += cat_l2)
     leaf_out: jnp.ndarray      # [L] f32 outputs fixed at split time
+    leaf_cmin: jnp.ndarray     # [L] f32 monotone output bounds
+    leaf_cmax: jnp.ndarray     # [L] f32
+    leaf_used: jnp.ndarray     # [L, F] bool path features (interaction)
+    leaf_pout: jnp.ndarray     # [L] f32 smoothing context
 
 
 @functools.partial(jax.jit,
@@ -98,6 +102,9 @@ def grow_tree_compact(
     layout: RowLayout,
     params: GrowerParams,
     n_real: int,
+    mono_types: jnp.ndarray = None,
+    inter_sets: jnp.ndarray = None,
+    bynode_key: jnp.ndarray = None,
 ):
     """Grow one tree; returns (TreeArrays, row_leaf [N], work', scratch',
     leaf_start [L], leaf_nrows [L]) — per-row outputs in the post-tree
@@ -111,8 +118,17 @@ def grow_tree_compact(
     sp_params = params.split_params()
     i32 = jnp.int32
 
-    def leaf_best(hist, pg, ph, pc, depth):
-        sp = best_split(hist, pg, ph, pc, *feat_info, feat_mask, sp_params)
+    if mono_types is None:
+        mono_types = jnp.zeros((F,), jnp.int8)
+    if inter_sets is None:
+        inter_sets = jnp.zeros((0, F), bool)
+    if bynode_key is None:
+        bynode_key = jax.random.PRNGKey(0)
+    big = jnp.float32(3.4e38)
+
+    def leaf_best(hist, pg, ph, pc, depth, fm, cmn, cmx, po):
+        sp = best_split(hist, pg, ph, pc, *feat_info, fm, sp_params,
+                        mono_types, cmn, cmx, po, depth)
         depth_ok = jnp.logical_or(params.max_depth <= 0,
                                   depth < params.max_depth)
         return sp._replace(gain=jnp.where(depth_ok, sp.gain, _NEG_INF))
@@ -128,7 +144,12 @@ def grow_tree_compact(
     root_g = root_hist[0, :, 0].sum()
     root_h = root_hist[0, :, 1].sum()
     root_c = root_hist[0, :, 2].sum()
-    sp0 = leaf_best(root_hist, root_g, root_h, root_c, jnp.asarray(0, i32))
+    from .grower import node_feature_mask
+    root_fm = node_feature_mask(
+        feat_mask, jnp.zeros((F,), bool), inter_sets,
+        jax.random.fold_in(bynode_key, 0), params)
+    sp0 = leaf_best(root_hist, root_g, root_h, root_c, jnp.asarray(0, i32),
+                    root_fm, -big, big, 0.0)
 
     W = params.bitset_words
     st = CompactState(
@@ -168,6 +189,10 @@ def grow_tree_compact(
         bs_cat_l2=jnp.zeros((L,), bool).at[0].set(sp0.is_cat_l2),
         leaf_out=jnp.zeros((L,), jnp.float32).at[0].set(
             leaf_output(root_g, root_h, sp_params)),
+        leaf_cmin=jnp.full((L,), -3.4e38, jnp.float32),
+        leaf_cmax=jnp.full((L,), 3.4e38, jnp.float32),
+        leaf_used=jnp.zeros((L, F), bool),
+        leaf_pout=jnp.zeros((L,), jnp.float32),
     )
 
     def body(k, st: CompactState) -> CompactState:
@@ -242,12 +267,44 @@ def grow_tree_compact(
         leaf_depth = leaf_depth.at[new_leaf].set(
             jnp.where(applied, d_child, leaf_depth[new_leaf]))
         l2_used = params.lambda_l2 + params.cat_l2 * catl2.astype(jnp.float32)
-        leaf_out = st.leaf_out.at[best_leaf].set(jnp.where(
-            applied, leaf_output(lg, lh, sp_params, l2_used),
-            st.leaf_out[best_leaf]))
-        leaf_out = leaf_out.at[new_leaf].set(jnp.where(
-            applied, leaf_output(rg, rh, sp_params, l2_used),
-            leaf_out[new_leaf]))
+        cminp = st.leaf_cmin[best_leaf]
+        cmaxp = st.leaf_cmax[best_leaf]
+        poutp = st.leaf_pout[best_leaf]
+        lw = child_output(lg, lh, lc, sp_params, l2_used, poutp, cminp, cmaxp)
+        rw = child_output(rg, rh, rc, sp_params, l2_used, poutp, cminp, cmaxp)
+        leaf_out = st.leaf_out.at[best_leaf].set(
+            jnp.where(applied, lw, st.leaf_out[best_leaf]))
+        leaf_out = leaf_out.at[new_leaf].set(
+            jnp.where(applied, rw, leaf_out[new_leaf]))
+        leaf_pout = st.leaf_pout.at[best_leaf].set(
+            jnp.where(applied, lw, poutp))
+        leaf_pout = leaf_pout.at[new_leaf].set(
+            jnp.where(applied, rw, leaf_pout[new_leaf]))
+        iscat_split = is_cat_arr[f_]
+        if params.use_monotone:
+            mt = mono_types[f_].astype(jnp.int32)
+            mid = 0.5 * (lw + rw)
+            act = applied & jnp.logical_not(iscat_split)
+            cmax_l = jnp.where(act & (mt > 0), jnp.minimum(cmaxp, mid), cmaxp)
+            cmin_l = jnp.where(act & (mt < 0), jnp.maximum(cminp, mid), cminp)
+            cmin_r = jnp.where(act & (mt > 0), jnp.maximum(cminp, mid), cminp)
+            cmax_r = jnp.where(act & (mt < 0), jnp.minimum(cmaxp, mid), cmaxp)
+        else:
+            cmax_l = cmax_r = cmaxp
+            cmin_l = cmin_r = cminp
+        leaf_cmin = st.leaf_cmin.at[best_leaf].set(
+            jnp.where(applied, cmin_l, cminp))
+        leaf_cmin = leaf_cmin.at[new_leaf].set(
+            jnp.where(applied, cmin_r, leaf_cmin[new_leaf]))
+        leaf_cmax = st.leaf_cmax.at[best_leaf].set(
+            jnp.where(applied, cmax_l, cmaxp))
+        leaf_cmax = leaf_cmax.at[new_leaf].set(
+            jnp.where(applied, cmax_r, leaf_cmax[new_leaf]))
+        used_child = st.leaf_used[best_leaf] | (jnp.arange(F) == f_)
+        leaf_used = st.leaf_used.at[best_leaf].set(
+            jnp.where(applied, used_child, st.leaf_used[best_leaf]))
+        leaf_used = leaf_used.at[new_leaf].set(
+            jnp.where(applied, used_child, leaf_used[new_leaf]))
 
         # ---- physical partition + children histograms + best splits ----
         s_ = st.leaf_start[best_leaf]
@@ -287,8 +344,16 @@ def grow_tree_compact(
             leaf_hist = leaf_hist.at[best_leaf].set(hist_left)
             leaf_hist = leaf_hist.at[new_leaf].set(hist_right)
 
-            spl = leaf_best(hist_left, lg, lh, lc, d_child)
-            spr = leaf_best(hist_right, rg, rh, rc, d_child)
+            fm_l = node_feature_mask(
+                feat_mask, used_child, inter_sets,
+                jax.random.fold_in(bynode_key, 2 * k + 1), params)
+            fm_r = node_feature_mask(
+                feat_mask, used_child, inter_sets,
+                jax.random.fold_in(bynode_key, 2 * k + 2), params)
+            spl = leaf_best(hist_left, lg, lh, lc, d_child, fm_l,
+                            cmin_l, cmax_l, lw)
+            spr = leaf_best(hist_right, rg, rh, rc, d_child, fm_r,
+                            cmin_r, cmax_r, rw)
             for leaf, sp in ((best_leaf, spl), (new_leaf, spr)):
                 bs_gain = bs_gain.at[leaf].set(sp.gain)
                 bs_feature = bs_feature.at[leaf].set(sp.feature)
@@ -344,6 +409,10 @@ def grow_tree_compact(
             bs_bitset=bs_bits,
             bs_cat_l2=bs_catl2,
             leaf_out=leaf_out,
+            leaf_cmin=leaf_cmin,
+            leaf_cmax=leaf_cmax,
+            leaf_used=leaf_used,
+            leaf_pout=leaf_pout,
         )
 
     st = lax.fori_loop(0, L - 1, body, st)

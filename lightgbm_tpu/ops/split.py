@@ -47,6 +47,14 @@ class SplitParams(NamedTuple):
     # static gate: skip the sorted-categorical machinery entirely when the
     # dataset has no categorical features (set from the dataset by the GBDT)
     enable_sorted_cat: bool = True
+    # monotone constraints, basic method (reference:
+    # BasicLeafConstraints, monotone_constraints.hpp:465) + split-gain
+    # penalty (:357); static gate keeps the unconstrained path unchanged
+    use_monotone: bool = False
+    monotone_penalty: float = 0.0
+    # path smoothing (reference: CalculateSplittedLeafOutput USE_SMOOTHING,
+    # feature_histogram.hpp: w*(n/s)/(n/s+1) + parent/(n/s+1))
+    path_smooth: float = 0.0
 
 
 class SplitResult(NamedTuple):
@@ -98,6 +106,38 @@ def leaf_gain(sum_grad, sum_hess, p: SplitParams, l2: Optional[float] = None):
     return (t * t) / (sum_hess + l2 + _EPS)
 
 
+def gain_given_output(sum_grad, sum_hess, w, p: SplitParams, l2=None):
+    """Leaf gain at a FIXED output (reference: GetLeafGainGivenOutput) —
+    used when constraints/smoothing move the output off the optimum."""
+    if l2 is None:
+        l2 = p.lambda_l2
+    sg = threshold_l1(sum_grad, p.lambda_l1)
+    return -(2.0 * sg * w + (sum_hess + l2) * w * w)
+
+
+def child_output(sum_grad, sum_hess, cnt, p: SplitParams, l2=None,
+                 parent_output=0.0, cmin=None, cmax=None):
+    """Constrained/smoothed child output (reference:
+    CalculateSplittedLeafOutput with USE_SMOOTHING + BasicConstraint clip)."""
+    w = leaf_output(sum_grad, sum_hess, p, l2)
+    if p.path_smooth > 0.0:
+        ratio = cnt / p.path_smooth
+        w = w * ratio / (ratio + 1.0) + parent_output / (ratio + 1.0)
+    if p.use_monotone and cmin is not None:
+        w = jnp.clip(w, cmin, cmax)
+    return w
+
+
+def monotone_penalty_factor(depth, penalty: float):
+    """(reference: ComputeMonotoneSplitGainPenalty,
+    monotone_constraints.hpp:357)"""
+    d = depth.astype(jnp.float32)
+    small = 1.0 - penalty / jnp.exp2(d) + _EPS
+    large = 1.0 - jnp.exp2(penalty - 1.0 - d) + _EPS
+    out = jnp.where(penalty <= 1.0, small, large)
+    return jnp.where(penalty >= d + 1.0, _EPS, out)
+
+
 def pack_bin_bitset(mask: jnp.ndarray) -> jnp.ndarray:
     """[B] bool bin-membership -> [ceil(B/32)] u32 bitset words."""
     b = mask.shape[0]
@@ -147,6 +187,11 @@ def best_split(
     is_cat: jnp.ndarray,      # [F] bool
     feat_mask: jnp.ndarray,   # [F] bool: features allowed at this node
     p: SplitParams,
+    mono_types: Optional[jnp.ndarray] = None,   # [F] i8 in {-1, 0, +1}
+    cmin: Optional[jnp.ndarray] = None,         # scalar: leaf output bounds
+    cmax: Optional[jnp.ndarray] = None,
+    parent_output: float = 0.0,                 # for path smoothing
+    depth: Optional[jnp.ndarray] = None,        # for the monotone penalty
 ) -> SplitResult:
     """Find the best (feature, threshold, direction) for one leaf."""
     f, b, k = hist.shape
@@ -185,6 +230,8 @@ def best_split(
     parent_gain = leaf_gain(parent_grad, parent_hess, p)
     gain_shift = parent_gain + p.min_gain_to_split
 
+    constrained = p.use_monotone or p.path_smooth > 0.0
+
     def dir_score(lg, lh, lc, extra_valid):
         rg = parent_grad - lg
         rh = parent_hess - lh
@@ -197,7 +244,23 @@ def best_split(
             & (lh >= p.min_sum_hessian_in_leaf)
             & (rh >= p.min_sum_hessian_in_leaf)
         )
-        gain = leaf_gain(lg, lh, p) + leaf_gain(rg, rh, p) - gain_shift
+        if constrained:
+            # outputs move off the optimum (clip/smooth), so gains are
+            # evaluated at the realized outputs (reference: GetSplitGains ->
+            # GetSplitGainsGivenOutputs path)
+            lw = child_output(lg, lh, lc, p, None, parent_output, cmin, cmax)
+            rw = child_output(rg, rh, rc, p, None, parent_output, cmin, cmax)
+            gain = gain_given_output(lg, lh, lw, p) \
+                + gain_given_output(rg, rh, rw, p) - gain_shift
+            if p.use_monotone and mono_types is not None:
+                mt = mono_types[:, None].astype(jnp.int32)
+                valid &= jnp.logical_not((mt > 0) & (lw > rw))
+                valid &= jnp.logical_not((mt < 0) & (lw < rw))
+                if p.monotone_penalty > 0.0:
+                    pen = monotone_penalty_factor(depth, p.monotone_penalty)
+                    gain = jnp.where(mt != 0, gain * pen, gain)
+        else:
+            gain = leaf_gain(lg, lh, p) + leaf_gain(rg, rh, p) - gain_shift
         return jnp.where(valid, gain, _NEG_INF)
 
     # categorical one-hot splits (only for low-cardinality features,
@@ -236,7 +299,8 @@ def best_split(
     sorted_any = bool(b > 1) and p.enable_sorted_cat
     cs, cbest = _sorted_cat_split(
         g, h, c, r, is_cat, num_bins, feat_mask, parent_grad, parent_hess,
-        parent_count, gain_shift, p) if sorted_any else (None, None)
+        parent_count, gain_shift, p, parent_output, cmin,
+        cmax) if sorted_any else (None, None)
     if cs is not None:
         use_sorted = cbest["gain"] > best_gain
     else:
@@ -277,7 +341,8 @@ def best_split(
 
 
 def _sorted_cat_split(g, h, c, r, is_cat, num_bins, feat_mask, parent_grad,
-                      parent_hess, parent_count, gain_shift, p: SplitParams):
+                      parent_hess, parent_count, gain_shift, p: SplitParams,
+                      parent_output=0.0, cmin=None, cmax=None):
     """Best sorted-many-category split over all features; returns
     (True, dict) or (None, None) when no feature qualifies statically."""
     f, b = g.shape
@@ -355,8 +420,20 @@ def _sorted_cat_split(g, h, c, r, is_cat, num_bins, feat_mask, parent_grad,
 
     rg_t = parent_grad - lg_t
     rh_t = parent_hess - lh_t
-    gains = leaf_gain(lg_t, lh_t, p, l2c) + leaf_gain(rg_t, rh_t, p, l2c) \
-        - gain_shift
+    if p.use_monotone or p.path_smooth > 0.0:
+        # gains at realized (clipped/smoothed) outputs so they stay
+        # comparable with the numerical candidates' constrained gains
+        # (reference: GetSplitGains with constraints in the cat branch)
+        rc_t = parent_count - lc_t
+        lw_t = child_output(lg_t, lh_t, lc_t, p, l2c, parent_output,
+                            cmin, cmax)
+        rw_t = child_output(rg_t, rh_t, rc_t, p, l2c, parent_output,
+                            cmin, cmax)
+        gains = gain_given_output(lg_t, lh_t, lw_t, p, l2c) \
+            + gain_given_output(rg_t, rh_t, rw_t, p, l2c) - gain_shift
+    else:
+        gains = leaf_gain(lg_t, lh_t, p, l2c) + leaf_gain(rg_t, rh_t, p, l2c) \
+            - gain_shift
     gains = jnp.where(evald, gains, _NEG_INF)
 
     flatc = gains.reshape(-1)

@@ -203,11 +203,10 @@ class TestConfigWarnings:
         from lightgbm_tpu.utils import log as _log
         _log.set_verbosity(1)  # earlier tests may have silenced warnings
         with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
-            Config({"monotone_constraints": [1, -1, 0],
-                    "linear_tree": True,
+            Config({"linear_tree": True,
                     "use_quantized_grad": True})
         text = caplog.text
-        for name in ("monotone_constraints", "linear_tree",
+        for name in ("linear_tree",
                      "use_quantized_grad"):
             assert f"{name}=" in text and "NOT implemented" in text, \
                 f"no warning for {name}: {text!r}"

@@ -393,3 +393,107 @@ class TestCategoricalSplits:
             preds[mode] = bst.predict(X)
         np.testing.assert_allclose(preds["compact"], preds["masked"],
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestConstraints:
+    """Monotone/interaction constraints + per-node sampling (reference:
+    monotone_constraints.hpp BasicLeafConstraints, col_sampler.hpp)."""
+
+    def _mono_problem(self, seed=0, n=2000):
+        rng = np.random.RandomState(seed)
+        x0 = rng.rand(n)
+        X = np.column_stack([x0, rng.randn(n)])
+        y = 2 * x0 + 0.5 * np.sin(8 * x0) + 0.1 * rng.randn(n)
+        return X, y
+
+    @pytest.mark.parametrize("grower", ["masked", "compact"])
+    def test_monotone_increasing(self, grower):
+        import lightgbm_tpu as lgb
+        X, y = self._mono_problem()
+        params = {"objective": "regression", "num_leaves": 31,
+                  "verbosity": -1, "monotone_constraints": [1, 0],
+                  "min_data_in_leaf": 5, "tpu_grower": grower,
+                  "tpu_part_block": 128, "tpu_hist_block": 256}
+        bst = lgb.train(params, lgb.Dataset(X, label=y), 40)
+        grid = np.column_stack([np.linspace(0, 1, 200), np.zeros(200)])
+        p = bst.predict(grid)
+        assert (np.diff(p) >= -1e-9).all()
+        # constrained model still fits the monotone trend
+        assert np.corrcoef(p, grid[:, 0])[0, 1] > 0.8
+
+    def test_monotone_decreasing(self):
+        import lightgbm_tpu as lgb
+        X, y = self._mono_problem()
+        params = {"objective": "regression", "num_leaves": 31,
+                  "verbosity": -1, "monotone_constraints": "-1,0",
+                  "min_data_in_leaf": 5}
+        bst = lgb.train(params, lgb.Dataset(X, label=-y), 40)
+        grid = np.column_stack([np.linspace(0, 1, 200), np.zeros(200)])
+        assert (np.diff(bst.predict(grid)) <= 1e-9).all()
+
+    def test_interaction_constraints(self):
+        import lightgbm_tpu as lgb
+        from tests.utils import FAST_PARAMS, regression_data
+        X, y = regression_data()
+        params = dict(FAST_PARAMS, objective="regression",
+                      interaction_constraints=[[0, 1, 2], [3, 4, 5, 6]])
+        bst = lgb.train(params, lgb.Dataset(X, label=y), 15)
+        # every tree's features must come from a single constraint group
+        dumped = bst.dump_model()
+        groups = [{0, 1, 2}, {3, 4, 5, 6}]
+
+        def tree_feats(node, acc):
+            if "split_feature" in node:
+                acc.add(node["split_feature"])
+                tree_feats(node["left_child"], acc)
+                tree_feats(node["right_child"], acc)
+            return acc
+
+        for t in dumped["tree_info"]:
+            feats = tree_feats(t["tree_structure"], set())
+            assert any(feats <= g for g in groups), feats
+
+    def test_feature_fraction_bynode_and_path_smooth(self):
+        import lightgbm_tpu as lgb
+        from tests.utils import FAST_PARAMS, regression_data
+        X, y = regression_data()
+        params = dict(FAST_PARAMS, objective="regression",
+                      feature_fraction_bynode=0.5, path_smooth=10.0)
+        bst = lgb.train(params, lgb.Dataset(X, label=y), 15)
+        mse = float(np.mean((bst.predict(X) - y) ** 2))
+        assert mse < np.var(y)  # learns something under both knobs
+
+    def test_rf_with_interaction_constraints(self):
+        # regression test: RF must forward constraint args to the grower
+        import lightgbm_tpu as lgb
+        from tests.utils import FAST_PARAMS, regression_data
+        X, y = regression_data()
+        params = dict(FAST_PARAMS, objective="regression", boosting="rf",
+                      bagging_fraction=0.7, bagging_freq=1,
+                      interaction_constraints=[[0, 1, 2], [3, 4, 5, 6]])
+        bst = lgb.train(params, lgb.Dataset(X, label=y), 10)
+        pred = bst.predict(X)
+        assert float(np.std(pred)) > 1e-3  # not an all-stump forest
+
+    def test_custom_feval_on_train_with_compact(self):
+        # regression test: feval sees original-order train predictions
+        import lightgbm_tpu as lgb
+        from tests.utils import FAST_PARAMS, binary_data
+        X, y = binary_data()
+
+        def acc(preds, data):
+            lbl = data.get_label()
+            return "acc", float(((preds > 0) == (lbl > 0)).mean()), True
+
+        results = {}
+        for mode in ("masked", "compact"):
+            ds = lgb.Dataset(X, label=y)
+            rec = {}
+            bst = lgb.train(
+                dict(FAST_PARAMS, objective="binary", tpu_grower=mode,
+                     tpu_part_block=128, tpu_hist_block=256, metric="None"),
+                ds, 15, valid_sets=[ds], valid_names=["train"], feval=acc,
+                callbacks=[lgb.record_evaluation(rec)])
+            results[mode] = rec["train"]["acc"][-1]
+        assert results["compact"] > 0.9
+        assert abs(results["compact"] - results["masked"]) < 0.05
