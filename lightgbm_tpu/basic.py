@@ -290,6 +290,75 @@ class Booster:
             raise ValueError(
                 "need at least one of train_set, model_file and model_str")
 
+    # -- continue-training (reference: init_model -> gbdt.cpp:250-258) ------
+    def _attach_pre_model(self, pre_model, pre_train_raw: np.ndarray) -> None:
+        """Seed cached train scores with a loaded model's raw predictions and
+        keep its value-space trees for prediction/saving."""
+        self._pre_model = pre_model
+        g = self._gbdt
+        k, n = pre_train_raw.shape
+        import jax.numpy as jnp
+        if k != g.num_tree_per_iteration:
+            raise ValueError(
+                f"init_model has {k} trees/iteration, training config has "
+                f"{g.num_tree_per_iteration}")
+        g.train_score = g.train_score.at[:, :n].add(jnp.asarray(pre_train_raw))
+        # suppress boost_from_average: scores already carry the loaded model
+        g._has_init_score = True
+
+    def _seed_valid_scores(self, which: int, pre_raw: np.ndarray) -> None:
+        import jax.numpy as jnp
+        vs = self._gbdt.valid_sets[which]
+        vs.score = vs.score.at[:, : pre_raw.shape[1]].add(jnp.asarray(pre_raw))
+
+    def refit(self, data, label, decay_rate: Optional[float] = None,
+              weight=None, **kwargs) -> "Booster":
+        """Re-fit all leaf values on new data, keeping tree structures
+        (reference: Booster.refit, basic.py -> GBDT::RefitTree gbdt.cpp:258:
+        gradients computed once per iteration at the running score, and each
+        leaf's value becomes decay*old + (1-decay)*shrinkage*(-ThL1(G)/(H+l2)))."""
+        from .model_io import LoadedGBDT, loaded_to_string
+        if kwargs:
+            raise TypeError(
+                f"refit got unsupported arguments: {sorted(kwargs)}")
+        if decay_rate is None:
+            decay_rate = float((self.config.get("refit_decay_rate", 0.9)
+                                if self.config else 0.9))
+        cfg = self.config or Config(self.params or {})
+        lam1 = float(cfg.get("lambda_l1", 0.0))
+        lam2 = float(cfg.get("lambda_l2", 0.0))
+        loaded = LoadedGBDT(self.model_to_string())
+        obj = loaded.objective
+        if obj is None:
+            raise ValueError("refit requires a model with a known objective")
+        import jax.numpy as jnp
+        X = np.asarray(_maybe_series(data), np.float64)
+        y = np.asarray(_maybe_series(label), np.float64)
+        md = Metadata(len(y))
+        md.set_label(y)
+        md.set_weight(_maybe_series(weight))
+        obj.init(md, len(y))
+        k = loaded.num_tree_per_iteration
+        score = np.zeros((k, len(y)), np.float64)
+        for it in range(len(loaded.models) // k):
+            # gradients once per iteration (reference: gbdt.cpp:279-281)
+            sc = score[0] if k == 1 else score
+            g, h = obj.get_gradients(jnp.asarray(sc, jnp.float32))
+            g = np.asarray(g, np.float64).reshape(k, -1)
+            h = np.asarray(h, np.float64).reshape(k, -1)
+            for cls in range(k):
+                t = loaded.models[it * k + cls]
+                leaf = t.route(X)
+                nl = t.num_leaves
+                gs = np.bincount(leaf, weights=g[cls], minlength=nl)
+                hs = np.bincount(leaf, weights=h[cls], minlength=nl)
+                thr = np.sign(gs) * np.maximum(np.abs(gs) - lam1, 0.0)
+                new_val = -thr / (hs + lam2 + 1e-15) * t.shrinkage
+                t.leaf_value = (decay_rate * t.leaf_value
+                                + (1.0 - decay_rate) * new_val)
+                score[cls] += t.leaf_value[leaf]
+        return Booster(model_str=loaded_to_string(loaded))
+
     # -- training ------------------------------------------------------------
     def add_valid(self, data: Dataset, name: str) -> "Booster":
         """(reference: Booster.add_valid, basic.py:3963)"""
@@ -420,11 +489,18 @@ class Booster:
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration > 0 else None
         arr = np.asarray(_maybe_series(data), dtype=np.float64)
+        pre = getattr(self, "_pre_model", None)
         if pred_leaf:
-            return inner.predict_leaf_matrix(arr, num_iteration)
+            own = inner.predict_leaf_matrix(arr, num_iteration)
+            if pre is not None:
+                own = np.concatenate(
+                    [pre.predict_leaf_matrix(arr), own], axis=1)
+            return own
         if pred_contrib:
             return self._predict_contrib(arr, num_iteration)
         raw = inner.predict_raw_matrix(arr, num_iteration)   # [K, N]
+        if pre is not None:
+            raw = raw + pre.predict_raw_matrix(arr)
         k = raw.shape[0]
         if raw_score or inner.objective is None:
             return raw[0] if k == 1 else raw.T
@@ -442,12 +518,16 @@ class Booster:
     def model_to_string(self, num_iteration: Optional[int] = None,
                         start_iteration: int = 0,
                         importance_type: str = "split") -> str:
-        from .model_io import booster_to_string
+        from .model_io import booster_to_string, merge_model_texts
         if num_iteration is None and self.best_iteration > 0:
             # reference behavior: default save cuts at best_iteration
             # (basic.py save_model num_iteration doc)
             num_iteration = self.best_iteration
-        return booster_to_string(self, num_iteration)
+        text = booster_to_string(self, num_iteration)
+        pre = getattr(self, "_pre_model", None)
+        if pre is not None:
+            text = merge_model_texts(pre.original_text, text)
+        return text
 
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0,
@@ -460,15 +540,25 @@ class Booster:
                    start_iteration: int = 0,
                    importance_type: str = "split") -> Dict:
         from .model_io import booster_to_dict
+        if getattr(self, "_pre_model", None) is not None:
+            # continue-trained boosters dump via the merged text (keeps the
+            # loaded trees; a text round-trip is exact for them)
+            from .model_io import LoadedGBDT, loaded_dump
+            return loaded_dump(LoadedGBDT(self.model_to_string(num_iteration)))
         return booster_to_dict(self, num_iteration)
 
     # -- introspection -------------------------------------------------------
     def num_trees(self) -> int:
         g = self._gbdt
-        return g.num_total_trees if hasattr(g, "num_total_trees") else len(g.models)
+        own = g.num_total_trees if hasattr(g, "num_total_trees") \
+            else len(g.models)
+        pre = getattr(self, "_pre_model", None)
+        return own + (len(pre.models) if pre is not None else 0)
 
     def current_iteration(self) -> int:
-        return self._gbdt.current_iteration
+        pre = getattr(self, "_pre_model", None)
+        return self._gbdt.current_iteration + \
+            (pre.current_iteration if pre is not None else 0)
 
     def num_model_per_iteration(self) -> int:
         return self._gbdt.num_tree_per_iteration
@@ -487,13 +577,30 @@ class Booster:
 
     def feature_importance(self, importance_type: str = "split",
                            iteration: Optional[int] = None) -> np.ndarray:
-        return self._gbdt.feature_importance(importance_type, iteration)
+        imp = self._gbdt.feature_importance(importance_type, iteration)
+        pre = getattr(self, "_pre_model", None)
+        if pre is not None:
+            pre_imp = pre.feature_importance(importance_type)
+            n = max(len(imp), len(pre_imp))
+            out = np.zeros(n, imp.dtype)
+            out[: len(imp)] += imp
+            out[: len(pre_imp)] += pre_imp
+            return out
+        return imp
+
+    def _all_leaf_values(self):
+        pre = getattr(self, "_pre_model", None)
+        models = list(self._gbdt.models) + \
+            (list(pre.models) if pre is not None else [])
+        return models
 
     def lower_bound(self):
-        return min((m.leaf_value.min() for m in self._gbdt.models), default=0.0)
+        return min((m.leaf_value.min() for m in self._all_leaf_values()),
+                   default=0.0)
 
     def upper_bound(self):
-        return max((m.leaf_value.max() for m in self._gbdt.models), default=0.0)
+        return max((m.leaf_value.max() for m in self._all_leaf_values()),
+                   default=0.0)
 
 
 class _DatasetView:

@@ -274,7 +274,6 @@ UNIMPLEMENTED_PARAMS: Dict[str, str] = {
     "feature_contri": "per-feature split-gain scaling",
     "forcedsplits_filename": "forced splits",
     "forcedbins_filename": "forced bin boundaries",
-    "refit_decay_rate": "refit",
     "pred_early_stop": "prediction early stopping",
     "start_iteration_predict": "prediction start_iteration",
     "num_iteration_predict": "prediction num_iteration",
@@ -283,8 +282,6 @@ UNIMPLEMENTED_PARAMS: Dict[str, str] = {
     "num_machines": "multi-host (DCN) training",
     "machines": "multi-host (DCN) training",
     "machine_list_filename": "multi-host (DCN) training",
-    "snapshot_freq": "periodic model snapshots",
-    "input_model": "continue training from a model file",
     "save_binary": "binary dataset files",
     "two_round": "two-round file loading",
     "header": "text-file loading",

@@ -61,14 +61,33 @@ def train(
             num_boost_round = int(params.pop(key))
     params["num_iterations"] = num_boost_round
 
+    # continue-training: the loaded model's trees stay value-space
+    # (reference: engine.py init_model -> _InnerPredictor; gbdt.cpp:250-258);
+    # its raw predictions seed all cached scores and its tree blocks are
+    # re-emitted ahead of the new ones at save time
+    pre_model = None
+    if init_model is None and params.get("input_model"):
+        init_model = str(params["input_model"])
     if init_model is not None:
-        raise NotImplementedError(
-            "continue-training (init_model) is not implemented yet")
+        from .model_io import LoadedGBDT
+        if isinstance(init_model, str):
+            with open(init_model) as fh:
+                pre_model = LoadedGBDT(fh.read())
+        else:
+            pre_model = LoadedGBDT(init_model.model_to_string())
 
     train_set._update_params(params)
+    if pre_model is not None and train_set.data is None:
+        raise ValueError(
+            "continue-training needs the Dataset's raw data to score the "
+            "loaded model; construct the Dataset with free_raw_data=False")
+    pre_train_raw = (pre_model.predict_raw_matrix(np.asarray(train_set.data))
+                     if pre_model is not None else None)
     train_set.construct()
     booster = Booster(params=params, train_set=train_set)
     booster._train_data_name = "training"
+    if pre_model is not None:
+        booster._attach_pre_model(pre_model, pre_train_raw)
 
     is_valid_contain_train = False
     name_valid_sets = []
@@ -84,9 +103,21 @@ def train(
                 is_valid_contain_train = True
                 booster._train_data_name = name
                 continue
+            pre_valid_raw = None
+            if pre_model is not None:
+                if valid_data.data is None:
+                    raise ValueError(
+                        "continue-training needs raw valid data "
+                        "(free_raw_data=False)")
+                pre_valid_raw = pre_model.predict_raw_matrix(
+                    np.asarray(valid_data.data))
             booster.add_valid(valid_data, name)
+            if pre_valid_raw is not None:
+                booster._seed_valid_scores(-1, pre_valid_raw)
 
     cbs_before, cbs_after = _setup_callbacks(params, callbacks)
+    snapshot_freq = int(params.get("snapshot_freq", -1) or -1)
+    snapshot_out = str(params.get("output_model", "LightGBM_model.txt"))
 
     evaluation_result_list: List = []
     for i in range(num_boost_round):
@@ -113,6 +144,10 @@ def train(
             booster.best_iteration = e.best_iteration + 1
             evaluation_result_list = e.best_score or []
             break
+        # periodic model snapshots (reference: GBDT::Train, gbdt.cpp:250-254
+        # -> model.txt.snapshot_iter_N every snapshot_freq iterations)
+        if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
+            booster.save_model(f"{snapshot_out}.snapshot_iter_{i + 1}")
         if finished:
             log.info("Finished training (no further splits possible)")
             break

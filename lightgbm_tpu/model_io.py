@@ -338,8 +338,10 @@ class LoadedGBDT:
         # split into header / tree blocks / footer on 'Tree=' markers
         header_lines: List[str] = []
         tree_chunks: List[List[str]] = []
+        footer_lines: List[str] = []
         cur: Optional[List[str]] = None
-        for line in lines:
+        rest_at: Optional[int] = None
+        for li, line in enumerate(lines):
             if line.startswith("Tree="):
                 if cur is not None:
                     tree_chunks.append(cur)
@@ -348,6 +350,7 @@ class LoadedGBDT:
                 if cur is not None:
                     tree_chunks.append(cur)
                 cur = None
+                rest_at = li
                 break
             elif cur is not None:
                 cur.append(line)
@@ -355,6 +358,16 @@ class LoadedGBDT:
                 header_lines.append(line)
         if cur is not None:
             tree_chunks.append(cur)
+        if rest_at is not None:
+            footer_lines = lines[rest_at:]
+        # raw pieces retained for faithful re-emission (continue-training
+        # merges and refit re-save; reference keeps the file as-is too)
+        self._header_lines = [l for l in header_lines
+                              if not l.startswith("tree_sizes=")]
+        while self._header_lines and not self._header_lines[-1].strip():
+            self._header_lines.pop()
+        self._tree_chunks = tree_chunks
+        self._footer_lines = footer_lines
 
         hdr = _parse_block(header_lines)
         self.num_class = int(hdr.get("num_class", 1))
@@ -451,6 +464,145 @@ def _objective_from_string(obj_str: str):
     except ValueError:
         log.warning(f"Unknown objective in model file: {name}")
         return None
+
+
+def _emit_loaded(header_lines, chunks, models, footer_lines,
+                 feature_names) -> str:
+    """Re-emit a parsed model: raw header + renumbered tree chunks (with
+    leaf_value refreshed from the in-memory trees) + raw footer with
+    feature_importances recomputed."""
+    blocks = []
+    for i, (chunk, t) in enumerate(zip(chunks, models)):
+        out = []
+        for line in chunk:
+            if line.startswith("Tree="):
+                out.append(f"Tree={i}")
+            elif line.startswith("leaf_value="):
+                out.append("leaf_value=" + " ".join(
+                    _fmt(v) for v in t.leaf_value))
+            else:
+                out.append(line)
+        while out and not out[-1].strip():
+            out.pop()
+        blocks.append("\n".join(out) + "\n")
+    sizes = [len(b) + 1 for b in blocks]
+    header = list(header_lines)
+    header.append("tree_sizes=" + " ".join(str(sz) for sz in sizes))
+    header.append("")
+
+    # recompute the informational importance footer over ALL trees
+    imp: Dict[int, int] = {}
+    for t in models:
+        for i in range(t.num_nodes):
+            f = int(t.split_feature[i])
+            imp[f] = imp.get(f, 0) + 1
+    footer = []
+    in_imp = False
+    for line in footer_lines:
+        if line.strip() == "feature_importances:":
+            in_imp = True
+            footer.append(line)
+            for f in sorted(imp, key=lambda j: -imp[j]):
+                name = (feature_names[f] if f < len(feature_names)
+                        else f"Column_{f}")
+                footer.append(f"{name}={imp[f]}")
+            continue
+        if in_imp:
+            if "=" in line and not line.startswith("["):
+                continue  # old importance entries
+            in_imp = False
+        footer.append(line)
+    return "\n".join(header) + "\n" + "\n".join(blocks) \
+        + "\n".join(footer)
+
+
+def loaded_to_string(loaded: "LoadedGBDT") -> str:
+    """Serialize a (possibly refitted) loaded model back to v4 text."""
+    return _emit_loaded(loaded._header_lines, loaded._tree_chunks,
+                        loaded.models, loaded._footer_lines,
+                        loaded.feature_names)
+
+
+def merge_model_texts(pre_text: str, new_text: str) -> str:
+    """Continue-training save: the loaded model's tree blocks followed by the
+    newly trained ones, under the new model's header/footer (reference:
+    models_ holds loaded + new trees, gbdt_model_text.cpp emits them all)."""
+    pre = LoadedGBDT(pre_text)
+    new = LoadedGBDT(new_text)
+    return _emit_loaded(new._header_lines,
+                        pre._tree_chunks + new._tree_chunks,
+                        pre.models + new.models,
+                        new._footer_lines, new.feature_names)
+
+
+def _loaded_node_json(t: "LoadedTree", node: int):
+    if node < 0:
+        leaf = -(node + 1)
+        return {
+            "leaf_index": int(leaf),
+            "leaf_value": float(t.leaf_value[leaf]),
+            "leaf_weight": float(t.leaf_weight[leaf])
+            if len(t.leaf_weight) > leaf else 0.0,
+            "leaf_count": int(t.leaf_count[leaf])
+            if len(t.leaf_count) > leaf else 0,
+        }
+    dt = int(t.decision_type[node])
+    out = {
+        "split_index": int(node),
+        "split_feature": int(t.split_feature[node]),
+        "split_gain": float(t.split_gain[node]),
+        "internal_value": float(t.internal_value[node])
+        if len(t.internal_value) > node else 0.0,
+    }
+    if dt & 1:
+        ci = int(t.threshold[node])
+        lo, hi = int(t.cat_boundaries[ci]), int(t.cat_boundaries[ci + 1])
+        cats = []
+        for wi in range(lo, hi):
+            word = int(t.cat_threshold[wi])
+            for bit in range(32):
+                if (word >> bit) & 1:
+                    cats.append((wi - lo) * 32 + bit)
+        out["decision_type"] = "=="
+        out["threshold"] = "||".join(str(c) for c in cats)
+        out["default_left"] = False
+        out["missing_type"] = "None"
+    else:
+        out["decision_type"] = "<="
+        out["threshold"] = float(t.threshold[node])
+        out["default_left"] = bool(dt & 2)
+        out["missing_type"] = {0: "None", 1: "Zero", 2: "NaN"}.get(
+            (dt >> 2) & 3, "None")
+    out["left_child"] = _loaded_node_json(t, int(t.left_child[node]))
+    out["right_child"] = _loaded_node_json(t, int(t.right_child[node]))
+    return out
+
+
+def loaded_dump(loaded: "LoadedGBDT"):
+    """JSON dump of a parsed model (reference: GBDT::DumpModel)."""
+    tree_info = []
+    for i, t in enumerate(loaded.models):
+        root = (_loaded_node_json(t, 0) if t.num_nodes > 0
+                else _loaded_node_json(t, -1))
+        tree_info.append({
+            "tree_index": i,
+            "num_leaves": int(t.num_leaves),
+            "num_cat": int(t.num_cat),
+            "shrinkage": float(t.shrinkage),
+            "tree_structure": root,
+        })
+    return {
+        "name": "tree",
+        "version": "v4",
+        "num_class": loaded.num_class,
+        "num_tree_per_iteration": loaded.num_tree_per_iteration,
+        "label_index": 0,
+        "max_feature_idx": loaded.max_feature_idx,
+        "objective": loaded.objective_str,
+        "average_output": loaded.average_output,
+        "feature_names": loaded.feature_names,
+        "tree_info": tree_info,
+    }
 
 
 def load_booster(booster, model_str: str, params) -> None:

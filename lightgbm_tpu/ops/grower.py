@@ -269,10 +269,13 @@ def grow_tree(
     root_fm = node_feature_mask(
         feat_mask, jnp.zeros((f,), bool), inter_sets,
         jax.random.fold_in(bynode_key, 0), params)
+    # path smoothing at the root smooths toward the root's own output
+    # (reference: GetParentOutput, serial_tree_learner.cpp:1005-1016)
+    root_out = leaf_output(root_g, root_h, params.split_params())
     sp0 = _leaf_best_split(
         root_hist, root_g, root_h, root_c, feat_info, root_fm,
         jnp.asarray(0, jnp.int32), params, mono_types,
-        -big, big, 0.0,
+        -big, big, root_out,
     )
 
     i32 = jnp.int32
@@ -308,12 +311,11 @@ def grow_tree(
         bs_left_cnt=jnp.zeros((L,), jnp.float32).at[0].set(sp0.left_count),
         bs_bitset=jnp.zeros((L, W), jnp.uint32).at[0].set(sp0.cat_bitset),
         bs_cat_l2=jnp.zeros((L,), bool).at[0].set(sp0.is_cat_l2),
-        leaf_out=jnp.zeros((L,), jnp.float32).at[0].set(
-            leaf_output(root_g, root_h, params.split_params())),
+        leaf_out=jnp.zeros((L,), jnp.float32).at[0].set(root_out),
         leaf_cmin=jnp.full((L,), -3.4e38, jnp.float32),
         leaf_cmax=jnp.full((L,), 3.4e38, jnp.float32),
         leaf_used=jnp.zeros((L, f), bool),
-        leaf_pout=jnp.zeros((L,), jnp.float32),
+        leaf_pout=jnp.zeros((L,), jnp.float32).at[0].set(root_out),
     )
 
     def body(k, st: GrowerState) -> GrowerState:

@@ -148,8 +148,11 @@ def grow_tree_compact(
     root_fm = node_feature_mask(
         feat_mask, jnp.zeros((F,), bool), inter_sets,
         jax.random.fold_in(bynode_key, 0), params)
+    # path smoothing at the root smooths toward the root's own output
+    # (reference: GetParentOutput, serial_tree_learner.cpp:1005-1016)
+    root_out = leaf_output(root_g, root_h, sp_params)
     sp0 = leaf_best(root_hist, root_g, root_h, root_c, jnp.asarray(0, i32),
-                    root_fm, -big, big, 0.0)
+                    root_fm, -big, big, root_out)
 
     W = params.bitset_words
     st = CompactState(
@@ -187,12 +190,11 @@ def grow_tree_compact(
             sp0.left_rows.astype(i32)),
         bs_bitset=jnp.zeros((L, W), jnp.uint32).at[0].set(sp0.cat_bitset),
         bs_cat_l2=jnp.zeros((L,), bool).at[0].set(sp0.is_cat_l2),
-        leaf_out=jnp.zeros((L,), jnp.float32).at[0].set(
-            leaf_output(root_g, root_h, sp_params)),
+        leaf_out=jnp.zeros((L,), jnp.float32).at[0].set(root_out),
         leaf_cmin=jnp.full((L,), -3.4e38, jnp.float32),
         leaf_cmax=jnp.full((L,), 3.4e38, jnp.float32),
         leaf_used=jnp.zeros((L, F), bool),
-        leaf_pout=jnp.zeros((L,), jnp.float32),
+        leaf_pout=jnp.zeros((L,), jnp.float32).at[0].set(root_out),
     )
 
     def body(k, st: CompactState) -> CompactState:

@@ -1,0 +1,101 @@
+"""Continue-training / refit / snapshots.
+
+Mirrors the reference's continue-train coverage (test_engine.py
+test_continue_train*, gbdt.cpp:250-258 snapshots, GBDT::RefitTree)."""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+from utils import FAST_PARAMS, binary_data, regression_data, \
+    train_test_split_simple
+
+
+def _params(**kw):
+    p = dict(FAST_PARAMS)
+    p.update(kw)
+    return p
+
+
+class TestContinueTraining:
+    def test_continue_matches_uninterrupted(self):
+        X, y = regression_data()
+        params = _params(objective="regression", learning_rate=0.1,
+                         boost_from_average=False)
+        # one uninterrupted 20-round run
+        full = lgb.train(params, lgb.Dataset(X, label=y), 20)
+        # 10 rounds, save, resume for 10 more
+        first = lgb.train(params, lgb.Dataset(X, label=y), 10)
+        resumed = lgb.train(params,
+                            lgb.Dataset(X, label=y, free_raw_data=False), 10,
+                            init_model=first)
+        np.testing.assert_allclose(resumed.predict(X), full.predict(X),
+                                   rtol=1e-4, atol=1e-5)
+        assert resumed.num_trees() == 20
+
+    def test_continue_from_file(self, tmp_path):
+        X, y = binary_data()
+        params = _params(objective="binary")
+        first = lgb.train(params, lgb.Dataset(X, label=y), 8)
+        path = str(tmp_path / "m.txt")
+        first.save_model(path)
+        resumed = lgb.train(params,
+                            lgb.Dataset(X, label=y, free_raw_data=False), 7,
+                            init_model=path)
+        assert resumed.num_trees() == 15
+        # saved resumed model contains all trees and round-trips
+        text = resumed.model_to_string()
+        assert text.count("Tree=") == 15
+        re_loaded = lgb.Booster(model_str=text)
+        np.testing.assert_allclose(re_loaded.predict(X), resumed.predict(X),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_continue_improves_metric(self):
+        X, y = binary_data()
+        Xtr, ytr, Xte, yte = train_test_split_simple(X, y)
+        from sklearn.metrics import log_loss
+        params = _params(objective="binary")
+        first = lgb.train(params, lgb.Dataset(Xtr, label=ytr), 5)
+        l1 = log_loss(yte, first.predict(Xte))
+        resumed = lgb.train(
+            params, lgb.Dataset(Xtr, label=ytr, free_raw_data=False), 15,
+            init_model=first)
+        l2 = log_loss(yte, resumed.predict(Xte))
+        assert l2 < l1
+
+
+class TestRefit:
+    def test_refit_adapts_leaf_values(self):
+        X, y = regression_data()
+        params = _params(objective="regression")
+        bst = lgb.train(params, lgb.Dataset(X, label=y), 15)
+        # refit on shifted labels moves predictions toward the new targets
+        y2 = y + 50.0
+        refitted = bst.refit(X, y2, decay_rate=0.0)
+        assert np.mean(refitted.predict(X)) > np.mean(bst.predict(X)) + 25
+        # structure unchanged
+        assert refitted.num_trees() == bst.num_trees()
+
+    def test_refit_decay(self):
+        X, y = regression_data()
+        bst = lgb.train(_params(objective="regression"),
+                        lgb.Dataset(X, label=y), 10)
+        same = bst.refit(X, y + 50.0, decay_rate=1.0)  # keep old values
+        np.testing.assert_allclose(same.predict(X), bst.predict(X),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestSnapshots:
+    def test_snapshot_files_written(self, tmp_path):
+        X, y = binary_data()
+        out = str(tmp_path / "model.txt")
+        params = _params(objective="binary", snapshot_freq=4,
+                         output_model=out)
+        lgb.train(params, lgb.Dataset(X, label=y), 10)
+        snaps = sorted(os.listdir(tmp_path))
+        assert f"model.txt.snapshot_iter_4" in "".join(snaps)
+        assert f"model.txt.snapshot_iter_8" in "".join(snaps)
+        snap = lgb.Booster(model_file=out + ".snapshot_iter_8")
+        assert snap.num_trees() == 8
