@@ -490,17 +490,27 @@ class Booster:
             num_iteration = self.best_iteration if self.best_iteration > 0 else None
         arr = np.asarray(_maybe_series(data), dtype=np.float64)
         pre = getattr(self, "_pre_model", None)
+        pre_cut = own_cut = None
+        if pre is not None and num_iteration is not None and num_iteration > 0:
+            # iteration counting starts at the loaded model's trees
+            # (reference: models_ holds loaded + new trees in order)
+            pre_cut = min(num_iteration, pre.current_iteration)
+            own_cut = max(num_iteration - pre.current_iteration, 0)
+        elif pre is None:
+            own_cut = num_iteration
         if pred_leaf:
-            own = inner.predict_leaf_matrix(arr, num_iteration)
+            own = inner.predict_leaf_matrix(arr, own_cut)
             if pre is not None:
-                own = np.concatenate(
-                    [pre.predict_leaf_matrix(arr), own], axis=1)
+                pre_leaf = pre.predict_leaf_matrix(arr, pre_cut)
+                own = (pre_leaf if own_cut == 0
+                       else np.concatenate([pre_leaf, own], axis=1))
             return own
         if pred_contrib:
             return self._predict_contrib(arr, num_iteration)
-        raw = inner.predict_raw_matrix(arr, num_iteration)   # [K, N]
+        raw = inner.predict_raw_matrix(arr, own_cut)   # [K, N]
         if pre is not None:
-            raw = raw + pre.predict_raw_matrix(arr)
+            pre_raw = pre.predict_raw_matrix(arr, pre_cut)
+            raw = pre_raw if own_cut == 0 else raw + pre_raw
         k = raw.shape[0]
         if raw_score or inner.objective is None:
             return raw[0] if k == 1 else raw.T
@@ -523,11 +533,15 @@ class Booster:
             # reference behavior: default save cuts at best_iteration
             # (basic.py save_model num_iteration doc)
             num_iteration = self.best_iteration
-        text = booster_to_string(self, num_iteration)
         pre = getattr(self, "_pre_model", None)
-        if pre is not None:
-            text = merge_model_texts(pre.original_text, text)
-        return text
+        if pre is None:
+            return booster_to_string(self, num_iteration)
+        pre_cut = own_cut = None
+        if num_iteration is not None and num_iteration > 0:
+            pre_cut = min(num_iteration, pre.current_iteration)
+            own_cut = max(num_iteration - pre.current_iteration, 0)
+        text = booster_to_string(self, own_cut)
+        return merge_model_texts(pre, text, pre_num_iteration=pre_cut)
 
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0,

@@ -145,8 +145,11 @@ def train(
             evaluation_result_list = e.best_score or []
             break
         # periodic model snapshots (reference: GBDT::Train, gbdt.cpp:250-254
-        # -> model.txt.snapshot_iter_N every snapshot_freq iterations)
+        # -> model.txt.snapshot_iter_N every snapshot_freq iterations).
+        # The save flushes pending device trees; capture its stop signal
+        # instead of discarding it (a no-split iteration pops its trees)
         if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
+            finished = booster._gbdt._flush_trees() or finished
             booster.save_model(f"{snapshot_out}.snapshot_iter_{i + 1}")
         if finished:
             log.info("Finished training (no further splits possible)")

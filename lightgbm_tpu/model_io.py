@@ -491,11 +491,8 @@ def _emit_loaded(header_lines, chunks, models, footer_lines,
     header.append("")
 
     # recompute the informational importance footer over ALL trees
-    imp: Dict[int, int] = {}
-    for t in models:
-        for i in range(t.num_nodes):
-            f = int(t.split_feature[i])
-            imp[f] = imp.get(f, 0) + 1
+    imp_arr = _split_importance(models)
+    imp: Dict[int, int] = {f: int(v) for f, v in enumerate(imp_arr) if v > 0}
     footer = []
     in_imp = False
     for line in footer_lines:
@@ -523,16 +520,38 @@ def loaded_to_string(loaded: "LoadedGBDT") -> str:
                         loaded.feature_names)
 
 
-def merge_model_texts(pre_text: str, new_text: str) -> str:
+def merge_model_texts(pre, new_text: str,
+                      pre_num_iteration: Optional[int] = None) -> str:
     """Continue-training save: the loaded model's tree blocks followed by the
     newly trained ones, under the new model's header/footer (reference:
-    models_ holds loaded + new trees, gbdt_model_text.cpp emits them all)."""
-    pre = LoadedGBDT(pre_text)
+    models_ holds loaded + new trees, gbdt_model_text.cpp emits them all).
+    ``pre`` is an already-parsed LoadedGBDT or raw model text."""
+    if not isinstance(pre, LoadedGBDT):
+        pre = LoadedGBDT(pre)
     new = LoadedGBDT(new_text)
+    take = len(pre.models)
+    if pre_num_iteration is not None:
+        take = pre_num_iteration * max(pre.num_tree_per_iteration, 1)
     return _emit_loaded(new._header_lines,
-                        pre._tree_chunks + new._tree_chunks,
-                        pre.models + new.models,
+                        pre._tree_chunks[:take] + new._tree_chunks,
+                        pre.models[:take] + new.models,
                         new._footer_lines, new.feature_names)
+
+
+def _split_importance(models) -> np.ndarray:
+    """Split-count importance over LoadedTree lists (shared by the emitter's
+    footer recompute and LoadedGBDT.feature_importance)."""
+    max_f = 0
+    for t in models:
+        if t.num_nodes:
+            max_f = max(max_f, int(np.max(t.split_feature[:t.num_nodes])))
+    out = np.zeros(max_f + 1, np.float64)
+    for t in models:
+        for i in range(t.num_nodes):
+            f = int(t.split_feature[i])
+            if f >= 0:
+                out[f] += 1
+    return out
 
 
 def _loaded_node_json(t: "LoadedTree", node: int):

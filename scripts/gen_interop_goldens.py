@@ -1,0 +1,143 @@
+"""Generate interop golden files from the REAL LightGBM library.
+
+Builds deterministic synthetic datasets, trains the reference LightGBM
+(built from /root/reference into .refsrc/lib_lightgbm.so — see
+tests/golden/README.md) and records:
+
+  * the reference's saved model text      -> tests/golden/<case>.model.txt
+  * its predictions + the input data      -> tests/golden/<case>.npz
+  * generation-time two-way checks        -> tests/golden/interop_report.json
+      - "theirs_in_ours": reference model loaded by lightgbm_tpu, max |diff|
+      - "ours_in_theirs": lightgbm_tpu model loaded by the reference lib,
+        max |diff| (the direction that can only be verified when the native
+        lib is present)
+
+Run from the repo root:  python scripts/gen_interop_goldens.py
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, ".refpkg"))
+sys.path.insert(0, ROOT)
+
+import lightgbm as real_lgb          # noqa: E402  (reference build)
+import lightgbm_tpu as tpu_lgb       # noqa: E402
+
+GOLDEN = os.path.join(ROOT, "tests", "golden")
+os.makedirs(GOLDEN, exist_ok=True)
+
+
+def _binary_case(rng):
+    n = 800
+    X = rng.randn(n, 6)
+    X[rng.rand(n, 6) < 0.1] = np.nan          # exercise NaN routing
+    logits = np.nan_to_num(X[:, 0]) + 0.8 * np.nan_to_num(X[:, 1] * X[:, 2])
+    y = (logits + 0.3 * rng.randn(n) > 0).astype(np.float64)
+    return X, y, {"objective": "binary", "metric": "binary_logloss"}
+
+
+def _regression_case(rng):
+    n = 700
+    X = rng.randn(n, 5)
+    y = X[:, 0] * 2.0 + np.abs(X[:, 1]) - 1.5 * (X[:, 2] > 0) \
+        + 0.2 * rng.randn(n)
+    return X, y, {"objective": "regression", "metric": "l2"}
+
+
+def _multiclass_case(rng):
+    n = 900
+    X = rng.randn(n, 5)
+    y = (np.argmax(X[:, :3] + 0.4 * rng.randn(n, 3), axis=1)).astype(
+        np.float64)
+    return X, y, {"objective": "multiclass", "num_class": 3}
+
+
+def _categorical_case(rng):
+    n = 800
+    cat = rng.randint(0, 10, n).astype(np.float64)
+    high = np.isin(cat, [1, 4, 5, 8])
+    y = np.where(high, 2.0, -2.0) + 0.4 * rng.randn(n)
+    X = np.column_stack([cat, rng.randn(n)])
+    return X, y, {"objective": "regression", "categorical_feature": [0],
+                  "min_data_per_group": 10, "cat_smooth": 2.0}
+
+
+CASES = {
+    "binary_nan": _binary_case,
+    "regression": _regression_case,
+    "multiclass": _multiclass_case,
+    "categorical": _categorical_case,
+}
+
+BASE = {"verbosity": -1, "num_leaves": 15, "max_bin": 63,
+        "min_data_in_leaf": 5, "learning_rate": 0.1, "deterministic": True,
+        "force_row_wise": True}
+
+
+def main():
+    report = {}
+    for name, make in CASES.items():
+        rng = np.random.RandomState(hash(name) % (2 ** 31))
+        X, y, extra = make(rng)
+        params = dict(BASE, **extra)
+        cat = params.pop("categorical_feature", "auto")
+
+        # ---- reference model + predictions -> goldens
+        ds = real_lgb.Dataset(X, label=y, categorical_feature=cat,
+                              free_raw_data=False)
+        ref = real_lgb.train(params, ds, 12)
+        ref_pred = ref.predict(X)
+        model_path = os.path.join(GOLDEN, f"{name}.model.txt")
+        ref.save_model(model_path)
+        np.savez_compressed(os.path.join(GOLDEN, f"{name}.npz"),
+                            X=X.astype(np.float64), y=y,
+                            pred=np.asarray(ref_pred, np.float64))
+
+        # ---- direction 1: reference model loaded by lightgbm_tpu
+        ours = tpu_lgb.Booster(model_file=model_path)
+        ours_pred = np.asarray(ours.predict(X), np.float64)
+        d1 = float(np.max(np.abs(ours_pred - ref_pred)))
+
+        # ---- direction 2: lightgbm_tpu model loaded by the reference lib
+        tpu_ds = tpu_lgb.Dataset(X, label=y, categorical_feature=cat)
+        tpu_bst = tpu_lgb.train(params, tpu_ds, 12)
+        tpu_pred = np.asarray(tpu_bst.predict(X), np.float64)
+        tpu_model = os.path.join(GOLDEN, f"{name}.tpu_model.txt")
+        with open(tpu_model, "w") as f:
+            f.write(tpu_bst.model_to_string())
+        theirs = real_lgb.Booster(model_file=tpu_model)
+        theirs_pred = np.asarray(theirs.predict(X), np.float64)
+        d2 = float(np.max(np.abs(theirs_pred - tpu_pred)))
+
+        # ---- same-data quality comparison (binning deliberately differs,
+        # so this is a model-quality check, not bit parity)
+        if params.get("num_class", 1) > 1:
+            q_ref = float(np.mean(np.argmax(ref_pred, 1) == y))
+            q_tpu = float(np.mean(np.argmax(tpu_pred, 1) == y))
+        elif params["objective"] == "binary":
+            q_ref = float(np.mean((ref_pred > 0.5) == y))
+            q_tpu = float(np.mean((tpu_pred > 0.5) == y))
+        else:
+            q_ref = float(np.mean((ref_pred - y) ** 2))
+            q_tpu = float(np.mean((tpu_pred - y) ** 2))
+
+        report[name] = {
+            "theirs_in_ours_maxdiff": d1,
+            "ours_in_theirs_maxdiff": d2,
+            "ref_quality": q_ref,
+            "tpu_quality": q_tpu,
+        }
+        print(f"{name:12s} theirs_in_ours={d1:.3e} ours_in_theirs={d2:.3e} "
+              f"q_ref={q_ref:.4f} q_tpu={q_tpu:.4f}")
+
+    with open(os.path.join(GOLDEN, "interop_report.json"), "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+    print("goldens written to", GOLDEN)
+
+
+if __name__ == "__main__":
+    main()

@@ -99,3 +99,24 @@ class TestSnapshots:
         assert f"model.txt.snapshot_iter_8" in "".join(snaps)
         snap = lgb.Booster(model_file=out + ".snapshot_iter_8")
         assert snap.num_trees() == 8
+
+
+class TestContinueNumIteration:
+    def test_num_iteration_counts_from_loaded_trees(self):
+        # reference semantics: iteration cuts start at the loaded model
+        X, y = regression_data()
+        params = _params(objective="regression", boost_from_average=False)
+        first = lgb.train(params, lgb.Dataset(X, label=y), 10)
+        resumed = lgb.train(params,
+                            lgb.Dataset(X, label=y, free_raw_data=False), 10,
+                            init_model=first)
+        # cutting at 10 iterations == the loaded model alone
+        np.testing.assert_allclose(resumed.predict(X, num_iteration=10),
+                                   first.predict(X), rtol=1e-5, atol=1e-6)
+        # serialized cut agrees with in-memory cut
+        text10 = resumed.model_to_string(num_iteration=10)
+        assert text10.count("Tree=") == 10
+        reload10 = lgb.Booster(model_str=text10)
+        np.testing.assert_allclose(reload10.predict(X),
+                                   resumed.predict(X, num_iteration=10),
+                                   rtol=1e-5, atol=1e-6)

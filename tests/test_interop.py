@@ -1,0 +1,86 @@
+"""Interop against the REAL LightGBM (golden files).
+
+The reference's saved models must load here and predict identically, and our
+saved models must load in the reference library (verified at golden
+generation time and re-verified live when the built lib is present).
+Reference format: src/boosting/gbdt_model_text.cpp, src/io/tree.cpp.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden")
+CASES = ["binary_nan", "regression", "multiclass", "categorical"]
+
+
+def _load(name):
+    data = np.load(os.path.join(GOLDEN, f"{name}.npz"))
+    with open(os.path.join(GOLDEN, f"{name}.model.txt")) as f:
+        model_text = f.read()
+    return data["X"], data["y"], data["pred"], model_text
+
+
+@pytest.mark.parametrize("name", CASES)
+def test_reference_model_loads_and_predicts_identically(name):
+    X, _, ref_pred, model_text = _load(name)
+    bst = lgb.Booster(model_str=model_text)
+    pred = np.asarray(bst.predict(X), np.float64)
+    np.testing.assert_allclose(pred, ref_pred, rtol=1e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("name", CASES)
+def test_generation_time_two_way_interchange(name):
+    """The recorded two-way check: our models loaded by the real lib (and
+    theirs by us) agreed to float32 precision when the goldens were made."""
+    with open(os.path.join(GOLDEN, "interop_report.json")) as f:
+        report = json.load(f)
+    entry = report[name]
+    assert entry["theirs_in_ours_maxdiff"] < 1e-5
+    assert entry["ours_in_theirs_maxdiff"] < 1e-5
+    # same-data quality parity (binning differs by design; quality must not)
+    if name == "regression":
+        assert entry["tpu_quality"] < entry["ref_quality"] * 1.1
+    elif name == "categorical":
+        assert entry["tpu_quality"] < entry["ref_quality"] * 1.2
+    else:
+        assert entry["tpu_quality"] > entry["ref_quality"] - 0.03
+
+
+@pytest.mark.parametrize("name", CASES)
+def test_our_model_text_reparses_reference_style(name):
+    """Round-trip our own saved model for the same case (golden provenance
+    file) — guards against format drift in either direction."""
+    path = os.path.join(GOLDEN, f"{name}.tpu_model.txt")
+    with open(path) as f:
+        text = f.read()
+    X, _, _, _ = _load(name)
+    bst = lgb.Booster(model_str=text)
+    pred = bst.predict(X)
+    assert np.isfinite(pred).all()
+
+
+_REF_LIB = os.path.join(os.path.dirname(__file__), "..", ".refpkg")
+
+
+@pytest.mark.skipif(not os.path.isdir(_REF_LIB),
+                    reason="reference LightGBM build not present")
+@pytest.mark.parametrize("name", ["binary_nan", "regression"])
+def test_live_ours_in_reference(name):
+    """When the reference build exists, verify the reverse direction live."""
+    import sys
+    sys.path.insert(0, os.path.abspath(_REF_LIB))
+    import lightgbm as real_lgb
+    X, y, _, _ = _load(name)
+    params = {"objective": "binary" if name == "binary_nan" else "regression",
+              "verbosity": -1, "num_leaves": 15, "max_bin": 63,
+              "min_data_in_leaf": 5}
+    ours = lgb.train(params, lgb.Dataset(X, label=y), 8)
+    text = ours.model_to_string()
+    theirs = real_lgb.Booster(model_str=text)
+    np.testing.assert_allclose(
+        np.asarray(theirs.predict(X), np.float64),
+        np.asarray(ours.predict(X), np.float64), rtol=1e-5, atol=2e-6)
