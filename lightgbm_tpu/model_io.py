@@ -136,7 +136,9 @@ def booster_to_string(booster, num_iteration: Optional[int] = None) -> str:
     ds = gbdt.train_set
     mappers = ds.mappers
     models = gbdt.models
-    if num_iteration is not None and num_iteration > 0:
+    # num_iteration == 0 means "no trees" (continue-training cuts that fall
+    # entirely inside the loaded model); None means "all"
+    if num_iteration is not None and num_iteration >= 0:
         models = models[: num_iteration * gbdt.num_tree_per_iteration]
 
     feature_infos = []
