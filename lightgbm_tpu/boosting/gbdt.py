@@ -286,6 +286,7 @@ class GBDT:
         self._device_trees_cache: Optional[StackedTrees] = None
         self._use_compact = False
         self._compact = None
+        self.tree_learner = "serial"
 
         if train_set is not None:
             self._setup_train(train_set)
@@ -294,11 +295,19 @@ class GBDT:
     def _setup_train(self, train_set: BinnedDataset) -> None:
         cfg = self.config
         from ..parallel.mesh import (class_row_sharding, make_mesh, pad_rows,
-                                     row_sharding, row_sharding_2d)
+                                     replicated, row_sharding, row_sharding_2d)
+        # multi-host bootstrap before any device queries (reference:
+        # Network::Init from config, src/network/linkers_socket.cpp)
+        if int(cfg.get("num_machines", 1) or 1) > 1:
+            from ..parallel.multihost import init_distributed
+            init_distributed(cfg)
         tree_learner = str(cfg.get("tree_learner", "serial")).lower()
-        distributed = tree_learner in (
-            "data", "voting", "feature", "data_parallel", "voting_parallel",
-            "feature_parallel") and len(jax.devices()) > 1
+        tree_learner = {"data_parallel": "data", "voting_parallel": "voting",
+                        "feature_parallel": "feature"}.get(
+                            tree_learner, tree_learner)
+        distributed = tree_learner in ("data", "voting", "feature") \
+            and len(jax.devices()) > 1
+        self.tree_learner = tree_learner
         self.mesh = make_mesh() if distributed else None
         self._n_real = train_set.num_data
         pad = pad_rows(self._n_real, len(self.mesh.devices.ravel())) \
@@ -309,7 +318,28 @@ class GBDT:
         binned_np = train_set.binned
         if pad:
             binned_np = np.pad(binned_np, ((0, pad), (0, 0)))
-        if self.mesh is not None:
+        # feature-parallel shards the feature axis; pad it to the mesh size
+        # with trivial (never-selectable) features
+        self._f_pad = 0
+        if self.mesh is not None and self.tree_learner == "feature":
+            self._f_pad = (-binned_np.shape[1]) % len(
+                self.mesh.devices.ravel())
+            if self._f_pad:
+                binned_np = np.pad(binned_np, ((0, 0), (0, self._f_pad)))
+            # feature-parallel: data replicated, split finding partitioned by
+            # feature (reference: feature_parallel_tree_learner.cpp — every
+            # rank holds full data; GSPMD shards the [F, B] histogram/scan
+            # over features and all-gathers the tiny best-split argmax, the
+            # analogue of SyncUpGlobalBestSplit)
+            from ..parallel.mesh import feature_sharding_2d
+            self.binned = jax.device_put(binned_np,
+                                         feature_sharding_2d(self.mesh))
+            ones = np.ones(self.num_data, np.float32)
+            if pad:
+                ones[self._n_real:] = 0.0
+            self._valid_row_mask = jax.device_put(
+                ones, replicated(self.mesh)) if pad else None
+        elif self.mesh is not None:
             # rows sharded over the mesh: the reference's row partitioning
             # across machines (data_parallel_tree_learner.cpp BeforeTrain)
             self.binned = jax.device_put(binned_np, row_sharding_2d(self.mesh))
@@ -320,28 +350,39 @@ class GBDT:
         else:
             self.binned = jnp.asarray(binned_np)
             self._valid_row_mask = None
-        self.num_bins_arr = jnp.asarray(train_set.feature_num_bins())
-        self.nan_bin_arr = jnp.asarray(train_set.feature_nan_bins())
-        self.has_nan_arr = jnp.asarray(
+        def fpad(arr, fill):
+            if self._f_pad:
+                return np.concatenate(
+                    [np.asarray(arr),
+                     np.full(self._f_pad, fill, np.asarray(arr).dtype)])
+            return np.asarray(arr)
+
+        self.num_bins_arr = jnp.asarray(
+            fpad(train_set.feature_num_bins(), 1))
+        self.nan_bin_arr = jnp.asarray(fpad(train_set.feature_nan_bins(), 0))
+        self.has_nan_arr = jnp.asarray(fpad(
             np.array([m.missing_type == 2 and not m.is_categorical
-                      for m in train_set.mappers], dtype=bool))
-        self.is_cat_arr = jnp.asarray(train_set.feature_is_categorical())
-        self.base_feat_mask = np.array(
-            [not m.is_trivial for m in train_set.mappers], dtype=bool)
+                      for m in train_set.mappers], dtype=bool), False))
+        self.is_cat_arr = jnp.asarray(fpad(
+            train_set.feature_is_categorical(), False))
+        self.base_feat_mask = fpad(np.array(
+            [not m.is_trivial for m in train_set.mappers], dtype=bool), False)
 
         nf = train_set.num_total_features
         mono_np = _parse_monotone(cfg.get("monotone_constraints"), nf,
                                   train_set.feature_names)
         inter_np = _parse_interactions(
             cfg.get("interaction_constraints"), nf)
-        self._mono_types = (jnp.asarray(mono_np) if mono_np is not None
-                            else None)
+        self._mono_types = (jnp.asarray(fpad(mono_np, 0))
+                            if mono_np is not None else None)
         if mono_np is not None and \
                 str(cfg.get("monotone_constraints_method", "basic")) != "basic":
             log.warning(
                 "monotone_constraints_method="
                 f"{cfg.get('monotone_constraints_method')!r} is not "
                 "implemented; using the 'basic' method")
+        if inter_np is not None and self._f_pad:
+            inter_np = np.pad(inter_np, ((0, 0), (0, self._f_pad)))
         self._inter_sets = (jnp.asarray(inter_np) if inter_np is not None
                             else None)
         self._bynode_key = jax.random.PRNGKey(
@@ -367,6 +408,12 @@ class GBDT:
             path_smooth=float(cfg.get("path_smooth", 0.0)),
             use_interaction=inter_np is not None,
             bynode_fraction=float(cfg.get("feature_fraction_bynode", 1.0)),
+            voting_k=(int(cfg.get("top_k", 20))
+                      if self.mesh is not None
+                      and self.tree_learner == "voting" else 0),
+            voting_shards=(len(self.mesh.devices.ravel())
+                           if self.mesh is not None
+                           and self.tree_learner == "voting" else 0),
             hist_impl=str(cfg.get("tpu_hist_impl", "auto")),
             part_block=_clamp_block(
                 int(cfg.get("tpu_part_block", 2048)), self._n_real),
@@ -415,9 +462,11 @@ class GBDT:
             self._has_init_score = True
         else:
             self._has_init_score = False
-        if self.mesh is not None:
+        if self.mesh is not None and self.tree_learner != "feature":
             self.train_score = jax.device_put(
                 score0, class_row_sharding(self.mesh))
+        elif self.mesh is not None:
+            self.train_score = jax.device_put(score0, replicated(self.mesh))
         else:
             self.train_score = jnp.asarray(score0)
 
@@ -724,7 +773,8 @@ class GBDT:
     def add_valid(self, valid_set: BinnedDataset, name: str,
                   metrics: Sequence[Metric]) -> None:
         vs = _ValidSet(valid_set, self.num_tree_per_iteration, name,
-                       mesh=self.mesh)
+                       mesh=self.mesh if self.tree_learner != "feature"
+                       else None)
         for m in metrics:
             m.init(valid_set.metadata, valid_set.num_data)
         vs.metrics = list(metrics)

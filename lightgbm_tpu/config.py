@@ -70,6 +70,8 @@ PARAMS: Dict[str, Tuple[Any, type, Tuple[str, ...]]] = {
     "xgboost_dart_mode": (False, bool, ()),
     "uniform_drop": (False, bool, ()),
     "drop_seed": (4, int, ()),
+    # voting-parallel (PV-Tree) vote size (reference: config.h top_k)
+    "top_k": (20, int, ("topk",)),
     # goss
     "top_rate": (0.2, float, ()),
     "other_rate": (0.1, float, ()),
@@ -279,9 +281,6 @@ UNIMPLEMENTED_PARAMS: Dict[str, str] = {
     "num_iteration_predict": "prediction num_iteration",
     "auc_mu_weights": "weighted auc_mu",
     "lambdarank_position_bias_regularization": "position bias correction",
-    "num_machines": "multi-host (DCN) training",
-    "machines": "multi-host (DCN) training",
-    "machine_list_filename": "multi-host (DCN) training",
     "save_binary": "binary dataset files",
     "two_round": "two-round file loading",
     "header": "text-file loading",

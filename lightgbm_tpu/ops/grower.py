@@ -67,6 +67,10 @@ class GrowerParams(NamedTuple):
     max_cat_to_onehot: int = 4
     min_data_per_group: float = 100.0
     any_cat: bool = True     # static: dataset has categorical features
+    # voting-parallel (PV-Tree): per-shard top-k feature vote caps the
+    # histogram reduction at 2k features (0 = off; reference: top_k config)
+    voting_k: int = 0
+    voting_shards: int = 0
     # constraints / per-node sampling (statics; defaults compile away)
     use_monotone: bool = False
     monotone_penalty: float = 0.0
@@ -240,6 +244,11 @@ def grow_tree(
 
     def hist3(mask):
         chans = jnp.stack([grad * mask, hess * mask, cnt_weight * mask], axis=1)
+        if params.voting_k > 0 and params.voting_shards > 1:
+            from ..parallel.voting import voting_histogram
+            return voting_histogram(binned, chans, B, params.voting_shards,
+                                    params.voting_k, params.split_params(),
+                                    impl=params.hist_impl)
         return histogram(binned, chans, B, ax, impl=params.hist_impl)
 
     if mono_types is None:
@@ -452,17 +461,27 @@ def grow_tree(
         def compute_children(bs):
             (leaf_hist, bs_gain, bs_feature, bs_bin, bs_dl, bs_lg, bs_lh,
              bs_lc, bs_bits, bs_catl2) = bs
-            # one masked pass over the SMALLER child only; the larger child is
-            # parent − smaller (reference: SubtractHistogramForLeaf,
-            # cuda_histogram_constructor.cu:723)
-            parent_hist = leaf_hist[best_leaf]
-            left_smaller = lc <= rc
-            small_id = jnp.where(left_smaller, best_leaf, new_leaf)
-            m = (row_leaf == small_id).astype(jnp.float32)
-            hist_small = hist3(m)
-            hist_large = parent_hist - hist_small
-            hist_left = jnp.where(left_smaller, hist_small, hist_large)
-            hist_right = jnp.where(left_smaller, hist_large, hist_small)
+            if params.voting_k > 0 and params.voting_shards > 1:
+                # voting elects a DIFFERENT feature subset per histogram
+                # (unvoted features are zeroed), so parent-minus-smaller
+                # subtraction would mix inconsistent elected sets — build
+                # both children fresh instead (the reference's voting
+                # learner re-elects per FindBestSplits round too,
+                # voting_parallel_tree_learner.cpp:151)
+                hist_left = hist3((row_leaf == best_leaf).astype(jnp.float32))
+                hist_right = hist3((row_leaf == new_leaf).astype(jnp.float32))
+            else:
+                # one masked pass over the SMALLER child only; the larger
+                # child is parent − smaller (reference:
+                # SubtractHistogramForLeaf, cuda_histogram_constructor.cu:723)
+                parent_hist = leaf_hist[best_leaf]
+                left_smaller = lc <= rc
+                small_id = jnp.where(left_smaller, best_leaf, new_leaf)
+                m = (row_leaf == small_id).astype(jnp.float32)
+                hist_small = hist3(m)
+                hist_large = parent_hist - hist_small
+                hist_left = jnp.where(left_smaller, hist_small, hist_large)
+                hist_right = jnp.where(left_smaller, hist_large, hist_small)
             leaf_hist = leaf_hist.at[best_leaf].set(hist_left)
             leaf_hist = leaf_hist.at[new_leaf].set(hist_right)
 

@@ -50,6 +50,12 @@ def row_sharding_2d(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS, None))
 
 
+def feature_sharding_2d(mesh: Mesh) -> NamedSharding:
+    """[N, F] arrays sharded along features, rows replicated
+    (feature-parallel learner: reference feature_parallel_tree_learner.cpp)."""
+    return NamedSharding(mesh, P(None, DATA_AXIS))
+
+
 def class_row_sharding(mesh: Mesh) -> NamedSharding:
     """[K, N] score arrays: classes replicated, rows sharded."""
     return NamedSharding(mesh, P(None, DATA_AXIS))

@@ -1,0 +1,127 @@
+"""Multi-host (multi-process) training entry.
+
+TPU-native replacement for the reference's cluster bootstrap
+(reference: src/network/linkers_socket.cpp:29-118 — parse ``machines`` /
+``machine_list_file``, bind ``local_listen_port``, build the full TCP mesh;
+Dask analogue python-package/lightgbm/dask.py:374-412 builds the machines
+string and runs one training process per worker).
+
+On TPU pods the socket mesh is replaced by ``jax.distributed.initialize``:
+every host runs the same training script, JAX wires the hosts over DCN, and
+``jax.devices()`` then exposes the GLOBAL device set — the existing
+data-parallel/voting/feature learners shard over all chips of all hosts with
+no further changes (GSPMD inserts ICI collectives within a host and DCN
+collectives across hosts).
+
+Launch recipe (the reference's ``machines=ip1:port1,ip2:port2`` maps 1:1):
+
+    # on every host, with the same machines list:
+    params = {"tree_learner": "data",
+              "machines": "10.0.0.1:12400,10.0.0.2:12400",
+              "num_machines": 2}
+    lgb.train(params, dataset, ...)
+
+The first machines entry is the coordinator. Each host's process index is
+inferred by matching a local interface address against the machines list, or
+set explicitly via the LIGHTGBM_TPU_PROCESS_ID environment variable (the
+reference resolves ranks the same way — by finding the local ip/port in the
+list, linkers_socket.cpp:78-101).
+
+Data feeding: each process passes only its local shard of rows (like the
+reference's ``pre_partition=true``) and JAX's global sharding treats the
+per-process arrays as one global dataset.
+"""
+from __future__ import annotations
+
+import os
+import socket
+from typing import List, Optional, Tuple
+
+from ..utils import log
+
+_initialized = False
+
+
+def _parse_machines(machines: str, machine_list_file: str) -> List[str]:
+    if machines:
+        return [m.strip() for m in machines.split(",") if m.strip()]
+    if machine_list_file:
+        with open(machine_list_file) as f:
+            out = []
+            for line in f:
+                line = line.strip().replace(" ", ":")
+                if line:
+                    out.append(line)
+            return out
+    return []
+
+
+def _local_addresses() -> List[str]:
+    addrs = {"127.0.0.1", "localhost"}
+    try:
+        hostname = socket.gethostname()
+        addrs.add(hostname)
+        for info in socket.getaddrinfo(hostname, None):
+            addrs.add(info[4][0])
+    except OSError:  # pragma: no cover
+        pass
+    return addrs
+
+
+def infer_process_id(machines: List[str]) -> Optional[int]:
+    """Rank = index of the local address in the machines list (reference:
+    linkers_socket.cpp:78-101 finds the local ip/port the same way)."""
+    env = os.environ.get("LIGHTGBM_TPU_PROCESS_ID")
+    if env is not None:
+        return int(env)
+    hosts = [m.rsplit(":", 1)[0] for m in machines]
+    if len(set(hosts)) != len(hosts):
+        # several processes on one host are indistinguishable by address
+        # (the reference disambiguates by binding the port,
+        # linkers_socket.cpp:78-101; we cannot bind the coordinator's port)
+        raise ValueError(
+            "machines lists the same host more than once; set "
+            "LIGHTGBM_TPU_PROCESS_ID per process to assign ranks")
+    local = _local_addresses()
+    for i, host in enumerate(hosts):
+        if host in local:
+            return i
+    return None
+
+
+def init_distributed(config) -> bool:
+    """Initialize JAX multi-process training when num_machines > 1.
+
+    Returns True when running (or already running) in multi-process mode.
+    Safe to call on every host; a no-op for single-machine configs.
+    """
+    global _initialized
+    num_machines = int(config.get("num_machines", 1) or 1)
+    if num_machines <= 1:
+        return False
+    if _initialized:
+        return True
+    import jax
+    machines = _parse_machines(
+        str(config.get("machines", "")),
+        str(config.get("machine_list_filename", "")))
+    if machines and len(machines) != num_machines:
+        raise ValueError(
+            f"num_machines={num_machines} but machines lists "
+            f"{len(machines)} entries")
+    coordinator = machines[0] if machines else None
+    process_id = infer_process_id(machines) if machines else None
+    if coordinator is None or process_id is None:
+        raise ValueError(
+            "multi-machine training needs machines='ip:port,...' (or "
+            "machine_list_filename) naming every host, with this host's "
+            "address in the list or LIGHTGBM_TPU_PROCESS_ID set "
+            "(reference: config.h machines / linkers_socket.cpp)")
+    log.info(f"Initializing multi-host training: rank {process_id}/"
+             f"{num_machines}, coordinator {coordinator}")
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_machines,
+        process_id=process_id)
+    _initialized = True
+    return True

@@ -1,0 +1,86 @@
+"""Voting-parallel histogram construction (PV-Tree).
+
+TPU-native re-design of the reference's VotingParallelTreeLearner
+(reference: src/treelearner/voting_parallel_tree_learner.cpp — each rank
+proposes its local top-k features, GlobalVoting picks the global top-2k by
+local gains (:151), and only those features' histograms are reduce-scattered
+(CopyLocalHistogram :184) — capping network traffic at O(2k*B) instead of
+O(F*B) per split).
+
+Here the same dataflow is expressed for GSPMD: rows reshape to a
+[shards, rows/shard] leading axis that stays sharded, so per-shard local
+histograms and local gains are computed without communication; the vote and
+the final reduction of ONLY the selected features' histograms are the only
+collectives XLA inserts (an all-reduce of [2k, B, K] — the comm cap the
+reference achieves with its socket ReduceScatter).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.histogram import histogram_block
+from ..ops.split import leaf_gain
+
+
+def _local_feature_gains(hist, p):
+    """Cheap per-feature best-gain proxy from a local histogram [F, B, K]:
+    the reference ranks features by their local best split gain
+    (voting_parallel_tree_learner.cpp local FindBestSplits)."""
+    g = hist[:, :, 0]
+    h = hist[:, :, 1]
+    cg = jnp.cumsum(g, axis=1)
+    ch = jnp.cumsum(h, axis=1)
+    pg = cg[:, -1:]
+    ph = ch[:, -1:]
+    gain = leaf_gain(cg, ch, p) + leaf_gain(pg - cg, ph - ch, p)
+    return jnp.max(gain, axis=1)                       # [F]
+
+
+def voting_histogram(
+    binned: jnp.ndarray,       # [N, F] u8, row-sharded over the mesh
+    chans: jnp.ndarray,        # [N, K] f32, row-sharded
+    num_bins: int,
+    num_shards: int,           # static: mesh size
+    top_k: int,                # static: per-shard vote size (config top_k)
+    split_params,
+    impl: str = "auto",
+) -> jnp.ndarray:              # [F, B, K] f32 (replicated)
+    """Histogram with voting-capped communication: only the globally voted
+    2k features carry reduced histograms; every other feature's histogram is
+    zero (its candidate splits then fail the min_data gate, exactly like the
+    reference never scanning unvoted features)."""
+    n, f = binned.shape
+    k = chans.shape[1]
+    b = num_bins
+    s = num_shards
+    n_local = n // s
+    top_k = min(top_k, f)
+    k2 = min(2 * top_k, f)
+
+    # per-shard local histograms: the leading axis keeps the row sharding,
+    # so this is communication-free under GSPMD
+    bs = binned.reshape(s, n_local, f)
+    cs = chans.reshape(s, n_local, k)
+    local = _vmap_hist(bs, cs, b, impl)                # [S, F, B, K]
+
+    # local votes (top-k features by local gain) and the global election
+    gains = _vmap_gains(local, split_params)           # [S, F]
+    kth = -jnp.sort(-gains, axis=1)[:, top_k - 1:top_k]
+    vote = gains >= kth                                # [S, F] local top-k
+    score = jnp.sum(jnp.where(vote, gains, 0.0), axis=0)   # [F] replicated
+    sel = jnp.argsort(-score)[:k2]                     # [2k] elected features
+
+    # reduce ONLY the elected features' histograms across shards
+    hist_sel = jnp.sum(jnp.take(local, sel, axis=1), axis=0)   # [2k, B, K]
+    full = jnp.zeros((f, b, k), jnp.float32)
+    return full.at[sel].set(hist_sel)
+
+
+def _vmap_hist(bs, cs, b, impl):
+    import jax
+    return jax.vmap(lambda x, c: histogram_block(x, c, b, impl=impl))(bs, cs)
+
+
+def _vmap_gains(local, p):
+    import jax
+    return jax.vmap(lambda h: _local_feature_gains(h, p))(local)
