@@ -483,8 +483,6 @@ class Booster:
         **kwargs,
     ) -> np.ndarray:
         """(reference: Booster.predict, basic.py:4701 → Predictor)"""
-        if start_iteration != 0:
-            raise NotImplementedError("start_iteration != 0 not supported yet")
         inner = self._gbdt
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration > 0 else None
@@ -499,7 +497,7 @@ class Booster:
         elif pre is None:
             own_cut = num_iteration
         if pred_leaf:
-            own = inner.predict_leaf_matrix(arr, own_cut)
+            own = inner.predict_leaf_matrix(arr, own_cut, start_iteration)
             if pre is not None:
                 pre_leaf = pre.predict_leaf_matrix(arr, pre_cut)
                 own = (pre_leaf if own_cut == 0
@@ -507,7 +505,16 @@ class Booster:
             return own
         if pred_contrib:
             return self._predict_contrib(arr, num_iteration)
-        raw = inner.predict_raw_matrix(arr, own_cut)   # [K, N]
+        early = None
+        if kwargs.get("pred_early_stop") or (
+                self.params and self.params.get("pred_early_stop")):
+            src = self.params or {}
+            early = (float(kwargs.get("pred_early_stop_margin",
+                                      src.get("pred_early_stop_margin", 10.0))),
+                     int(kwargs.get("pred_early_stop_freq",
+                                    src.get("pred_early_stop_freq", 10))))
+        raw = inner.predict_raw_matrix(arr, own_cut, start_iteration,
+                                       early)   # [K, N]
         if pre is not None:
             pre_raw = pre.predict_raw_matrix(arr, pre_cut)
             raw = pre_raw if own_cut == 0 else raw + pre_raw
@@ -518,11 +525,36 @@ class Booster:
             raw.T if k > 1 else raw[0]))
         return conv
 
-    def _predict_contrib(self, binned, num_iteration):
-        """SHAP-style contributions via per-tree path attribution
-        (reference: PredictContrib → TreeSHAP, tree.cpp). Implemented as the
-        simpler Saabas attribution for now; full TreeSHAP is planned."""
-        raise NotImplementedError("pred_contrib is not implemented yet")
+    def _predict_contrib(self, arr, num_iteration):
+        """Exact TreeSHAP contributions [N, K*(F+1)] (reference:
+        PredictContrib -> Tree::TreeSHAP, src/io/tree.cpp)."""
+        from .ops.treeshap import booster_contrib
+        g = self._gbdt
+        if not hasattr(g, "bin_matrix"):
+            raise NotImplementedError(
+                "pred_contrib on loaded models: retrain or load with a "
+                "training dataset attached")
+        if getattr(self, "_pre_model", None) is not None:
+            raise NotImplementedError(
+                "pred_contrib on continue-trained boosters is not "
+                "supported yet")
+        g._flush_trees()
+        models = g.models
+        if num_iteration is not None and num_iteration > 0:
+            models = models[: num_iteration * g.num_tree_per_iteration]
+        binned = np.asarray(g.bin_matrix(arr))
+        nan_bin = np.asarray(g.nan_bin_arr)
+        is_cat = np.asarray(g.is_cat_arr)
+
+        def go_left_np(col, bin_, dl, nb, iscat, words):
+            if iscat:
+                w = int(words[col // 32]) if col // 32 < len(words) else 0
+                return bool((w >> (col % 32)) & 1)
+            return col <= bin_ or (dl and col == nb)
+
+        return booster_contrib(models, binned, nan_bin, is_cat, go_left_np,
+                               g.num_tree_per_iteration,
+                               int(binned.shape[1]))
 
     # -- model IO ------------------------------------------------------------
     def model_to_string(self, num_iteration: Optional[int] = None,

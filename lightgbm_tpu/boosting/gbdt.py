@@ -1066,37 +1066,50 @@ class GBDT:
         return out
 
     # -- prediction ----------------------------------------------------------
-    def device_trees(self, num_iteration: Optional[int] = None) -> StackedTrees:
+    def device_trees(self, num_iteration: Optional[int] = None,
+                     start_iteration: int = 0) -> StackedTrees:
         self._flush_trees()
         models = self.models
+        k = self.num_tree_per_iteration
+        if start_iteration > 0:
+            # (reference: start_iteration in GBDT::Predict* and Predictor)
+            models = models[start_iteration * k:]
         if num_iteration is not None and num_iteration > 0:
-            models = models[: num_iteration * self.num_tree_per_iteration]
-        if num_iteration is None and self._device_trees_cache is not None:
+            models = models[: num_iteration * k]
+        if num_iteration is None and start_iteration == 0 \
+                and self._device_trees_cache is not None:
             return self._device_trees_cache
         # width from the models themselves: num_leaves may have been changed
         # mid-training via reset_parameter
         max_lv = max((len(m.leaf_value) for m in models), default=self.max_leaves)
         st = stack_trees(models, max_lv - 1, max_lv)
-        if num_iteration is None:
+        if num_iteration is None and start_iteration == 0:
             self._device_trees_cache = st
         return st
 
     def predict_raw_binned(self, binned: jax.Array,
-                           num_iteration: Optional[int] = None) -> np.ndarray:
-        """Raw scores [K, N] for already-binned rows."""
+                           num_iteration: Optional[int] = None,
+                           start_iteration: int = 0,
+                           early_stop=None) -> np.ndarray:
+        """Raw scores [K, N] for already-binned rows. ``early_stop`` is an
+        optional (margin, freq) pair (reference:
+        src/boosting/prediction_early_stop.cpp)."""
         self._flush_trees()
         if not self.models:
             n = binned.shape[0]
             return np.zeros((self.num_tree_per_iteration, n), np.float32)
-        trees = self.device_trees(num_iteration)
+        trees = self.device_trees(num_iteration, start_iteration)
         raw = predict_raw(
             jnp.asarray(binned), trees, self.nan_bin_arr, self.is_cat_arr,
             jnp.asarray(self.num_tree_per_iteration, jnp.int32),
-            self.num_tree_per_iteration)
+            self.num_tree_per_iteration,
+            early_stop_margin=(early_stop[0] if early_stop else 0.0),
+            early_stop_freq=(early_stop[1] if early_stop else 0))
         raw = np.asarray(raw)
         if self.average_output:
-            n_iters = len(self.models) // self.num_tree_per_iteration \
-                if num_iteration is None else num_iteration
+            # divide by the iteration count actually accumulated (after the
+            # start/num slicing), reference: num_iteration_for_pred_
+            n_iters = trees.num_trees // max(self.num_tree_per_iteration, 1)
             raw = raw / max(n_iters, 1)
         return raw
 
@@ -1119,14 +1132,18 @@ class GBDT:
         return out
 
     def predict_raw_matrix(self, arr: np.ndarray,
-                           num_iteration: Optional[int] = None) -> np.ndarray:
-        return self.predict_raw_binned(self.bin_matrix(arr), num_iteration)
+                           num_iteration: Optional[int] = None,
+                           start_iteration: int = 0,
+                           early_stop=None) -> np.ndarray:
+        return self.predict_raw_binned(self.bin_matrix(arr), num_iteration,
+                                       start_iteration, early_stop)
 
     def predict_leaf_matrix(self, arr: np.ndarray,
-                            num_iteration: Optional[int] = None) -> np.ndarray:
+                            num_iteration: Optional[int] = None,
+                            start_iteration: int = 0) -> np.ndarray:
         from ..ops.predict import predict_leaf_index
         binned = self.bin_matrix(arr)
-        trees = self.device_trees(num_iteration)
+        trees = self.device_trees(num_iteration, start_iteration)
         leaves = predict_leaf_index(
             jnp.asarray(binned), trees, self.nan_bin_arr, self.is_cat_arr)
         return np.asarray(leaves).T

@@ -414,11 +414,15 @@ class LoadedGBDT:
         return len(self.models) // max(self.num_tree_per_iteration, 1)
 
     def predict_raw_matrix(self, arr: np.ndarray,
-                           num_iteration: Optional[int] = None) -> np.ndarray:
+                           num_iteration: Optional[int] = None,
+                           start_iteration: int = 0,
+                           early_stop=None) -> np.ndarray:
         arr = np.asarray(arr, np.float64)
         if arr.ndim == 1:
             arr = arr.reshape(1, -1)
         models = self.models
+        if start_iteration > 0:
+            models = models[start_iteration * self.num_tree_per_iteration:]
         if num_iteration is not None and num_iteration > 0:
             models = models[: num_iteration * self.num_tree_per_iteration]
         k = self.num_tree_per_iteration
@@ -431,9 +435,12 @@ class LoadedGBDT:
         return out.astype(np.float32)
 
     def predict_leaf_matrix(self, arr: np.ndarray,
-                            num_iteration: Optional[int] = None) -> np.ndarray:
+                            num_iteration: Optional[int] = None,
+                            start_iteration: int = 0) -> np.ndarray:
         arr = np.asarray(arr, np.float64)
         models = self.models
+        if start_iteration > 0:
+            models = models[start_iteration * self.num_tree_per_iteration:]
         if num_iteration is not None and num_iteration > 0:
             models = models[: num_iteration * self.num_tree_per_iteration]
         return np.stack([t.route(arr) for t in models], axis=1)

@@ -481,15 +481,14 @@ class CrossEntropyLambda(Objective):
 # RankXENDCG :378; CUDA mirror cuda_rank_objective.cu)
 # ---------------------------------------------------------------------------
 def _pad_queries(boundaries: np.ndarray) -> Tuple[np.ndarray, int]:
-    """Build a [Q, M] row-index matrix (padded with -1) from query boundaries."""
+    """Build a [Q, M] row-index matrix (padded with -1) from query
+    boundaries — vectorized (no O(total rows) Python loop)."""
     sizes = np.diff(boundaries)
     q = len(sizes)
     m = int(sizes.max()) if q else 1
-    idx = np.full((q, m), -1, dtype=np.int32)
-    for i in range(q):
-        s, e = boundaries[i], boundaries[i + 1]
-        idx[i, : e - s] = np.arange(s, e, dtype=np.int32)
-    return idx, m
+    pos = np.arange(m, dtype=np.int32)[None, :]
+    idx = boundaries[:-1, None].astype(np.int32) + pos
+    return np.where(pos < sizes[:, None], idx, -1), m
 
 
 class LambdarankNDCG(Objective):
@@ -536,67 +535,112 @@ class LambdarankNDCG(Objective):
         row_gain = gains[lbl]
         self.row_gain = jnp.asarray(row_gain, jnp.float32)
         self.row_label = jnp.asarray(lbl, jnp.int32)
-        # inverse max DCG per query (reference: lambdarank_ndcg init)
-        inv_max_dcg = np.zeros(len(qb) - 1, dtype=np.float64)
-        for i in range(len(qb) - 1):
-            g = np.sort(row_gain[qb[i]: qb[i + 1]])[::-1]
-            k = min(len(g), self.truncation_level)
-            disc = 1.0 / np.log2(np.arange(k) + 2.0)
-            mdcg = float((g[:k] * disc).sum())
-            inv_max_dcg[i] = 1.0 / mdcg if mdcg > 0 else 0.0
-        self.inv_max_dcg = jnp.asarray(inv_max_dcg, jnp.float32)  # [Q]
+        # inverse max DCG per query, vectorized over the padded query matrix
+        # (reference: lambdarank_ndcg init)
+        gp = np.where(idx >= 0, row_gain[np.maximum(idx, 0)], -np.inf)
+        gp = -np.sort(-gp, axis=1)                           # desc per query
+        k = min(m, self.truncation_level)
+        disc = 1.0 / np.log2(np.arange(k) + 2.0)
+        mdcg = np.sum(np.where(np.isfinite(gp[:, :k]), gp[:, :k], 0.0)
+                      * disc[None, :], axis=1)
+        self.inv_max_dcg = jnp.asarray(
+            np.where(mdcg > 0, 1.0 / np.maximum(mdcg, 1e-300), 0.0),
+            jnp.float32)                                     # [Q]
 
-    def get_gradients(self, score):
-        idx = self.query_index                       # [Q, M]
-        mask = self.query_mask
-        safe_idx = jnp.maximum(idx, 0)
-        s = jnp.where(mask, score[safe_idx], -jnp.inf)        # [Q, M]
-        g = jnp.where(mask, self.row_gain[safe_idx], 0.0)     # gains
-        # rank each document by descending score (reference sorts per query)
-        order = jnp.argsort(-s, axis=1)                       # [Q, M]
-        rank_of = jnp.argsort(order, axis=1)                  # doc -> position
-        # true positional discounts for ALL ranked positions; the truncation
-        # level only restricts which pairs are enumerated (reference:
-        # rank_objective.hpp:222-257 — the paired doc below truncation_level
-        # keeps its real discount)
-        disc = 1.0 / jnp.log2(rank_of.astype(jnp.float32) + 2.0)  # [Q, M]
-        within_trunc = rank_of < self.truncation_level
+    # queries processed in chunks of this many per pair-tensor block; the
+    # block is [CHUNK, T, M] floats — memory stays bounded for MS-LTR-scale
+    # datasets (the old formulation materialized [Q, M, M])
+    _QUERY_CHUNK = 256
 
+    def _query_chunk_grads(self, s, g, mask, inv_max_dcg):
+        """Lambda gradients for one chunk of padded queries [Qc, M].
+
+        The reference enumerates pairs (i, j) over SORTED positions with
+        i < truncation_level and j > i (rank_objective.hpp:222-257) — a
+        [T, M] pair block per query, not [M, M]."""
+        qc, m = s.shape
+        t = min(self.truncation_level, m)
         sig = self.sigmoid
-        # pair matrices [Q, M, M]: i = higher-labeled doc, j = lower
-        s_i = s[:, :, None]
-        s_j = s[:, None, :]
-        g_i = g[:, :, None]
-        g_j = g[:, None, :]
-        d_i = disc[:, :, None]
-        d_j = disc[:, None, :]
-        pair_valid = (
-            mask[:, :, None] & mask[:, None, :] & (g_i > g_j)
-            & (within_trunc[:, :, None] | within_trunc[:, None, :])
-        )
+
+        order = jnp.argsort(-s, axis=1)                      # [Qc, M]
+        rank_of = jnp.argsort(order, axis=1)
+        s_s = jnp.take_along_axis(s, order, axis=1)
+        g_s = jnp.take_along_axis(g, order, axis=1)
+        m_s = jnp.take_along_axis(mask, order, axis=1)
+        disc = 1.0 / jnp.log2(jnp.arange(m, dtype=jnp.float32) + 2.0)  # [M]
+
+        # pair block [Qc, T, M]: i = sorted position < T, j = any position > i
+        s_i = s_s[:, :t, None]
+        s_j = s_s[:, None, :]
+        g_i = g_s[:, :t, None]
+        g_j = g_s[:, None, :]
+        d_i = disc[None, :t, None]
+        d_j = disc[None, None, :]
+        upper = jnp.arange(t)[:, None] < jnp.arange(m)[None, :]
+        pair_valid = (m_s[:, :t, None] & m_s[:, None, :]
+                      & (g_i != g_j) & upper[None])
         delta_ndcg = jnp.abs((g_i - g_j) * (d_i - d_j)) \
-            * self.inv_max_dcg[:, None, None]
-        ds = s_i - s_j
-        p = jax.nn.sigmoid(sig * ds)          # P(i ranked above j)
-        lam = sig * (p - 1.0) * delta_ndcg    # d loss / d s_i  (negative)
+            * inv_max_dcg[:, None, None]
+        # lambda applies to the HIGHER-labeled doc of the pair
+        i_high = g_i > g_j
+        ds_high = jnp.where(i_high, s_i - s_j, s_j - s_i)
+        p = jax.nn.sigmoid(sig * ds_high)
+        lam_h = sig * (p - 1.0) * delta_ndcg           # <= 0, on higher doc
         hes = sig * sig * p * (1.0 - p) * delta_ndcg
-        lam = jnp.where(pair_valid, lam, 0.0)
+        lam_h = jnp.where(pair_valid, lam_h, 0.0)
         hes = jnp.where(pair_valid, hes, 0.0)
 
-        grad_q = lam.sum(axis=2) - lam.sum(axis=1)   # [Q, M]
-        hess_q = hes.sum(axis=2) + hes.sum(axis=1)
+        lam_i = jnp.where(i_high, lam_h, -lam_h)       # contribution @ pos i
+        pad_t = ((0, 0), (0, m - t))
+        grad_sorted = jnp.pad(lam_i.sum(axis=2), pad_t) - lam_i.sum(axis=1)
+        hess_sorted = jnp.pad(hes.sum(axis=2), pad_t) + hes.sum(axis=1)
 
         if self.norm:
-            # reference norm_ (rank_objective.hpp:259-263): accumulate
-            # sum_lambdas = sum over pairs of 2*|lambda| and scale the query's
-            # grad/hess by log2(1 + sum_lambdas) / sum_lambdas
-            sum_lambdas = 2.0 * (-lam).sum(axis=(1, 2))   # lam <= 0 per pair
+            # reference norm_ (rank_objective.hpp:259-263)
+            sum_lambdas = 2.0 * (-lam_h).sum(axis=(1, 2))
             scale = jnp.where(
                 sum_lambdas > 0,
                 jnp.log2(1.0 + sum_lambdas) / jnp.maximum(sum_lambdas, _EPS),
                 1.0)
-            grad_q = grad_q * scale[:, None]
-            hess_q = hess_q * scale[:, None]
+            grad_sorted = grad_sorted * scale[:, None]
+            hess_sorted = hess_sorted * scale[:, None]
+
+        # back to document order within the query
+        grad_q = jnp.take_along_axis(grad_sorted, rank_of, axis=1)
+        hess_q = jnp.take_along_axis(hess_sorted, rank_of, axis=1)
+        return grad_q, hess_q
+
+    def get_gradients(self, score):
+        idx = self.query_index                       # [Q, M]
+        mask = self.query_mask
+        q, m = idx.shape
+        safe_idx = jnp.maximum(idx, 0)
+        s = jnp.where(mask, score[safe_idx], -jnp.inf)        # [Q, M]
+        g = jnp.where(mask, self.row_gain[safe_idx], 0.0)     # gains
+
+        chunk = min(self._QUERY_CHUNK, q)
+        q_pad = (-q) % chunk
+        if q_pad:
+            s = jnp.pad(s, ((0, q_pad), (0, 0)), constant_values=-jnp.inf)
+            g = jnp.pad(g, ((0, q_pad), (0, 0)))
+            mask_p = jnp.pad(mask, ((0, q_pad), (0, 0)))
+            imd = jnp.pad(self.inv_max_dcg, (0, q_pad))
+        else:
+            mask_p = mask
+            imd = self.inv_max_dcg
+        n_chunks = (q + q_pad) // chunk
+
+        def one_chunk(args):
+            sc, gc, mc, imdc = args
+            return self._query_chunk_grads(sc, gc, mc, imdc)
+
+        grad_q, hess_q = jax.lax.map(
+            one_chunk,
+            (s.reshape(n_chunks, chunk, m), g.reshape(n_chunks, chunk, m),
+             mask_p.reshape(n_chunks, chunk, m),
+             imd.reshape(n_chunks, chunk)))
+        grad_q = grad_q.reshape(-1, m)[:q]
+        hess_q = hess_q.reshape(-1, m)[:q]
 
         grad = jnp.zeros_like(score).at[safe_idx.reshape(-1)].add(
             jnp.where(mask, grad_q, 0.0).reshape(-1))

@@ -86,7 +86,8 @@ def route_one_tree(
     return -(cur + 1)
 
 
-@functools.partial(jax.jit, static_argnames=("num_class",))
+@functools.partial(jax.jit, static_argnames=(
+    "num_class", "early_stop_margin", "early_stop_freq"))
 def predict_raw(
     binned: jax.Array,         # [N, F]
     trees: StackedTrees,
@@ -94,29 +95,57 @@ def predict_raw(
     is_cat_arr: jax.Array,     # [F] bool
     num_model_per_iteration: jax.Array,  # scalar i32 (K trees interleaved per iter)
     num_class: int = 1,
+    early_stop_margin: float = 0.0,
+    early_stop_freq: int = 0,
 ) -> jax.Array:
     """Accumulate raw scores over all trees; returns [num_class, N].
 
     Trees are stored iteration-major (reference: GBDT::models_ ordering — tree
     ``t`` belongs to class ``t % num_class``), matching gbdt_prediction.cpp.
+
+    Prediction early stopping (reference: prediction_early_stop.cpp): every
+    ``early_stop_freq`` trees, rows whose decided margin exceeds
+    ``early_stop_margin`` stop accumulating — binary: |score|; multiclass:
+    best minus second-best. Per-row freezing replaces the reference's
+    per-row tree-loop break (all rows ride the same scan on TPU).
     """
     n = binned.shape[0]
     t_total = trees.num_trees
+    use_stop = early_stop_freq > 0 and early_stop_margin > 0.0
+
+    def margin_of(scores):
+        if num_class == 1:
+            # reference binary margin: 2*|score|
+            # (prediction_early_stop.cpp CreatePredictionEarlyStopInstance)
+            return 2.0 * jnp.abs(scores[0])
+        top2 = jnp.sort(scores, axis=0)[-2:]
+        return top2[1] - top2[0]
 
     def step(carry, tree_slice):
-        scores = carry
+        scores, done, t_idx = carry
         (sf, sb, cb, dl, lc, rc, lv, nn, class_id) = tree_slice
         leaf = route_one_tree(binned, sf, sb, cb, dl, lc, rc, nn,
                               nan_bin_arr, is_cat_arr)
         add = lv[leaf]
+        if use_stop:
+            add = jnp.where(done, 0.0, add)
         scores = scores.at[class_id].add(add)
-        return scores, None
+        if use_stop:
+            # freq counts ITERATIONS (k trees each), checked at iteration
+            # boundaries only (reference: gbdt_prediction.cpp round counter)
+            k_it = jnp.maximum(num_model_per_iteration, 1)
+            at_boundary = (t_idx + 1) % k_it == 0
+            it_done = (t_idx + 1) // k_it
+            check = at_boundary & (it_done % early_stop_freq == 0)
+            done = done | (check & (margin_of(scores) > early_stop_margin))
+        return (scores, done, t_idx + 1), None
 
     class_ids = (jnp.arange(t_total, dtype=jnp.int32)
                  % jnp.maximum(num_model_per_iteration, 1))
     scores0 = jnp.zeros((num_class, n), jnp.float32)
-    scores, _ = lax.scan(
-        step, scores0,
+    done0 = jnp.zeros((n,), bool)
+    (scores, _, _), _ = lax.scan(
+        step, (scores0, done0, jnp.asarray(0, jnp.int32)),
         (trees.split_feature, trees.split_bin, trees.cat_bitset,
          trees.default_left, trees.left_child, trees.right_child,
          trees.leaf_value, trees.num_nodes, class_ids),

@@ -1,0 +1,192 @@
+"""TreeSHAP feature contributions (pred_contrib).
+
+Host-side implementation of the exact tree SHAP path-attribution algorithm
+(Lundberg et al., "Consistent Individualized Feature Attribution for Tree
+Ensembles"), the same algorithm the reference runs per tree for
+``pred_contrib`` (reference: Tree::TreeSHAP / TreeSHAPByMap in
+src/io/tree.cpp, driven from GBDT::PredictContrib gbdt_prediction.cpp).
+
+Trees are tiny and SHAP is an interpretation tool, not a training hot path,
+so this runs in numpy on the host over the booster's struct-of-array trees
+(bin-space thresholds; rows are routed exactly like training/prediction).
+Complexity O(rows * trees * leaves * depth^2).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class _Path:
+    """Decision-path state for the EXTEND/UNWIND recursion."""
+
+    __slots__ = ("feature", "zero_fraction", "one_fraction", "pweight")
+
+    def __init__(self, depth_cap: int):
+        self.feature = np.full(depth_cap, -1, np.int64)
+        self.zero_fraction = np.zeros(depth_cap)
+        self.one_fraction = np.zeros(depth_cap)
+        self.pweight = np.zeros(depth_cap)
+
+    def copy_to(self, other: "_Path", n: int) -> None:
+        other.feature[:n] = self.feature[:n]
+        other.zero_fraction[:n] = self.zero_fraction[:n]
+        other.one_fraction[:n] = self.one_fraction[:n]
+        other.pweight[:n] = self.pweight[:n]
+
+
+def _extend(p: _Path, unique_depth: int, zero_fraction: float,
+            one_fraction: float, feature: int) -> None:
+    p.feature[unique_depth] = feature
+    p.zero_fraction[unique_depth] = zero_fraction
+    p.one_fraction[unique_depth] = one_fraction
+    p.pweight[unique_depth] = 1.0 if unique_depth == 0 else 0.0
+    ud = unique_depth
+    for i in range(ud - 1, -1, -1):
+        p.pweight[i + 1] += one_fraction * p.pweight[i] * (i + 1) / (ud + 1)
+        p.pweight[i] = zero_fraction * p.pweight[i] * (ud - i) / (ud + 1)
+
+
+def _unwind(p: _Path, unique_depth: int, path_index: int) -> None:
+    one = p.one_fraction[path_index]
+    zero = p.zero_fraction[path_index]
+    ud = unique_depth
+    next_one_portion = p.pweight[ud]
+    for i in range(ud - 1, -1, -1):
+        if one != 0.0:
+            tmp = p.pweight[i]
+            p.pweight[i] = next_one_portion * (ud + 1) / ((i + 1) * one)
+            next_one_portion = tmp - p.pweight[i] * zero * (ud - i) / (ud + 1)
+        else:
+            p.pweight[i] = p.pweight[i] * (ud + 1) / (zero * (ud - i))
+    for i in range(path_index, ud):
+        p.feature[i] = p.feature[i + 1]
+        p.zero_fraction[i] = p.zero_fraction[i + 1]
+        p.one_fraction[i] = p.one_fraction[i + 1]
+
+
+def _unwound_sum(p: _Path, unique_depth: int, path_index: int) -> float:
+    one = p.one_fraction[path_index]
+    zero = p.zero_fraction[path_index]
+    ud = unique_depth
+    total = 0.0
+    next_one_portion = p.pweight[ud]
+    for i in range(ud - 1, -1, -1):
+        if one != 0.0:
+            tmp = next_one_portion * (ud + 1) / ((i + 1) * one)
+            total += tmp
+            next_one_portion = p.pweight[i] - tmp * zero * (ud - i) / (ud + 1)
+        else:
+            total += p.pweight[i] / (zero * (ud - i) / (ud + 1))
+    return total
+
+
+def tree_expected_value(left_child, right_child, leaf_value, node_count,
+                        leaf_count, num_nodes: int) -> float:
+    """Cover-weighted mean prediction of a tree (row-independent; hoisted
+    out of the per-row loop)."""
+    if num_nodes == 0:
+        return float(leaf_value[0])
+
+    def cover(node: int) -> float:
+        if node < 0:
+            return max(float(leaf_count[-(node + 1)]), 1e-12)
+        return max(float(node_count[node]), 1e-12)
+
+    def value(node: int) -> float:
+        if node < 0:
+            return float(leaf_value[-(node + 1)])
+        lc, rc = int(left_child[node]), int(right_child[node])
+        cl, cr = cover(lc), cover(rc)
+        return (value(lc) * cl + value(rc) * cr) / (cl + cr)
+
+    return value(0)
+
+
+def tree_shap_one_row(go_left_fn, split_feature, left_child, right_child,
+                      leaf_value, node_count, leaf_count, num_nodes: int,
+                      phi: np.ndarray, max_depth: int,
+                      expected_value: float) -> None:
+    """Accumulate one tree's SHAP values for one row into ``phi`` [F+1]."""
+    if num_nodes == 0:
+        phi[-1] += float(leaf_value[0])
+        return
+    depth_cap = max_depth + 2
+
+    def cover(node: int) -> float:
+        if node < 0:
+            return max(float(leaf_count[-(node + 1)]), 1e-12)
+        return max(float(node_count[node]), 1e-12)
+
+    def recurse(node: int, path: _Path, unique_depth: int,
+                parent_zero: float, parent_one: float,
+                parent_feature: int) -> None:
+        p = _Path(depth_cap)
+        path.copy_to(p, unique_depth)
+        _extend(p, unique_depth, parent_zero, parent_one, parent_feature)
+        if node < 0:
+            leaf = -(node + 1)
+            for i in range(1, unique_depth + 1):
+                w = _unwound_sum(p, unique_depth, i)
+                phi[p.feature[i]] += (
+                    w * (p.one_fraction[i] - p.zero_fraction[i])
+                    * float(leaf_value[leaf]))
+            return
+        f = int(split_feature[node])
+        hot = int(left_child[node]) if go_left_fn(node) \
+            else int(right_child[node])
+        cold = int(right_child[node]) if go_left_fn(node) \
+            else int(left_child[node])
+        node_cover = cover(node)
+        hot_zero = cover(hot) / node_cover
+        cold_zero = cover(cold) / node_cover
+        incoming_zero, incoming_one = 1.0, 1.0
+        new_depth = unique_depth + 1
+        # feature already on the path: undo its previous element first
+        prev = -1
+        for i in range(1, unique_depth + 1):
+            if p.feature[i] == f:
+                prev = i
+                break
+        if prev >= 0:
+            incoming_zero = p.zero_fraction[prev]
+            incoming_one = p.one_fraction[prev]
+            _unwind(p, unique_depth, prev)
+            new_depth = unique_depth
+        recurse(hot, p, new_depth, hot_zero * incoming_zero,
+                incoming_one, f)
+        recurse(cold, p, new_depth, cold_zero * incoming_zero, 0.0, f)
+
+    # expected value of the tree goes to the bias slot
+    phi[-1] += expected_value
+    root = _Path(depth_cap)
+    recurse(0, root, 0, 1.0, 1.0, -1)
+
+
+def booster_contrib(models, binned: np.ndarray, nan_bin, is_cat,
+                    go_left_pred_np, num_tree_per_iteration: int,
+                    num_features: int) -> np.ndarray:
+    """SHAP contributions [N, K*(F+1)] over all trees of a booster."""
+    n = binned.shape[0]
+    k = max(num_tree_per_iteration, 1)
+    out = np.zeros((n, k, num_features + 1))
+    for t_idx, m in enumerate(models):
+        cls = t_idx % k
+        depth = int(np.max(m.leaf_depth[: m.num_leaves])) \
+            if m.num_nodes > 0 else 0
+        ev = tree_expected_value(m.left_child, m.right_child, m.leaf_value,
+                                 m.internal_count, m.leaf_count, m.num_nodes)
+        for r in range(n):
+            row = binned[r]
+
+            def go_left(node: int) -> bool:
+                f = int(m.split_feature[node])
+                return bool(go_left_pred_np(
+                    int(row[f]), int(m.split_bin[node]),
+                    bool(m.default_left[node]), int(nan_bin[f]),
+                    bool(is_cat[f]), m.cat_bitset[node]))
+
+            tree_shap_one_row(
+                go_left, m.split_feature, m.left_child, m.right_child,
+                m.leaf_value, m.internal_count, m.leaf_count, m.num_nodes,
+                out[r, cls], depth, ev)
+    return out.reshape(n, k * (num_features + 1))

@@ -228,3 +228,51 @@ class TestConfigWarnings:
                             "feature_fraction", "lambda_l1", "max_bin",
                             "is_unbalance", "tree_learner", "max_depth"):
             assert implemented not in UNIMPLEMENTED_PARAMS
+
+
+class TestPredictionExtras:
+    def test_start_iteration(self):
+        X, y = binary_data()
+        bst = lgb.train(_params(objective="binary"), lgb.Dataset(X, label=y), 10)
+        full = bst.predict(X, raw_score=True)
+        head = bst.predict(X, raw_score=True, num_iteration=4)
+        tail = bst.predict(X, raw_score=True, start_iteration=4)
+        np.testing.assert_allclose(head + tail, full, rtol=1e-5, atol=1e-6)
+
+    def test_pred_contrib_sums_to_raw(self):
+        X, y = binary_data(n=200)
+        bst = lgb.train(_params(objective="binary"), lgb.Dataset(X, label=y), 5)
+        contrib = bst.predict(X[:40], pred_contrib=True)
+        assert contrib.shape == (40, X.shape[1] + 1)
+        raw = bst.predict(X[:40], raw_score=True)
+        # SHAP local accuracy: contributions + expected value == raw score
+        np.testing.assert_allclose(contrib.sum(axis=1), raw,
+                                   rtol=1e-4, atol=1e-4)
+        # informative features dominate attributions
+        imp = np.abs(contrib[:, :-1]).mean(0)
+        assert imp.max() > 0
+
+    def test_pred_contrib_multiclass(self):
+        X, y = multiclass_data()
+        bst = lgb.train(_params(objective="multiclass", num_class=3),
+                        lgb.Dataset(X, label=y), 4)
+        contrib = bst.predict(X[:20], pred_contrib=True)
+        assert contrib.shape == (20, 3 * (X.shape[1] + 1))
+        raw = bst.predict(X[:20], raw_score=True)
+        sums = contrib.reshape(20, 3, X.shape[1] + 1).sum(axis=2)
+        np.testing.assert_allclose(sums, raw, rtol=1e-4, atol=1e-4)
+
+    def test_pred_early_stop(self):
+        X, y = binary_data()
+        bst = lgb.train(_params(objective="binary"), lgb.Dataset(X, label=y), 30)
+        full = bst.predict(X)
+        stopped = bst.predict(X, pred_early_stop=True,
+                              pred_early_stop_margin=1.5,
+                              pred_early_stop_freq=5)
+        # decisions agree even though accumulation stops early
+        assert ((full > 0.5) == (stopped > 0.5)).mean() > 0.98
+        # and a huge margin disables stopping entirely
+        same = bst.predict(X, pred_early_stop=True,
+                           pred_early_stop_margin=1e9,
+                           pred_early_stop_freq=5)
+        np.testing.assert_allclose(same, full, rtol=1e-6)
