@@ -29,12 +29,20 @@ __all__ = [
     "early_stopping", "log_evaluation", "record_evaluation", "reset_parameter",
     "EarlyStopException",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
+    "plot_importance", "plot_metric", "plot_split_value_histogram",
+    "plot_tree", "create_tree_digraph",
 ]
+
+_PLOTTING = ("plot_importance", "plot_metric", "plot_split_value_histogram",
+             "plot_tree", "create_tree_digraph")
 
 
 def __getattr__(name):
-    # sklearn wrappers import lazily to keep base import light
+    # sklearn wrappers / plotting import lazily to keep base import light
     if name in ("LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"):
         from . import sklearn as _sk
         return getattr(_sk, name)
+    if name in _PLOTTING:
+        from . import plotting as _pl
+        return getattr(_pl, name)
     raise AttributeError(f"module 'lightgbm_tpu' has no attribute {name!r}")

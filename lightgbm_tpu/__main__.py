@@ -1,0 +1,6 @@
+"""``python -m lightgbm_tpu config=train.conf`` — the reference CLI surface
+(reference: src/main.cpp)."""
+from .cli import run
+
+if __name__ == "__main__":
+    raise SystemExit(run())

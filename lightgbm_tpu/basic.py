@@ -239,6 +239,8 @@ class Dataset:
 def _maybe_series(x):
     if x is None:
         return None
+    if hasattr(x, "tocsr") and hasattr(x, "toarray"):  # scipy.sparse
+        return x.toarray()
     if hasattr(x, "values"):
         return np.asarray(x.values)
     return np.asarray(x)
@@ -488,36 +490,56 @@ class Booster:
             num_iteration = self.best_iteration if self.best_iteration > 0 else None
         arr = np.asarray(_maybe_series(data), dtype=np.float64)
         pre = getattr(self, "_pre_model", None)
-        pre_cut = own_cut = None
-        if pre is not None and num_iteration is not None and num_iteration > 0:
-            # iteration counting starts at the loaded model's trees
-            # (reference: models_ holds loaded + new trees in order)
-            pre_cut = min(num_iteration, pre.current_iteration)
-            own_cut = max(num_iteration - pre.current_iteration, 0)
-        elif pre is None:
-            own_cut = num_iteration
+        # global tree-window semantics across loaded + new trees (reference:
+        # models_ holds them in order and start/num address that sequence)
+        pre_iters = pre.current_iteration if pre is not None else 0
+        end = (start_iteration + num_iteration
+               if num_iteration is not None and num_iteration > 0 else None)
+        pre_start = min(start_iteration, pre_iters)
+        pre_cut = (max(min(end, pre_iters) - pre_start, 0)
+                   if end is not None else None)
+        own_start = max(start_iteration - pre_iters, 0)
+        own_cut = (max(end - pre_iters - own_start, 0)
+                   if end is not None else None)
+        pre_empty = pre is None or pre_start >= pre_iters or pre_cut == 0
+        own_empty = own_cut == 0
         if pred_leaf:
-            own = inner.predict_leaf_matrix(arr, own_cut, start_iteration)
-            if pre is not None:
-                pre_leaf = pre.predict_leaf_matrix(arr, pre_cut)
-                own = (pre_leaf if own_cut == 0
+            own = (inner.predict_leaf_matrix(arr, own_cut, own_start)
+                   if not own_empty else None)
+            if not pre_empty:
+                pre_leaf = pre.predict_leaf_matrix(arr, pre_cut, pre_start)
+                own = (pre_leaf if own is None
                        else np.concatenate([pre_leaf, own], axis=1))
             return own
         if pred_contrib:
+            if start_iteration != 0:
+                raise NotImplementedError(
+                    "pred_contrib with start_iteration != 0 is not supported")
             return self._predict_contrib(arr, num_iteration)
         early = None
-        if kwargs.get("pred_early_stop") or (
-                self.params and self.params.get("pred_early_stop")):
-            src = self.params or {}
-            early = (float(kwargs.get("pred_early_stop_margin",
-                                      src.get("pred_early_stop_margin", 10.0))),
-                     int(kwargs.get("pred_early_stop_freq",
-                                    src.get("pred_early_stop_freq", 10))))
-        raw = inner.predict_raw_matrix(arr, own_cut, start_iteration,
-                                       early)   # [K, N]
-        if pre is not None:
-            pre_raw = pre.predict_raw_matrix(arr, pre_cut)
-            raw = pre_raw if own_cut == 0 else raw + pre_raw
+        want_early = kwargs.get(
+            "pred_early_stop",
+            bool(self.params and self.params.get("pred_early_stop")))
+        if want_early:
+            # the reference only early-stops classification predictions
+            # (predictor.hpp NeedAccuratePrediction gate)
+            obj_name = getattr(inner.objective, "name", "")
+            if obj_name == "binary" or inner.num_tree_per_iteration > 1:
+                src = self.params or {}
+                early = (
+                    float(kwargs.get(
+                        "pred_early_stop_margin",
+                        src.get("pred_early_stop_margin", 10.0))),
+                    int(kwargs.get("pred_early_stop_freq",
+                                   src.get("pred_early_stop_freq", 10))))
+        raw = (inner.predict_raw_matrix(arr, own_cut, own_start, early)
+               if not own_empty else None)   # [K, N]
+        if not pre_empty:
+            pre_raw = pre.predict_raw_matrix(arr, pre_cut, pre_start)
+            raw = pre_raw if raw is None else raw + pre_raw
+        if raw is None:
+            raw = np.zeros((max(inner.num_tree_per_iteration, 1),
+                            arr.shape[0]), np.float32)
         k = raw.shape[0]
         if raw_score or inner.objective is None:
             return raw[0] if k == 1 else raw.T
@@ -546,13 +568,9 @@ class Booster:
         nan_bin = np.asarray(g.nan_bin_arr)
         is_cat = np.asarray(g.is_cat_arr)
 
-        def go_left_np(col, bin_, dl, nb, iscat, words):
-            if iscat:
-                w = int(words[col // 32]) if col // 32 < len(words) else 0
-                return bool((w >> (col % 32)) & 1)
-            return col <= bin_ or (dl and col == nb)
-
-        return booster_contrib(models, binned, nan_bin, is_cat, go_left_np,
+        from .ops.split import go_left_scalar_np
+        return booster_contrib(models, binned, nan_bin, is_cat,
+                               go_left_scalar_np,
                                g.num_tree_per_iteration,
                                int(binned.shape[1]))
 

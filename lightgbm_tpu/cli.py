@@ -1,0 +1,134 @@
+"""Config-file driven command line (the reference's ``lightgbm`` binary).
+
+Mirrors the reference CLI (reference: src/main.cpp:13, Application::Run
+src/application/application.cpp:168-285 — ``lightgbm config=train.conf``
+plus key=value overrides; tasks train/predict/convert_model/refit from
+include/LightGBM/config.h:34).
+
+Usage:
+    python -m lightgbm_tpu config=train.conf [key=value ...]
+    python -m lightgbm_tpu task=predict data=test.csv input_model=model.txt
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict
+
+import numpy as np
+
+
+def parse_config_file(path: str) -> Dict[str, str]:
+    """key=value lines, '#' comments (reference: Application::LoadParameters,
+    application.cpp:50)."""
+    out: Dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            key, _, value = line.partition("=")
+            out[key.strip()] = value.strip()
+    return out
+
+
+def _load_dataset(params, data_path: str):
+    import lightgbm_tpu as lgb
+    from .io.loader import load_query_file, load_text_file, load_weight_file
+
+    X, label, weight, group, names = load_text_file(
+        data_path,
+        has_header=str(params.get("header", "false")).lower()
+        in ("true", "1"),
+        label_column=params.get("label_column", "0"),
+        weight_column=params.get("weight_column", ""),
+        group_column=params.get("group_column", ""),
+        ignore_column=params.get("ignore_column", ""),
+    )
+    if weight is None:
+        weight = load_weight_file(data_path)
+    if group is None:
+        group = load_query_file(data_path)
+    return lgb.Dataset(X, label=label, weight=weight, group=group,
+                       feature_name=names or "auto",
+                       free_raw_data=False), X
+
+
+def run(argv=None) -> int:
+    import lightgbm_tpu as lgb
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    params: Dict[str, str] = {}
+    for arg in argv:
+        if "=" not in arg:
+            print(f"ignoring argument without '=': {arg}", file=sys.stderr)
+            continue
+        key, _, value = arg.partition("=")
+        if key == "config":
+            file_params = parse_config_file(value)
+            # command line overrides the config file (application.cpp:56-66)
+            file_params.update(params)
+            params = file_params
+        else:
+            params[key] = value
+
+    task = params.pop("task", "train")
+    if task == "train":
+        data = params.pop("data", None)
+        if not data:
+            print("task=train needs data=<file>", file=sys.stderr)
+            return 1
+        valid = params.pop("valid", params.pop("valid_data", ""))
+        num_round = int(params.pop("num_iterations",
+                                   params.pop("num_boost_round", 100)))
+        output_model = params.get("output_model", "LightGBM_model.txt")
+        ds, _ = _load_dataset(params, data)
+        valid_sets = []
+        valid_names = []
+        for i, v in enumerate(p for p in valid.split(",") if p):
+            vds, _ = _load_dataset(params, v)
+            vds.reference = ds
+            valid_sets.append(vds)
+            valid_names.append(f"valid_{i}")
+        bst = lgb.train(params, ds, num_round,
+                        valid_sets=valid_sets or None,
+                        valid_names=valid_names or None,
+                        callbacks=[lgb.log_evaluation(1)] if valid_sets
+                        else None)
+        bst.save_model(output_model)
+        print(f"model saved to {output_model}")
+        return 0
+
+    if task == "predict":
+        data = params.pop("data", None)
+        input_model = params.pop("input_model", None)
+        if not data or not input_model:
+            print("task=predict needs data=<file> input_model=<model>",
+                  file=sys.stderr)
+            return 1
+        output_result = params.pop("output_result", "LightGBM_predict_result.txt")
+        from .io.loader import load_text_file
+        X, _, _, _, _ = load_text_file(
+            data,
+            has_header=str(params.get("header", "false")).lower()
+            in ("true", "1"),
+            label_column=params.get("label_column", "0"))
+        bst = lgb.Booster(model_file=input_model)
+        pred = bst.predict(
+            X,
+            raw_score=str(params.get("predict_raw_score", "false")).lower()
+            in ("true", "1"),
+            pred_leaf=str(params.get("predict_leaf_index", "false")).lower()
+            in ("true", "1"))
+        np.savetxt(output_result, np.asarray(pred), fmt="%.9g")
+        print(f"predictions saved to {output_result}")
+        return 0
+
+    if task in ("convert_model", "refit"):
+        print(f"task={task} is not implemented yet", file=sys.stderr)
+        return 1
+    print(f"unknown task: {task}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(run())

@@ -28,8 +28,16 @@ from .binning import (
 
 
 def _to_2d_float(data: Any) -> np.ndarray:
-    """Coerce input features to a float64 2-D numpy array (host side)."""
-    if hasattr(data, "values") and hasattr(data, "columns"):  # pandas DataFrame
+    """Coerce input features to a float64 2-D numpy array (host side).
+
+    scipy CSR/CSC matrices densify here: the TPU bin storage is a dense
+    [N, F] uint8 matrix by design (io/dataset.py module doc — HBM-friendly
+    MXU layout), so sparse inputs are a host-side ingestion format, not a
+    device format (reference accepts CSR/CSC the same way through
+    LGBM_DatasetCreateFromCSR/CSC, src/c_api.cpp)."""
+    if hasattr(data, "tocsr") and hasattr(data, "toarray"):  # scipy.sparse
+        arr = data.toarray()
+    elif hasattr(data, "values") and hasattr(data, "columns"):  # pandas
         arr = data.values
     else:
         arr = data

@@ -1,0 +1,144 @@
+"""Text-file dataset loading: CSV / TSV / LibSVM.
+
+TPU-native counterpart of the reference's DatasetLoader + Parser
+(reference: src/io/dataset_loader.cpp LoadFromFile :203, format
+auto-detection src/io/parser.cpp — CSV/TSV/LibSVM with an optional header,
+label/weight/group columns by index or name). Parsing is host-side numpy;
+the result feeds the same BinnedDataset construction as array inputs.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _detect_format(path: str, line: str) -> str:
+    lower = path.lower()
+    for ext, fmt in ((".csv", "csv"), (".tsv", "tsv"), (".svm", "libsvm"),
+                     (".libsvm", "libsvm")):
+        if lower.endswith(ext):
+            return fmt
+    # auto-detect like the reference Parser::CreateParser: LibSVM tokens
+    # look like idx:value
+    tokens = line.replace("\t", " ").split()
+    if any(":" in t for t in tokens[1:3]):
+        return "libsvm"
+    return "tsv" if "\t" in line else "csv"
+
+
+def _parse_column_spec(spec: str, names) -> Optional[int]:
+    """'0' or 'name:label_col' column addressing (reference:
+    config.h label_column docs)."""
+    if spec in ("", None):
+        return None
+    spec = str(spec)
+    if spec.startswith("name:"):
+        return list(names).index(spec[5:])
+    return int(spec)
+
+
+def load_text_file(
+    path: str,
+    has_header: bool = False,
+    label_column: str = "0",
+    weight_column: str = "",
+    group_column: str = "",
+    ignore_column: str = "",
+) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray],
+           Optional[np.ndarray], Optional[list]]:
+    """Returns (X, label, weight, group_sizes, feature_names)."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    with open(path) as f:
+        first = f.readline()
+    fmt = _detect_format(path, first if not has_header else "")
+
+    if fmt == "libsvm":
+        return _load_libsvm(path, has_header)
+
+    delim = "\t" if fmt == "tsv" else ","
+    names = None
+    skip = 0
+    if has_header:
+        names = [c.strip() for c in first.strip().split(delim)]
+        skip = 1
+    raw = np.genfromtxt(path, delimiter=delim, skip_header=skip,
+                        dtype=np.float64)
+    if raw.ndim == 1:
+        raw = raw.reshape(-1, 1)
+
+    def col_of(spec):
+        return _parse_column_spec(spec, names or [])
+
+    label_idx = col_of(label_column)
+    weight_idx = col_of(weight_column)
+    group_idx = col_of(group_column)
+    ignore = set()
+    if ignore_column:
+        for part in str(ignore_column).split(","):
+            idx = col_of(part)
+            if idx is not None:
+                ignore.add(idx)
+    special = {i for i in (label_idx, weight_idx, group_idx)
+               if i is not None} | ignore
+    feat_cols = [i for i in range(raw.shape[1]) if i not in special]
+    X = raw[:, feat_cols]
+    label = raw[:, label_idx] if label_idx is not None else None
+    weight = raw[:, weight_idx] if weight_idx is not None else None
+    group_sizes = None
+    if group_idx is not None:
+        gid = raw[:, group_idx]
+        # consecutive identical group ids -> sizes (reference query files)
+        change = np.flatnonzero(np.diff(gid)) + 1
+        bounds = np.concatenate([[0], change, [len(gid)]])
+        group_sizes = np.diff(bounds)
+    feat_names = ([names[i] for i in feat_cols] if names else None)
+    return X, label, weight, group_sizes, feat_names
+
+
+def _load_libsvm(path: str, has_header: bool):
+    labels = []
+    rows = []
+    max_idx = -1
+    with open(path) as f:
+        if has_header:
+            f.readline()
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            labels.append(float(parts[0]))
+            feats = {}
+            for tok in parts[1:]:
+                if ":" not in tok:
+                    continue
+                i, _, v = tok.partition(":")
+                i = int(i)
+                feats[i] = float(v)
+                max_idx = max(max_idx, i)
+            rows.append(feats)
+    x = np.zeros((len(rows), max_idx + 1))
+    for r, feats in enumerate(rows):
+        for i, v in feats.items():
+            x[r, i] = v
+    return x, np.asarray(labels), None, None, None
+
+
+def load_query_file(path: str) -> Optional[np.ndarray]:
+    """``<data>.query`` / ``.group`` sidecar with one group size per line
+    (reference: Metadata::LoadQueryBoundaries)."""
+    for suffix in (".query", ".group"):
+        p = path + suffix
+        if os.path.exists(p):
+            return np.loadtxt(p, dtype=np.int64).reshape(-1)
+    return None
+
+
+def load_weight_file(path: str) -> Optional[np.ndarray]:
+    p = path + ".weight"
+    if os.path.exists(p):
+        return np.loadtxt(p, dtype=np.float64).reshape(-1)
+    return None

@@ -417,6 +417,9 @@ class LoadedGBDT:
                            num_iteration: Optional[int] = None,
                            start_iteration: int = 0,
                            early_stop=None) -> np.ndarray:
+        if early_stop is not None:
+            log.warning("pred_early_stop is ignored for models loaded from "
+                        "file (host prediction path)")
         arr = np.asarray(arr, np.float64)
         if arr.ndim == 1:
             arr = arr.reshape(1, -1)

@@ -176,6 +176,16 @@ def go_left_pred(col: jnp.ndarray, bin_: jnp.ndarray, default_left,
     )
 
 
+def go_left_scalar_np(col: int, bin_: int, default_left: bool, nan_bin: int,
+                      is_cat: bool, cat_bitset) -> bool:
+    """Numpy scalar twin of go_left_pred for host-side consumers (TreeSHAP);
+    MUST mirror go_left_pred bit-for-bit."""
+    if is_cat:
+        w = int(cat_bitset[col // 32]) if col // 32 < len(cat_bitset) else 0
+        return bool((w >> (col % 32)) & 1)
+    return col <= bin_ or (default_left and col == nan_bin)
+
+
 def best_split(
     hist: jnp.ndarray,        # [F, B, K>=3] (grad, hess, count-weight[, raw-count])
     parent_grad: jnp.ndarray,

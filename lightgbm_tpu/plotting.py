@@ -1,0 +1,203 @@
+"""Plotting utilities (reference: python-package/lightgbm/plotting.py —
+plot_importance, plot_metric, plot_split_value_histogram, plot_tree,
+create_tree_digraph). Matplotlib-backed; graphviz only for tree rendering."""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple, Union
+
+import numpy as np
+
+
+def _check_matplotlib():
+    try:
+        import matplotlib.pyplot as plt
+        return plt
+    except ImportError as e:  # pragma: no cover
+        raise ImportError("plotting requires matplotlib") from e
+
+
+def plot_importance(booster, ax=None, height: float = 0.2,
+                    xlim=None, ylim=None, title="Feature importance",
+                    xlabel="Feature importance", ylabel="Features",
+                    importance_type="split", max_num_features=None,
+                    ignore_zero=True, figsize=None, dpi=None, grid=True,
+                    precision=3, **kwargs):
+    """(reference: plotting.py plot_importance)"""
+    plt = _check_matplotlib()
+    imp = booster.feature_importance(importance_type)
+    names = booster.feature_name()
+    tuples = [(n, v) for n, v in zip(names, imp)
+              if not (ignore_zero and v == 0)]
+    tuples.sort(key=lambda t: t[1])
+    if max_num_features is not None and max_num_features > 0:
+        tuples = tuples[-max_num_features:]
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    labels, values = zip(*tuples) if tuples else ((), ())
+    ylocs = np.arange(len(values))
+    ax.barh(ylocs, values, align="center", height=height, **kwargs)
+    for y, v in zip(ylocs, values):
+        ax.text(v + 1, y,
+                f"{v:.{precision}f}" if importance_type == "gain"
+                else str(int(v)), va="center")
+    ax.set_yticks(ylocs)
+    ax.set_yticklabels(labels)
+    if xlim is not None:
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        ax.set_ylim(ylim)
+    ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_metric(booster_or_record, metric=None, dataset_names=None, ax=None,
+                xlim=None, ylim=None, title="Metric during training",
+                xlabel="Iterations", ylabel="@metric@", figsize=None,
+                dpi=None, grid=True):
+    """(reference: plotting.py plot_metric) — takes a record_evaluation dict
+    or a Booster trained with keep_training_booster."""
+    plt = _check_matplotlib()
+    if isinstance(booster_or_record, dict):
+        eval_results = booster_or_record
+    else:
+        raise TypeError(
+            "plot_metric expects the dict filled by record_evaluation()")
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    names = dataset_names or list(eval_results.keys())
+    chosen_metric = metric
+    for name in names:
+        metrics = eval_results[name]
+        if chosen_metric is None:
+            chosen_metric = next(iter(metrics))
+        values = metrics[chosen_metric]
+        ax.plot(range(len(values)), values, label=name)
+    ax.legend(loc="best")
+    if xlim is not None:
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        ax.set_ylim(ylim)
+    ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel.replace("@metric@", str(chosen_metric)))
+    ax.grid(grid)
+    return ax
+
+
+def plot_split_value_histogram(booster, feature, bins=None, ax=None,
+                               width_coef=0.8, xlim=None, ylim=None,
+                               title="Split value histogram for feature with "
+                                     "@index/name@ @feature@",
+                               xlabel="Feature split value", ylabel="Count",
+                               figsize=None, dpi=None, grid=True):
+    """(reference: plotting.py plot_split_value_histogram)"""
+    plt = _check_matplotlib()
+    d = booster.dump_model()
+    names = d["feature_names"]
+    if isinstance(feature, str):
+        fidx = names.index(feature)
+    else:
+        fidx = int(feature)
+    values = []
+
+    def walk(node):
+        if "split_feature" in node:
+            if node["split_feature"] == fidx and \
+                    not isinstance(node["threshold"], str):
+                values.append(float(node["threshold"]))
+            walk(node["left_child"])
+            walk(node["right_child"])
+
+    for t in d["tree_info"]:
+        walk(t["tree_structure"])
+    if not values:
+        raise ValueError(
+            f"feature {feature} was not used in splitting of trees")
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    hist, edges = np.histogram(values, bins=bins or "auto")
+    centres = (edges[:-1] + edges[1:]) / 2
+    ax.bar(centres, hist, width=width_coef * (edges[1] - edges[0]))
+    if xlim is not None:
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        ax.set_ylim(ylim)
+    tag = "name" if isinstance(feature, str) else "index"
+    ax.set_title(title.replace("@index/name@", tag)
+                 .replace("@feature@", str(feature)))
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def create_tree_digraph(booster, tree_index: int = 0, show_info=None,
+                        precision: int = 3, orientation: str = "horizontal",
+                        **kwargs):
+    """(reference: plotting.py create_tree_digraph) — needs graphviz."""
+    try:
+        import graphviz
+    except ImportError as e:  # pragma: no cover
+        raise ImportError("create_tree_digraph requires graphviz") from e
+    d = booster.dump_model()
+    if tree_index >= len(d["tree_info"]):
+        raise IndexError(f"tree_index {tree_index} out of range")
+    tree = d["tree_info"][tree_index]
+    names = d["feature_names"]
+    graph = graphviz.Digraph(**kwargs)
+    graph.attr(rankdir="LR" if orientation == "horizontal" else "TB")
+    show_info = show_info or []
+
+    def node_id(node):
+        if "split_index" in node:
+            return f"split{node['split_index']}"
+        return f"leaf{node['leaf_index']}"
+
+    def walk(node):
+        nid = node_id(node)
+        if "split_index" in node:
+            f = names[node["split_feature"]]
+            thr = node["threshold"]
+            op = node["decision_type"]
+            label = f"{f} {op} {thr}"
+            for info in show_info:
+                if info in node:
+                    label += f"\\n{info}: {node[info]}"
+            graph.node(nid, label=label, shape="rectangle")
+            for child, edge in ((node["left_child"], "yes"),
+                                (node["right_child"], "no")):
+                walk(child)
+                graph.edge(nid, node_id(child), label=edge)
+        else:
+            label = f"leaf {node['leaf_index']}: " \
+                    f"{round(node['leaf_value'], precision)}"
+            if "leaf_count" in show_info and "leaf_count" in node:
+                label += f"\\ncount: {node['leaf_count']}"
+            graph.node(nid, label=label)
+
+    walk(tree["tree_structure"])
+    return graph
+
+
+def plot_tree(booster, tree_index: int = 0, ax=None, figsize=None, dpi=None,
+              show_info=None, precision: int = 3,
+              orientation: str = "horizontal", **kwargs):
+    """(reference: plotting.py plot_tree) — renders via graphviz+matplotlib."""
+    plt = _check_matplotlib()
+    graph = create_tree_digraph(booster, tree_index, show_info, precision,
+                                orientation, **kwargs)
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    import io as _io
+    try:
+        image = graph.pipe(format="png")
+    except Exception as e:  # graphviz binary missing
+        raise RuntimeError(
+            "plot_tree needs the graphviz system binaries") from e
+    import matplotlib.image as mpimg
+    ax.imshow(mpimg.imread(_io.BytesIO(image)))
+    ax.axis("off")
+    return ax

@@ -1,0 +1,125 @@
+"""Sparse ingestion, file loading, CLI, plotting.
+
+Mirrors the reference's test_basic.py Dataset construction paths,
+test_consistency.py (CLI-config vs Python parity) and test_plotting.py."""
+import os
+import subprocess
+import sys
+
+import matplotlib
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import lightgbm_tpu as lgb
+
+from utils import FAST_PARAMS, binary_data
+
+
+def _params(**kw):
+    p = dict(FAST_PARAMS)
+    p.update(kw)
+    return p
+
+
+class TestSparse:
+    def test_csr_train_and_predict(self):
+        X, y = binary_data()
+        Xs = sp.csr_matrix(X)
+        bst = lgb.train(_params(objective="binary"), lgb.Dataset(Xs, label=y), 10)
+        p_sparse = bst.predict(sp.csr_matrix(X))
+        p_dense = bst.predict(X)
+        np.testing.assert_allclose(p_sparse, p_dense, rtol=1e-6)
+        # same model as dense input (dense is the canonical layout)
+        bst_d = lgb.train(_params(objective="binary"), lgb.Dataset(X, label=y), 10)
+        np.testing.assert_allclose(bst_d.predict(X), p_dense, rtol=1e-6)
+
+    def test_csc_input(self):
+        X, y = binary_data()
+        bst = lgb.train(_params(objective="binary"),
+                        lgb.Dataset(sp.csc_matrix(X), label=y), 5)
+        from sklearn.metrics import roc_auc_score
+        assert roc_auc_score(y, bst.predict(X)) > 0.9
+
+
+class TestFileLoading:
+    def test_csv_roundtrip(self, tmp_path):
+        X, y = binary_data()
+        path = tmp_path / "train.csv"
+        np.savetxt(path, np.column_stack([y, X]), delimiter=",", fmt="%.9g")
+        from lightgbm_tpu.io.loader import load_text_file
+        X2, y2, w, g, names = load_text_file(str(path))
+        np.testing.assert_allclose(X2, X, rtol=1e-6)
+        np.testing.assert_allclose(y2, y)
+        assert w is None and g is None
+
+    def test_tsv_with_header_and_columns(self, tmp_path):
+        X, y = binary_data(n=100, f=4)
+        w = np.random.RandomState(0).rand(100)
+        path = tmp_path / "train.tsv"
+        header = "target\tw\tc0\tc1\tc2\tc3"
+        np.savetxt(path, np.column_stack([y, w, X]), delimiter="\t",
+                   fmt="%.9g", header=header, comments="")
+        from lightgbm_tpu.io.loader import load_text_file
+        X2, y2, w2, _, names = load_text_file(
+            str(path), has_header=True, label_column="name:target",
+            weight_column="name:w")
+        np.testing.assert_allclose(X2, X, rtol=1e-6)
+        np.testing.assert_allclose(w2, w, rtol=1e-6)
+        assert names == ["c0", "c1", "c2", "c3"]
+
+    def test_libsvm(self, tmp_path):
+        path = tmp_path / "train.svm"
+        path.write_text("1 0:1.5 2:3.0\n0 1:2.0\n1 0:0.5 1:1.0 2:-1\n")
+        from lightgbm_tpu.io.loader import load_text_file
+        X, y, _, _, _ = load_text_file(str(path))
+        assert X.shape == (3, 3)
+        np.testing.assert_allclose(y, [1, 0, 1])
+        np.testing.assert_allclose(X[0], [1.5, 0, 3.0])
+
+
+class TestCLI:
+    def test_train_and_predict_tasks(self, tmp_path):
+        X, y = binary_data()
+        data = tmp_path / "train.csv"
+        np.savetxt(data, np.column_stack([y, X]), delimiter=",", fmt="%.9g")
+        conf = tmp_path / "train.conf"
+        model = tmp_path / "model.txt"
+        conf.write_text(
+            f"task = train\ndata = {data}\nobjective = binary\n"
+            f"num_iterations = 10\nnum_leaves = 15\nmax_bin = 31\n"
+            f"min_data_in_leaf = 5\noutput_model = {model}\n"
+            "verbosity = -1\n")
+        from lightgbm_tpu.cli import run
+        assert run([f"config={conf}"]) == 0
+        assert model.exists()
+        out = tmp_path / "pred.txt"
+        assert run([f"task=predict", f"data={data}",
+                    f"input_model={model}", f"output_result={out}"]) == 0
+        pred = np.loadtxt(out)
+        bst = lgb.Booster(model_file=str(model))
+        np.testing.assert_allclose(pred, bst.predict(X), rtol=1e-5, atol=1e-6)
+
+
+class TestPlotting:
+    def test_plot_importance_and_metric(self):
+        X, y = binary_data()
+        rec = {}
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train(_params(objective="binary", metric="binary_logloss"),
+                        ds, 10, valid_sets=[ds], valid_names=["t"],
+                        callbacks=[lgb.record_evaluation(rec)])
+        ax = lgb.plot_importance(bst)
+        assert ax is not None
+        ax2 = lgb.plot_metric(rec)
+        assert ax2 is not None
+        ax3 = lgb.plot_split_value_histogram(bst, 0) if \
+            bst.feature_importance()[0] > 0 else None
+
+    def test_create_tree_digraph(self):
+        X, y = binary_data()
+        bst = lgb.train(_params(objective="binary"), lgb.Dataset(X, label=y), 3)
+        g = lgb.create_tree_digraph(bst, 0)
+        assert "leaf" in g.source

@@ -120,3 +120,23 @@ class TestContinueNumIteration:
         np.testing.assert_allclose(reload10.predict(X),
                                    resumed.predict(X, num_iteration=10),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestContinueStartIteration:
+    def test_start_iteration_counts_from_loaded_trees(self):
+        X, y = regression_data()
+        params = _params(objective="regression", boost_from_average=False)
+        first = lgb.train(params, lgb.Dataset(X, label=y), 10)
+        resumed = lgb.train(params,
+                            lgb.Dataset(X, label=y, free_raw_data=False), 5,
+                            init_model=first)
+        full = resumed.predict(X, raw_score=True)
+        head = resumed.predict(X, raw_score=True, num_iteration=10)
+        tail = resumed.predict(X, raw_score=True, start_iteration=10)
+        # the window starting after the loaded trees == only the new trees
+        np.testing.assert_allclose(head + tail, full, rtol=1e-5, atol=1e-5)
+        mid = resumed.predict(X, raw_score=True, start_iteration=8,
+                              num_iteration=4)
+        rest = (resumed.predict(X, raw_score=True, num_iteration=8)
+                + resumed.predict(X, raw_score=True, start_iteration=12))
+        np.testing.assert_allclose(mid + rest, full, rtol=1e-5, atol=1e-5)
