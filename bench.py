@@ -2,12 +2,13 @@
 
 Reference baseline (BASELINE.md): LightGBM CPU trains Higgs (10.5M rows x 28
 features, num_leaves=255, 500 iters) at ~3.84 iters/s on 2x Xeon E5-2690v4
-(docs/Experiments.rst:113). This bench runs the same shape of problem —
-binary logloss, 28 dense float features — on the TPU chip the driver exposes.
+(docs/Experiments.rst:113). This bench runs the same FULL configuration —
+binary logloss, 28 dense float features, 10.5M rows, 255 leaves, 255 bins —
+on the TPU chip the driver exposes (round 1 ran a 10x-smaller config; the
+compact grower made the full shape tractable, see ops/grower_compact.py).
 
-Round-1 scale: BENCH_ROWS=1e6, num_leaves=31, max_bin=63 (the GPU-doc speed
-setting, docs/GPU-Performance.rst). The scale knobs exist so later rounds can
-push to the full 10.5M x 255-leaf config as the kernel work lands.
+Env knobs (BENCH_ROWS/FEATURES/NUM_LEAVES/MAX_BIN/ITERS/WARMUP) scale it
+down for quick runs.
 
 Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -18,12 +19,12 @@ import time
 
 import numpy as np
 
-ROWS = int(float(os.environ.get("BENCH_ROWS", 1_000_000)))
+ROWS = int(float(os.environ.get("BENCH_ROWS", 10_500_000)))
 FEATURES = int(os.environ.get("BENCH_FEATURES", 28))
-NUM_LEAVES = int(os.environ.get("BENCH_NUM_LEAVES", 31))
-MAX_BIN = int(os.environ.get("BENCH_MAX_BIN", 63))
-ITERS = int(os.environ.get("BENCH_ITERS", 30))
-WARMUP = int(os.environ.get("BENCH_WARMUP", 3))
+NUM_LEAVES = int(os.environ.get("BENCH_NUM_LEAVES", 255))
+MAX_BIN = int(os.environ.get("BENCH_MAX_BIN", 255))
+ITERS = int(os.environ.get("BENCH_ITERS", 15))
+WARMUP = int(os.environ.get("BENCH_WARMUP", 2))
 BASELINE_ITERS_PER_SEC = 3.84  # Higgs-10.5M CPU, docs/Experiments.rst:113
 
 

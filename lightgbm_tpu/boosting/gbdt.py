@@ -87,6 +87,12 @@ def _parse_interactions(value, num_features: int) -> Optional[np.ndarray]:
     return sets
 
 
+def _tree_used_features(tree, nf: int, used: jax.Array) -> jax.Array:
+    """OR the tree's split features into the model-level CEGB used set."""
+    idx = jnp.where(tree.split_feature >= 0, tree.split_feature, nf)
+    return used | jnp.zeros((nf + 1,), bool).at[idx].set(True)[:nf]
+
+
 def _clamp_block(block: int, n: int, floor: int = 128) -> int:
     """Shrink a streaming block size toward the data size (power-of-two)."""
     while block // 2 >= max(n, floor) and block > floor:
@@ -387,6 +393,29 @@ class GBDT:
                             else None)
         self._bynode_key = jax.random.PRNGKey(
             int(cfg.get("feature_fraction_seed", 2)))
+        # CEGB (reference: cost_effective_gradient_boosting.hpp): coupled
+        # feature costs are paid once per model, so the used-feature set
+        # persists across trees
+        tradeoff = float(cfg.get("cegb_tradeoff", 1.0))
+        coupled = cfg.get("cegb_penalty_feature_coupled")
+        split_pen = float(cfg.get("cegb_penalty_split", 0.0))
+        self._use_cegb = split_pen > 0.0 or coupled is not None
+        if cfg.get("cegb_penalty_feature_lazy") is not None:
+            log.warning("cegb_penalty_feature_lazy is not implemented; "
+                        "only split and coupled penalties apply")
+        if coupled is not None:
+            cp = np.asarray(list(coupled), np.float32)
+            if cp.size != nf:
+                raise ValueError(
+                    "cegb_penalty_feature_coupled must have one entry per "
+                    f"feature ({nf}), got {cp.size}")
+            self._cegb_coupled = jnp.asarray(
+                fpad(tradeoff * cp, 0.0)) if self._f_pad else \
+                jnp.asarray(tradeoff * cp)
+        else:
+            self._cegb_coupled = None
+        self._cegb_split_pen = tradeoff * split_pen
+        self._cegb_used = None  # lazily a [F] bool device array
         self.grower_params = GrowerParams(
             num_leaves=self.max_leaves,
             max_depth=int(cfg.get("max_depth", -1)),
@@ -408,6 +437,8 @@ class GBDT:
             path_smooth=float(cfg.get("path_smooth", 0.0)),
             use_interaction=inter_np is not None,
             bynode_fraction=float(cfg.get("feature_fraction_bynode", 1.0)),
+            use_cegb=self._use_cegb,
+            cegb_split_pen=self._cegb_split_pen,
             voting_k=(int(cfg.get("top_k", 20))
                       if self.mesh is not None
                       and self.tree_learner == "voting" else 0),
@@ -497,15 +528,20 @@ class GBDT:
 
         mono_types = self._mono_types
         inter_sets = self._inter_sets
+        cegb_coupled = self._cegb_coupled
+        use_cegb = self._use_cegb
 
         def step(score_k, grad_k, hess_k, mask, feat_mask, shrinkage,
-                 bynode_key):
+                 bynode_key, cegb_used):
             g = grad_k * mask
             h = hess_k * mask
             tree, row_leaf = grow_tree(
                 binned, g, h, mask, num_bins_arr, nan_bin_arr, has_nan_arr,
                 is_cat_arr, feat_mask, grower_params, mono_types,
-                inter_sets, bynode_key)
+                inter_sets, bynode_key, cegb_coupled, cegb_used)
+            if use_cegb:
+                cegb_used = _tree_used_features(tree, binned.shape[1],
+                                                cegb_used)
             if renew:
                 residual = obj.label - score_k
                 w = mask if row_weight is None else mask * row_weight
@@ -522,7 +558,7 @@ class GBDT:
                 leaf_value=lv * shrinkage,
                 internal_value=tree.internal_value * shrinkage)
             new_score = score_k + tree.leaf_value[row_leaf]
-            return tree, row_leaf, new_score
+            return tree, row_leaf, new_score, cegb_used
 
         return jax.jit(step)
 
@@ -610,6 +646,8 @@ class GBDT:
         is_cat_arr = self.is_cat_arr
         mono_types = self._mono_types
         inter_sets = self._inter_sets
+        cegb_coupled = self._cegb_coupled
+        use_cegb = self._use_cegb
         sc_off = layout.extra_off            # K score columns live first
         lbl_off = layout.extra_off + 4 * self._cx_label
         w_off = (layout.extra_off + 4 * self._cx_weight
@@ -626,7 +664,7 @@ class GBDT:
                   if self._cx_grads is not None else None)
 
         def step(work, scratch, scores, bag_w, use_stored_bag, feat_mask,
-                 shrinkage, bynode_key, k):
+                 shrinkage, bynode_key, cegb_used, k):
             pad_n = work.shape[0] - n
 
             def set_col(work, off, vec):     # vec: [n] f32
@@ -665,7 +703,10 @@ class GBDT:
              leaf_nrows) = grow_tree_compact(
                 work, scratch, num_bins_arr, nan_bin_arr, has_nan_arr,
                 is_cat_arr, feat_mask, layout, gp, n,
-                mono_types, inter_sets, bynode_key)
+                mono_types, inter_sets, bynode_key, cegb_coupled, cegb_used)
+            if use_cegb:
+                cegb_used = _tree_used_features(tree, layout.num_features,
+                                                cegb_used)
 
             leaf_value = tree.leaf_value
             if renew:
@@ -686,7 +727,7 @@ class GBDT:
             _, row_delta = segments_to_leaf_vectors(
                 leaf_start, leaf_nrows, lv, n)
             sc = scores_of(work).at[k].add(row_delta)
-            return tree, work, scratch, sc
+            return tree, work, scratch, sc, cegb_used
 
         return jax.jit(step, donate_argnums=(0, 1), static_argnames=("k",))
 
@@ -699,6 +740,11 @@ class GBDT:
             c["perm"] = np.asarray(rid).astype(np.int64)
             c["perm_epoch"] = c["epoch"]
         return c["perm"]
+
+    def _cegb_state(self) -> jax.Array:
+        if self._cegb_used is None:
+            self._cegb_used = jnp.zeros((int(self.binned.shape[1]),), bool)
+        return self._cegb_used
 
     def _compact_gradients(self):
         """Gradients in the current (permuted) row order, for GOSS ranking."""
@@ -749,12 +795,13 @@ class GBDT:
             # trees after the first in an iteration reuse the stored bag
             # (same bag for all trees of one iteration, like the reference)
             use_stored = not (fresh and k == 0)
-            tree, work, scratch, scores = c["step"](
+            (tree, work, scratch, scores,
+             self._cegb_used) = c["step"](
                 c["work"], c["scratch"], self.train_score, mask,
                 jnp.asarray(use_stored), feat_mask,
                 jnp.float32(self.shrinkage_rate),
                 jax.random.fold_in(self._bynode_key, self.num_total_trees),
-                k=k)
+                self._cegb_state(), k=k)
             c["work"], c["scratch"] = work, scratch
             c["epoch"] += 1
             self.train_score = scores
@@ -872,11 +919,12 @@ class GBDT:
             self._step_fn = self._build_step_fn()
 
         for cur_tree_id in range(k):
-            tree, row_leaf, new_score = self._step_fn(
+            tree, row_leaf, new_score, self._cegb_used = self._step_fn(
                 self.train_score[cur_tree_id], grad[cur_tree_id],
                 hess[cur_tree_id], mask, feat_mask,
                 jnp.float32(self.shrinkage_rate),
-                jax.random.fold_in(self._bynode_key, self.num_total_trees))
+                jax.random.fold_in(self._bynode_key, self.num_total_trees),
+                self._cegb_state())
             self.train_score = self.train_score.at[cur_tree_id].set(new_score)
             # valid scores got the init at _boost_from_average already, so the
             # tree must be pushed through them BEFORE the bias fold

@@ -70,7 +70,12 @@ class RF(GBDT):
                 self.is_cat_arr, feat_mask, self.grower_params,
                 self._mono_types, self._inter_sets,
                 _jax.random.fold_in(self._bynode_key, self.num_total_trees),
+                self._cegb_coupled, self._cegb_state(),
             )
+            if self._use_cegb:
+                from .gbdt import _tree_used_features
+                self._cegb_used = _tree_used_features(
+                    tree, int(self.binned.shape[1]), self._cegb_used)
             if int(tree.num_nodes) > 0:
                 tree = self._renew_tree_output(tree, row_leaf, mask, cur_tree_id)
                 # RF folds the init score into every tree (rf.hpp AddBias)

@@ -270,9 +270,6 @@ UNIMPLEMENTED_PARAMS: Dict[str, str] = {
     "max_bin_by_feature": "per-feature bin caps",
     "use_quantized_grad": "quantized-gradient training",
     "linear_tree": "linear leaf models",
-    "cegb_penalty_split": "cost-effective gradient boosting",
-    "cegb_penalty_feature_lazy": "cost-effective gradient boosting",
-    "cegb_penalty_feature_coupled": "cost-effective gradient boosting",
     "feature_contri": "per-feature split-gain scaling",
     "forcedsplits_filename": "forced splits",
     "forcedbins_filename": "forced bin boundaries",

@@ -77,6 +77,8 @@ class GrowerParams(NamedTuple):
     path_smooth: float = 0.0
     use_interaction: bool = False
     bynode_fraction: float = 1.0
+    use_cegb: bool = False
+    cegb_split_pen: float = 0.0
     axis_name: Optional[str] = None
     hist_impl: str = "auto"  # auto | xla | pallas (ops/histogram.py dispatch)
     # compact-grower streaming block sizes (ops/grower_compact.py)
@@ -100,6 +102,8 @@ class GrowerParams(NamedTuple):
             use_monotone=self.use_monotone,
             monotone_penalty=self.monotone_penalty,
             path_smooth=self.path_smooth,
+            use_cegb=self.use_cegb,
+            cegb_split_pen=self.cegb_split_pen,
         )
 
     @property
@@ -178,16 +182,18 @@ class GrowerState(NamedTuple):
     leaf_used: jax.Array       # [L, F] bool
     # output of the parent at leaf creation (path smoothing context)
     leaf_pout: jax.Array       # [L] f32
+    # features already used by any split (CEGB coupled costs paid once)
+    cegb_used: jax.Array       # [F] bool
 
 
 def _leaf_best_split(hist3, pg, ph, pc, feat_info, feat_mask, depth,
                      params: GrowerParams, mono_types=None, cmin=None,
-                     cmax=None, pout=0.0):
+                     cmax=None, pout=0.0, cegb_pen=None):
     num_bins_arr, nan_bin_arr, has_nan_arr, is_cat_arr = feat_info
     sp = best_split(
         hist3, pg, ph, pc,
         num_bins_arr, nan_bin_arr, has_nan_arr, is_cat_arr, feat_mask,
-        params.split_params(), mono_types, cmin, cmax, pout, depth,
+        params.split_params(), mono_types, cmin, cmax, pout, depth, cegb_pen,
     )
     depth_ok = jnp.logical_or(params.max_depth <= 0, depth < params.max_depth)
     return sp._replace(gain=jnp.where(depth_ok, sp.gain, _NEG_INF))
@@ -227,6 +233,8 @@ def grow_tree(
     mono_types: Optional[jax.Array] = None,   # [F] i8 (use_monotone)
     inter_sets: Optional[jax.Array] = None,   # [S, F] bool (use_interaction)
     bynode_key: Optional[jax.Array] = None,   # PRNG key (bynode_fraction<1)
+    cegb_coupled: Optional[jax.Array] = None,  # [F] tradeoff*coupled costs
+    cegb_used0: Optional[jax.Array] = None,    # [F] bool (persisted model-level)
 ):
     """Grow one tree; returns (TreeArrays, row_leaf [N] i32)."""
     n, f = binned.shape
@@ -257,13 +265,18 @@ def grow_tree(
         inter_sets = jnp.zeros((0, f), bool)
     if bynode_key is None:
         bynode_key = jax.random.PRNGKey(0)
+    if cegb_coupled is None:
+        cegb_coupled = jnp.zeros((f,), jnp.float32)
+    if cegb_used0 is None:
+        cegb_used0 = jnp.zeros((f,), bool)
     big = jnp.float32(3.4e38)
 
     # batched best-split over the two fresh children (one fused scan)
-    def two_best_splits(h2, pg2, ph2, pc2, fm2, depth, cmin2, cmax2, pout2):
+    def two_best_splits(h2, pg2, ph2, pc2, fm2, depth, cmin2, cmax2, pout2,
+                        cegb_pen):
         fn = lambda h, pg, ph, pc, fm, cmn, cmx, po: _leaf_best_split(
             h, pg, ph, pc, feat_info, fm, depth, params, mono_types,
-            cmn, cmx, po)
+            cmn, cmx, po, cegb_pen)
         return jax.vmap(fn)(h2, pg2, ph2, pc2, fm2, cmin2, cmax2, pout2)
 
     # ---- root ----
@@ -285,6 +298,7 @@ def grow_tree(
         root_hist, root_g, root_h, root_c, feat_info, root_fm,
         jnp.asarray(0, jnp.int32), params, mono_types,
         -big, big, root_out,
+        cegb_coupled * jnp.logical_not(cegb_used0),
     )
 
     i32 = jnp.int32
@@ -325,6 +339,7 @@ def grow_tree(
         leaf_cmax=jnp.full((L,), 3.4e38, jnp.float32),
         leaf_used=jnp.zeros((L, f), bool),
         leaf_pout=jnp.zeros((L,), jnp.float32).at[0].set(root_out),
+        cegb_used=cegb_used0,
     )
 
     def body(k, st: GrowerState) -> GrowerState:
@@ -452,6 +467,7 @@ def grow_tree(
             jnp.where(applied, used_child, st.leaf_used[best_leaf]))
         leaf_used = leaf_used.at[new_leaf].set(
             jnp.where(applied, used_child, leaf_used[new_leaf]))
+        cegb_used = st.cegb_used | (applied & (jnp.arange(f) == f_))
 
         # ---- children histograms + best splits (skipped when done) ----
         bs_arrays = (st.leaf_hist, st.bs_gain, st.bs_feature, st.bs_bin,
@@ -496,7 +512,8 @@ def grow_tree(
                 h2, jnp.stack([lg, rg]), jnp.stack([lh, rh]),
                 jnp.stack([lc, rc]), jnp.stack([fm_l, fm_r]), d_child,
                 jnp.stack([cmin_l, cmin_r]), jnp.stack([cmax_l, cmax_r]),
-                jnp.stack([lw, rw]))
+                jnp.stack([lw, rw]),
+                cegb_coupled * jnp.logical_not(cegb_used))
             bs_gain = bs_gain.at[best_leaf].set(sp.gain[0]).at[new_leaf].set(sp.gain[1])
             bs_feature = bs_feature.at[best_leaf].set(sp.feature[0]).at[new_leaf].set(sp.feature[1])
             bs_bin = bs_bin.at[best_leaf].set(sp.bin[0]).at[new_leaf].set(sp.bin[1])
@@ -550,6 +567,7 @@ def grow_tree(
             leaf_cmax=leaf_cmax,
             leaf_used=leaf_used,
             leaf_pout=leaf_pout,
+            cegb_used=cegb_used,
         )
 
     st = lax.fori_loop(0, L - 1, body, st)

@@ -87,6 +87,7 @@ class CompactState(NamedTuple):
     leaf_cmax: jnp.ndarray     # [L] f32
     leaf_used: jnp.ndarray     # [L, F] bool path features (interaction)
     leaf_pout: jnp.ndarray     # [L] f32 smoothing context
+    cegb_used: jnp.ndarray     # [F] bool (CEGB coupled costs paid once)
 
 
 @functools.partial(jax.jit,
@@ -105,6 +106,8 @@ def grow_tree_compact(
     mono_types: jnp.ndarray = None,
     inter_sets: jnp.ndarray = None,
     bynode_key: jnp.ndarray = None,
+    cegb_coupled: jnp.ndarray = None,
+    cegb_used0: jnp.ndarray = None,
 ):
     """Grow one tree; returns (TreeArrays, row_leaf [N], work', scratch',
     leaf_start [L], leaf_nrows [L]) — per-row outputs in the post-tree
@@ -124,11 +127,15 @@ def grow_tree_compact(
         inter_sets = jnp.zeros((0, F), bool)
     if bynode_key is None:
         bynode_key = jax.random.PRNGKey(0)
+    if cegb_coupled is None:
+        cegb_coupled = jnp.zeros((F,), jnp.float32)
+    if cegb_used0 is None:
+        cegb_used0 = jnp.zeros((F,), bool)
     big = jnp.float32(3.4e38)
 
-    def leaf_best(hist, pg, ph, pc, depth, fm, cmn, cmx, po):
+    def leaf_best(hist, pg, ph, pc, depth, fm, cmn, cmx, po, cegb_pen=None):
         sp = best_split(hist, pg, ph, pc, *feat_info, fm, sp_params,
-                        mono_types, cmn, cmx, po, depth)
+                        mono_types, cmn, cmx, po, depth, cegb_pen)
         depth_ok = jnp.logical_or(params.max_depth <= 0,
                                   depth < params.max_depth)
         return sp._replace(gain=jnp.where(depth_ok, sp.gain, _NEG_INF))
@@ -152,7 +159,8 @@ def grow_tree_compact(
     # (reference: GetParentOutput, serial_tree_learner.cpp:1005-1016)
     root_out = leaf_output(root_g, root_h, sp_params)
     sp0 = leaf_best(root_hist, root_g, root_h, root_c, jnp.asarray(0, i32),
-                    root_fm, -big, big, root_out)
+                    root_fm, -big, big, root_out,
+                    cegb_coupled * jnp.logical_not(cegb_used0))
 
     W = params.bitset_words
     st = CompactState(
@@ -195,6 +203,7 @@ def grow_tree_compact(
         leaf_cmax=jnp.full((L,), 3.4e38, jnp.float32),
         leaf_used=jnp.zeros((L, F), bool),
         leaf_pout=jnp.zeros((L,), jnp.float32).at[0].set(root_out),
+        cegb_used=cegb_used0,
     )
 
     def body(k, st: CompactState) -> CompactState:
@@ -307,6 +316,7 @@ def grow_tree_compact(
             jnp.where(applied, used_child, st.leaf_used[best_leaf]))
         leaf_used = leaf_used.at[new_leaf].set(
             jnp.where(applied, used_child, leaf_used[new_leaf]))
+        cegb_used = st.cegb_used | (applied & (jnp.arange(F) == f_))
 
         # ---- physical partition + children histograms + best splits ----
         s_ = st.leaf_start[best_leaf]
@@ -352,10 +362,11 @@ def grow_tree_compact(
             fm_r = node_feature_mask(
                 feat_mask, used_child, inter_sets,
                 jax.random.fold_in(bynode_key, 2 * k + 2), params)
+            pen = cegb_coupled * jnp.logical_not(cegb_used)
             spl = leaf_best(hist_left, lg, lh, lc, d_child, fm_l,
-                            cmin_l, cmax_l, lw)
+                            cmin_l, cmax_l, lw, pen)
             spr = leaf_best(hist_right, rg, rh, rc, d_child, fm_r,
-                            cmin_r, cmax_r, rw)
+                            cmin_r, cmax_r, rw, pen)
             for leaf, sp in ((best_leaf, spl), (new_leaf, spr)):
                 bs_gain = bs_gain.at[leaf].set(sp.gain)
                 bs_feature = bs_feature.at[leaf].set(sp.feature)
@@ -415,6 +426,7 @@ def grow_tree_compact(
             leaf_cmax=leaf_cmax,
             leaf_used=leaf_used,
             leaf_pout=leaf_pout,
+            cegb_used=cegb_used,
         )
 
     st = lax.fori_loop(0, L - 1, body, st)

@@ -55,6 +55,11 @@ class SplitParams(NamedTuple):
     # path smoothing (reference: CalculateSplittedLeafOutput USE_SMOOTHING,
     # feature_histogram.hpp: w*(n/s)/(n/s+1) + parent/(n/s+1))
     path_smooth: float = 0.0
+    # cost-effective gradient boosting (reference:
+    # cost_effective_gradient_boosting.hpp DeltaGain — per-split data cost +
+    # one-time coupled feature-acquisition cost, both scaled by tradeoff)
+    use_cegb: bool = False
+    cegb_split_pen: float = 0.0    # tradeoff * cegb_penalty_split
 
 
 class SplitResult(NamedTuple):
@@ -202,6 +207,7 @@ def best_split(
     cmax: Optional[jnp.ndarray] = None,
     parent_output: float = 0.0,                 # for path smoothing
     depth: Optional[jnp.ndarray] = None,        # for the monotone penalty
+    cegb_pen: Optional[jnp.ndarray] = None,     # [F] remaining coupled costs
 ) -> SplitResult:
     """Find the best (feature, threshold, direction) for one leaf."""
     f, b, k = hist.shape
@@ -271,6 +277,10 @@ def best_split(
                     gain = jnp.where(mt != 0, gain * pen, gain)
         else:
             gain = leaf_gain(lg, lh, p) + leaf_gain(rg, rh, p) - gain_shift
+        if p.use_cegb and cegb_pen is not None:
+            # (reference: CostEfficientGradientBoosting::DeltaGain)
+            gain = gain - cegb_pen[:, None] \
+                - p.cegb_split_pen * parent_count
         return jnp.where(valid, gain, _NEG_INF)
 
     # categorical one-hot splits (only for low-cardinality features,
@@ -310,7 +320,7 @@ def best_split(
     cs, cbest = _sorted_cat_split(
         g, h, c, r, is_cat, num_bins, feat_mask, parent_grad, parent_hess,
         parent_count, gain_shift, p, parent_output, cmin,
-        cmax) if sorted_any else (None, None)
+        cmax, cegb_pen) if sorted_any else (None, None)
     if cs is not None:
         use_sorted = cbest["gain"] > best_gain
     else:
@@ -352,7 +362,7 @@ def best_split(
 
 def _sorted_cat_split(g, h, c, r, is_cat, num_bins, feat_mask, parent_grad,
                       parent_hess, parent_count, gain_shift, p: SplitParams,
-                      parent_output=0.0, cmin=None, cmax=None):
+                      parent_output=0.0, cmin=None, cmax=None, cegb_pen=None):
     """Best sorted-many-category split over all features; returns
     (True, dict) or (None, None) when no feature qualifies statically."""
     f, b = g.shape
@@ -444,6 +454,9 @@ def _sorted_cat_split(g, h, c, r, is_cat, num_bins, feat_mask, parent_grad,
     else:
         gains = leaf_gain(lg_t, lh_t, p, l2c) + leaf_gain(rg_t, rh_t, p, l2c) \
             - gain_shift
+    if p.use_cegb and cegb_pen is not None:
+        gains = gains - cegb_pen[:, None, None] \
+            - p.cegb_split_pen * parent_count
     gains = jnp.where(evald, gains, _NEG_INF)
 
     flatc = gains.reshape(-1)

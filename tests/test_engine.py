@@ -540,3 +540,37 @@ class TestRankingScale:
                         ds, 30, valid_sets=[ds], valid_names=["t"],
                         callbacks=[lgb.record_evaluation(rec)])
         assert rec["t"]["ndcg@5"][-1] > 0.9
+
+
+class TestCEGB:
+    """Cost-effective gradient boosting (reference:
+    cost_effective_gradient_boosting.hpp)."""
+
+    def test_coupled_penalty_limits_features(self):
+        import lightgbm_tpu as lgb
+        from tests.utils import FAST_PARAMS, regression_data
+        X, y = regression_data()
+        base = dict(FAST_PARAMS, objective="regression")
+        plain = lgb.train(base, lgb.Dataset(X, label=y), 10)
+        pen = lgb.train(dict(base, cegb_tradeoff=1.0,
+                             cegb_penalty_feature_coupled=[1e5] * X.shape[1]),
+                        lgb.Dataset(X, label=y), 10)
+
+        def nfeat(bst):
+            return len(set(int(f) for m in bst._gbdt.models
+                           for f in m.split_feature[:m.num_nodes]))
+
+        assert nfeat(pen) < nfeat(plain)
+        # still learns with the features it pays for
+        assert np.mean((pen.predict(X) - y) ** 2) < np.var(y)
+
+    def test_split_penalty_prunes(self):
+        import lightgbm_tpu as lgb
+        from tests.utils import FAST_PARAMS, regression_data
+        X, y = regression_data()
+        base = dict(FAST_PARAMS, objective="regression")
+        plain = lgb.train(base, lgb.Dataset(X, label=y), 10)
+        pen = lgb.train(dict(base, cegb_penalty_split=1e4),
+                        lgb.Dataset(X, label=y), 10)
+        assert sum(m.num_nodes for m in pen._gbdt.models) < \
+            sum(m.num_nodes for m in plain._gbdt.models)
