@@ -110,6 +110,24 @@ class Dataset:
         if self._inner is not None:
             return self
         cfg = Config(self.params)
+        if isinstance(self.data, str) and (self.data.endswith(".npz")
+                                           or self.data.endswith(".bin")):
+            # binary dataset reload (reference: DatasetLoader::LoadFromBinFile)
+            self._inner = BinnedDataset.load_binary(self.data)
+            md = self._inner.metadata
+            if self.label is not None:
+                md.set_label(_maybe_series(self.label))
+            if self.weight is not None:
+                md.set_weight(_maybe_series(self.weight))
+            if self.group is not None:
+                md.set_group(self.group)
+            if self.init_score is not None:
+                md.set_init_score(self.init_score)
+            if self.position is not None:
+                md.set_position(self.position)
+            if self.free_raw_data:
+                self.data = None
+            return self
         ref_inner = None
         if self.reference is not None:
             self.reference.construct()
@@ -180,6 +198,13 @@ class Dataset:
         self.position = position
         if self._inner is not None:
             self._inner.metadata.set_position(position)
+        return self
+
+    def save_binary(self, filename: str) -> "Dataset":
+        """Persist the constructed dataset (reference: Dataset.save_binary ->
+        LGBM_DatasetSaveBinary; reload by passing the file path as data)."""
+        self.construct()
+        self._inner.save_binary(filename)
         return self
 
     def get_label(self):

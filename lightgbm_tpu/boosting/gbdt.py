@@ -87,6 +87,32 @@ def _parse_interactions(value, num_features: int) -> Optional[np.ndarray]:
     return sets
 
 
+def _quantize_gradients(grad, hess, key, num_bins: int, stochastic: bool,
+                        const_hess: bool):
+    """Quantized-gradient training (reference:
+    GradientDiscretizer::DiscretizeGradients, gradient_discretizer.cpp):
+    gradients snap to num_grad_quant_bins levels of max|g|/(bins/2) with
+    stochastic rounding. Quantized values are kept DE-quantized in f32
+    (exact integer multiples of the scale), so the histogram pipeline is
+    unchanged while the training statistics match the reference's
+    coarse-gradient regularization."""
+    gmax = jnp.max(jnp.abs(grad))
+    hmax = jnp.max(jnp.abs(hess))
+    g_scale = jnp.maximum(gmax / (num_bins // 2), 1e-30)
+    h_scale = jnp.maximum(
+        hmax if const_hess else hmax / num_bins, 1e-30)
+    if stochastic:
+        kg, kh = jax.random.split(key)
+        ug = jax.random.uniform(kg, grad.shape)
+        uh = jax.random.uniform(kh, hess.shape)
+        qg = jnp.trunc(grad / g_scale + jnp.sign(grad) * ug)
+        qh = jnp.trunc(hess / h_scale + uh)
+    else:
+        qg = jnp.trunc(grad / g_scale + jnp.sign(grad) * 0.5)
+        qh = jnp.trunc(hess / h_scale + 0.5)
+    return qg * g_scale, qh * h_scale
+
+
 def _tree_used_features(tree, nf: int, used: jax.Array) -> jax.Array:
     """OR the tree's split features into the model-level CEGB used set."""
     idx = jnp.where(tree.split_feature >= 0, tree.split_feature, nf)
@@ -416,6 +442,13 @@ class GBDT:
             self._cegb_coupled = None
         self._cegb_split_pen = tradeoff * split_pen
         self._cegb_used = None  # lazily a [F] bool device array
+        # quantized-gradient training (reference: gradient_discretizer.cpp)
+        self._use_quant = bool(cfg.get("use_quantized_grad", False))
+        self._quant_bins = int(cfg.get("num_grad_quant_bins", 4))
+        self._quant_renew = bool(cfg.get("quant_train_renew_leaf", False))
+        self._quant_stochastic = bool(cfg.get("stochastic_rounding", True))
+        self._quant_key = jax.random.PRNGKey(
+            int(cfg.get("seed", 0) or 0) + 1337)
         self.grower_params = GrowerParams(
             num_leaves=self.max_leaves,
             max_depth=int(cfg.get("max_depth", -1)),
@@ -530,9 +563,17 @@ class GBDT:
         inter_sets = self._inter_sets
         cegb_coupled = self._cegb_coupled
         use_cegb = self._use_cegb
+        use_quant = self._use_quant
+        quant_renew = use_quant and self._quant_renew
+        quant_bins = self._quant_bins
+        quant_stoch = self._quant_stochastic
+        const_hess = bool(getattr(obj, "is_constant_hessian", False))
 
         def step(score_k, grad_k, hess_k, mask, feat_mask, shrinkage,
-                 bynode_key, cegb_used):
+                 bynode_key, cegb_used, true_grad_k, true_hess_k):
+            # grad_k/hess_k arrive already quantized when use_quantized_grad
+            # (once per iteration over all classes, like the reference's
+            # GradientDiscretizer); true_* carry the originals for renewal
             g = grad_k * mask
             h = hess_k * mask
             tree, row_leaf = grow_tree(
@@ -542,6 +583,18 @@ class GBDT:
             if use_cegb:
                 cegb_used = _tree_used_features(tree, binned.shape[1],
                                                 cegb_used)
+            if quant_renew:
+                # re-fit leaf outputs from the TRUE gradient sums
+                # (reference: RenewIntGradTreeOutput, gbdt.cpp)
+                tg = true_grad_k * mask
+                th = true_hess_k * mask
+                sums_g = jnp.zeros((max_leaves,)).at[row_leaf].add(tg)
+                sums_h = jnp.zeros((max_leaves,)).at[row_leaf].add(th)
+                from ..ops.split import leaf_output as _lo
+                live = jnp.arange(max_leaves) < tree.num_leaves
+                tree = tree._replace(leaf_value=jnp.where(
+                    live, _lo(sums_g, sums_h, grower_params.split_params()),
+                    tree.leaf_value))
             if renew:
                 residual = obj.label - score_k
                 w = mask if row_weight is None else mask * row_weight
@@ -648,6 +701,17 @@ class GBDT:
         inter_sets = self._inter_sets
         cegb_coupled = self._cegb_coupled
         use_cegb = self._use_cegb
+        use_quant = self._use_quant
+        quant_renew = use_quant and self._quant_renew
+        if quant_renew and k_total > 1:
+            # multiclass renewal needs iteration-start gradients, which are
+            # not carried post-permutation; masked grower supports it
+            log.warning("quant_train_renew_leaf with num_class>1 is only "
+                        "supported by tpu_grower=masked; skipping renewal")
+            quant_renew = False
+        quant_bins = self._quant_bins
+        quant_stoch = self._quant_stochastic
+        const_hess = bool(getattr(obj, "is_constant_hessian", False))
         sc_off = layout.extra_off            # K score columns live first
         lbl_off = layout.extra_off + 4 * self._cx_label
         w_off = (layout.extra_off + 4 * self._cx_weight
@@ -664,7 +728,7 @@ class GBDT:
                   if self._cx_grads is not None else None)
 
         def step(work, scratch, scores, bag_w, use_stored_bag, feat_mask,
-                 shrinkage, bynode_key, cegb_used, k):
+                 shrinkage, bynode_key, cegb_used, quant_key, k):
             pad_n = work.shape[0] - n
 
             def set_col(work, off, vec):     # vec: [n] f32
@@ -677,6 +741,9 @@ class GBDT:
             weight = col(work, w_off) if w_off is not None else None
             if k_total == 1:
                 g, h = _bound_gradients(obj, k_total, scores, label, weight)
+                if use_quant:
+                    g, h = _quantize_gradients(
+                        g, h, quant_key, quant_bins, quant_stoch, const_hess)
                 g_k, h_k = g[0], h[0]
             elif k == 0:
                 # all K class gradients once per iteration, from the
@@ -684,6 +751,9 @@ class GBDT:
                 # before the per-class tree loop, gbdt.cpp:220); stored in
                 # carried columns so later trees see them permutation-aligned
                 g, h = _bound_gradients(obj, k_total, scores, label, weight)
+                if use_quant:
+                    g, h = _quantize_gradients(
+                        g, h, quant_key, quant_bins, quant_stoch, const_hess)
                 for j in range(k_total):
                     work = set_col(work, gx_off + 4 * j, g[j])
                     work = set_col(work, gx_off + 4 * (k_total + j), h[j])
@@ -720,6 +790,26 @@ class GBDT:
                 live = jnp.arange(max_leaves) < tree.num_leaves
                 leaf_value = jnp.where(live, renewed, leaf_value)
 
+            if quant_renew:
+                # TRUE gradients from carried label/score columns, summed
+                # per contiguous leaf segment via cumsum differences
+                # (reference: RenewIntGradTreeOutput)
+                tg, th = _bound_gradients(
+                    obj, k_total, scores_of(work),
+                    col(work, lbl_off),
+                    col(work, w_off) if w_off is not None else None)
+                wq = col(work, layout.cnt_off)
+                tgk = tg[k] * wq
+                thk = th[k] * wq
+                csg = jnp.concatenate([jnp.zeros(1), jnp.cumsum(tgk)])
+                csh = jnp.concatenate([jnp.zeros(1), jnp.cumsum(thk)])
+                ends = jnp.minimum(leaf_start + leaf_nrows, n)
+                sums_g = csg[ends] - csg[jnp.minimum(leaf_start, n)]
+                sums_h = csh[ends] - csh[jnp.minimum(leaf_start, n)]
+                from ..ops.split import leaf_output as _lo
+                live = jnp.arange(max_leaves) < tree.num_leaves
+                leaf_value = jnp.where(
+                    live, _lo(sums_g, sums_h, gp.split_params()), leaf_value)
             lv = jnp.where(tree.num_nodes > 0, leaf_value, 0.0) * shrinkage
             tree = tree._replace(
                 leaf_value=lv,
@@ -801,7 +891,8 @@ class GBDT:
                 jnp.asarray(use_stored), feat_mask,
                 jnp.float32(self.shrinkage_rate),
                 jax.random.fold_in(self._bynode_key, self.num_total_trees),
-                self._cegb_state(), k=k)
+                self._cegb_state(),
+                jax.random.fold_in(self._quant_key, self.iter_), k=k)
             c["work"], c["scratch"] = work, scratch
             c["epoch"] += 1
             self.train_score = scores
@@ -917,6 +1008,15 @@ class GBDT:
         first_iter = self.num_total_trees < self.num_tree_per_iteration
         if self._step_fn is None:
             self._step_fn = self._build_step_fn()
+        true_grad, true_hess = grad, hess
+        if self._use_quant:
+            # one global-scale quantization per iteration over all classes
+            # (reference: DiscretizeGradients on the full k*N buffer)
+            grad, hess = _quantize_gradients(
+                grad, hess,
+                jax.random.fold_in(self._quant_key, self.iter_),
+                self._quant_bins, self._quant_stochastic,
+                bool(getattr(self.objective, "is_constant_hessian", False)))
 
         for cur_tree_id in range(k):
             tree, row_leaf, new_score, self._cegb_used = self._step_fn(
@@ -924,7 +1024,8 @@ class GBDT:
                 hess[cur_tree_id], mask, feat_mask,
                 jnp.float32(self.shrinkage_rate),
                 jax.random.fold_in(self._bynode_key, self.num_total_trees),
-                self._cegb_state())
+                self._cegb_state(),
+                true_grad[cur_tree_id], true_hess[cur_tree_id])
             self.train_score = self.train_score.at[cur_tree_id].set(new_score)
             # valid scores got the init at _boost_from_average already, so the
             # tree must be pushed through them BEFORE the bias fold

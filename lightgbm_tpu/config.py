@@ -268,7 +268,6 @@ METRIC_ALIASES: Dict[str, str] = {
 UNIMPLEMENTED_PARAMS: Dict[str, str] = {
     "extra_trees": "extremely randomized trees",
     "max_bin_by_feature": "per-feature bin caps",
-    "use_quantized_grad": "quantized-gradient training",
     "linear_tree": "linear leaf models",
     "feature_contri": "per-feature split-gain scaling",
     "forcedsplits_filename": "forced splits",

@@ -37,6 +37,11 @@ def _to_2d_float(data: Any) -> np.ndarray:
     LGBM_DatasetCreateFromCSR/CSC, src/c_api.cpp)."""
     if hasattr(data, "tocsr") and hasattr(data, "toarray"):  # scipy.sparse
         arr = data.toarray()
+    elif type(data).__module__.startswith("pyarrow"):
+        # Arrow Table/RecordBatch ingestion (reference:
+        # LGBM_DatasetCreateFromArrow, include/LightGBM/arrow.h)
+        arr = np.column_stack([
+            np.asarray(data.column(i)) for i in range(data.num_columns)])
     elif hasattr(data, "values") and hasattr(data, "columns"):  # pandas
         arr = data.values
     else:
@@ -50,6 +55,8 @@ def _to_2d_float(data: Any) -> np.ndarray:
 
 
 def _feature_names_of(data: Any, num_features: int) -> List[str]:
+    if hasattr(data, "column_names"):  # pyarrow Table / RecordBatch
+        return [str(c) for c in data.column_names]
     if hasattr(data, "columns"):
         return [str(c) for c in data.columns]
     return [f"Column_{i}" for i in range(num_features)]
@@ -132,6 +139,73 @@ class BinnedDataset:
         self.used_features: List[int] = []         # non-trivial feature indices
         self.categorical_features: List[int] = []
         self.raw_data: Optional[np.ndarray] = None  # kept only if needed (linear trees)
+
+    # -- binary serialization (reference: Dataset::SaveBinaryFile,
+    # src/io/dataset.cpp / DatasetLoader::LoadFromBinFile :417) -------------
+    def save_binary(self, path: str) -> None:
+        """Save the constructed dataset (bins + mappers + metadata) so later
+        runs skip text parsing and re-binning."""
+        import pickle
+        mapper_blobs = [{
+            "num_bins": m.num_bins, "is_categorical": m.is_categorical,
+            "missing_type": m.missing_type,
+            "bin_upper_bounds": m.bin_upper_bounds,
+            "cat_to_bin": m.cat_to_bin, "bin_to_cat": m.bin_to_cat,
+            "default_bin": m.default_bin,
+            "min_value": m.min_value, "max_value": m.max_value,
+        } for m in self.mappers]
+        md = self.metadata
+        # np.savez appends '.npz' to bare paths; write via a handle so the
+        # requested filename (e.g. train.bin) is used verbatim
+        fh = open(path, "wb")
+        np.savez_compressed(
+            fh,
+            magic=np.frombuffer(b"lgbtpu.bin.v1\x00\x00\x00", np.uint8),
+            binned=self.binned,
+            feature_names=np.asarray(self.feature_names),
+            max_num_bins=self.max_num_bins,
+            num_data=self.num_data,
+            num_total_features=self.num_total_features,
+            used_features=np.asarray(self.used_features, np.int64),
+            categorical_features=np.asarray(self.categorical_features,
+                                            np.int64),
+            mappers=np.frombuffer(pickle.dumps(mapper_blobs), np.uint8),
+            label=md.label if md.label is not None else np.zeros(0),
+            weight=md.weight if md.weight is not None else np.zeros(0),
+            init_score=(md.init_score if md.init_score is not None
+                        else np.zeros(0)),
+            group=md.group if md.group is not None else np.zeros(0, np.int64),
+            position=(md.position if md.position is not None
+                      else np.zeros(0)),
+        )
+        fh.close()
+
+    @staticmethod
+    def load_binary(path: str) -> "BinnedDataset":
+        import pickle
+        from .binning import BinMapper
+        z = np.load(path, allow_pickle=False)
+        if bytes(z["magic"].tobytes())[:13] != b"lgbtpu.bin.v1":
+            raise ValueError(f"{path} is not a lightgbm_tpu binary dataset")
+        ds = BinnedDataset()
+        ds.binned = z["binned"]
+        ds.feature_names = [str(x) for x in z["feature_names"]]
+        ds.max_num_bins = int(z["max_num_bins"])
+        ds.num_data = int(z["num_data"])
+        ds.num_total_features = int(z["num_total_features"])
+        ds.used_features = [int(i) for i in z["used_features"]]
+        ds.categorical_features = [int(i) for i in z["categorical_features"]]
+        ds.mappers = [BinMapper(**blob)
+                      for blob in pickle.loads(z["mappers"].tobytes())]
+        md = Metadata(ds.num_data)
+        for name in ("label", "weight", "init_score", "position"):
+            arr = z[name]
+            if arr.size:
+                setattr(md, name, arr)
+        if z["group"].size:
+            md.set_group(z["group"])
+        ds.metadata = md
+        return ds
 
     # -- construction -------------------------------------------------------
     @staticmethod

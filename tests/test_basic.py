@@ -204,10 +204,10 @@ class TestConfigWarnings:
         _log.set_verbosity(1)  # earlier tests may have silenced warnings
         with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
             Config({"linear_tree": True,
-                    "use_quantized_grad": True})
+                    "forcedsplits_filename": "f.json"})
         text = caplog.text
         for name in ("linear_tree",
-                     "use_quantized_grad"):
+                     "forcedsplits_filename"):
             assert f"{name}=" in text and "NOT implemented" in text, \
                 f"no warning for {name}: {text!r}"
 

@@ -123,3 +123,40 @@ class TestPlotting:
         bst = lgb.train(_params(objective="binary"), lgb.Dataset(X, label=y), 3)
         g = lgb.create_tree_digraph(bst, 0)
         assert "leaf" in g.source
+
+
+class TestBinaryDatasetAndArrow:
+    def test_save_binary_roundtrip(self, tmp_path):
+        X, y = binary_data()
+        w = np.random.RandomState(1).rand(len(y))
+        ds = lgb.Dataset(X, label=y, weight=w)
+        path = str(tmp_path / "ds.npz")
+        ds.save_binary(path)
+        ds2 = lgb.Dataset(path)
+        bst1 = lgb.train(_params(objective="binary"), ds, 10)
+        bst2 = lgb.train(_params(objective="binary"), ds2, 10)
+        np.testing.assert_allclose(bst2.predict(X), bst1.predict(X),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_arrow_table_input(self):
+        import pyarrow as pa
+        X, y = binary_data()
+        table = pa.table({f"c{i}": X[:, i] for i in range(X.shape[1])})
+        bst = lgb.train(_params(objective="binary"),
+                        lgb.Dataset(table, label=y), 10)
+        from sklearn.metrics import roc_auc_score
+        assert roc_auc_score(y, bst.predict(X)) > 0.95
+
+    def test_save_binary_bin_extension_and_arrow_names(self, tmp_path):
+        import pyarrow as pa
+        X, y = binary_data()
+        ds = lgb.Dataset(X, label=y)
+        path = str(tmp_path / "train.bin")     # reference's canonical name
+        ds.save_binary(path)
+        assert os.path.exists(path)
+        bst = lgb.train(_params(objective="binary"), lgb.Dataset(path), 5)
+        assert bst.num_trees() == 5
+        table = pa.table({"alpha": X[:, 0], "beta": X[:, 1]})
+        ds2 = lgb.Dataset(table, label=y)
+        ds2.construct()
+        assert ds2._inner.feature_names == ["alpha", "beta"]

@@ -574,3 +574,46 @@ class TestCEGB:
                         lgb.Dataset(X, label=y), 10)
         assert sum(m.num_nodes for m in pen._gbdt.models) < \
             sum(m.num_nodes for m in plain._gbdt.models)
+
+
+class TestQuantizedTraining:
+    """use_quantized_grad (reference: gradient_discretizer.cpp)."""
+
+    @pytest.mark.parametrize("grower", ["masked", "compact"])
+    def test_quantized_matches_quality(self, grower):
+        import lightgbm_tpu as lgb
+        from sklearn.metrics import roc_auc_score
+        from tests.utils import FAST_PARAMS, binary_data, \
+            train_test_split_simple
+        X, y = binary_data()
+        Xtr, ytr, Xte, yte = train_test_split_simple(X, y)
+        base = dict(FAST_PARAMS, objective="binary", tpu_grower=grower,
+                    tpu_part_block=128, tpu_hist_block=256)
+        full = lgb.train(base, lgb.Dataset(Xtr, label=ytr), 25)
+        quant = lgb.train(dict(base, use_quantized_grad=True),
+                          lgb.Dataset(Xtr, label=ytr), 25)
+        a_full = roc_auc_score(yte, full.predict(Xte))
+        a_quant = roc_auc_score(yte, quant.predict(Xte))
+        assert a_quant > a_full - 0.02            # coarse grads, close quality
+        # quantization really happened: different trees
+        assert not np.allclose(quant.predict(Xte), full.predict(Xte))
+
+    def test_renew_leaf_is_newton_optimal(self):
+        """With identical quantized growth, renewed leaf values are the true
+        Newton outputs, so one full-step iteration cannot fit worse
+        (reference: RenewIntGradTreeOutput)."""
+        import lightgbm_tpu as lgb
+        from tests.utils import FAST_PARAMS, regression_data
+        X, y = regression_data()
+        base = dict(FAST_PARAMS, objective="regression",
+                    use_quantized_grad=True, num_grad_quant_bins=4,
+                    learning_rate=1.0, boost_from_average=False)
+        plain = lgb.train(base, lgb.Dataset(X, label=y), 1)
+        renew = lgb.train(dict(base, quant_train_renew_leaf=True),
+                          lgb.Dataset(X, label=y), 1)
+        tq, tr = plain._gbdt.models[0], renew._gbdt.models[0]
+        np.testing.assert_array_equal(tq.split_feature, tr.split_feature)
+        assert not np.allclose(tq.leaf_value, tr.leaf_value)
+        mse_plain = float(np.mean((plain.predict(X) - y) ** 2))
+        mse_renew = float(np.mean((renew.predict(X) - y) ** 2))
+        assert mse_renew <= mse_plain + 1e-6
