@@ -69,7 +69,7 @@ class Dataset:
     _DATASET_PARAM_KEYS = (
         "max_bin", "min_data_in_bin", "bin_construct_sample_cnt",
         "use_missing", "zero_as_missing", "data_random_seed",
-        "feature_pre_filter", "max_bin_by_feature")
+        "feature_pre_filter", "max_bin_by_feature", "linear_tree")
 
     def _update_params(self, params: Optional[Dict[str, Any]]) -> "Dataset":
         """Merge binning params from a Booster into a not-yet-constructed
@@ -147,7 +147,10 @@ class Dataset:
             feature_names=feature_names,
             data_random_seed=cfg.get("data_random_seed", 1),
             reference=ref_inner,
-            keep_raw=not self.free_raw_data,
+            # linear leaves fit against raw values (reference keeps raw data
+            # when linear_tree is set, dataset.h raw_data_)
+            keep_raw=not self.free_raw_data
+            or bool(cfg.get("linear_tree", False)),
         )
         md = self._inner.metadata
         if self.label is not None:
@@ -511,6 +514,15 @@ class Booster:
     ) -> np.ndarray:
         """(reference: Booster.predict, basic.py:4701 → Predictor)"""
         inner = self._gbdt
+        # params-level prediction controls (reference: start_iteration_predict
+        # / num_iteration_predict, config.h predict section)
+        src = self.params or {}
+        if start_iteration == 0 and int(src.get("start_iteration_predict",
+                                                0) or 0) > 0:
+            start_iteration = int(src["start_iteration_predict"])
+        if num_iteration is None and int(src.get("num_iteration_predict",
+                                                 -1) or -1) > 0:
+            num_iteration = int(src["num_iteration_predict"])
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration > 0 else None
         arr = np.asarray(_maybe_series(data), dtype=np.float64)
@@ -581,6 +593,9 @@ class Booster:
             raise NotImplementedError(
                 "pred_contrib on loaded models: retrain or load with a "
                 "training dataset attached")
+        if getattr(g, "_linear", False):
+            raise NotImplementedError(
+                "pred_contrib is not supported with linear_tree")
         if getattr(self, "_pre_model", None) is not None:
             raise NotImplementedError(
                 "pred_contrib on continue-trained boosters is not "

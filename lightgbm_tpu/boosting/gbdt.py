@@ -133,7 +133,9 @@ class HostTree:
                  "default_left", "left_child", "right_child", "leaf_value",
                  "leaf_weight", "leaf_count", "leaf_parent", "leaf_depth",
                  "internal_value", "internal_weight", "internal_count",
-                 "num_leaves", "num_nodes", "shrinkage")
+                 "num_leaves", "num_nodes", "shrinkage",
+                 # linear leaves (boosting/linear.py)
+                 "is_linear", "leaf_const", "leaf_features", "leaf_coeff")
 
     def __init__(self, tree: TreeArrays, shrinkage: float = 1.0):
         self.split_feature = np.asarray(tree.split_feature)
@@ -154,6 +156,7 @@ class HostTree:
         self.num_leaves = int(tree.num_leaves)
         self.num_nodes = int(tree.num_nodes)
         self.shrinkage = shrinkage
+        self.is_linear = False
 
     def scale(self, factor: float) -> None:
         """(reference: Tree::Shrinkage, tree.h:185)"""
@@ -443,6 +446,21 @@ class GBDT:
         self._cegb_split_pen = tradeoff * split_pen
         self._cegb_used = None  # lazily a [F] bool device array
         # quantized-gradient training (reference: gradient_discretizer.cpp)
+        self._linear = bool(cfg.get("linear_tree", False)) \
+            and self.mesh is None and self.boosting_type == "gbdt"
+        if bool(cfg.get("linear_tree", False)) \
+                and self.boosting_type != "gbdt":
+            log.warning(f"linear_tree is not supported with "
+                        f"boosting={self.boosting_type}; training constant "
+                        "leaves")
+        if self._linear and train_set.raw_data is None:
+            raise ValueError(
+                "linear_tree=true needs raw feature values; construct the "
+                "Dataset with the linear_tree parameter set (or "
+                "free_raw_data=False) so they are retained")
+        if bool(cfg.get("linear_tree", False)) and self.mesh is not None:
+            log.warning("linear_tree is not supported with distributed "
+                        "tree learners; training constant leaves")
         self._use_quant = bool(cfg.get("use_quantized_grad", False))
         self._quant_bins = int(cfg.get("num_grad_quant_bins", 4))
         self._quant_renew = bool(cfg.get("quant_train_renew_leaf", False))
@@ -508,6 +526,9 @@ class GBDT:
         if grower == "compact" and not can_compact:
             log.warning("tpu_grower=compact requires a serial learner and a "
                         "row-elementwise objective; using masked grower")
+        # linear leaves fit against raw rows in the ORIGINAL order; the
+        # compact grower permutes rows, so linear mode uses the masked path
+        can_compact = can_compact and not self._linear
         self._use_compact = can_compact and (
             grower == "compact"
             or (grower == "auto" and self._n_real >= 65536))
@@ -913,6 +934,11 @@ class GBDT:
         vs = _ValidSet(valid_set, self.num_tree_per_iteration, name,
                        mesh=self.mesh if self.tree_learner != "feature"
                        else None)
+        if self._linear and valid_set.raw_data is None:
+            raise ValueError(
+                "linear_tree validation sets need raw data; create them "
+                "from the training Dataset (create_valid) with "
+                "free_raw_data=False or the linear_tree param set")
         for m in metrics:
             m.init(valid_set.metadata, valid_set.num_data)
         vs.metrics = list(metrics)
@@ -1026,6 +1052,13 @@ class GBDT:
                 jax.random.fold_in(self._bynode_key, self.num_total_trees),
                 self._cegb_state(),
                 true_grad[cur_tree_id], true_hess[cur_tree_id])
+            if self._linear:
+                split_ok = self._linear_tree_iter(
+                    tree, row_leaf, grad[cur_tree_id], hess[cur_tree_id],
+                    mask, cur_tree_id, first_iter)
+                self._linear_any_split = (
+                    getattr(self, "_linear_any_split", False) or split_ok)
+                continue
             self.train_score = self.train_score.at[cur_tree_id].set(new_score)
             # valid scores got the init at _boost_from_average already, so the
             # tree must be pushed through them BEFORE the bias fold
@@ -1040,12 +1073,61 @@ class GBDT:
             self._device_trees_cache = None
 
         self.iter_ += 1
+        if self._linear:
+            # all-constant iteration ends training (reference gbdt.cpp:440)
+            if not getattr(self, "_linear_any_split", False):
+                if len(self.models) > k:
+                    del self.models[-k:]
+                    self.iter_ -= 1
+                log.warning("Stopped training because there are no more "
+                            "leaves that meet the split requirements")
+                return True
+            self._linear_any_split = False
+            return False
         # stop-check + host materialization, batched to bound device->host
         # round trips (reference checks every iter, gbdt.cpp:440; one sync per
         # `stop_check_freq` iters here — the tunneled-TPU RTT is ~130ms)
         if len(self._dev_trees) >= k * self.stop_check_freq:
             return self._flush_trees()
         return False
+
+    def _linear_tree_iter(self, tree, row_leaf, grad_k, hess_k, mask,
+                          cur_tree_id: int, first_iter: bool) -> None:
+        """Host-orchestrated linear-leaf fitting + score updates for one tree
+        (reference: LinearTreeLearner::CalculateLinear; CPU-only there too)."""
+        from .linear import (add_bias_linear, fit_linear_leaves,
+                             linear_leaf_outputs)
+        host = HostTree(jax.device_get(tree), shrinkage=self.shrinkage_rate)
+        if host.num_nodes == 0:
+            host.num_leaves = 1
+        raw = self.train_set.raw_data
+        leaf_np = np.asarray(row_leaf)
+        g_np = np.asarray(grad_k * mask)
+        h_np = np.asarray(hess_k * mask)
+        is_cat = np.asarray(self.is_cat_arr)
+        fit_linear_leaves(host, raw, leaf_np, g_np, h_np, is_cat,
+                          float(self.config.get("linear_lambda", 0.0)),
+                          shrinkage=self.shrinkage_rate)
+        delta = linear_leaf_outputs(host, raw, leaf_np)
+        self.train_score = self.train_score.at[cur_tree_id].add(
+            jnp.asarray(delta, jnp.float32))
+        for vs in self.valid_sets:
+            vleaf = route_one_tree(
+                vs.binned, tree.split_feature, tree.split_bin,
+                tree.cat_bitset, tree.default_left, tree.left_child,
+                tree.right_child, tree.num_nodes, self.nan_bin_arr,
+                self.is_cat_arr)
+            vdelta = linear_leaf_outputs(
+                host, vs.dataset.raw_data, np.asarray(vleaf)[: vs.n_real])
+            vs.score = vs.score.at[cur_tree_id, : vs.n_real].add(
+                jnp.asarray(vdelta, jnp.float32))
+        if first_iter and abs(self._init_scores[cur_tree_id]) > 1e-10:
+            init = self._init_scores[cur_tree_id]
+            host.leaf_value = host.leaf_value + init
+            add_bias_linear(host, init)
+        self.models.append(host)
+        self._device_trees_cache = None
+        return host.num_nodes > 0
 
     @property
     def num_total_trees(self) -> int:
@@ -1284,6 +1366,23 @@ class GBDT:
                            num_iteration: Optional[int] = None,
                            start_iteration: int = 0,
                            early_stop=None) -> np.ndarray:
+        if getattr(self, "_linear", False):
+            from .linear import linear_leaf_outputs
+            self._flush_trees()
+            if arr.ndim == 1:
+                arr = arr.reshape(1, -1)
+            leaves = self.predict_leaf_matrix(arr, num_iteration,
+                                              start_iteration)
+            models = self.models[start_iteration
+                                 * self.num_tree_per_iteration:]
+            if num_iteration is not None and num_iteration > 0:
+                models = models[: num_iteration
+                                * self.num_tree_per_iteration]
+            k = self.num_tree_per_iteration
+            out = np.zeros((k, arr.shape[0]), np.float64)
+            for i, m in enumerate(models):
+                out[i % k] += linear_leaf_outputs(m, arr, leaves[:, i])
+            return out.astype(np.float32)
         return self.predict_raw_binned(self.bin_matrix(arr), num_iteration,
                                        start_iteration, early_stop)
 

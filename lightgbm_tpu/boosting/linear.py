@@ -1,0 +1,116 @@
+"""Linear leaves (``linear_tree=true``).
+
+TPU-adapted re-design of the reference's LinearTreeLearner
+(reference: src/treelearner/linear_tree_learner.cpp — per-leaf weighted
+least squares ``beta = -(X^T H X + lambda I)^{-1} X^T g`` over the numerical
+features on the leaf's path, NaN rows skipped, near-zero coefficients
+dropped, NaN prediction falls back to the constant leaf value,
+include/LightGBM/tree.h:587 Predict).
+
+The reference restricts linear trees to its CPU learner (no CUDA support);
+here the tree STRUCTURE still grows on-device, and the per-leaf solves run
+host-side in numpy — leaves are few and the solves are tiny, so this is a
+host-orchestrated mode like the reference's, not a device kernel.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+_ZERO = 1e-35
+
+
+def path_features(host, leaf: int, is_cat: np.ndarray) -> List[int]:
+    """Numerical features on the path from the root to ``leaf``
+    (reference: Tree branch_features with categorical features excluded,
+    linear_tree_learner.cpp GetLeafMap/InitLinear)."""
+    feats = []
+    node = int(host.leaf_parent[leaf])
+    while node >= 0:
+        f = int(host.split_feature[node])
+        if f >= 0 and not bool(is_cat[f]) and f not in feats:
+            feats.append(f)
+        # walk up: find the parent node pointing at `node`
+        parents = np.where((host.left_child == node)
+                           | (host.right_child == node))[0]
+        node = int(parents[0]) if len(parents) else -1
+    return sorted(feats)
+
+
+def fit_linear_leaves(host, raw: np.ndarray, row_leaf: np.ndarray,
+                      grad: np.ndarray, hess: np.ndarray,
+                      is_cat: np.ndarray, linear_lambda: float,
+                      shrinkage: float = 1.0) -> None:
+    """Fit each leaf's linear model in place on the HostTree (adds
+    leaf_const / leaf_features / leaf_coeff). ``host.leaf_value`` arrives
+    already scaled by the learning rate, so fitted betas scale here and
+    constant-fallback leaves keep the already-scaled value untouched."""
+    nl = host.num_leaves
+    host.leaf_const = np.array(host.leaf_value[:len(host.leaf_value)],
+                               np.float64).copy()
+    host.leaf_features = [[] for _ in range(len(host.leaf_value))]
+    host.leaf_coeff = [[] for _ in range(len(host.leaf_value))]
+    host.is_linear = True
+    for leaf in range(nl):
+        feats = path_features(host, leaf, is_cat)
+        if not feats:
+            host.leaf_const[leaf] = float(host.leaf_value[leaf])
+            continue
+        rows = np.flatnonzero(row_leaf == leaf)
+        if rows.size == 0:
+            host.leaf_const[leaf] = float(host.leaf_value[leaf])
+            continue
+        x = raw[np.ix_(rows, feats)]
+        ok = ~np.isnan(x).any(axis=1)
+        rows = rows[ok]
+        x = x[ok]
+        # too little data for a stable solve: keep the constant model
+        # (reference: num < num_feat * 2 check in CalculateLinear)
+        if rows.size < 2 * (len(feats) + 1):
+            host.leaf_const[leaf] = float(host.leaf_value[leaf])
+            continue
+        g = grad[rows].astype(np.float64)
+        h = hess[rows].astype(np.float64)
+        xi = np.column_stack([x, np.ones(len(x))])
+        xthx = xi.T @ (xi * h[:, None])
+        # ridge on the feature diagonal only (not the intercept)
+        xthx[np.arange(len(feats)), np.arange(len(feats))] += linear_lambda
+        xtg = xi.T @ g
+        try:
+            beta = -np.linalg.solve(xthx, xtg)
+        except np.linalg.LinAlgError:
+            host.leaf_const[leaf] = float(host.leaf_value[leaf])
+            continue
+        if not np.isfinite(beta).all():
+            host.leaf_const[leaf] = float(host.leaf_value[leaf])
+            continue
+        beta = beta * shrinkage
+        keep = np.abs(beta[:-1]) > _ZERO
+        host.leaf_features[leaf] = [f for f, k in zip(feats, keep) if k]
+        host.leaf_coeff[leaf] = [float(b) for b, k in zip(beta[:-1], keep)
+                                 if k]
+        host.leaf_const[leaf] = float(beta[-1])
+
+
+def linear_leaf_outputs(host, raw: np.ndarray, leaf: np.ndarray) -> np.ndarray:
+    """Per-row outputs of a linear tree (NaN in a needed feature falls back
+    to the constant leaf value — reference: tree.h:587)."""
+    out = np.asarray(host.leaf_value, np.float64)[leaf].copy()
+    for l in range(host.num_leaves):
+        feats = host.leaf_features[l]
+        rows = np.flatnonzero(leaf == l)
+        if rows.size == 0:
+            continue
+        if not feats:
+            out[rows] = host.leaf_const[l]
+            continue
+        x = raw[np.ix_(rows, feats)]
+        ok = ~np.isnan(x).any(axis=1)
+        vals = host.leaf_const[l] + x[ok] @ np.asarray(host.leaf_coeff[l])
+        out[rows[ok]] = vals
+    return out
+
+
+def add_bias_linear(host, bias: float) -> None:
+    host.leaf_const = np.asarray(host.leaf_const) + bias

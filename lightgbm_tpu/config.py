@@ -268,26 +268,14 @@ METRIC_ALIASES: Dict[str, str] = {
 UNIMPLEMENTED_PARAMS: Dict[str, str] = {
     "extra_trees": "extremely randomized trees",
     "max_bin_by_feature": "per-feature bin caps",
-    "linear_tree": "linear leaf models",
     "feature_contri": "per-feature split-gain scaling",
     "forcedsplits_filename": "forced splits",
     "forcedbins_filename": "forced bin boundaries",
-    "pred_early_stop": "prediction early stopping",
-    "start_iteration_predict": "prediction start_iteration",
-    "num_iteration_predict": "prediction num_iteration",
     "auc_mu_weights": "weighted auc_mu",
     "lambdarank_position_bias_regularization": "position bias correction",
-    "save_binary": "binary dataset files",
     "two_round": "two-round file loading",
-    "header": "text-file loading",
-    "label_column": "text-file loading",
-    "weight_column": "text-file loading",
-    "group_column": "text-file loading",
-    "ignore_column": "text-file loading",
     "parser_config_file": "custom parsers",
-    "precise_float_parser": "text-file loading",
     "pre_partition": "pre-partitioned distributed data",
-    # tree-learner features scheduled this round; warn until wired
 }
 
 # alias -> canonical param name

@@ -121,7 +121,19 @@ def _tree_to_text(host, tree_idx: int, mappers) -> str:
     if num_cat > 0:
         lines.append("cat_boundaries=" + join(cat_boundaries))
         lines.append("cat_threshold=" + join(cat_thresholds))
-    lines.append("is_linear=0")
+    if getattr(host, "is_linear", False):
+        # (reference: Tree::ToString linear block, src/io/tree.cpp:377-399)
+        lines.append("is_linear=1")
+        lines.append("leaf_const=" + join(
+            _fmt(v) for v in host.leaf_const[:nl]))
+        lines.append("num_features=" + join(
+            len(host.leaf_features[i]) for i in range(nl)))
+        lines.append("leaf_features=" + join(
+            str(f) for i in range(nl) for f in host.leaf_features[i]))
+        lines.append("leaf_coeff=" + join(
+            _fmt(c) for i in range(nl) for c in host.leaf_coeff[i]))
+    else:
+        lines.append("is_linear=0")
     lines.append(f"shrinkage={host.shrinkage:g}")
     lines.append("")
     return "\n".join(lines)
@@ -263,7 +275,8 @@ def booster_to_dict(booster, num_iteration: Optional[int] = None) -> Dict[str, A
 # parser Tree::Tree(const char*), src/io/tree.cpp)
 # ---------------------------------------------------------------------------
 class LoadedTree:
-    __slots__ = ("num_leaves", "num_cat", "split_feature", "split_gain",
+    __slots__ = ("is_linear", "leaf_const", "leaf_features", "leaf_coeff",
+                 "num_leaves", "num_cat", "split_feature", "split_gain",
                  "threshold", "decision_type", "left_child", "right_child",
                  "leaf_value", "leaf_weight", "leaf_count", "internal_value",
                  "cat_boundaries", "cat_threshold", "shrinkage", "num_nodes")
@@ -406,6 +419,21 @@ class LoadedGBDT:
             t.cat_threshold = _arr(d, "cat_threshold", np.uint32, 0) \
                 if t.num_cat else np.zeros(0, np.uint32)
             t.shrinkage = float(d.get("shrinkage", 1.0))
+            t.is_linear = bool(int(d.get("is_linear", "0") or 0))
+            if t.is_linear:
+                t.leaf_const = _arr(d, "leaf_const", np.float64, nl)
+                counts = _arr(d, "num_features", np.int64, nl)
+                feats = _arr(d, "leaf_features", np.int64, 0)
+                coeffs = _arr(d, "leaf_coeff", np.float64, 0)
+                t.leaf_features = []
+                t.leaf_coeff = []
+                pos = 0
+                for c in counts:
+                    t.leaf_features.append(
+                        [int(f) for f in feats[pos:pos + int(c)]])
+                    t.leaf_coeff.append(
+                        [float(v) for v in coeffs[pos:pos + int(c)]])
+                    pos += int(c)
             self.models.append(t)
 
     # Booster-compat surface -------------------------------------------------
@@ -432,7 +460,11 @@ class LoadedGBDT:
         out = np.zeros((k, arr.shape[0]), np.float64)
         for i, t in enumerate(models):
             leaf = t.route(arr)
-            out[i % k] += t.leaf_value[leaf]
+            if getattr(t, "is_linear", False):
+                from .boosting.linear import linear_leaf_outputs
+                out[i % k] += linear_leaf_outputs(t, arr, leaf)
+            else:
+                out[i % k] += t.leaf_value[leaf]
         if self.average_output:
             out /= max(len(models) // k, 1)
         return out.astype(np.float32)

@@ -617,3 +617,59 @@ class TestQuantizedTraining:
         mse_plain = float(np.mean((plain.predict(X) - y) ** 2))
         mse_renew = float(np.mean((renew.predict(X) - y) ** 2))
         assert mse_renew <= mse_plain + 1e-6
+
+
+class TestLinearTrees:
+    """linear_tree=true (reference: linear_tree_learner.cpp)."""
+
+    def _linear_problem(self, seed=0, n=1500):
+        rng = np.random.RandomState(seed)
+        X = rng.rand(n, 3) * 4
+        seg = (X[:, 0] > 2).astype(float)
+        y = np.where(seg > 0, 3.0 * X[:, 1] + 1.0, -2.0 * X[:, 1] + 5.0) \
+            + 0.05 * rng.randn(n)
+        return X, y
+
+    def test_linear_beats_constant_leaves(self):
+        import lightgbm_tpu as lgb
+        X, y = self._linear_problem()
+        params = dict(objective="regression", num_leaves=4, max_bin=31,
+                      min_data_in_leaf=20, verbosity=-1, learning_rate=0.5)
+        const = lgb.train(params, lgb.Dataset(X, label=y), 20)
+        lin = lgb.train(dict(params, linear_tree=True),
+                        lgb.Dataset(X, label=y), 20)
+        mse_const = float(np.mean((const.predict(X) - y) ** 2))
+        mse_lin = float(np.mean((lin.predict(X) - y) ** 2))
+        assert mse_lin < mse_const * 0.7   # piecewise-linear target
+        assert mse_lin < 0.5  # leaves only use path features (ref behavior)
+
+    def test_linear_model_roundtrip_and_nan_fallback(self):
+        import lightgbm_tpu as lgb
+        X, y = self._linear_problem()
+        lin = lgb.train(dict(objective="regression", num_leaves=4, max_bin=31,
+                             min_data_in_leaf=20, verbosity=-1,
+                             linear_tree=True, learning_rate=0.5),
+                        lgb.Dataset(X, label=y), 10)
+        text = lin.model_to_string()
+        assert "is_linear=1" in text and "leaf_coeff=" in text
+        loaded = lgb.Booster(model_str=text)
+        np.testing.assert_allclose(loaded.predict(X), lin.predict(X),
+                                   rtol=1e-4, atol=1e-5)
+        # NaN in a linear feature falls back to the constant leaf value
+        Xn = X.copy()
+        Xn[:5, 1] = np.nan
+        p = lin.predict(Xn)
+        assert np.isfinite(p).all()
+
+    def test_linear_with_valid_early_stopping(self):
+        import lightgbm_tpu as lgb
+        X, y = self._linear_problem()
+        ds = lgb.Dataset(X[:1000], label=y[:1000], params={"linear_tree": True})
+        dv = ds.create_valid(X[1000:], label=y[1000:])
+        bst = lgb.train(dict(objective="regression", metric="l2",
+                             num_leaves=4, max_bin=31, min_data_in_leaf=20,
+                             verbosity=-1, linear_tree=True),
+                        ds, 30, valid_sets=[dv],
+                        callbacks=[lgb.early_stopping(5, verbose=False)])
+        mse = float(np.mean((bst.predict(X[1000:]) - y[1000:]) ** 2))
+        assert mse < 2.0
