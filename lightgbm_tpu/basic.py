@@ -69,7 +69,8 @@ class Dataset:
     _DATASET_PARAM_KEYS = (
         "max_bin", "min_data_in_bin", "bin_construct_sample_cnt",
         "use_missing", "zero_as_missing", "data_random_seed",
-        "feature_pre_filter", "max_bin_by_feature", "linear_tree")
+        "feature_pre_filter", "max_bin_by_feature", "linear_tree",
+        "forcedbins_filename")
 
     def _update_params(self, params: Optional[Dict[str, Any]]) -> "Dataset":
         """Merge binning params from a Booster into a not-yet-constructed
@@ -151,6 +152,8 @@ class Dataset:
             # when linear_tree is set, dataset.h raw_data_)
             keep_raw=not self.free_raw_data
             or bool(cfg.get("linear_tree", False)),
+            forcedbins_filename=str(cfg.get("forcedbins_filename", "") or ""),
+            max_bin_by_feature=cfg.get("max_bin_by_feature"),
         )
         md = self._inner.metadata
         if self.label is not None:

@@ -467,6 +467,16 @@ class GBDT:
         self._quant_stochastic = bool(cfg.get("stochastic_rounding", True))
         self._quant_key = jax.random.PRNGKey(
             int(cfg.get("seed", 0) or 0) + 1337)
+        self._extra_key = jax.random.PRNGKey(int(cfg.get("extra_seed", 6)))
+        fc = cfg.get("feature_contri")
+        if fc is not None:
+            fcv = np.asarray(list(fc), np.float32)
+            if fcv.size != nf:
+                raise ValueError("feature_contri needs one entry per feature")
+            self._feature_contri = jnp.asarray(
+                fpad(fcv, 1.0)) if self._f_pad else jnp.asarray(fcv)
+        else:
+            self._feature_contri = None
         self.grower_params = GrowerParams(
             num_leaves=self.max_leaves,
             max_depth=int(cfg.get("max_depth", -1)),
@@ -490,6 +500,7 @@ class GBDT:
             bynode_fraction=float(cfg.get("feature_fraction_bynode", 1.0)),
             use_cegb=self._use_cegb,
             cegb_split_pen=self._cegb_split_pen,
+            extra_trees=bool(cfg.get("extra_trees", False)),
             voting_k=(int(cfg.get("top_k", 20))
                       if self.mesh is not None
                       and self.tree_learner == "voting" else 0),
@@ -589,9 +600,10 @@ class GBDT:
         quant_bins = self._quant_bins
         quant_stoch = self._quant_stochastic
         const_hess = bool(getattr(obj, "is_constant_hessian", False))
+        feature_contri = self._feature_contri
 
         def step(score_k, grad_k, hess_k, mask, feat_mask, shrinkage,
-                 bynode_key, cegb_used, true_grad_k, true_hess_k):
+                 bynode_key, cegb_used, true_grad_k, true_hess_k, extra_key):
             # grad_k/hess_k arrive already quantized when use_quantized_grad
             # (once per iteration over all classes, like the reference's
             # GradientDiscretizer); true_* carry the originals for renewal
@@ -600,7 +612,8 @@ class GBDT:
             tree, row_leaf = grow_tree(
                 binned, g, h, mask, num_bins_arr, nan_bin_arr, has_nan_arr,
                 is_cat_arr, feat_mask, grower_params, mono_types,
-                inter_sets, bynode_key, cegb_coupled, cegb_used)
+                inter_sets, bynode_key, cegb_coupled, cegb_used,
+                extra_key, feature_contri)
             if use_cegb:
                 cegb_used = _tree_used_features(tree, binned.shape[1],
                                                 cegb_used)
@@ -733,6 +746,7 @@ class GBDT:
         quant_bins = self._quant_bins
         quant_stoch = self._quant_stochastic
         const_hess = bool(getattr(obj, "is_constant_hessian", False))
+        feature_contri = self._feature_contri
         sc_off = layout.extra_off            # K score columns live first
         lbl_off = layout.extra_off + 4 * self._cx_label
         w_off = (layout.extra_off + 4 * self._cx_weight
@@ -749,7 +763,7 @@ class GBDT:
                   if self._cx_grads is not None else None)
 
         def step(work, scratch, scores, bag_w, use_stored_bag, feat_mask,
-                 shrinkage, bynode_key, cegb_used, quant_key, k):
+                 shrinkage, bynode_key, cegb_used, quant_key, extra_key, k):
             pad_n = work.shape[0] - n
 
             def set_col(work, off, vec):     # vec: [n] f32
@@ -794,7 +808,8 @@ class GBDT:
              leaf_nrows) = grow_tree_compact(
                 work, scratch, num_bins_arr, nan_bin_arr, has_nan_arr,
                 is_cat_arr, feat_mask, layout, gp, n,
-                mono_types, inter_sets, bynode_key, cegb_coupled, cegb_used)
+                mono_types, inter_sets, bynode_key, cegb_coupled, cegb_used,
+                extra_key, feature_contri)
             if use_cegb:
                 cegb_used = _tree_used_features(tree, layout.num_features,
                                                 cegb_used)
@@ -913,7 +928,9 @@ class GBDT:
                 jnp.float32(self.shrinkage_rate),
                 jax.random.fold_in(self._bynode_key, self.num_total_trees),
                 self._cegb_state(),
-                jax.random.fold_in(self._quant_key, self.iter_), k=k)
+                jax.random.fold_in(self._quant_key, self.iter_),
+                jax.random.fold_in(self._extra_key, self.num_total_trees),
+                k=k)
             c["work"], c["scratch"] = work, scratch
             c["epoch"] += 1
             self.train_score = scores
@@ -1051,11 +1068,12 @@ class GBDT:
                 jnp.float32(self.shrinkage_rate),
                 jax.random.fold_in(self._bynode_key, self.num_total_trees),
                 self._cegb_state(),
-                true_grad[cur_tree_id], true_hess[cur_tree_id])
+                true_grad[cur_tree_id], true_hess[cur_tree_id],
+                jax.random.fold_in(self._extra_key, self.num_total_trees))
             if self._linear:
                 split_ok = self._linear_tree_iter(
-                    tree, row_leaf, grad[cur_tree_id], hess[cur_tree_id],
-                    mask, cur_tree_id, first_iter)
+                    tree, row_leaf, true_grad[cur_tree_id],
+                    true_hess[cur_tree_id], mask, cur_tree_id, first_iter)
                 self._linear_any_split = (
                     getattr(self, "_linear_any_split", False) or split_ok)
                 continue
@@ -1076,9 +1094,11 @@ class GBDT:
         if self._linear:
             # all-constant iteration ends training (reference gbdt.cpp:440)
             if not getattr(self, "_linear_any_split", False):
+                # same accounting as _flush_trees (reference gbdt.cpp:440):
+                # pop the failed iteration unless it is the very first
                 if len(self.models) > k:
                     del self.models[-k:]
-                    self.iter_ -= 1
+                self.iter_ -= 1
                 log.warning("Stopped training because there are no more "
                             "leaves that meet the split requirements")
                 return True
@@ -1213,6 +1233,29 @@ class GBDT:
         rc = jnp.asarray(host.right_child)
         nn = jnp.asarray(host.num_nodes)
         lv = jnp.asarray(host.leaf_value * factor)
+        if getattr(host, "is_linear", False):
+            # linear leaves contributed leaf_const + x.coeff to the scores;
+            # replay the same formula (host-side) for exact add/subtract
+            from .linear import linear_leaf_outputs
+            if train:
+                leaf = route_one_tree(
+                    self._routing_binned(), sf, sb, cb, dl, lc, rc, nn,
+                    self.nan_bin_arr, self.is_cat_arr)
+                delta = linear_leaf_outputs(
+                    host, self.train_set.raw_data, np.asarray(leaf)) * factor
+                self.train_score = self.train_score.at[cur_tree_id].add(
+                    jnp.asarray(delta, jnp.float32))
+            if valid:
+                for vs in self.valid_sets:
+                    vleaf = route_one_tree(
+                        vs.binned, sf, sb, cb, dl, lc, rc, nn,
+                        self.nan_bin_arr, self.is_cat_arr)
+                    vdelta = linear_leaf_outputs(
+                        host, vs.dataset.raw_data,
+                        np.asarray(vleaf)[: vs.n_real]) * factor
+                    vs.score = vs.score.at[cur_tree_id, : vs.n_real].add(
+                        jnp.asarray(vdelta, jnp.float32))
+            return
         if train:
             leaf = route_one_tree(self._routing_binned(), sf, sb, cb, dl, lc,
                                   rc, nn, self.nan_bin_arr, self.is_cat_arr)
@@ -1368,6 +1411,9 @@ class GBDT:
                            early_stop=None) -> np.ndarray:
         if getattr(self, "_linear", False):
             from .linear import linear_leaf_outputs
+            if early_stop is not None:
+                log.warning(
+                    "pred_early_stop is ignored with linear_tree models")
             self._flush_trees()
             if arr.ndim == 1:
                 arr = arr.reshape(1, -1)

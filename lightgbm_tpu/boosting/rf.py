@@ -71,6 +71,8 @@ class RF(GBDT):
                 self._mono_types, self._inter_sets,
                 _jax.random.fold_in(self._bynode_key, self.num_total_trees),
                 self._cegb_coupled, self._cegb_state(),
+                _jax.random.fold_in(self._extra_key, self.num_total_trees),
+                self._feature_contri,
             )
             if self._use_cegb:
                 from .gbdt import _tree_used_features

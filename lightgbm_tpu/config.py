@@ -266,11 +266,7 @@ METRIC_ALIASES: Dict[str, str] = {
 # rejects inconsistent configs outright, src/io/config.cpp:286). Entries are
 # removed from this set as the corresponding feature lands.
 UNIMPLEMENTED_PARAMS: Dict[str, str] = {
-    "extra_trees": "extremely randomized trees",
-    "max_bin_by_feature": "per-feature bin caps",
-    "feature_contri": "per-feature split-gain scaling",
     "forcedsplits_filename": "forced splits",
-    "forcedbins_filename": "forced bin boundaries",
     "auc_mu_weights": "weighted auc_mu",
     "lambdarank_position_bias_regularization": "position bias correction",
     "two_round": "two-round file loading",

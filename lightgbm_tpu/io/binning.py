@@ -180,6 +180,7 @@ def find_bin_numerical(
     use_missing: bool = True,
     zero_as_missing: bool = False,
     pre_filter_min_data: int = 0,
+    forced_bounds: "Optional[np.ndarray]" = None,
 ) -> BinMapper:
     """Construct a numerical BinMapper from sampled values.
 
@@ -187,6 +188,16 @@ def find_bin_numerical(
     value was zero and therefore may exceed ``len(sample_values)`` in sparse
     ingestion paths (reference semantics: zeros counted implicitly).
     """
+    if forced_bounds is not None and len(forced_bounds):
+        # user-specified boundaries take priority; the greedy budget shrinks
+        # (reference: forced_bin_bounds in bin.cpp FindBin). The inner fit
+        # sees the ORIGINAL values so NaN missing handling is preserved.
+        m = _find_bin_with_forced(sample_values, total_sample_cnt, max_bin,
+                                  min_data_in_bin, use_missing,
+                                  zero_as_missing,
+                                  np.asarray(forced_bounds, np.float64))
+        if m is not None:
+            return m
     values = np.asarray(sample_values, dtype=np.float64)
     nan_cnt = int(np.isnan(values).sum())
     values = values[~np.isnan(values)]
@@ -265,6 +276,32 @@ def find_bin_numerical(
     # default bin = bin of 0.0
     mapper.default_bin = int(np.searchsorted(upper_arr[:-1], 0.0, side="left"))
     return mapper
+
+
+def _find_bin_with_forced(values, total_sample_cnt, max_bin, min_data_in_bin,
+                          use_missing, zero_as_missing,
+                          forced) -> Optional[BinMapper]:
+    """Greedy binning constrained to include the user's boundaries."""
+    forced = np.unique(forced)
+    if len(forced) == 0:
+        return None
+    # budget left for greedy refinement after reserving forced boundaries
+    base = find_bin_numerical(values, total_sample_cnt,
+                              max(max_bin - len(forced), 2),
+                              min_data_in_bin, use_missing, zero_as_missing)
+    finite = base.bin_upper_bounds[np.isfinite(base.bin_upper_bounds)]
+    bounds = np.unique(np.concatenate([finite, forced]))[: max_bin - 1]
+    m = BinMapper(
+        num_bins=len(bounds) + 1 + (1 if base.missing_type == MISSING_NAN
+                                    else 0),
+        is_categorical=False,
+        missing_type=base.missing_type,
+        bin_upper_bounds=np.concatenate([bounds, [np.inf]]),
+        min_value=base.min_value,
+        max_value=base.max_value,
+    )
+    m.default_bin = int(m.value_to_bin(np.array([0.0]))[0])
+    return m
 
 
 def find_bin_categorical(

@@ -222,6 +222,8 @@ class BinnedDataset:
         data_random_seed: int = 1,
         reference: Optional["BinnedDataset"] = None,
         keep_raw: bool = False,
+        forcedbins_filename: str = "",
+        max_bin_by_feature: Optional[Sequence[int]] = None,
     ) -> "BinnedDataset":
         arr = _to_2d_float(data)
         n, f = arr.shape
@@ -257,19 +259,36 @@ class BinnedDataset:
             else:
                 sample = arr
             total_sample_cnt = len(sample)
+            # user-forced bin boundaries, JSON list of {"feature": i,
+            # "bin_upper_bound": [...]} (reference: forcedbins_filename,
+            # DatasetLoader::GetForcedBins dataset_loader.cpp:1493)
+            forced: Dict[int, np.ndarray] = {}
+            if forcedbins_filename:
+                import json as _json
+                with open(forcedbins_filename) as fh:
+                    for entry in _json.load(fh):
+                        forced[int(entry["feature"])] = np.asarray(
+                            entry["bin_upper_bound"], np.float64)
+            if max_bin_by_feature is not None \
+                    and len(max_bin_by_feature) != f:
+                raise ValueError(
+                    "max_bin_by_feature needs one entry per feature")
             mappers: List[BinMapper] = []
             for j in range(f):
                 col = sample[:, j]
+                mb = (int(max_bin_by_feature[j])
+                      if max_bin_by_feature is not None else max_bin)
                 if j in cat_idx:
-                    m = find_bin_categorical(col, max_bin, min_data_in_bin)
+                    m = find_bin_categorical(col, mb, min_data_in_bin)
                 else:
                     m = find_bin_numerical(
                         col,
                         total_sample_cnt,
-                        max_bin,
+                        mb,
                         min_data_in_bin,
                         use_missing=use_missing,
                         zero_as_missing=zero_as_missing,
+                        forced_bounds=forced.get(j),
                     )
                 mappers.append(m)
             ds.mappers = mappers

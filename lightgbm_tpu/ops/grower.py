@@ -79,6 +79,7 @@ class GrowerParams(NamedTuple):
     bynode_fraction: float = 1.0
     use_cegb: bool = False
     cegb_split_pen: float = 0.0
+    extra_trees: bool = False
     axis_name: Optional[str] = None
     hist_impl: str = "auto"  # auto | xla | pallas (ops/histogram.py dispatch)
     # compact-grower streaming block sizes (ops/grower_compact.py)
@@ -104,6 +105,7 @@ class GrowerParams(NamedTuple):
             path_smooth=self.path_smooth,
             use_cegb=self.use_cegb,
             cegb_split_pen=self.cegb_split_pen,
+            extra_trees=self.extra_trees,
         )
 
     @property
@@ -188,12 +190,14 @@ class GrowerState(NamedTuple):
 
 def _leaf_best_split(hist3, pg, ph, pc, feat_info, feat_mask, depth,
                      params: GrowerParams, mono_types=None, cmin=None,
-                     cmax=None, pout=0.0, cegb_pen=None):
+                     cmax=None, pout=0.0, cegb_pen=None, extra_key=None,
+                     feature_contri=None):
     num_bins_arr, nan_bin_arr, has_nan_arr, is_cat_arr = feat_info
     sp = best_split(
         hist3, pg, ph, pc,
         num_bins_arr, nan_bin_arr, has_nan_arr, is_cat_arr, feat_mask,
         params.split_params(), mono_types, cmin, cmax, pout, depth, cegb_pen,
+        extra_key, feature_contri,
     )
     depth_ok = jnp.logical_or(params.max_depth <= 0, depth < params.max_depth)
     return sp._replace(gain=jnp.where(depth_ok, sp.gain, _NEG_INF))
@@ -235,6 +239,8 @@ def grow_tree(
     bynode_key: Optional[jax.Array] = None,   # PRNG key (bynode_fraction<1)
     cegb_coupled: Optional[jax.Array] = None,  # [F] tradeoff*coupled costs
     cegb_used0: Optional[jax.Array] = None,    # [F] bool (persisted model-level)
+    extra_key: Optional[jax.Array] = None,     # PRNG key (extra_trees)
+    feature_contri: Optional[jax.Array] = None,  # [F] gain multipliers
 ):
     """Grow one tree; returns (TreeArrays, row_leaf [N] i32)."""
     n, f = binned.shape
@@ -269,15 +275,17 @@ def grow_tree(
         cegb_coupled = jnp.zeros((f,), jnp.float32)
     if cegb_used0 is None:
         cegb_used0 = jnp.zeros((f,), bool)
+    if extra_key is None:
+        extra_key = jax.random.PRNGKey(6)
     big = jnp.float32(3.4e38)
 
     # batched best-split over the two fresh children (one fused scan)
     def two_best_splits(h2, pg2, ph2, pc2, fm2, depth, cmin2, cmax2, pout2,
-                        cegb_pen):
-        fn = lambda h, pg, ph, pc, fm, cmn, cmx, po: _leaf_best_split(
+                        cegb_pen, ek2):
+        fn = lambda h, pg, ph, pc, fm, cmn, cmx, po, ek: _leaf_best_split(
             h, pg, ph, pc, feat_info, fm, depth, params, mono_types,
-            cmn, cmx, po, cegb_pen)
-        return jax.vmap(fn)(h2, pg2, ph2, pc2, fm2, cmin2, cmax2, pout2)
+            cmn, cmx, po, cegb_pen, ek, feature_contri)
+        return jax.vmap(fn)(h2, pg2, ph2, pc2, fm2, cmin2, cmax2, pout2, ek2)
 
     # ---- root ----
     root_g = grad.sum()
@@ -299,6 +307,7 @@ def grow_tree(
         jnp.asarray(0, jnp.int32), params, mono_types,
         -big, big, root_out,
         cegb_coupled * jnp.logical_not(cegb_used0),
+        jax.random.fold_in(extra_key, 0), feature_contri,
     )
 
     i32 = jnp.int32
@@ -513,7 +522,9 @@ def grow_tree(
                 jnp.stack([lc, rc]), jnp.stack([fm_l, fm_r]), d_child,
                 jnp.stack([cmin_l, cmin_r]), jnp.stack([cmax_l, cmax_r]),
                 jnp.stack([lw, rw]),
-                cegb_coupled * jnp.logical_not(cegb_used))
+                cegb_coupled * jnp.logical_not(cegb_used),
+                jnp.stack([jax.random.fold_in(extra_key, 2 * k + 1),
+                           jax.random.fold_in(extra_key, 2 * k + 2)]))
             bs_gain = bs_gain.at[best_leaf].set(sp.gain[0]).at[new_leaf].set(sp.gain[1])
             bs_feature = bs_feature.at[best_leaf].set(sp.feature[0]).at[new_leaf].set(sp.feature[1])
             bs_bin = bs_bin.at[best_leaf].set(sp.bin[0]).at[new_leaf].set(sp.bin[1])

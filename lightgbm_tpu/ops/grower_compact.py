@@ -108,6 +108,8 @@ def grow_tree_compact(
     bynode_key: jnp.ndarray = None,
     cegb_coupled: jnp.ndarray = None,
     cegb_used0: jnp.ndarray = None,
+    extra_key: jnp.ndarray = None,
+    feature_contri: jnp.ndarray = None,
 ):
     """Grow one tree; returns (TreeArrays, row_leaf [N], work', scratch',
     leaf_start [L], leaf_nrows [L]) — per-row outputs in the post-tree
@@ -131,11 +133,15 @@ def grow_tree_compact(
         cegb_coupled = jnp.zeros((F,), jnp.float32)
     if cegb_used0 is None:
         cegb_used0 = jnp.zeros((F,), bool)
+    if extra_key is None:
+        extra_key = jax.random.PRNGKey(6)
     big = jnp.float32(3.4e38)
 
-    def leaf_best(hist, pg, ph, pc, depth, fm, cmn, cmx, po, cegb_pen=None):
+    def leaf_best(hist, pg, ph, pc, depth, fm, cmn, cmx, po, cegb_pen=None,
+                  ek=None):
         sp = best_split(hist, pg, ph, pc, *feat_info, fm, sp_params,
-                        mono_types, cmn, cmx, po, depth, cegb_pen)
+                        mono_types, cmn, cmx, po, depth, cegb_pen, ek,
+                        feature_contri)
         depth_ok = jnp.logical_or(params.max_depth <= 0,
                                   depth < params.max_depth)
         return sp._replace(gain=jnp.where(depth_ok, sp.gain, _NEG_INF))
@@ -160,7 +166,8 @@ def grow_tree_compact(
     root_out = leaf_output(root_g, root_h, sp_params)
     sp0 = leaf_best(root_hist, root_g, root_h, root_c, jnp.asarray(0, i32),
                     root_fm, -big, big, root_out,
-                    cegb_coupled * jnp.logical_not(cegb_used0))
+                    cegb_coupled * jnp.logical_not(cegb_used0),
+                    jax.random.fold_in(extra_key, 0))
 
     W = params.bitset_words
     st = CompactState(
@@ -364,9 +371,11 @@ def grow_tree_compact(
                 jax.random.fold_in(bynode_key, 2 * k + 2), params)
             pen = cegb_coupled * jnp.logical_not(cegb_used)
             spl = leaf_best(hist_left, lg, lh, lc, d_child, fm_l,
-                            cmin_l, cmax_l, lw, pen)
+                            cmin_l, cmax_l, lw, pen,
+                            jax.random.fold_in(extra_key, 2 * k + 1))
             spr = leaf_best(hist_right, rg, rh, rc, d_child, fm_r,
-                            cmin_r, cmax_r, rw, pen)
+                            cmin_r, cmax_r, rw, pen,
+                            jax.random.fold_in(extra_key, 2 * k + 2))
             for leaf, sp in ((best_leaf, spl), (new_leaf, spr)):
                 bs_gain = bs_gain.at[leaf].set(sp.gain)
                 bs_feature = bs_feature.at[leaf].set(sp.feature)

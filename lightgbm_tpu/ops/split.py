@@ -60,6 +60,10 @@ class SplitParams(NamedTuple):
     # one-time coupled feature-acquisition cost, both scaled by tradeoff)
     use_cegb: bool = False
     cegb_split_pen: float = 0.0    # tradeoff * cegb_penalty_split
+    # extremely randomized trees: each feature evaluates ONE random
+    # threshold instead of the full scan (reference: USE_RAND branch of
+    # FindBestThresholdSequentially, rand_threshold)
+    extra_trees: bool = False
 
 
 class SplitResult(NamedTuple):
@@ -208,6 +212,8 @@ def best_split(
     parent_output: float = 0.0,                 # for path smoothing
     depth: Optional[jnp.ndarray] = None,        # for the monotone penalty
     cegb_pen: Optional[jnp.ndarray] = None,     # [F] remaining coupled costs
+    extra_key: Optional[jnp.ndarray] = None,    # PRNG key (extra_trees)
+    feature_contri: Optional[jnp.ndarray] = None,  # [F] gain multipliers
 ) -> SplitResult:
     """Find the best (feature, threshold, direction) for one leaf."""
     f, b, k = hist.shape
@@ -281,6 +287,10 @@ def best_split(
             # (reference: CostEfficientGradientBoosting::DeltaGain)
             gain = gain - cegb_pen[:, None] \
                 - p.cegb_split_pen * parent_count
+        if feature_contri is not None:
+            # per-feature split-gain scaling (reference: config.h
+            # feature_contri / feature_histogram.hpp meta_->penalty)
+            gain = jnp.where(gain > 0, gain * feature_contri[:, None], gain)
         return jnp.where(valid, gain, _NEG_INF)
 
     # categorical one-hot splits (only for low-cardinality features,
@@ -290,9 +300,23 @@ def best_split(
     onehot_ok = is_cat_b & (num_bins[:, None] <= p.max_cat_to_onehot)
     cat_tmask = jnp.where(is_cat_b, onehot_ok & (t_iota < num_bins[:, None]),
                           t_iota < num_bins[:, None] - 1)
+    if p.extra_trees and extra_key is not None:
+        # one random candidate threshold per feature (reference: USE_RAND
+        # rand_threshold per feature in FindBestThresholdSequentially)
+        import jax as _jax
+        # numerical thresholds live in [0, num_bins-1); one-hot categorical
+        # candidates may use any bin incl. the last
+        hi = jnp.where(is_cat, num_bins, num_bins - 1)
+        rnd = _jax.random.randint(extra_key, (f,), 0, jnp.maximum(hi, 1))
+        cat_tmask = cat_tmask & (t_iota == rnd[:, None])
+        below_rand = (t_iota == rnd[:, None])
+    else:
+        below_rand = None
     score1 = dir_score(left_g1, left_h1, left_c1, cat_tmask)
     dir2_ok = (~is_cat_b) & has_nan_bin[:, None] & below \
         & (t_iota < num_bins[:, None] - 1)
+    if below_rand is not None:
+        dir2_ok = dir2_ok & below_rand
     score2 = dir_score(left_g2, left_h2, left_c2, dir2_ok)
 
     scores = jnp.stack([score1, score2], axis=-1)            # [F, B, 2]

@@ -203,10 +203,10 @@ class TestConfigWarnings:
         from lightgbm_tpu.utils import log as _log
         _log.set_verbosity(1)  # earlier tests may have silenced warnings
         with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
-            Config({"extra_trees": True,
+            Config({"two_round": True,
                     "forcedsplits_filename": "f.json"})
         text = caplog.text
-        for name in ("extra_trees",
+        for name in ("two_round",
                      "forcedsplits_filename"):
             assert f"{name}=" in text and "NOT implemented" in text, \
                 f"no warning for {name}: {text!r}"

@@ -673,3 +673,51 @@ class TestLinearTrees:
                         callbacks=[lgb.early_stopping(5, verbose=False)])
         mse = float(np.mean((bst.predict(X[1000:]) - y[1000:]) ** 2))
         assert mse < 2.0
+
+
+class TestMiscTreeKnobs:
+    def test_extra_trees_randomizes_thresholds(self):
+        import lightgbm_tpu as lgb
+        from tests.utils import FAST_PARAMS, binary_data
+        from sklearn.metrics import roc_auc_score
+        X, y = binary_data()
+        base = dict(FAST_PARAMS, objective="binary")
+        plain = lgb.train(base, lgb.Dataset(X, label=y), 15)
+        et = lgb.train(dict(base, extra_trees=True), lgb.Dataset(X, label=y), 15)
+        assert not np.allclose(et.predict(X), plain.predict(X))
+        assert roc_auc_score(y, et.predict(X)) > 0.9
+
+    def test_feature_contri_discourages_feature(self):
+        import lightgbm_tpu as lgb
+        from tests.utils import FAST_PARAMS, binary_data
+        X, y = binary_data()
+        base = dict(FAST_PARAMS, objective="binary")
+        plain = lgb.train(base, lgb.Dataset(X, label=y), 15)
+        imp = plain.feature_importance("split")
+        top = int(np.argmax(imp))
+        contri = [1.0] * X.shape[1]
+        contri[top] = 0.01
+        pen = lgb.train(dict(base, feature_contri=contri),
+                        lgb.Dataset(X, label=y), 15)
+        assert pen.feature_importance("split")[top] < imp[top]
+
+    def test_forced_bins_and_max_bin_by_feature(self, tmp_path):
+        import json
+        import lightgbm_tpu as lgb
+        rng = np.random.RandomState(0)
+        X = rng.rand(500, 2) * 10
+        y = (X[:, 0] > 3.3333).astype(float)
+        fb = tmp_path / "forced.json"
+        fb.write_text(json.dumps(
+            [{"feature": 0, "bin_upper_bound": [3.3333]}]))
+        ds = lgb.Dataset(X, label=y,
+                         params={"forcedbins_filename": str(fb),
+                                 "max_bin_by_feature": [16, 4]})
+        ds.construct()
+        m0, m1 = ds._inner.mappers
+        assert np.any(np.isclose(m0.bin_upper_bounds, 3.3333))
+        assert m1.num_bins <= 5
+        bst = lgb.train({"objective": "binary", "verbosity": -1,
+                         "num_leaves": 4, "min_data_in_leaf": 5,
+                         "forcedbins_filename": str(fb)}, ds, 5)
+        assert ((bst.predict(X) > 0.5) == y).mean() > 0.99
