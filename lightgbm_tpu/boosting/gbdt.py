@@ -119,6 +119,44 @@ def _tree_used_features(tree, nf: int, used: jax.Array) -> jax.Array:
     return used | jnp.zeros((nf + 1,), bool).at[idx].set(True)[:nf]
 
 
+def _forced_split_schedule(path: str, mappers, num_leaves: int):
+    """Precompute the (leaf, feature, bin) schedule for a forced-splits JSON
+    tree (reference: forcedsplits_filename, SerialTreeLearner::ForceSplits
+    serial_tree_learner.cpp:620 — BFS order). Leaf ids follow the grower's
+    creation-order convention (left keeps the parent's leaf id, the right
+    child becomes leaf k+1)."""
+    import json as _json
+    from collections import deque
+    with open(path) as fh:
+        root = _json.load(fh)
+    leaves, feats, bins = [], [], []
+    queue = deque([(root, 0)])
+    k = 0
+    while queue and k < num_leaves - 1:
+        node, leaf = queue.popleft()
+        if node is None or "feature" not in node:
+            continue
+        f = int(node["feature"])
+        thr = float(node["threshold"])
+        m = mappers[f]
+        if m.is_categorical:
+            raise ValueError(
+                "forced splits on categorical features are not supported")
+        b = int(m.value_to_bin(np.array([thr]))[0])
+        leaves.append(leaf)
+        feats.append(f)
+        bins.append(b)
+        k += 1
+        if node.get("left"):
+            queue.append((node["left"], leaf))
+        if node.get("right"):
+            queue.append((node["right"], k))
+    if not leaves:
+        return None
+    return (jnp.asarray(leaves, jnp.int32), jnp.asarray(feats, jnp.int32),
+            jnp.asarray(bins, jnp.int32))
+
+
 def _clamp_block(block: int, n: int, floor: int = 128) -> int:
     """Shrink a streaming block size toward the data size (power-of-two)."""
     while block // 2 >= max(n, floor) and block > floor:
@@ -216,7 +254,9 @@ def _pad_metadata(md, n_padded: int):
     out.init_score = md.init_score
     out.group = md.group
     out.query_boundaries = md.query_boundaries
-    out.position = md.position
+    out.position = (np.pad(np.asarray(md.position),
+                           (0, n_padded - n_real))
+                    if md.position is not None else None)
     return out
 
 
@@ -468,6 +508,9 @@ class GBDT:
         self._quant_key = jax.random.PRNGKey(
             int(cfg.get("seed", 0) or 0) + 1337)
         self._extra_key = jax.random.PRNGKey(int(cfg.get("extra_seed", 6)))
+        fs_path = str(cfg.get("forcedsplits_filename", "") or "")
+        self._forced_splits = _forced_split_schedule(
+            fs_path, train_set.mappers, self.max_leaves) if fs_path else None
         fc = cfg.get("feature_contri")
         if fc is not None:
             fcv = np.asarray(list(fc), np.float32)
@@ -538,8 +581,10 @@ class GBDT:
             log.warning("tpu_grower=compact requires a serial learner and a "
                         "row-elementwise objective; using masked grower")
         # linear leaves fit against raw rows in the ORIGINAL order; the
-        # compact grower permutes rows, so linear mode uses the masked path
-        can_compact = can_compact and not self._linear
+        # compact grower permutes rows, so linear mode uses the masked path;
+        # forced splits are implemented in the masked grower only
+        can_compact = can_compact and not self._linear \
+            and self._forced_splits is None
         self._use_compact = can_compact and (
             grower == "compact"
             or (grower == "auto" and self._n_real >= 65536))
@@ -613,7 +658,7 @@ class GBDT:
                 binned, g, h, mask, num_bins_arr, nan_bin_arr, has_nan_arr,
                 is_cat_arr, feat_mask, grower_params, mono_types,
                 inter_sets, bynode_key, cegb_coupled, cegb_used,
-                extra_key, feature_contri)
+                extra_key, feature_contri, self._forced_splits)
             if use_cegb:
                 cegb_used = _tree_used_features(tree, binned.shape[1],
                                                 cegb_used)

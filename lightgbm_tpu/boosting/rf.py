@@ -72,7 +72,7 @@ class RF(GBDT):
                 _jax.random.fold_in(self._bynode_key, self.num_total_trees),
                 self._cegb_coupled, self._cegb_state(),
                 _jax.random.fold_in(self._extra_key, self.num_total_trees),
-                self._feature_contri,
+                self._feature_contri, self._forced_splits,
             )
             if self._use_cegb:
                 from .gbdt import _tree_used_features

@@ -290,7 +290,10 @@ def _find_bin_with_forced(values, total_sample_cnt, max_bin, min_data_in_bin,
                               max(max_bin - len(forced), 2),
                               min_data_in_bin, use_missing, zero_as_missing)
     finite = base.bin_upper_bounds[np.isfinite(base.bin_upper_bounds)]
-    bounds = np.unique(np.concatenate([finite, forced]))[: max_bin - 1]
+    forced = forced[: max_bin - 1]           # user bounds take priority
+    budget = max_bin - 1 - len(forced)
+    greedy = np.setdiff1d(finite, forced)[:budget]
+    bounds = np.sort(np.concatenate([forced, greedy]))
     m = BinMapper(
         num_bins=len(bounds) + 1 + (1 if base.missing_type == MISSING_NAN
                                     else 0),

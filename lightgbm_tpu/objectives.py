@@ -512,6 +512,11 @@ class LambdarankNDCG(Objective):
         trunc = int(config.get("lambdarank_truncation_level", 30))
         self.truncation_level = trunc
         self.label_gain = config.get("label_gain", None)
+        # position-bias correction (reference: RankingObjective pos_biases_,
+        # rank_objective.hpp:56-98 + UpdatePositionBiasFactors :296)
+        self.bias_reg = float(config.get(
+            "lambdarank_position_bias_regularization", 0.0))
+        self.bias_lr = float(config.get("learning_rate", 0.1))
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
@@ -546,6 +551,20 @@ class LambdarankNDCG(Objective):
         self.inv_max_dcg = jnp.asarray(
             np.where(mdcg > 0, 1.0 / np.maximum(mdcg, 1e-300), 0.0),
             jnp.float32)                                     # [Q]
+        # per-position bias state (updated every iteration -> the gradient
+        # fn must not be jit-frozen; see is_stochastic)
+        self.positions = None
+        if metadata.position is not None:
+            pos = np.asarray(metadata.position).astype(np.int32)
+            if len(pos) != num_data:
+                raise ValueError("position length != num_data")
+            self.positions = jnp.asarray(pos)
+            self.num_position_ids = int(pos.max()) + 1
+            self.pos_biases = jnp.zeros((self.num_position_ids,), jnp.float32)
+            self._pos_counts = jnp.asarray(
+                np.bincount(pos, minlength=self.num_position_ids)
+                .astype(np.float32))
+            self.is_stochastic = True  # stateful bias updates each call
 
     # queries processed in chunks of this many per pair-tensor block; the
     # block is [CHUNK, T, M] floats — memory stays bounded for MS-LTR-scale
@@ -615,6 +634,10 @@ class LambdarankNDCG(Objective):
         mask = self.query_mask
         q, m = idx.shape
         safe_idx = jnp.maximum(idx, 0)
+        if self.positions is not None:
+            # ranking math sees position-debiased scores (reference:
+            # rank_objective.hpp:70 score + pos_biases_[positions_[j]])
+            score = score + self.pos_biases[self.positions]
         s = jnp.where(mask, score[safe_idx], -jnp.inf)        # [Q, M]
         g = jnp.where(mask, self.row_gain[safe_idx], 0.0)     # gains
 
@@ -646,6 +669,16 @@ class LambdarankNDCG(Objective):
             jnp.where(mask, grad_q, 0.0).reshape(-1))
         hess = jnp.zeros_like(score).at[safe_idx.reshape(-1)].add(
             jnp.where(mask, hess_q, 0.0).reshape(-1))
+        if self.positions is not None:
+            # Newton step on the per-position bias factors (reference:
+            # UpdatePositionBiasFactors, rank_objective.hpp:296-331)
+            p_ids = self.positions
+            d1 = jnp.zeros((self.num_position_ids,)).at[p_ids].add(-grad)
+            d2 = jnp.zeros((self.num_position_ids,)).at[p_ids].add(-hess)
+            d1 = d1 - self.pos_biases * self.bias_reg * self._pos_counts
+            d2 = d2 - self.bias_reg * self._pos_counts
+            self.pos_biases = self.pos_biases + \
+                self.bias_lr * d1 / (jnp.abs(d2) + 0.001)
         return self._weighted(grad, hess)
 
 

@@ -241,6 +241,7 @@ def grow_tree(
     cegb_used0: Optional[jax.Array] = None,    # [F] bool (persisted model-level)
     extra_key: Optional[jax.Array] = None,     # PRNG key (extra_trees)
     feature_contri: Optional[jax.Array] = None,  # [F] gain multipliers
+    forced: Optional[tuple] = None,   # (leaf[J], feature[J], bin[J]) arrays
 ):
     """Grow one tree; returns (TreeArrays, row_leaf [N] i32)."""
     n, f = binned.shape
@@ -368,13 +369,45 @@ def grow_tree(
         dl = st.bs_default_left[best_leaf]
         bits = st.bs_bitset[best_leaf]
         catl2 = st.bs_cat_l2[best_leaf]
+        if forced is not None:
+            # the first len(forced) splits are dictated by the user's JSON
+            # tree (reference: SerialTreeLearner::ForceSplits,
+            # serial_tree_learner.cpp:620 — forced splits apply before the
+            # gain-driven growth). The target leaf ids were precomputed on
+            # the host from the creation-order convention.
+            fleaf, ffeat, fbin = forced
+            j_forced = fleaf.shape[0]
+            is_forced = k < j_forced
+            kf = jnp.minimum(k, j_forced - 1)
+            best_leaf = jnp.where(is_forced, fleaf[kf], best_leaf)
+            f_ = jnp.where(is_forced, ffeat[kf], f_)
+            b_ = jnp.where(is_forced, fbin[kf], b_)
+            dl = jnp.where(is_forced, False, dl)
+            bits = jnp.where(is_forced, 0, bits)
+            catl2 = jnp.where(is_forced, False, catl2)
+            # sums for the forced (feature, bin): one feature row sliced
+            # from the leaf's histogram, then a single-bin cumulative read
+            frow = lax.dynamic_slice_in_dim(
+                st.leaf_hist[best_leaf], f_, 1, axis=0)[0]   # [B, K]
+            cum = jnp.cumsum(frow, axis=0)
+            flg = cum[b_, 0]
+            flh = cum[b_, 1]
+            flc = cum[b_, 2]
+            applied = jnp.logical_or(applied, is_forced)
+            done = jnp.where(is_forced, False, done)
 
         # ---- record split; wire tree structure ----
         split_feature = st.split_feature.at[node].set(jnp.where(applied, f_, -1))
         split_bin = st.split_bin.at[node].set(jnp.where(applied, b_, 0))
         cat_bitset = st.cat_bitset.at[node].set(jnp.where(applied, bits, 0))
+        gain_rec = st.bs_gain[best_leaf]
+        if forced is not None:
+            # the cached candidate gain belongs to a different (feature,
+            # bin); record 0 for forced nodes (reference reports the forced
+            # SplitInfo's own gain, which we do not evaluate)
+            gain_rec = jnp.where(is_forced, 0.0, gain_rec)
         split_gain = st.split_gain.at[node].set(
-            jnp.where(applied, st.bs_gain[best_leaf], 0.0))
+            jnp.where(applied, gain_rec, 0.0))
         default_left = st.default_left.at[node].set(jnp.where(applied, dl, False))
         p = st.leaf_parent[best_leaf]
         side = st.leaf_parent_side[best_leaf]
@@ -410,6 +443,10 @@ def grow_tree(
         # ---- per-leaf aggregates for the two children ----
         lg, lh, lc = (st.bs_left_grad[best_leaf], st.bs_left_hess[best_leaf],
                       st.bs_left_cnt[best_leaf])
+        if forced is not None:
+            lg = jnp.where(is_forced, flg, lg)
+            lh = jnp.where(is_forced, flh, lh)
+            lc = jnp.where(is_forced, flc, lc)
         pg, ph, pc = (st.leaf_grad[best_leaf], st.leaf_hess[best_leaf],
                       st.leaf_cnt[best_leaf])
         rg, rh, rc = pg - lg, ph - lh, pc - lc

@@ -326,75 +326,85 @@ def grow_tree_compact(
         cegb_used = st.cegb_used | (applied & (jnp.arange(F) == f_))
 
         # ---- physical partition + children histograms + best splits ----
+        # NO lax.cond around the heavy buffers: a cond output forces XLA to
+        # copy the carried work/scratch arrays (~1.4 GB) every split. The
+        # not-applied case instead zeroes the loop trip counts, so the same
+        # program runs with empty partition/histogram walks.
         s_ = st.leaf_start[best_leaf]
         m_ = st.leaf_nrows[best_leaf]
         n_right = m_ - n_left
+        m_eff = jnp.where(applied, m_, 0)
+        n_left_eff = jnp.where(applied, n_left, 0)
 
-        mut = (st.work, st.scratch, st.leaf_hist, st.leaf_start, st.leaf_nrows,
-               st.bs_gain, st.bs_feature, st.bs_bin, st.bs_default_left,
-               st.bs_left_grad, st.bs_left_hess, st.bs_left_cnt,
-               st.bs_left_rows, st.bs_bitset, st.bs_cat_l2)
+        # stable partition of the parent's contiguous segment
+        # (reference: DataPartition::Split / cuda_data_partition.cu:907)
+        work, scratch = partition_segment(
+            st.work, st.scratch, s_, m_eff, n_left_eff, f_, b_, dl,
+            nan_bin_arr[f_], is_cat_arr[f_], bits, params.part_block)
+        leaf_start = st.leaf_start.at[best_leaf].set(
+            jnp.where(applied, s_, st.leaf_start[best_leaf]))
+        leaf_start = leaf_start.at[new_leaf].set(
+            jnp.where(applied, s_ + n_left, leaf_start[new_leaf]))
+        leaf_nrows = st.leaf_nrows.at[best_leaf].set(
+            jnp.where(applied, n_left, st.leaf_nrows[best_leaf]))
+        leaf_nrows = leaf_nrows.at[new_leaf].set(
+            jnp.where(applied, n_right, leaf_nrows[new_leaf]))
 
-        def apply_split(mut):
-            (work, scratch, leaf_hist, leaf_start, leaf_nrows,
-             bs_gain, bs_feature, bs_bin, bs_dl, bs_lg, bs_lh, bs_lc,
-             bs_lr, bs_bits, bs_catl2) = mut
-            # stable partition of the parent's contiguous segment
-            # (reference: DataPartition::Split / cuda_data_partition.cu:907)
-            work, scratch = partition_segment(
-                work, scratch, s_, m_, n_left, f_, b_, dl,
-                nan_bin_arr[f_], is_cat_arr[f_], bits, params.part_block)
-            leaf_start = leaf_start.at[best_leaf].set(s_)
-            leaf_start = leaf_start.at[new_leaf].set(s_ + n_left)
-            leaf_nrows = leaf_nrows.at[best_leaf].set(n_left)
-            leaf_nrows = leaf_nrows.at[new_leaf].set(n_right)
+        # one streamed pass over the SMALLER child only; the larger child
+        # is parent - smaller (reference: SubtractHistogramForLeaf,
+        # cuda_histogram_constructor.cu:723)
+        parent_hist = st.leaf_hist[best_leaf]
+        left_smaller = n_left <= n_right
+        s_small = jnp.where(left_smaller, s_, s_ + n_left)
+        m_small = jnp.where(left_smaller, n_left_eff, m_eff - n_left_eff)
+        hist_small = seg_hist(work, s_small, m_small)
+        hist_large = parent_hist - hist_small
+        hist_left = jnp.where(left_smaller, hist_small, hist_large)
+        hist_right = jnp.where(left_smaller, hist_large, hist_small)
+        leaf_hist = st.leaf_hist.at[best_leaf].set(
+            jnp.where(applied, hist_left, parent_hist))
+        leaf_hist = leaf_hist.at[new_leaf].set(
+            jnp.where(applied, hist_right, leaf_hist[new_leaf]))
 
-            # one streamed pass over the SMALLER child only; the larger child
-            # is parent - smaller (reference: SubtractHistogramForLeaf,
-            # cuda_histogram_constructor.cu:723)
-            parent_hist = leaf_hist[best_leaf]
-            left_smaller = n_left <= n_right
-            s_small = jnp.where(left_smaller, s_, s_ + n_left)
-            m_small = jnp.where(left_smaller, n_left, n_right)
-            hist_small = seg_hist(work, s_small, m_small)
-            hist_large = parent_hist - hist_small
-            hist_left = jnp.where(left_smaller, hist_small, hist_large)
-            hist_right = jnp.where(left_smaller, hist_large, hist_small)
-            leaf_hist = leaf_hist.at[best_leaf].set(hist_left)
-            leaf_hist = leaf_hist.at[new_leaf].set(hist_right)
-
-            fm_l = node_feature_mask(
-                feat_mask, used_child, inter_sets,
-                jax.random.fold_in(bynode_key, 2 * k + 1), params)
-            fm_r = node_feature_mask(
-                feat_mask, used_child, inter_sets,
-                jax.random.fold_in(bynode_key, 2 * k + 2), params)
-            pen = cegb_coupled * jnp.logical_not(cegb_used)
-            spl = leaf_best(hist_left, lg, lh, lc, d_child, fm_l,
-                            cmin_l, cmax_l, lw, pen,
-                            jax.random.fold_in(extra_key, 2 * k + 1))
-            spr = leaf_best(hist_right, rg, rh, rc, d_child, fm_r,
-                            cmin_r, cmax_r, rw, pen,
-                            jax.random.fold_in(extra_key, 2 * k + 2))
-            for leaf, sp in ((best_leaf, spl), (new_leaf, spr)):
-                bs_gain = bs_gain.at[leaf].set(sp.gain)
-                bs_feature = bs_feature.at[leaf].set(sp.feature)
-                bs_bin = bs_bin.at[leaf].set(sp.bin)
-                bs_dl = bs_dl.at[leaf].set(sp.default_left)
-                bs_lg = bs_lg.at[leaf].set(sp.left_grad)
-                bs_lh = bs_lh.at[leaf].set(sp.left_hess)
-                bs_lc = bs_lc.at[leaf].set(sp.left_count)
-                bs_lr = bs_lr.at[leaf].set(sp.left_rows.astype(i32))
-                bs_bits = bs_bits.at[leaf].set(sp.cat_bitset)
-                bs_catl2 = bs_catl2.at[leaf].set(sp.is_cat_l2)
-            return (work, scratch, leaf_hist, leaf_start, leaf_nrows,
-                    bs_gain, bs_feature, bs_bin, bs_dl, bs_lg, bs_lh, bs_lc,
-                    bs_lr, bs_bits, bs_catl2)
-
-        mut = lax.cond(applied, apply_split, lambda m: m, mut)
-        (work, scratch, leaf_hist, leaf_start, leaf_nrows, bs_gain,
-         bs_feature, bs_bin, bs_dl, bs_lg, bs_lh, bs_lc, bs_lr, bs_bits,
-         bs_catl2) = mut
+        fm_l = node_feature_mask(
+            feat_mask, used_child, inter_sets,
+            jax.random.fold_in(bynode_key, 2 * k + 1), params)
+        fm_r = node_feature_mask(
+            feat_mask, used_child, inter_sets,
+            jax.random.fold_in(bynode_key, 2 * k + 2), params)
+        pen = cegb_coupled * jnp.logical_not(cegb_used)
+        spl = leaf_best(hist_left, lg, lh, lc, d_child, fm_l,
+                        cmin_l, cmax_l, lw, pen,
+                        jax.random.fold_in(extra_key, 2 * k + 1))
+        spr = leaf_best(hist_right, rg, rh, rc, d_child, fm_r,
+                        cmin_r, cmax_r, rw, pen,
+                        jax.random.fold_in(extra_key, 2 * k + 2))
+        (bs_gain, bs_feature, bs_bin, bs_dl, bs_lg, bs_lh, bs_lc, bs_lr,
+         bs_bits, bs_catl2) = (st.bs_gain, st.bs_feature, st.bs_bin,
+                               st.bs_default_left, st.bs_left_grad,
+                               st.bs_left_hess, st.bs_left_cnt,
+                               st.bs_left_rows, st.bs_bitset, st.bs_cat_l2)
+        for leaf, sp in ((best_leaf, spl), (new_leaf, spr)):
+            bs_gain = bs_gain.at[leaf].set(
+                jnp.where(applied, sp.gain, bs_gain[leaf]))
+            bs_feature = bs_feature.at[leaf].set(
+                jnp.where(applied, sp.feature, bs_feature[leaf]))
+            bs_bin = bs_bin.at[leaf].set(
+                jnp.where(applied, sp.bin, bs_bin[leaf]))
+            bs_dl = bs_dl.at[leaf].set(
+                jnp.where(applied, sp.default_left, bs_dl[leaf]))
+            bs_lg = bs_lg.at[leaf].set(
+                jnp.where(applied, sp.left_grad, bs_lg[leaf]))
+            bs_lh = bs_lh.at[leaf].set(
+                jnp.where(applied, sp.left_hess, bs_lh[leaf]))
+            bs_lc = bs_lc.at[leaf].set(
+                jnp.where(applied, sp.left_count, bs_lc[leaf]))
+            bs_lr = bs_lr.at[leaf].set(
+                jnp.where(applied, sp.left_rows.astype(i32), bs_lr[leaf]))
+            bs_bits = bs_bits.at[leaf].set(
+                jnp.where(applied, sp.cat_bitset, bs_bits[leaf]))
+            bs_catl2 = bs_catl2.at[leaf].set(
+                jnp.where(applied, sp.is_cat_l2, bs_catl2[leaf]))
 
         return CompactState(
             done=done,

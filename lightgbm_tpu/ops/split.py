@@ -344,7 +344,8 @@ def best_split(
     cs, cbest = _sorted_cat_split(
         g, h, c, r, is_cat, num_bins, feat_mask, parent_grad, parent_hess,
         parent_count, gain_shift, p, parent_output, cmin,
-        cmax, cegb_pen) if sorted_any else (None, None)
+        cmax, cegb_pen, extra_key, feature_contri) \
+        if sorted_any else (None, None)
     if cs is not None:
         use_sorted = cbest["gain"] > best_gain
     else:
@@ -386,7 +387,8 @@ def best_split(
 
 def _sorted_cat_split(g, h, c, r, is_cat, num_bins, feat_mask, parent_grad,
                       parent_hess, parent_count, gain_shift, p: SplitParams,
-                      parent_output=0.0, cmin=None, cmax=None, cegb_pen=None):
+                      parent_output=0.0, cmin=None, cmax=None, cegb_pen=None,
+                      extra_key=None, feature_contri=None):
     """Best sorted-many-category split over all features; returns
     (True, dict) or (None, None) when no feature qualifies statically."""
     f, b = g.shape
@@ -481,6 +483,19 @@ def _sorted_cat_split(g, h, c, r, is_cat, num_bins, feat_mask, parent_grad,
     if p.use_cegb and cegb_pen is not None:
         gains = gains - cegb_pen[:, None, None] \
             - p.cegb_split_pen * parent_count
+    if feature_contri is not None:
+        gains = jnp.where(gains > 0,
+                          gains * feature_contri[:, None, None], gains)
+    if p.extra_trees and extra_key is not None:
+        # one random prefix size per feature (reference: USE_RAND
+        # rand_threshold in the categorical branch)
+        import jax as _jax
+        rnd_t = _jax.random.randint(
+            _jax.random.fold_in(extra_key, 1), (f,), 0,
+            jnp.maximum(max_num_cat, 1))
+        gains = jnp.where(
+            (jnp.arange(mct)[None, :, None] == rnd_t[:, None, None]),
+            gains, _NEG_INF)
     gains = jnp.where(evald, gains, _NEG_INF)
 
     flatc = gains.reshape(-1)

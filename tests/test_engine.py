@@ -721,3 +721,56 @@ class TestMiscTreeKnobs:
                          "num_leaves": 4, "min_data_in_leaf": 5,
                          "forcedbins_filename": str(fb)}, ds, 5)
         assert ((bst.predict(X) > 0.5) == y).mean() > 0.99
+
+
+class TestPositionBias:
+    def test_lambdarank_position_bias_learns(self):
+        """Position-bias correction (reference: rank_objective.hpp
+        pos_biases_ / UpdatePositionBiasFactors)."""
+        import lightgbm_tpu as lgb
+        rng = np.random.RandomState(0)
+        n_q, m = 60, 10
+        n = n_q * m
+        X = rng.randn(n, 5)
+        w = rng.randn(5)
+        true_rel = (X @ w > 0.5).astype(float)
+        # clicks biased by display position: early positions over-labeled
+        pos = np.tile(np.arange(m), n_q)
+        click_prob = np.clip(0.4 * true_rel + 0.5 / (1 + pos), 0, 1)
+        y = (rng.rand(n) < click_prob).astype(float)
+        ds = lgb.Dataset(X, label=y, group=np.full(n_q, m), position=pos)
+        bst = lgb.train(dict(objective="lambdarank", verbosity=-1,
+                             num_leaves=15, min_data_in_leaf=5, max_bin=31,
+                             lambdarank_position_bias_regularization=0.001),
+                        ds, 15)
+        biases = np.asarray(bst._gbdt.objective.pos_biases)
+        assert np.isfinite(biases).all()
+        assert np.abs(biases).max() > 1e-3          # something was learned
+        # earlier positions absorb larger (more positive) bias than later
+        assert biases[0] > biases[-1]
+        assert np.isfinite(bst.predict(X)).all()
+
+
+class TestForcedSplits:
+    def test_forced_tree_prefix(self, tmp_path):
+        """forcedsplits_filename dictates the first splits (reference:
+        SerialTreeLearner::ForceSplits)."""
+        import json
+        import lightgbm_tpu as lgb
+        from tests.utils import FAST_PARAMS, binary_data
+        X, y = binary_data()
+        fs = tmp_path / "forced.json"
+        fs.write_text(json.dumps({
+            "feature": 3, "threshold": 0.0,
+            "left": {"feature": 5, "threshold": 0.5},
+        }))
+        bst = lgb.train(dict(FAST_PARAMS, objective="binary",
+                             forcedsplits_filename=str(fs)),
+                        lgb.Dataset(X, label=y), 8)
+        d = bst.dump_model()
+        for t in d["tree_info"]:
+            root = t["tree_structure"]
+            assert root["split_feature"] == 3
+            assert root["left_child"].get("split_feature") == 5
+        from sklearn.metrics import roc_auc_score
+        assert roc_auc_score(y, bst.predict(X)) > 0.9
