@@ -509,6 +509,12 @@ class GBDT:
             int(cfg.get("seed", 0) or 0) + 1337)
         self._extra_key = jax.random.PRNGKey(int(cfg.get("extra_seed", 6)))
         fs_path = str(cfg.get("forcedsplits_filename", "") or "")
+        if fs_path and self.mesh is not None and self.tree_learner == "voting":
+            # voted histograms zero un-elected features, so forced child
+            # sums would be wrong (grower reads them from leaf_hist)
+            log.warning("forcedsplits_filename is not supported with "
+                        "tree_learner=voting; ignoring it")
+            fs_path = ""
         self._forced_splits = _forced_split_schedule(
             fs_path, train_set.mappers, self.max_leaves) if fs_path else None
         fc = cfg.get("feature_contri")

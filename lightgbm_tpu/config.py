@@ -171,6 +171,9 @@ PARAMS: Dict[str, Tuple[Any, type, Tuple[str, ...]]] = {
     # per-leaf segments (O(N*depth)/tree), 'masked' streams all rows per
     # split (O(N*num_leaves)/tree); 'auto' picks compact for large data
     "tpu_grower": ("auto", str, ()),        # auto | compact | masked
+    # profiling: write a jax.profiler trace of the training loop here
+    # (reference aux analogue: USE_TIMETAG Common::Timer registry)
+    "tpu_trace_dir": ("", str, ()),
     "tpu_part_block": (2048, int, ()),      # compact partition stream block
     "tpu_hist_block": (16384, int, ()),     # compact histogram stream block
     "num_shards": (0, int, ()),             # 0 = use all local devices when tree_learner != serial

@@ -1,0 +1,42 @@
+"""Dask integration surface (reference: python-package/lightgbm/dask.py).
+
+The reference uses Dask to place data partitions on workers, assign ports,
+and run one socket-connected training process per worker
+(dask.py:115,182-412). On TPU pods that orchestration role is filled by
+JAX multi-process initialization instead: run the same training script on
+every host with ``num_machines``/``machines`` set (see
+``lightgbm_tpu.parallel.multihost``) and the data-parallel learner shards
+rows over all chips of all hosts — no separate scheduler process is needed.
+
+These classes exist so code written against the reference's Dask API fails
+with a actionable message rather than an AttributeError. If dask is
+installed, ``DaskLGBM*`` could be implemented as thin wrappers that gather
+partitions per host and call the multihost path; this environment does not
+ship dask, so they raise.
+"""
+from __future__ import annotations
+
+_MSG = (
+    "Dask orchestration is not available in lightgbm_tpu. On TPU pods use "
+    "jax multi-process training instead: run the same script on every host "
+    "with params={'tree_learner': 'data', 'num_machines': N, "
+    "'machines': 'host1:port,host2:port,...'} (see "
+    "lightgbm_tpu.parallel.multihost)."
+)
+
+
+class _DaskUnavailable:
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(_MSG)
+
+
+class DaskLGBMClassifier(_DaskUnavailable):
+    pass
+
+
+class DaskLGBMRegressor(_DaskUnavailable):
+    pass
+
+
+class DaskLGBMRanker(_DaskUnavailable):
+    pass

@@ -119,42 +119,55 @@ def train(
     snapshot_freq = int(params.get("snapshot_freq", -1) or -1)
     snapshot_out = str(params.get("output_model", "LightGBM_model.txt"))
 
-    evaluation_result_list: List = []
-    for i in range(num_boost_round):
-        for cb in cbs_before:
-            cb(callback_mod.CallbackEnv(
-                model=booster, params=params, iteration=i,
-                begin_iteration=0, end_iteration=num_boost_round,
-                evaluation_result_list=None))
-        finished = booster.update()
+    # profiling (reference aux: USE_TIMETAG timers; here a jax.profiler
+    # trace of the device programs, viewable in TensorBoard/Perfetto)
+    trace_dir = str(params.get("tpu_trace_dir", "") or "")
+    trace_ctx = None
+    if trace_dir:
+        import jax
+        trace_ctx = jax.profiler.trace(trace_dir)
+        trace_ctx.__enter__()
 
-        evaluation_result_list = []
-        if (valid_sets is not None and (booster._valid_names
-                                        or is_valid_contain_train)) or feval:
-            if is_valid_contain_train:
-                evaluation_result_list.extend(booster.eval_train(feval))
-            evaluation_result_list.extend(booster.eval_valid(feval))
-        try:
-            for cb in cbs_after:
+    try:
+        evaluation_result_list: List = []
+        for i in range(num_boost_round):
+            for cb in cbs_before:
                 cb(callback_mod.CallbackEnv(
                     model=booster, params=params, iteration=i,
                     begin_iteration=0, end_iteration=num_boost_round,
-                    evaluation_result_list=evaluation_result_list))
-        except callback_mod.EarlyStopException as e:
-            booster.best_iteration = e.best_iteration + 1
-            evaluation_result_list = e.best_score or []
-            break
-        # periodic model snapshots (reference: GBDT::Train, gbdt.cpp:250-254
-        # -> model.txt.snapshot_iter_N every snapshot_freq iterations).
-        # The save flushes pending device trees; capture its stop signal
-        # instead of discarding it (a no-split iteration pops its trees)
-        if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
-            finished = booster._gbdt._flush_trees() or finished
-            booster.save_model(f"{snapshot_out}.snapshot_iter_{i + 1}")
-        if finished:
-            log.info("Finished training (no further splits possible)")
-            break
+                    evaluation_result_list=None))
+            finished = booster.update()
 
+            evaluation_result_list = []
+            if (valid_sets is not None and (booster._valid_names
+                                            or is_valid_contain_train)) or feval:
+                if is_valid_contain_train:
+                    evaluation_result_list.extend(booster.eval_train(feval))
+                evaluation_result_list.extend(booster.eval_valid(feval))
+            try:
+                for cb in cbs_after:
+                    cb(callback_mod.CallbackEnv(
+                        model=booster, params=params, iteration=i,
+                        begin_iteration=0, end_iteration=num_boost_round,
+                        evaluation_result_list=evaluation_result_list))
+            except callback_mod.EarlyStopException as e:
+                booster.best_iteration = e.best_iteration + 1
+                evaluation_result_list = e.best_score or []
+                break
+            # periodic model snapshots (reference: GBDT::Train, gbdt.cpp:250-254
+            # -> model.txt.snapshot_iter_N every snapshot_freq iterations).
+            # The save flushes pending device trees; capture its stop signal
+            # instead of discarding it (a no-split iteration pops its trees)
+            if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
+                finished = booster._gbdt._flush_trees() or finished
+                booster.save_model(f"{snapshot_out}.snapshot_iter_{i + 1}")
+            if finished:
+                log.info("Finished training (no further splits possible)")
+                break
+
+    finally:
+        if trace_ctx is not None:
+            trace_ctx.__exit__(None, None, None)
     # record final scores (reference: engine.py:346-352)
     if evaluation_result_list:
         best: Dict[str, Dict[str, float]] = collections.OrderedDict()

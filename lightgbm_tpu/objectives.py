@@ -561,8 +561,13 @@ class LambdarankNDCG(Objective):
             self.positions = jnp.asarray(pos)
             self.num_position_ids = int(pos.max()) + 1
             self.pos_biases = jnp.zeros((self.num_position_ids,), jnp.float32)
+            # padding rows carry zero weight (gbdt._pad_metadata) and must
+            # not count toward the per-position regularizer
+            wts = (np.asarray(metadata.weight, np.float64)
+                   if metadata.weight is not None else np.ones(num_data))
             self._pos_counts = jnp.asarray(
-                np.bincount(pos, minlength=self.num_position_ids)
+                np.bincount(pos, weights=(wts > 0).astype(np.float64),
+                            minlength=self.num_position_ids)
                 .astype(np.float32))
             self.is_stochastic = True  # stateful bias updates each call
 
