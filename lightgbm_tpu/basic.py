@@ -293,6 +293,7 @@ class Booster:
         self.best_score: Dict = {}
         self._train_data_name = "training"
         self._custom_objective: Optional[Callable] = None
+        self._pending_finish = False
 
         if train_set is not None:
             if not isinstance(train_set, Dataset):
@@ -429,8 +430,12 @@ class Booster:
         fobj = fobj or self._custom_objective
         if fobj is not None:
             grad, hess = _call_custom_objective(fobj, self)
-            return self._gbdt.train_one_iter(grad, hess)
-        return self._gbdt.train_one_iter()
+            finished = self._gbdt.train_one_iter(grad, hess)
+        else:
+            finished = self._gbdt.train_one_iter()
+        # a stop detected by a mid-training flush (e.g. in reset_parameter)
+        pending, self._pending_finish = self._pending_finish, False
+        return finished or pending
 
     def rollback_one_iter(self) -> "Booster":
         self._gbdt.rollback_one_iter()
@@ -443,6 +448,7 @@ class Booster:
         gbdt = self._gbdt
         gbdt.learning_rate = float(self.config.learning_rate)
         gbdt.shrinkage_rate = gbdt.learning_rate
+        old_gp = gbdt.grower_params
         gbdt.grower_params = gbdt.grower_params._replace(
             num_leaves=int(self.config.num_leaves),
             max_depth=int(self.config.max_depth),
@@ -455,7 +461,19 @@ class Booster:
         )
         gbdt.max_leaves = int(self.config.num_leaves)
         gbdt.feature_fraction = float(self.config.feature_fraction)
-        gbdt._step_fn = None  # step closes over grower_params; rebuild
+        if gbdt.grower_params != old_gp:
+            # the step fns close over grower_params; rebuild only on actual
+            # change — learning_rate (the common per-iteration schedule) is a
+            # runtime argument, and an unconditional invalidation would force
+            # an XLA recompile every iteration
+            gbdt._step_fn = None
+            if getattr(gbdt, "_compact", None) is not None:
+                # flush trees grown under the old num_leaves first so the
+                # pending-tree stack never mixes shapes; a no-split stop
+                # detected here must reach the engine loop, not be dropped
+                self._pending_finish = gbdt._flush_trees() or \
+                    self._pending_finish
+                gbdt._compact["step"] = None
         return self
 
     # -- evaluation ----------------------------------------------------------

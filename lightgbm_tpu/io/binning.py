@@ -292,7 +292,17 @@ def _find_bin_with_forced(values, total_sample_cnt, max_bin, min_data_in_bin,
     finite = base.bin_upper_bounds[np.isfinite(base.bin_upper_bounds)]
     forced = forced[: max_bin - 1]           # user bounds take priority
     budget = max_bin - 1 - len(forced)
-    greedy = np.setdiff1d(finite, forced)[:budget]
+    leftover = np.setdiff1d(finite, forced)
+    if budget <= 0:
+        greedy = leftover[:0]
+    elif len(leftover) > budget:
+        # keep the base mapper's resolution profile: sample the complement at
+        # evenly spaced positions — the sorted prefix would concentrate every
+        # remaining bin at the low end of the feature range
+        pick = np.linspace(0, len(leftover) - 1, budget).round().astype(int)
+        greedy = leftover[np.unique(pick)]
+    else:
+        greedy = leftover
     bounds = np.sort(np.concatenate([forced, greedy]))
     m = BinMapper(
         num_bins=len(bounds) + 1 + (1 if base.missing_type == MISSING_NAN

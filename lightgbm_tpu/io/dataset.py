@@ -144,15 +144,24 @@ class BinnedDataset:
     # src/io/dataset.cpp / DatasetLoader::LoadFromBinFile :417) -------------
     def save_binary(self, path: str) -> None:
         """Save the constructed dataset (bins + mappers + metadata) so later
-        runs skip text parsing and re-binning."""
-        import pickle
+        runs skip text parsing and re-binning.
+
+        Mappers serialize as JSON inside the npz (never pickle: loading a
+        dataset file must not execute code — the reference's binary format is
+        plain structs, dataset_loader.cpp:417)."""
+        import json
         mapper_blobs = [{
-            "num_bins": m.num_bins, "is_categorical": m.is_categorical,
-            "missing_type": m.missing_type,
-            "bin_upper_bounds": m.bin_upper_bounds,
-            "cat_to_bin": m.cat_to_bin, "bin_to_cat": m.bin_to_cat,
-            "default_bin": m.default_bin,
-            "min_value": m.min_value, "max_value": m.max_value,
+            "num_bins": int(m.num_bins),
+            "is_categorical": bool(m.is_categorical),
+            "missing_type": int(m.missing_type),
+            # non-finite bounds (the last bound is always +inf) go as strings
+            # so the blob stays strict RFC-8259 JSON for external consumers
+            "bin_upper_bounds": [float(x) if np.isfinite(x) else str(float(x))
+                                 for x in m.bin_upper_bounds],
+            "cat_to_bin": {str(k): int(v) for k, v in m.cat_to_bin.items()},
+            "bin_to_cat": [int(x) for x in m.bin_to_cat],
+            "default_bin": int(m.default_bin),
+            "min_value": float(m.min_value), "max_value": float(m.max_value),
         } for m in self.mappers]
         md = self.metadata
         # np.savez appends '.npz' to bare paths; write via a handle so the
@@ -160,7 +169,7 @@ class BinnedDataset:
         fh = open(path, "wb")
         np.savez_compressed(
             fh,
-            magic=np.frombuffer(b"lgbtpu.bin.v1\x00\x00\x00", np.uint8),
+            magic=np.frombuffer(b"lgbtpu.bin.v2\x00\x00\x00", np.uint8),
             binned=self.binned,
             feature_names=np.asarray(self.feature_names),
             max_num_bins=self.max_num_bins,
@@ -169,7 +178,8 @@ class BinnedDataset:
             used_features=np.asarray(self.used_features, np.int64),
             categorical_features=np.asarray(self.categorical_features,
                                             np.int64),
-            mappers=np.frombuffer(pickle.dumps(mapper_blobs), np.uint8),
+            mappers=np.frombuffer(
+                json.dumps(mapper_blobs, allow_nan=False).encode(), np.uint8),
             label=md.label if md.label is not None else np.zeros(0),
             weight=md.weight if md.weight is not None else np.zeros(0),
             init_score=(md.init_score if md.init_score is not None
@@ -182,11 +192,13 @@ class BinnedDataset:
 
     @staticmethod
     def load_binary(path: str) -> "BinnedDataset":
-        import pickle
+        import json
         from .binning import BinMapper
         z = np.load(path, allow_pickle=False)
-        if bytes(z["magic"].tobytes())[:13] != b"lgbtpu.bin.v1":
-            raise ValueError(f"{path} is not a lightgbm_tpu binary dataset")
+        if bytes(z["magic"].tobytes())[:13] != b"lgbtpu.bin.v2":
+            raise ValueError(
+                f"{path} is not a lightgbm_tpu binary dataset (v2); "
+                "re-save with save_binary()")
         ds = BinnedDataset()
         ds.binned = z["binned"]
         ds.feature_names = [str(x) for x in z["feature_names"]]
@@ -195,8 +207,14 @@ class BinnedDataset:
         ds.num_total_features = int(z["num_total_features"])
         ds.used_features = [int(i) for i in z["used_features"]]
         ds.categorical_features = [int(i) for i in z["categorical_features"]]
-        ds.mappers = [BinMapper(**blob)
-                      for blob in pickle.loads(z["mappers"].tobytes())]
+        blobs = json.loads(z["mappers"].tobytes().decode())
+        for blob in blobs:
+            blob["bin_upper_bounds"] = np.asarray(
+                [float(v) for v in blob["bin_upper_bounds"]], np.float64)
+            blob["cat_to_bin"] = {int(k): int(v)
+                                  for k, v in blob["cat_to_bin"].items()}
+            blob["bin_to_cat"] = np.asarray(blob["bin_to_cat"], np.int64)
+        ds.mappers = [BinMapper(**blob) for blob in blobs]
         md = Metadata(ds.num_data)
         for name in ("label", "weight", "init_score", "position"):
             arr = z[name]

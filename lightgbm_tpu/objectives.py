@@ -674,9 +674,11 @@ class LambdarankNDCG(Objective):
             jnp.where(mask, grad_q, 0.0).reshape(-1))
         hess = jnp.zeros_like(score).at[safe_idx.reshape(-1)].add(
             jnp.where(mask, hess_q, 0.0).reshape(-1))
+        grad, hess = self._weighted(grad, hess)
         if self.positions is not None:
             # Newton step on the per-position bias factors (reference:
-            # UpdatePositionBiasFactors, rank_objective.hpp:296-331)
+            # UpdatePositionBiasFactors, rank_objective.hpp:296-331, fed the
+            # weight-multiplied lambdas — hence after _weighted)
             p_ids = self.positions
             d1 = jnp.zeros((self.num_position_ids,)).at[p_ids].add(-grad)
             d2 = jnp.zeros((self.num_position_ids,)).at[p_ids].add(-hess)
@@ -684,7 +686,7 @@ class LambdarankNDCG(Objective):
             d2 = d2 - self.bias_reg * self._pos_counts
             self.pos_biases = self.pos_biases + \
                 self.bias_lr * d1 / (jnp.abs(d2) + 0.001)
-        return self._weighted(grad, hess)
+        return grad, hess
 
 
 class RankXENDCG(Objective):
