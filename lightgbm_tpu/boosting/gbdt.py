@@ -157,6 +157,20 @@ def _forced_split_schedule(path: str, mappers, num_leaves: int):
             jnp.asarray(bins, jnp.int32))
 
 
+def _pick_fused_block(cfg) -> int:
+    """Resolve ``tpu_fused``: the fused per-split Mosaic kernel
+    (ops/fused_split.py) replaces the XLA partition+histogram streams on the
+    compact path. auto = on whenever a real TPU backend is present."""
+    from ..ops.fused_split import fused_available
+    mode = str(cfg.get("tpu_fused", "auto")).lower()
+    if mode in ("off", "0", "false"):
+        return 0
+    if mode == "on" or (mode == "auto" and fused_available()):
+        bs = int(cfg.get("tpu_fused_block", 512))
+        return max(32, (bs // 32) * 32)
+    return 0
+
+
 def _clamp_block(block: int, n: int, floor: int = 128) -> int:
     """Shrink a streaming block size toward the data size (power-of-two)."""
     while block // 2 >= max(n, floor) and block > floor:
@@ -561,6 +575,7 @@ class GBDT:
                 int(cfg.get("tpu_part_block", 2048)), self._n_real),
             hist_block=_clamp_block(
                 int(cfg.get("tpu_hist_block", 16384)), self._n_real),
+            fused_block=_pick_fused_block(cfg),
         )
 
         # serial-learner row storage: the compact grower physically
@@ -729,7 +744,9 @@ class GBDT:
         self._cx_weight = k + gcols + 1 if has_w else None
         self._cx_rowid = e - 1
         gp = self.grower_params
-        pad = max(gp.part_block, gp.hist_block)
+        # the fused kernel's aligned block writes may overrun a segment end
+        # by up to one block + one alignment tile
+        pad = max(gp.part_block, gp.hist_block, gp.fused_block + 32)
         parts = [self.train_score]
         if gcols:
             parts.append(jnp.zeros((gcols, n), jnp.float32))

@@ -176,6 +176,10 @@ PARAMS: Dict[str, Tuple[Any, type, Tuple[str, ...]]] = {
     "tpu_trace_dir": ("", str, ()),
     "tpu_part_block": (2048, int, ()),      # compact partition stream block
     "tpu_hist_block": (16384, int, ()),     # compact histogram stream block
+    # fused per-split Mosaic kernel (partition + smaller-child histogram in
+    # one streamed walk, ops/fused_split.py): auto = on with a TPU backend
+    "tpu_fused": ("auto", str, ()),         # auto | on | off
+    "tpu_fused_block": (512, int, ()),      # fused kernel block size (x32)
     "num_shards": (0, int, ()),             # 0 = use all local devices when tree_learner != serial
     # snapshot / continue
     "snapshot_freq": (-1, int, ("save_period",)),

@@ -75,8 +75,11 @@ class RowLayout(NamedTuple):
     @property
     def num_cols(self) -> int:
         c = self.num_features + 12 + 4 * self.num_extra
-        # round lanes up for clean VMEM tiling
-        return -(-c // 32) * 32
+        # round lanes up to the full 128-lane tile: TPU HBM layouts pad the
+        # minor dimension to 128 anyway (tiled storage), so this costs no
+        # physical memory, and the fused Pallas kernel (ops/fused_split.py)
+        # requires the logical and physical layouts to coincide
+        return -(-c // 128) * 128
 
 
 def _f32_to_u8(x: jnp.ndarray) -> jnp.ndarray:

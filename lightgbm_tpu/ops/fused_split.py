@@ -1,0 +1,487 @@
+"""Fused per-split Pallas kernel: partition + smaller-child histogram.
+
+TPU-native re-design of the reference's per-split device work (reference:
+CUDA kernels GenDataToLeftBitVectorKernel / AggregateBlockOffsetKernel /
+SplitInnerKernel, src/treelearner/cuda/cuda_data_partition.cu:288,679,907,
+plus CUDAConstructHistogramDenseKernel,
+src/treelearner/cuda/cuda_histogram_constructor.cu:17-68 — there three
+separate kernel launches per split; here ONE fused streaming walk).
+
+The XLA compact path (ops/compact.py) implements the same stable partition as
+a chain of slice / compare / one-hot-matmul / roll / cond-flush ops per
+2048-row block; measured on v5e it sustains only ~22-45 Mrows/s in context
+because every block is ~10 separate XLA ops and the Pallas histogram calls
+inside the dynamic while_loop cannot pipeline. This kernel internalizes the
+whole walk:
+
+  * the parent leaf's contiguous segment streams HBM -> VMEM once, with
+    double-buffered DMA;
+  * each block stably partitions via ONE dest-indexed one-hot MXU matmul
+    (dest = carry_offset + rank, so the carry append costs nothing extra);
+  * left rows flush to `work` in place (the left write cursor can never
+    overtake the read cursor), right rows flush to `scratch` at their final
+    offsets and are copied back after the walk;
+  * the SMALLER child's histogram accumulates in VMEM whenever that stream
+    flushes a full block — histogram work is n_smaller rows exactly, like the
+    reference's smaller-leaf trick (serial_tree_learner.cpp:404);
+  * `mode=1` turns the kernel into a plain segment histogram (used for the
+    root), skipping all partition work.
+
+Alignment: Mosaic requires dynamic DMA offsets provably divisible by the
+sublane tiling (8 rows; 32 covers int8 packing), so the segment start is
+rounded down to 32 and the `phi` pre-segment rows ride the left stream as
+preserved head rows (they rank first in block 0, flush back to their original
+slots, and are masked out of the histogram). All DMA offsets in the kernel
+are of the form `32*t + k*BS`, which the compiler can prove aligned.
+
+Numerics: row bytes move through the permutation matmul as bf16 values
+(0..255 exact, one-hot contraction, f32 accumulate — exact). Histogram
+channels use the same hi/lo-bf16 split as ops/pallas_histogram.py: counts
+exact, grad/hess ~2^-17 relative.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:  # pallas is TPU/Mosaic only; CPU tests use interpret mode
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+from .compact import RowLayout
+
+_A = 32  # row alignment every DMA offset is provably divisible by
+
+# sp scalar-prefetch vector layout (i32[16])
+_MODE, _BASE_T, _PHI, _COUNT, _NLEFT, _FEAT, _BIN, _DLEFT, _NANBIN, _ISCAT, \
+    _SMALLER_L, _RBASE_T, _PSI = range(13)
+
+# smem bookkeeping slots
+_LCNT, _RCNT, _LF, _RF, _CBW = range(5)
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _assemble_f32(blk_i32, off: int):
+    """4 u8 lanes at static offset ``off`` -> f32 column [BS, 1].
+
+    Assembles via multiplies, NOT shifts: Mosaic miscompiles `<< 16` on
+    values cast from u8 (observed on v5e: some lanes come back zero), while
+    integer multiply wraps correctly — byte3 * 2^24 overflowing into the sign
+    bit is exactly the bit pattern we want.
+    """
+    w = (blk_i32[:, off:off + 1] + blk_i32[:, off + 1:off + 2] * 256
+         + blk_i32[:, off + 2:off + 3] * 65536
+         + blk_i32[:, off + 3:off + 4] * 16777216)
+    return lax.bitcast_convert_type(w, jnp.float32)
+
+
+def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
+                  hist_ref, sem_in, sem_l, sem_r, sem_cw, inbuf, lcarry,
+                  rcarry, lstage, rstage, cbstage, smem, *, layout: RowLayout,
+                  num_bins: int, bs: int, bitset_words: int):
+    F = layout.num_features
+    C = layout.num_cols
+    B = num_bins
+    Bk = _round_up(B, 128)
+    i32 = jnp.int32
+
+    mode = sp_ref[_MODE]
+    base = sp_ref[_BASE_T] * _A
+    phi = sp_ref[_PHI]
+    count = sp_ref[_COUNT]
+    n_left = sp_ref[_NLEFT]
+    feature = sp_ref[_FEAT]
+    bin_ = sp_ref[_BIN]
+    default_left = sp_ref[_DLEFT]
+    nan_bin = sp_ref[_NANBIN]
+    is_cat = sp_ref[_ISCAT]
+    smaller_left = sp_ref[_SMALLER_L]
+    rbase = sp_ref[_RBASE_T] * _A
+    psi = sp_ref[_PSI]
+
+    start = base + phi
+    span = phi + count
+    nblocks = (span + bs - 1) // bs
+
+    hist_ref[:, :] = jnp.zeros_like(hist_ref)
+    smem[_LCNT] = 0
+    smem[_RCNT] = psi
+    smem[_LF] = 0
+    smem[_RF] = 0
+    smem[_CBW] = 0
+    lcarry[:, :] = jnp.zeros_like(lcarry)
+    rcarry[:, :] = jnp.zeros_like(rcarry)
+
+    iota = lax.broadcasted_iota(i32, (bs, 1), 0)[:, 0]
+    lane = lax.broadcasted_iota(i32, (bs, C), 1)
+    io2 = lax.broadcasted_iota(i32, (bs, bs), 0)
+    jo2 = lax.broadcasted_iota(i32, (bs, bs), 1)
+    lt = (io2 > jo2).astype(jnp.bfloat16)          # strict lower triangular
+    iota4 = lax.broadcasted_iota(i32, (4 * bs, bs), 0)
+    iota_b = lax.broadcasted_iota(i32, (bs, Bk), 1)
+
+    def read_dma(i, slot):
+        return pltpu.make_async_copy(
+            work_out.at[pl.ds(base + i * bs, bs), :], inbuf.at[slot],
+            sem_in.at[slot])
+
+    def hist_accum(rows_u8, mask_f32):
+        """Accumulate masked rows of a [BS, C] u8 buffer into hist_ref."""
+        rows = rows_u8.astype(i32)
+        bins = rows[:, :F]
+        m = mask_f32[:, None]                              # [BS, 1]
+        g = _assemble_f32(rows, layout.grad_off) * m
+        h = _assemble_f32(rows, layout.hess_off) * m
+        cw = _assemble_f32(rows, layout.cnt_off)
+        inbag = jnp.where(cw != 0.0, m, 0.0)
+        ghi = g.astype(jnp.bfloat16).astype(jnp.float32)
+        hhi = h.astype(jnp.bfloat16).astype(jnp.float32)
+        chans = [ghi, hhi, inbag, m, g - ghi, h - hhi,
+                 jnp.zeros_like(g), jnp.zeros_like(g)]
+        lane8 = lax.broadcasted_iota(i32, (bs, 8), 1)
+        ch8 = jnp.zeros((bs, 8), jnp.float32)
+        for k, c in enumerate(chans):
+            ch8 = ch8 + jnp.where(lane8 == k, c, 0.0)
+        ch8 = ch8.astype(jnp.bfloat16)
+        w = max(1, min(F, 512 // Bk))
+        fc = 0
+        while fc < F:
+            wc = min(w, F - fc)
+            oh = jnp.concatenate(
+                [(bins[:, fc + j:fc + j + 1] == iota_b).astype(jnp.bfloat16)
+                 for j in range(wc)], axis=1)            # [BS, wc*Bk]
+            part = lax.dot_general(
+                ch8, oh, dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [8, wc*Bk]
+            hist_ref[:, fc * Bk:(fc + wc) * Bk] += part
+            fc += wc
+
+    def stage_flush(stream, data_u8, hbm_base, do_hist, hist_mask):
+        """Write one full block via the stream's staging ring; maybe hist."""
+        stage, sem, cslot = ((lstage, sem_l, _LF) if stream == 0
+                             else (rstage, sem_r, _RF))
+        ref = work_out if stream == 0 else scr_out
+        cnt = smem[cslot]
+        slot = lax.rem(cnt, 2)
+
+        @pl.when(cnt >= 2)
+        def _():
+            pltpu.make_async_copy(
+                stage.at[slot], ref.at[pl.ds(0, bs), :], sem.at[slot]).wait()
+
+        stage[slot] = data_u8
+        pltpu.make_async_copy(
+            stage.at[slot], ref.at[pl.ds(hbm_base, bs), :],
+            sem.at[slot]).start()
+
+        @pl.when(do_hist)
+        def _():
+            hist_accum(stage[slot], hist_mask)
+        smem[cslot] = cnt + 1
+
+    def drain(stream):
+        stage, sem, cslot = ((lstage, sem_l, _LF) if stream == 0
+                             else (rstage, sem_r, _RF))
+        ref = work_out if stream == 0 else scr_out
+        cnt = smem[cslot]
+        for back in (2, 1):
+            @pl.when(cnt >= back)
+            def _():
+                slot = lax.rem(cnt - back, 2)
+                pltpu.make_async_copy(
+                    stage.at[slot], ref.at[pl.ds(0, bs), :],
+                    sem.at[slot]).wait()
+
+    # ---------------- main walk ----------------
+    @pl.when(nblocks > 0)
+    def _():
+        read_dma(0, 0).start()
+
+    def body(i, _):
+        slot = lax.rem(i, 2)
+
+        @pl.when(i + 1 < nblocks)
+        def _():
+            read_dma(i + 1, lax.rem(i + 1, 2)).start()
+
+        read_dma(i, slot).wait()
+        blk_u8 = inbuf[slot]
+        blk = blk_u8.astype(i32)
+        g_idx = base + i * bs + iota
+        in_seg = jnp.logical_and(g_idx >= start, g_idx < start + count)
+
+        @pl.when(mode == 1)
+        def _():
+            hist_accum(blk_u8, in_seg.astype(jnp.float32))
+
+        @pl.when(mode == 0)
+        def _():
+            head = g_idx < start
+            col = jnp.sum(jnp.where(lane == feature, blk, 0), axis=1)
+            # routing predicate — mirrors ops/split.py go_left_pred
+            gl_num = jnp.logical_or(
+                col <= bin_,
+                jnp.logical_and(default_left != 0, col == nan_bin))
+            word = col >> 5
+            bw = jnp.zeros_like(col)
+            for wd in range(bitset_words):
+                bw = jnp.where(word == wd, bits_ref[wd].astype(i32), bw)
+            gl_cat = ((bw >> (col & 31)) & 1) != 0
+            # no select on i1 vectors in Mosaic — combine logically
+            gl = jnp.logical_or(jnp.logical_and(is_cat != 0, gl_cat),
+                                jnp.logical_and(is_cat == 0, gl_num))
+            sel_l = jnp.logical_or(jnp.logical_and(gl, in_seg), head)
+            sel_r = jnp.logical_and(jnp.logical_not(gl), in_seg)
+
+            lane2 = lax.broadcasted_iota(i32, (bs, 2), 1)
+            sel2 = jnp.where(lane2 == 0,
+                             sel_l.astype(jnp.float32)[:, None],
+                             sel_r.astype(jnp.float32)[:, None]
+                             ).astype(jnp.bfloat16)
+            ranks = lax.dot_general(
+                lt, sel2, dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(i32)  # [BS, 2]
+            rank_l = ranks[:, 0]
+            rank_r = ranks[:, 1]
+            nl_b = jnp.sum(sel_l.astype(i32))
+            nr_b = jnp.sum(sel_r.astype(i32))
+
+            lcnt = smem[_LCNT]
+            rcnt = smem[_RCNT]
+            dest = jnp.where(
+                sel_l, lcnt + rank_l,
+                jnp.where(sel_r, 2 * bs + rcnt + rank_r, 4 * bs))
+            oh = (iota4 == dest[None, :]).astype(jnp.bfloat16)  # [4BS, BS]
+            blk_bf = blk.astype(jnp.float32).astype(jnp.bfloat16)
+            comp = lax.dot_general(
+                oh, blk_bf, dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)             # [4BS, C]
+            lcarry[:, :] = lcarry[:, :] + comp[:2 * bs]
+            rcarry[:, :] = rcarry[:, :] + comp[2 * bs:]
+
+            new_l = lcnt + nl_b
+            new_r = rcnt + nr_b
+
+            @pl.when(new_l >= bs)
+            def _():
+                lf = smem[_LF]
+                h0 = jnp.where(lf == 0, phi, 0)
+                stage_flush(
+                    0, lcarry[:bs].astype(i32).astype(jnp.uint8),
+                    base + lf * bs, smaller_left == 1,
+                    (iota >= h0).astype(jnp.float32))
+                lcarry[:, :] = jnp.concatenate(
+                    [lcarry[bs:], jnp.zeros_like(lcarry[:bs])], axis=0)
+            smem[_LCNT] = new_l - bs * (new_l >= bs).astype(i32)
+
+            @pl.when(new_r >= bs)
+            def _():
+                rf = smem[_RF]
+                h0 = jnp.where(rf == 0, psi, 0)
+                stage_flush(
+                    1, rcarry[:bs].astype(i32).astype(jnp.uint8),
+                    rbase + rf * bs, smaller_left == 0,
+                    (iota >= h0).astype(jnp.float32))
+                rcarry[:, :] = jnp.concatenate(
+                    [rcarry[bs:], jnp.zeros_like(rcarry[:bs])], axis=0)
+            smem[_RCNT] = new_r - bs * (new_r >= bs).astype(i32)
+        return 0
+
+    lax.fori_loop(0, nblocks, body, 0)
+
+    # ---------------- tails ----------------
+    @pl.when(jnp.logical_and(mode == 0, count > 0))
+    def _():
+        lcnt = smem[_LCNT]
+        rcnt = smem[_RCNT]
+
+        @pl.when(lcnt > 0)
+        def _():
+            lf = smem[_LF]
+            # RMW blend: rows beyond lcnt may belong to a live neighbour
+            d = pltpu.make_async_copy(
+                work_out.at[pl.ds(base + lf * bs, bs), :], inbuf.at[0],
+                sem_in.at[0])
+            d.start(); d.wait()
+            blend = jnp.where(
+                (iota < lcnt)[:, None], lcarry[:bs].astype(i32),
+                inbuf[0].astype(i32)).astype(jnp.uint8)
+            h0 = jnp.where(lf == 0, phi, 0)
+            mask = jnp.logical_and(iota >= h0, iota < lcnt)
+            stage_flush(0, blend, base + lf * bs, smaller_left == 1,
+                        mask.astype(jnp.float32))
+
+        @pl.when(rcnt > 0)
+        def _():
+            rf = smem[_RF]
+            # full-block write: overrun lands in scratch garbage (safe)
+            h0 = jnp.where(rf == 0, psi, 0)
+            mask = jnp.logical_and(iota >= h0, iota < rcnt)
+            stage_flush(1, rcarry[:bs].astype(i32).astype(jnp.uint8),
+                        rbase + rf * bs, smaller_left == 0,
+                        mask.astype(jnp.float32))
+
+        drain(0)
+        drain(1)
+
+        # ---------------- copy-back of the right stream ----------------
+        n_right = count - n_left
+        span_r = psi + n_right
+        nb_cb = (span_r + bs - 1) // bs
+
+        def cb_body(k, _):
+            win = rbase + k * bs
+            d1 = pltpu.make_async_copy(
+                scr_out.at[pl.ds(win, bs), :], inbuf.at[0], sem_in.at[0])
+            d2 = pltpu.make_async_copy(
+                work_out.at[pl.ds(win, bs), :], inbuf.at[1], sem_in.at[1])
+            d1.start(); d2.start(); d1.wait(); d2.wait()
+            g = win + iota
+            keep = jnp.logical_and(g >= start + n_left, g < start + count)
+            out = jnp.where(keep[:, None], inbuf[0].astype(i32),
+                            inbuf[1].astype(i32)).astype(jnp.uint8)
+            cw = smem[_CBW]
+            slot = lax.rem(cw, 2)
+
+            @pl.when(cw >= 2)
+            def _():
+                pltpu.make_async_copy(
+                    cbstage.at[slot], work_out.at[pl.ds(0, bs), :],
+                    sem_cw.at[slot]).wait()
+            cbstage[slot] = out
+            pltpu.make_async_copy(
+                cbstage.at[slot], work_out.at[pl.ds(win, bs), :],
+                sem_cw.at[slot]).start()
+            smem[_CBW] = cw + 1
+            return 0
+
+        lax.fori_loop(0, nb_cb, cb_body, 0)
+        cw = smem[_CBW]
+        for back in (2, 1):
+            @pl.when(cw >= back)
+            def _():
+                pltpu.make_async_copy(
+                    cbstage.at[lax.rem(cw - back, 2)],
+                    work_out.at[pl.ds(0, bs), :],
+                    sem_cw.at[lax.rem(cw - back, 2)]).wait()
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("layout", "num_bins", "block_size", "bitset_words",
+                     "interpret"))
+def fused_split(
+    work: jnp.ndarray,          # [N + pad, C] u8, C % 128 == 0
+    scratch: jnp.ndarray,       # [N + pad, C] u8
+    mode: jnp.ndarray,          # i32: 0 = partition+hist, 1 = hist-only
+    start: jnp.ndarray,         # i32 segment start
+    count: jnp.ndarray,         # i32 segment rows
+    n_left: jnp.ndarray,        # i32 exact left-row count (from the scan)
+    feature: jnp.ndarray,
+    bin_: jnp.ndarray,
+    default_left: jnp.ndarray,  # bool/i32
+    nan_bin: jnp.ndarray,
+    is_cat: jnp.ndarray,        # bool/i32
+    cat_bitset: jnp.ndarray,    # [W] u32
+    layout: RowLayout,
+    num_bins: int,
+    block_size: int = 512,
+    bitset_words: int = 8,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One fused split. Returns (work', scratch', hist_smaller [F, B, 4]).
+
+    In mode 1 the partition is skipped and the histogram covers the whole
+    segment (hist channels: grad, hess, in-bag count, raw count).
+    """
+    F = layout.num_features
+    C = layout.num_cols
+    if C % 128:
+        raise ValueError(f"fused_split needs 128-aligned row records, C={C}")
+    if block_size % _A:
+        raise ValueError(f"block_size must be a multiple of {_A}")
+    B = num_bins
+    Bk = _round_up(B, 128)
+    i32 = jnp.int32
+
+    start = start.astype(i32)
+    count = count.astype(i32)
+    n_left = n_left.astype(i32)
+    n_left_eff = jnp.where(mode == 1, count, n_left)
+    base_t = start // _A
+    phi = start - base_t * _A
+    rstart = start + n_left_eff
+    rbase_t = rstart // _A
+    psi = rstart - rbase_t * _A
+    n_right = count - n_left_eff
+    smaller_left = jnp.where(mode == 1,
+                             jnp.asarray(1, i32),
+                             (n_left_eff <= n_right).astype(i32))
+    sp = jnp.stack([
+        mode.astype(i32), base_t, phi, count, n_left_eff,
+        feature.astype(i32), bin_.astype(i32), default_left.astype(i32),
+        nan_bin.astype(i32), is_cat.astype(i32), smaller_left, rbase_t, psi,
+        jnp.asarray(0, i32), jnp.asarray(0, i32), jnp.asarray(0, i32)])
+
+    bs = block_size
+    W = bitset_words
+    kernel = functools.partial(
+        _fused_kernel, layout=layout, num_bins=B, bs=bs, bitset_words=W)
+
+    work_o, scr_o, hist8 = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(1,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                      pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                       pl.BlockSpec(memory_space=pl.ANY),
+                       pl.BlockSpec(memory_space=pltpu.VMEM)],
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA((2,)),      # sem_in
+                pltpu.SemaphoreType.DMA((2,)),      # sem_l
+                pltpu.SemaphoreType.DMA((2,)),      # sem_r
+                pltpu.SemaphoreType.DMA((2,)),      # sem_cw
+                pltpu.VMEM((2, bs, C), jnp.uint8),  # inbuf
+                pltpu.VMEM((2 * bs, C), jnp.float32),   # lcarry
+                pltpu.VMEM((2 * bs, C), jnp.float32),   # rcarry
+                pltpu.VMEM((2, bs, C), jnp.uint8),  # lstage
+                pltpu.VMEM((2, bs, C), jnp.uint8),  # rstage
+                pltpu.VMEM((2, bs, C), jnp.uint8),  # cbstage
+                pltpu.SMEM((8,), jnp.int32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(work.shape, work.dtype),
+            jax.ShapeDtypeStruct(scratch.shape, scratch.dtype),
+            jax.ShapeDtypeStruct((8, F * Bk), jnp.float32),
+        ],
+        input_output_aliases={2: 0, 3: 1},
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )(sp, cat_bitset, work, scratch)
+
+    hist8 = hist8.reshape(8, F, Bk)[:, :, :B]
+    hist = jnp.transpose(hist8[:4] + hist8[4:], (1, 2, 0))  # [F, B, 4]
+    return work_o, scr_o, hist
+
+
+def fused_available() -> bool:
+    """The fused Mosaic kernel needs a real TPU backend."""
+    if not _HAS_PALLAS:
+        return False
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:  # pragma: no cover
+        return False

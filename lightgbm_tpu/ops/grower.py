@@ -85,6 +85,9 @@ class GrowerParams(NamedTuple):
     # compact-grower streaming block sizes (ops/grower_compact.py)
     part_block: int = 2048
     hist_block: int = 16384
+    # fused per-split Mosaic kernel (ops/fused_split.py): 0 = off, else the
+    # kernel's streaming block size (multiple of 32)
+    fused_block: int = 0
 
     def split_params(self) -> SplitParams:
         return SplitParams(

@@ -40,6 +40,7 @@ from jax import lax
 
 from .compact import (RowLayout, partition_segment, segment_histogram,
                       segments_to_leaf_vectors)
+from .fused_split import fused_split
 from .grower import GrowerParams, TreeArrays, _NEG_INF
 from .split import best_split, child_output, leaf_output
 
@@ -150,8 +151,18 @@ def grow_tree_compact(
         return segment_histogram(work, start, count, layout, B,
                                  params.hist_block, params.hist_impl)
 
+    W = params.bitset_words
+    zero = jnp.asarray(0, i32)
+
     # ---- root ----
-    root_hist = seg_hist(work, jnp.asarray(0, i32), jnp.asarray(n, i32))
+    if params.fused_block:
+        # hist-only mode of the fused Mosaic kernel (ops/fused_split.py)
+        work, scratch, root_hist = fused_split(
+            work, scratch, jnp.asarray(1, i32), zero, jnp.asarray(n, i32),
+            zero, zero, zero, zero, zero, zero,
+            jnp.zeros((W,), jnp.uint32), layout, B, params.fused_block, W)
+    else:
+        root_hist = seg_hist(work, jnp.asarray(0, i32), jnp.asarray(n, i32))
     # every feature's bins sum to the global totals (each row lands in
     # exactly one bin per feature), so feature 0 gives the root sums
     root_g = root_hist[0, :, 0].sum()
@@ -169,7 +180,6 @@ def grow_tree_compact(
                     cegb_coupled * jnp.logical_not(cegb_used0),
                     jax.random.fold_in(extra_key, 0))
 
-    W = params.bitset_words
     st = CompactState(
         done=jnp.asarray(False),
         num_nodes=jnp.asarray(0, i32),
@@ -338,9 +348,17 @@ def grow_tree_compact(
 
         # stable partition of the parent's contiguous segment
         # (reference: DataPartition::Split / cuda_data_partition.cu:907)
-        work, scratch = partition_segment(
-            st.work, st.scratch, s_, m_eff, n_left_eff, f_, b_, dl,
-            nan_bin_arr[f_], is_cat_arr[f_], bits, params.part_block)
+        if params.fused_block:
+            # one fused Mosaic kernel: partition + smaller-child histogram
+            # in a single streamed walk (ops/fused_split.py)
+            work, scratch, hist_small_fused = fused_split(
+                st.work, st.scratch, jnp.asarray(0, i32), s_, m_eff,
+                n_left_eff, f_, b_, dl, nan_bin_arr[f_], is_cat_arr[f_],
+                bits, layout, B, params.fused_block, W)
+        else:
+            work, scratch = partition_segment(
+                st.work, st.scratch, s_, m_eff, n_left_eff, f_, b_, dl,
+                nan_bin_arr[f_], is_cat_arr[f_], bits, params.part_block)
         leaf_start = st.leaf_start.at[best_leaf].set(
             jnp.where(applied, s_, st.leaf_start[best_leaf]))
         leaf_start = leaf_start.at[new_leaf].set(
@@ -355,9 +373,12 @@ def grow_tree_compact(
         # cuda_histogram_constructor.cu:723)
         parent_hist = st.leaf_hist[best_leaf]
         left_smaller = n_left <= n_right
-        s_small = jnp.where(left_smaller, s_, s_ + n_left)
-        m_small = jnp.where(left_smaller, n_left_eff, m_eff - n_left_eff)
-        hist_small = seg_hist(work, s_small, m_small)
+        if params.fused_block:
+            hist_small = hist_small_fused
+        else:
+            s_small = jnp.where(left_smaller, s_, s_ + n_left)
+            m_small = jnp.where(left_smaller, n_left_eff, m_eff - n_left_eff)
+            hist_small = seg_hist(work, s_small, m_small)
         hist_large = parent_hist - hist_small
         hist_left = jnp.where(left_smaller, hist_small, hist_large)
         hist_right = jnp.where(left_smaller, hist_large, hist_small)
