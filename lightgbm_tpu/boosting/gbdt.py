@@ -165,6 +165,10 @@ def _pick_fused_block(cfg) -> int:
     mode = str(cfg.get("tpu_fused", "auto")).lower()
     if mode in ("off", "0", "false"):
         return 0
+    if mode == "on" and not fused_available():
+        log.warning("tpu_fused=on requires a TPU backend (Mosaic); "
+                    "falling back to the XLA compact path")
+        return 0
     if mode == "on" or (mode == "auto" and fused_available()):
         bs = int(cfg.get("tpu_fused_block", 512))
         return max(32, (bs // 32) * 32)
@@ -834,14 +838,11 @@ class GBDT:
                  shrinkage, bynode_key, cegb_used, quant_key, extra_key, k):
             pad_n = work.shape[0] - n
 
-            def set_col(work, off, vec):     # vec: [n] f32
-                return work.at[:, off:off + 4].set(
-                    _f32_to_u8(jnp.pad(vec, (0, pad_n))))
-
             w_col = jnp.where(use_stored_bag, col(work, layout.cnt_off),
                               bag_w)
             label = col(work, lbl_off)
             weight = col(work, w_off) if w_off is not None else None
+            class_grads = []
             if k_total == 1:
                 g, h = _bound_gradients(obj, k_total, scores, label, weight)
                 if use_quant:
@@ -857,20 +858,25 @@ class GBDT:
                 if use_quant:
                     g, h = _quantize_gradients(
                         g, h, quant_key, quant_bins, quant_stoch, const_hess)
-                for j in range(k_total):
-                    work = set_col(work, gx_off + 4 * j, g[j])
-                    work = set_col(work, gx_off + 4 * (k_total + j), h[j])
                 g_k, h_k = g[0], h[0]
+                class_grads = ([g[j] for j in range(k_total)]
+                               + [h[j] for j in range(k_total)])
             else:
                 g_k = col(work, gx_off + 4 * k)
                 h_k = col(work, gx_off + 4 * (k_total + k))
-            work = set_col(work, layout.grad_off, g_k * w_col)
-            work = set_col(work, layout.hess_off, h_k * w_col)
-            work = set_col(work, layout.cnt_off, w_col)
+            # grad/hess/cnt, the K score columns, and (at k=0) the per-class
+            # gradient columns are CONTIGUOUS lanes — write them in ONE
+            # update (4 separate lane-slice updates cost ~27 ms each at 10.5M
+            # rows; one fused update costs the same as one of them)
+            cols = [g_k * w_col, h_k * w_col, w_col]
             # scores are authoritative outside the work array; write all K
             # columns fresh so they ride the partition correctly
-            for j in range(k_total):
-                work = set_col(work, sc_off + 4 * j, scores[j])
+            cols += [scores[j] for j in range(k_total)]
+            cols += class_grads
+            packed = jnp.concatenate(
+                [_f32_to_u8(jnp.pad(v, (0, pad_n))) for v in cols], axis=1)
+            work = work.at[:, layout.grad_off:
+                           layout.grad_off + 4 * len(cols)].set(packed)
 
             (tree, row_leaf, work, scratch, leaf_start,
              leaf_nrows) = grow_tree_compact(

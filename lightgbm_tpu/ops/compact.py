@@ -73,6 +73,11 @@ class RowLayout(NamedTuple):
         return self.num_features + 12
 
     @property
+    def num_real_cols(self) -> int:
+        """Columns carrying actual record bytes (rest is lane padding)."""
+        return self.num_features + 12 + 4 * self.num_extra
+
+    @property
     def num_cols(self) -> int:
         c = self.num_features + 12 + 4 * self.num_extra
         # round lanes up to the full 128-lane tile: TPU HBM layouts pad the

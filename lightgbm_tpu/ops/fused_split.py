@@ -34,10 +34,12 @@ preserved head rows (they rank first in block 0, flush back to their original
 slots, and are masked out of the histogram). All DMA offsets in the kernel
 are of the form `32*t + k*BS`, which the compiler can prove aligned.
 
-Numerics: row bytes move through the permutation matmul as bf16 values
-(0..255 exact, one-hot contraction, f32 accumulate — exact). Histogram
-channels use the same hi/lo-bf16 split as ops/pallas_histogram.py: counts
-exact, grad/hess ~2^-17 relative.
+Numerics: row bytes move through the permutation matmul as (byte - 128) int8
+values at 2x the bf16 MXU rate (one-hot contraction, i32 accumulate — exact;
+a spare padding lane carries the per-slot receive indicator so the offset is
+undone exactly at flush). With no spare lane the kernel falls back to bf16
+(0..255 exact, f32 accumulate). Histogram channels use the same hi/lo-bf16
+split as ops/pallas_histogram.py: counts exact, grad/hess ~2^-17 relative.
 """
 from __future__ import annotations
 
@@ -88,7 +90,7 @@ def _assemble_f32(blk_i32, off: int):
 def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
                   hist_ref, sem_in, sem_l, sem_r, sem_cw, inbuf, lcarry,
                   rcarry, lstage, rstage, cbstage, smem, *, layout: RowLayout,
-                  num_bins: int, bs: int, bitset_words: int):
+                  num_bins: int, bs: int, bitset_words: int, use_int8: bool):
     F = layout.num_features
     C = layout.num_cols
     B = num_bins
@@ -126,9 +128,22 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
     lane = lax.broadcasted_iota(i32, (bs, C), 1)
     io2 = lax.broadcasted_iota(i32, (bs, bs), 0)
     jo2 = lax.broadcasted_iota(i32, (bs, bs), 1)
-    lt = (io2 > jo2).astype(jnp.bfloat16)          # strict lower triangular
+    # strict lower triangular: ranks via MXU (int8 runs at 2x bf16 rate)
+    lt = (io2 > jo2).astype(jnp.int8 if use_int8 else jnp.bfloat16)
     iota4 = lax.broadcasted_iota(i32, (4 * bs, bs), 0)
     iota_b = lax.broadcasted_iota(i32, (bs, Bk), 1)
+
+    def carry_block_i32(c):
+        """First BS carry rows as exact [BS, C] i32 byte values.
+
+        int8 mode stores carries in offset form (byte - 128, with lane C-1
+        carrying the receive indicator from the permutation matmul); the
+        +128 correction applies only to filled slots and the indicator lane
+        is zeroed so flushed bytes match the bf16/XLA paths bit-for-bit."""
+        if use_int8:
+            fixed = c[:bs] + 128 * c[:bs, C - 1:C]
+            return jnp.where(lane == C - 1, 0, fixed)
+        return c[:bs].astype(i32)
 
     def read_dma(i, slot):
         return pltpu.make_async_copy(
@@ -244,13 +259,19 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
             sel_r = jnp.logical_and(jnp.logical_not(gl), in_seg)
 
             lane2 = lax.broadcasted_iota(i32, (bs, 2), 1)
-            sel2 = jnp.where(lane2 == 0,
-                             sel_l.astype(jnp.float32)[:, None],
-                             sel_r.astype(jnp.float32)[:, None]
-                             ).astype(jnp.bfloat16)
-            ranks = lax.dot_general(
-                lt, sel2, dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32).astype(i32)  # [BS, 2]
+            sel2i = jnp.where(lane2 == 0,
+                              sel_l.astype(i32)[:, None],
+                              sel_r.astype(i32)[:, None])
+            if use_int8:
+                ranks = lax.dot_general(
+                    lt, sel2i.astype(jnp.int8),
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=i32)                 # [BS, 2]
+            else:
+                ranks = lax.dot_general(
+                    lt, sel2i.astype(jnp.float32).astype(jnp.bfloat16),
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32).astype(i32)
             rank_l = ranks[:, 0]
             rank_r = ranks[:, 1]
             nl_b = jnp.sum(sel_l.astype(i32))
@@ -261,11 +282,22 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
             dest = jnp.where(
                 sel_l, lcnt + rank_l,
                 jnp.where(sel_r, 2 * bs + rcnt + rank_r, 4 * bs))
-            oh = (iota4 == dest[None, :]).astype(jnp.bfloat16)  # [4BS, BS]
-            blk_bf = blk.astype(jnp.float32).astype(jnp.bfloat16)
-            comp = lax.dot_general(
-                oh, blk_bf, dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)             # [4BS, C]
+            oh = (iota4 == dest[None, :])                       # [4BS, BS] i1
+            if use_int8:
+                # bytes ride the MXU as (b - 128) int8; lane C-1 is repurposed
+                # as a constant 1 so each dest slot also receives a "filled"
+                # indicator, letting carry_block_i32 undo the offset exactly
+                blk8 = jnp.where(lane == C - 1, 1, blk - 128).astype(jnp.int8)
+                comp = lax.dot_general(
+                    oh.astype(jnp.int8), blk8,
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=i32)                 # [4BS, C]
+            else:
+                blk_bf = blk.astype(jnp.float32).astype(jnp.bfloat16)
+                comp = lax.dot_general(
+                    oh.astype(jnp.bfloat16), blk_bf,
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
             lcarry[:, :] = lcarry[:, :] + comp[:2 * bs]
             rcarry[:, :] = rcarry[:, :] + comp[2 * bs:]
 
@@ -277,7 +309,7 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
                 lf = smem[_LF]
                 h0 = jnp.where(lf == 0, phi, 0)
                 stage_flush(
-                    0, lcarry[:bs].astype(i32).astype(jnp.uint8),
+                    0, carry_block_i32(lcarry).astype(jnp.uint8),
                     base + lf * bs, smaller_left == 1,
                     (iota >= h0).astype(jnp.float32))
                 lcarry[:, :] = jnp.concatenate(
@@ -289,7 +321,7 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
                 rf = smem[_RF]
                 h0 = jnp.where(rf == 0, psi, 0)
                 stage_flush(
-                    1, rcarry[:bs].astype(i32).astype(jnp.uint8),
+                    1, carry_block_i32(rcarry).astype(jnp.uint8),
                     rbase + rf * bs, smaller_left == 0,
                     (iota >= h0).astype(jnp.float32))
                 rcarry[:, :] = jnp.concatenate(
@@ -314,7 +346,7 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
                 sem_in.at[0])
             d.start(); d.wait()
             blend = jnp.where(
-                (iota < lcnt)[:, None], lcarry[:bs].astype(i32),
+                (iota < lcnt)[:, None], carry_block_i32(lcarry),
                 inbuf[0].astype(i32)).astype(jnp.uint8)
             h0 = jnp.where(lf == 0, phi, 0)
             mask = jnp.logical_and(iota >= h0, iota < lcnt)
@@ -327,7 +359,7 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
             # full-block write: overrun lands in scratch garbage (safe)
             h0 = jnp.where(rf == 0, psi, 0)
             mask = jnp.logical_and(iota >= h0, iota < rcnt)
-            stage_flush(1, rcarry[:bs].astype(i32).astype(jnp.uint8),
+            stage_flush(1, carry_block_i32(rcarry).astype(jnp.uint8),
                         rbase + rf * bs, smaller_left == 0,
                         mask.astype(jnp.float32))
 
@@ -435,8 +467,12 @@ def fused_split(
 
     bs = block_size
     W = bitset_words
+    # int8 MXU path needs one free padding lane for the receive indicator
+    use_int8 = layout.num_real_cols < C
+    carry_t = jnp.int32 if use_int8 else jnp.float32
     kernel = functools.partial(
-        _fused_kernel, layout=layout, num_bins=B, bs=bs, bitset_words=W)
+        _fused_kernel, layout=layout, num_bins=B, bs=bs, bitset_words=W,
+        use_int8=use_int8)
 
     work_o, scr_o, hist8 = pl.pallas_call(
         kernel,
@@ -454,8 +490,8 @@ def fused_split(
                 pltpu.SemaphoreType.DMA((2,)),      # sem_r
                 pltpu.SemaphoreType.DMA((2,)),      # sem_cw
                 pltpu.VMEM((2, bs, C), jnp.uint8),  # inbuf
-                pltpu.VMEM((2 * bs, C), jnp.float32),   # lcarry
-                pltpu.VMEM((2 * bs, C), jnp.float32),   # rcarry
+                pltpu.VMEM((2 * bs, C), carry_t),   # lcarry
+                pltpu.VMEM((2 * bs, C), carry_t),   # rcarry
                 pltpu.VMEM((2, bs, C), jnp.uint8),  # lstage
                 pltpu.VMEM((2, bs, C), jnp.uint8),  # rstage
                 pltpu.VMEM((2, bs, C), jnp.uint8),  # cbstage
