@@ -299,6 +299,12 @@ class Booster:
             if not isinstance(train_set, Dataset):
                 raise TypeError("Training data should be a Dataset instance")
             train_set._update_params(params)
+            # multi-host bootstrap must precede dataset construction: bin
+            # mappers are synced across processes at construct time
+            # (reference: Network::Init runs before LoadData,
+            # application.cpp:88)
+            from .parallel.multihost import maybe_init_distributed
+            maybe_init_distributed(params)
             train_set.construct()
             self.config = Config(params)
             objective = self.config.objective
@@ -494,14 +500,15 @@ class Booster:
     def _eval_custom(self, feval, name, which):
         fevals = feval if isinstance(feval, (list, tuple)) else [feval]
         if which == "train":
-            raw = np.asarray(self._gbdt.train_score)
+            from .parallel.multihost import to_host
+            raw = to_host(self._gbdt.train_score)
             if getattr(self._gbdt, "_compact", None) is not None:
                 # compact grower keeps train scores in a permuted row order;
                 # user fevals see the dataset's original order
                 perm = self._gbdt._compact_perm()
                 unperm = np.empty_like(raw)
                 unperm[:, perm] = raw
-                raw = unperm
+                raw = unperm[:, :self._gbdt._n_real]
             data = self.train_set
         else:
             vs = self._gbdt.valid_sets[which]

@@ -18,6 +18,8 @@ Layout decisions (vs the reference):
 """
 from __future__ import annotations
 
+import functools
+
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -31,6 +33,7 @@ from ..ops.compact import RowLayout, pack_rows, segments_to_leaf_vectors
 from ..ops.grower import GrowerParams, TreeArrays, grow_tree
 from ..ops.grower_compact import grow_tree_compact
 from ..ops.predict import StackedTrees, predict_raw, route_one_tree
+from ..parallel.multihost import to_host as _to_host
 from ..ops.renew import renew_leaf_quantile
 from ..utils import log
 from .sample_strategy import create_sample_strategy
@@ -165,6 +168,10 @@ def _pick_fused_block(cfg) -> int:
     mode = str(cfg.get("tpu_fused", "auto")).lower()
     if mode in ("off", "0", "false"):
         return 0
+    if bool(cfg.get("tpu_fused_interpret", False)):
+        # CI-only: run the Mosaic kernel in Pallas interpret mode on CPU
+        bs = int(cfg.get("tpu_fused_block", 512))
+        return max(32, (bs // 32) * 32)
     if mode == "on" and not fused_available():
         log.warning("tpu_fused=on requires a TPU backend (Mosaic); "
                     "falling back to the XLA compact path")
@@ -402,9 +409,27 @@ class GBDT:
             and len(jax.devices()) > 1
         self.tree_learner = tree_learner
         self.mesh = make_mesh() if distributed else None
-        self._n_real = train_set.num_data
-        pad = pad_rows(self._n_real, len(self.mesh.devices.ravel())) \
-            if self.mesh else 0
+        self._multiproc = jax.process_count() > 1
+        if self._multiproc:
+            # each process holds only its LOCAL row shard; the global array
+            # is assembled below from the per-process pieces (reference:
+            # pre_partition=true rank-local loading, dataset_loader.cpp:203)
+            if tree_learner != "data":
+                raise ValueError(
+                    "multi-host training supports tree_learner=data")
+            n_loc = train_set.num_data
+            d_loc = len(jax.local_devices())
+            if n_loc % d_loc:
+                raise ValueError(
+                    f"multi-host: each process's rows ({n_loc}) must divide "
+                    f"its local device count ({d_loc}); pad or re-partition "
+                    "the local shard")
+            self._n_real = n_loc * jax.process_count()
+            pad = 0
+        else:
+            self._n_real = train_set.num_data
+            pad = pad_rows(self._n_real, len(self.mesh.devices.ravel())) \
+                if self.mesh else 0
         self._pad = pad
         self.num_data = self._n_real + pad
 
@@ -435,11 +460,19 @@ class GBDT:
         elif self.mesh is not None:
             # rows sharded over the mesh: the reference's row partitioning
             # across machines (data_parallel_tree_learner.cpp BeforeTrain)
-            self.binned = jax.device_put(binned_np, row_sharding_2d(self.mesh))
-            ones = np.ones(self.num_data, np.float32)
-            if pad:
-                ones[self._n_real:] = 0.0
-            self._valid_row_mask = jax.device_put(ones, row_sharding(self.mesh))
+            if self._multiproc:
+                # assemble the global array from per-process local shards
+                self.binned = jax.make_array_from_process_local_data(
+                    row_sharding_2d(self.mesh), binned_np)
+                self._valid_row_mask = None
+            else:
+                self.binned = jax.device_put(binned_np,
+                                             row_sharding_2d(self.mesh))
+                ones = np.ones(self.num_data, np.float32)
+                if pad:
+                    ones[self._n_real:] = 0.0
+                self._valid_row_mask = jax.device_put(
+                    ones, row_sharding(self.mesh))
         else:
             self.binned = jnp.asarray(binned_np)
             self._valid_row_mask = None
@@ -580,6 +613,7 @@ class GBDT:
             hist_block=_clamp_block(
                 int(cfg.get("tpu_hist_block", 16384)), self._n_real),
             fused_block=_pick_fused_block(cfg),
+            fused_interpret=bool(cfg.get("tpu_fused_interpret", False)),
         )
 
         # serial-learner row storage: the compact grower physically
@@ -588,13 +622,23 @@ class GBDT:
         # ops/grower_compact.py). It requires row-elementwise gradients
         # (the rows live in a per-tree permuted order).
         grower = str(cfg.get("tpu_grower", "auto")).lower()
-        can_compact = (
+        # data-parallel: the compact grower runs per shard under shard_map,
+        # with shard-local partitions and psum-ed histograms (reference:
+        # DataParallelTreeLearner, data_parallel_tree_learner.cpp:223-300);
+        # voting/feature learners keep the masked GSPMD path
+        mesh_compact_ok = (
             self.mesh is None
+            or (self.tree_learner == "data"
+                and not self._multiproc
+                and not (self.objective is not None
+                         and self.objective.renew_leaves)))
+        can_compact = (
+            mesh_compact_ok
             and self.objective is not None
             and getattr(self.objective, "row_elementwise", True)
             and not getattr(self.objective, "is_stochastic", False)
             and int(train_set.max_num_bins) <= 256
-            and self._n_real < (1 << 24)
+            and self.num_data < (1 << 24)
             # balanced / by-query bagging and query-structured train metrics
             # index rows in the original order
             and float(cfg.get("pos_bagging_fraction", 1.0)) >= 1.0
@@ -616,14 +660,19 @@ class GBDT:
         self._compact = None          # lazy _CompactTrainState
         md = train_set.metadata if not pad else _pad_metadata(
             train_set.metadata, self.num_data)
+        if self._multiproc:
+            # label/weight/... become the host-side GLOBAL arrays on every
+            # process (metrics, averages and objectives are global state)
+            from ..parallel.multihost import gather_metadata
+            md = gather_metadata(train_set.metadata, train_set.num_data)
+        self._global_md = md
         if self.objective is not None:
             self.objective.init(md, self.num_data)
 
         k, n = self.num_tree_per_iteration, self.num_data
         score0 = np.zeros((k, n), np.float32)
-        if train_set.metadata.init_score is not None:
-            init = _init_score_matrix(
-                train_set.metadata.init_score, k, self._n_real)
+        if md.init_score is not None:
+            init = _init_score_matrix(md.init_score, k, self._n_real)
             score0[:, : self._n_real] += init
             self._has_init_score = True
         else:
@@ -658,7 +707,6 @@ class GBDT:
         nan_bin_arr = self.nan_bin_arr
         has_nan_arr = self.has_nan_arr
         is_cat_arr = self.is_cat_arr
-        binned = self.binned
         max_leaves = self.max_leaves
 
         mono_types = self._mono_types
@@ -672,8 +720,11 @@ class GBDT:
         const_hess = bool(getattr(obj, "is_constant_hessian", False))
         feature_contri = self._feature_contri
 
-        def step(score_k, grad_k, hess_k, mask, feat_mask, shrinkage,
-                 bynode_key, cegb_used, true_grad_k, true_hess_k, extra_key):
+        def step(binned, score_k, grad_k, hess_k, mask, feat_mask,
+                 shrinkage, bynode_key, cegb_used, true_grad_k, true_hess_k,
+                 extra_key):
+            # binned is an argument, not a closure: multi-process global
+            # arrays cannot be captured as jit constants
             # grad_k/hess_k arrive already quantized when use_quantized_grad
             # (once per iteration over all classes, like the reference's
             # GradientDiscretizer); true_* carry the originals for renewal
@@ -725,13 +776,13 @@ class GBDT:
         (ops/grower_compact.py). Extras carried through every partition:
         [scores(K), objective label, objective weight?, original row id]."""
         obj = self.objective
-        n = self._n_real
+        n = self.num_data
         if n >= (1 << 24):
             # f32 raw-count histograms drive the partition offsets and f32
             # row ids drive the metric permutation; both are exact only
             # below 2^24 rows (ops/compact.py)
             raise RuntimeError(
-                "tpu_grower=compact supports up to 2^24 rows per chip; use "
+                "tpu_grower=compact supports up to 2^24 rows; use "
                 "tree_learner=data to shard rows or tpu_grower=masked")
         k = self.num_tree_per_iteration
         has_w = obj.weight is not None
@@ -760,8 +811,31 @@ class GBDT:
         parts.append(jnp.arange(n, dtype=jnp.float32)[None, :])
         extras = jnp.concatenate(parts, axis=0)
         zeros = jnp.zeros((n,), jnp.float32)
-        work = pack_rows(self.binned, zeros, zeros, jnp.ones((n,), jnp.float32),
-                         extras, layout, pad_rows=pad)
+        # padded rows (mesh row-count alignment) start permanently out of
+        # bag: zero count weight, zero gradients
+        cnt0 = (np.asarray(self._valid_row_mask, np.float32)
+                if getattr(self, "_valid_row_mask", None) is not None
+                else jnp.ones((n,), jnp.float32))
+        if self.mesh is not None:
+            # per-shard layout: each shard's rows sit in a contiguous block
+            # followed by its own `pad` overrun rows, so the per-shard
+            # partition walks never touch a neighbour shard
+            from ..parallel.mesh import row_sharding_2d
+            S = len(self.mesh.devices.ravel())
+            nl = n // S
+            flat = pack_rows(self.binned, zeros, zeros,
+                             jnp.asarray(cnt0, jnp.float32), extras, layout,
+                             pad_rows=0)
+            c = flat.shape[1]
+            work = jnp.pad(flat.reshape(S, nl, c),
+                           ((0, 0), (0, pad), (0, 0))).reshape(-1, c)
+            work = jax.device_put(work, row_sharding_2d(self.mesh))
+            shards = {"S": S, "nl": nl, "pad_rows": pad}
+        else:
+            work = pack_rows(self.binned, zeros, zeros,
+                             jnp.asarray(cnt0, jnp.float32), extras, layout,
+                             pad_rows=pad)
+            shards = {"S": 1, "nl": n, "pad_rows": pad}
         self._compact = {
             "layout": layout,
             "work": work,
@@ -770,17 +844,26 @@ class GBDT:
             "epoch": 0,        # bumped per grown tree; keys the perm cache
             "perm_epoch": -1,
             "perm": None,
+            **shards,
         }
+
+    def _compact_rows(self, work):
+        """The row records in current order, per-shard pad rows stripped."""
+        c = self._compact
+        S, nl, pr = c["S"], c["nl"], c["pad_rows"]
+        if S > 1:
+            return work.reshape(S, nl + pr, -1)[:, :nl].reshape(S * nl, -1)
+        return work[:self.num_data]
 
     def _compact_cols(self, work, *extra_idx):
         """Unpack selected extra f32 columns from the work array."""
         from ..ops.compact import _u8_to_f32
         layout = self._compact["layout"]
-        n = self._n_real
+        rows = self._compact_rows(work)
         out = []
         for i in extra_idx:
             off = layout.extra_off + 4 * i
-            out.append(_u8_to_f32(work[:n, off:off + 4]))
+            out.append(_u8_to_f32(rows[:, off:off + 4]))
         return out
 
     def _build_compact_step_fn(self):
@@ -796,8 +879,14 @@ class GBDT:
         renew = obj.renew_leaves
         layout = self._compact["layout"]
         gp = self.grower_params
+        mesh = self.mesh
+        if mesh is not None:
+            from ..parallel.mesh import DATA_AXIS
+            gp = gp._replace(axis_name=DATA_AXIS)
         k_total = self.num_tree_per_iteration
-        n = self._n_real
+        n = self._compact["nl"]          # per-shard rows (serial: all rows)
+        n_real_g = self._n_real
+        rid_off = (self._compact["layout"].extra_off + 4 * self._cx_rowid)
         max_leaves = self.max_leaves
         num_bins_arr = self.num_bins_arr
         nan_bin_arr = self.nan_bin_arr
@@ -840,6 +929,11 @@ class GBDT:
 
             w_col = jnp.where(use_stored_bag, col(work, layout.cnt_off),
                               bag_w)
+            if mesh is not None and self.num_data > n_real_g:
+                # mesh row-count padding: pad rows (row id >= n_real) must
+                # stay permanently out of bag even when a fresh bag draws
+                # them — their label/score bytes are meaningless
+                w_col = w_col * (col(work, rid_off) < n_real_g)
             label = col(work, lbl_off)
             weight = col(work, w_off) if w_off is not None else None
             class_grads = []
@@ -916,6 +1010,10 @@ class GBDT:
                 ends = jnp.minimum(leaf_start + leaf_nrows, n)
                 sums_g = csg[ends] - csg[jnp.minimum(leaf_start, n)]
                 sums_h = csh[ends] - csh[jnp.minimum(leaf_start, n)]
+                if mesh is not None:
+                    from ..parallel.mesh import DATA_AXIS
+                    sums_g = jax.lax.psum(sums_g, DATA_AXIS)
+                    sums_h = jax.lax.psum(sums_h, DATA_AXIS)
                 from ..ops.split import leaf_output as _lo
                 live = jnp.arange(max_leaves) < tree.num_leaves
                 leaf_value = jnp.where(
@@ -929,7 +1027,48 @@ class GBDT:
             sc = scores_of(work).at[k].add(row_delta)
             return tree, work, scratch, sc, cegb_used
 
-        return jax.jit(step, donate_argnums=(0, 1), static_argnames=("k",))
+        if mesh is None:
+            return jax.jit(step, donate_argnums=(0, 1),
+                           static_argnames=("k",))
+
+        # data-parallel: the whole per-tree step runs per shard under
+        # shard_map — shard-local partitions, psum-ed histograms inside
+        # grow_tree_compact. Trees replicate bit-identically because every
+        # shard scans the same psum-ed histograms (reference: all ranks apply
+        # the same SyncUpGlobalBestSplit decision, parallel_tree_learner.h)
+        from jax.sharding import PartitionSpec as P
+        from ..parallel.mesh import DATA_AXIS
+        try:
+            from jax import shard_map as _shard_map
+
+            def smap(f, in_specs, out_specs):
+                return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                                  out_specs=out_specs, check_vma=False)
+        except ImportError:  # pragma: no cover
+            from jax.experimental.shard_map import shard_map as _shard_map
+
+            def smap(f, in_specs, out_specs):
+                return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                                  out_specs=out_specs, check_rep=False)
+
+        row2 = P(DATA_AXIS, None)
+        krow = P(None, DATA_AXIS)
+        rep = P()
+        in_specs = (row2, row2, krow, P(DATA_AXIS), rep, rep, rep, rep,
+                    rep, rep, rep)
+        # outputs: (tree pytree — replicated, work, scratch, scores,
+        # cegb_used); specs are pytree prefixes
+        out_specs = (rep, row2, row2, krow, rep)
+        fns = {}
+
+        def dispatch(*args, k):
+            if k not in fns:
+                fns[k] = jax.jit(
+                    smap(functools.partial(step, k=k), in_specs, out_specs),
+                    donate_argnums=(0, 1))
+            return fns[k](*args)
+
+        return dispatch
 
     def _compact_perm(self) -> np.ndarray:
         """Current row permutation (original index per position), cached per
@@ -970,7 +1109,7 @@ class GBDT:
         if c["step"] is None:
             c["step"] = self._build_compact_step_fn()
         strat = self.sample_strategy
-        n = self._n_real
+        n = self.num_data      # bag vectors align with work rows (incl. pad)
 
         # GOSS ranks rows by gradient magnitude; compute in current order
         g = h = None
@@ -1037,7 +1176,8 @@ class GBDT:
 
     def set_train_metrics(self, metrics: Sequence[Metric]) -> None:
         for m in metrics:
-            m.init(self.train_set.metadata, self._n_real)
+            m.init(getattr(self, "_global_md", None)
+                   or self.train_set.metadata, self._n_real)
         self.train_metrics = list(metrics)
 
     # -- one boosting iteration ---------------------------------------------
@@ -1137,6 +1277,7 @@ class GBDT:
 
         for cur_tree_id in range(k):
             tree, row_leaf, new_score, self._cegb_used = self._step_fn(
+                self.binned,
                 self.train_score[cur_tree_id], grad[cur_tree_id],
                 hess[cur_tree_id], mask, feat_mask,
                 jnp.float32(self.shrinkage_rate),
@@ -1239,7 +1380,12 @@ class GBDT:
         # one batched device_get of all pending trees; deliberately NOT a
         # jnp.stack program — its shape would depend on the pending count and
         # recompile for every distinct flush size
-        host_trees = jax.device_get(trees)
+        if getattr(self, "_multiproc", False):
+            # replicated device trees are not fully addressable across
+            # processes; pull the local replica of each array
+            host_trees = jax.tree.map(_to_host, trees)
+        else:
+            host_trees = jax.device_get(trees)
         self._dev_trees = []
         for i, one in enumerate(host_trees):
             ht = HostTree(one, shrinkage=shrinks[i])
@@ -1361,7 +1507,7 @@ class GBDT:
         the current work order)."""
         if self._compact is not None:
             f = self._compact["layout"].num_features
-            return self._compact["work"][: self._n_real, :f]
+            return self._compact_rows(self._compact["work"])[:, :f]
         return self.binned
 
     # -- evaluation ----------------------------------------------------------
@@ -1370,31 +1516,39 @@ class GBDT:
             # train scores live in the compact grower's permuted row order;
             # give the metrics matching label/weight views
             perm = self._compact_perm()
+            # mesh row-count padding: pad rows carry ids >= n_real; clamp
+            # the index and zero their metric weight instead
+            valid = perm < self._n_real
+            safe = np.minimum(perm, self._n_real - 1)
+            padded = not bool(valid.all())
             swaps = []
             for m in self.train_metrics:
                 lbl = getattr(m, "label", None)
                 wgt = getattr(m, "weight", None)
                 swaps.append((m, lbl, wgt))
                 if lbl is not None:
-                    m.label = np.asarray(lbl)[perm]
+                    m.label = np.asarray(lbl)[safe]
                 if wgt is not None:
-                    m.weight = np.asarray(wgt)[perm]
+                    m.weight = np.asarray(wgt)[safe] * valid
+                elif padded and hasattr(m, "weight"):
+                    m.weight = valid.astype(np.float64)
             try:
-                return self._eval("training", np.asarray(self.train_score),
-                                  self.train_metrics)
+                return self._eval("training", _to_host(self.train_score),
+                                  self.train_metrics,
+                                  n_real=self.num_data)
             finally:
                 for m, lbl, wgt in swaps:
                     if lbl is not None:
                         m.label = lbl
-                    if wgt is not None:
+                    if hasattr(m, "weight"):
                         m.weight = wgt
-        return self._eval("training", np.asarray(self.train_score),
+        return self._eval("training", _to_host(self.train_score),
                           self.train_metrics)
 
     def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
         out = []
         for vs in self.valid_sets:
-            out.extend(self._eval(vs.name, np.asarray(vs.score), vs.metrics,
+            out.extend(self._eval(vs.name, _to_host(vs.score), vs.metrics,
                                   n_real=vs.n_real))
         return out
 

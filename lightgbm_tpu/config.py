@@ -180,6 +180,7 @@ PARAMS: Dict[str, Tuple[Any, type, Tuple[str, ...]]] = {
     # one streamed walk, ops/fused_split.py): auto = on with a TPU backend
     "tpu_fused": ("auto", str, ()),         # auto | on | off
     "tpu_fused_block": (512, int, ()),      # fused kernel block size (x32)
+    "tpu_fused_interpret": (False, bool, ()),  # CI: Pallas interpret on CPU
     "num_shards": (0, int, ()),             # 0 = use all local devices when tree_learner != serial
     # snapshot / continue
     "snapshot_freq": (-1, int, ("save_period",)),

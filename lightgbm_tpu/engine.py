@@ -77,6 +77,11 @@ def train(
             pre_model = LoadedGBDT(init_model.model_to_string())
 
     train_set._update_params(params)
+    # multi-host bootstrap must precede dataset construction (bin-mapper
+    # sync) AND any backend-initializing call (reference: Network::Init
+    # before LoadData, application.cpp:88)
+    from .parallel.multihost import maybe_init_distributed
+    maybe_init_distributed(params)
     if pre_model is not None and train_set.data is None:
         raise ValueError(
             "continue-training needs the Dataset's raw data to score the "

@@ -276,6 +276,12 @@ class BinnedDataset:
                 sample = arr[np.sort(sample_idx)]
             else:
                 sample = arr
+            # multi-host: every process contributes its sample and all build
+            # identical mappers from the pooled global distribution
+            # (reference: ConstructBinMappersFromTextData,
+            # src/io/dataset_loader.cpp:1070)
+            from ..parallel.multihost import pool_bin_sample
+            sample = pool_bin_sample(sample)
             total_sample_cnt = len(sample)
             # user-forced bin boundaries, JSON list of {"feature": i,
             # "bin_upper_bound": [...]} (reference: forcedbins_filename,

@@ -430,11 +430,16 @@ def fused_split(
     block_size: int = 512,
     bitset_words: int = 8,
     interpret: bool = False,
+    smaller_left=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One fused split. Returns (work', scratch', hist_smaller [F, B, 4]).
 
     In mode 1 the partition is skipped and the histogram covers the whole
     segment (hist channels: grad, hess, in-bag count, raw count).
+
+    ``smaller_left`` overrides which side's histogram is accumulated —
+    the data-parallel learner must histogram the GLOBALLY smaller child on
+    every shard even where it is locally the larger one.
     """
     F = layout.num_features
     C = layout.num_cols
@@ -456,9 +461,10 @@ def fused_split(
     rbase_t = rstart // _A
     psi = rstart - rbase_t * _A
     n_right = count - n_left_eff
-    smaller_left = jnp.where(mode == 1,
-                             jnp.asarray(1, i32),
-                             (n_left_eff <= n_right).astype(i32))
+    if smaller_left is None:
+        smaller_left = (n_left_eff <= n_right).astype(i32)
+    smaller_left = jnp.where(mode == 1, jnp.asarray(1, i32),
+                             smaller_left.astype(i32))
     sp = jnp.stack([
         mode.astype(i32), base_t, phi, count, n_left_eff,
         feature.astype(i32), bin_.astype(i32), default_left.astype(i32),

@@ -88,6 +88,7 @@ class GrowerParams(NamedTuple):
     # fused per-split Mosaic kernel (ops/fused_split.py): 0 = off, else the
     # kernel's streaming block size (multiple of 32)
     fused_block: int = 0
+    fused_interpret: bool = False   # Pallas interpret mode (CPU tests)
 
     def split_params(self) -> SplitParams:
         return SplitParams(

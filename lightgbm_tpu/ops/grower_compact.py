@@ -42,17 +42,20 @@ from .compact import (RowLayout, partition_segment, segment_histogram,
                       segments_to_leaf_vectors)
 from .fused_split import fused_split
 from .grower import GrowerParams, TreeArrays, _NEG_INF
-from .split import best_split, child_output, leaf_output
+from .split import best_split, child_output, leaf_output, left_rows_of_split
 
 
 class CompactState(NamedTuple):
     done: jnp.ndarray
     num_nodes: jnp.ndarray
-    work: jnp.ndarray        # [N + pad, C] u8 row records
+    work: jnp.ndarray        # [N + pad, C] u8 row records (shard-local)
     scratch: jnp.ndarray     # [N + pad, C] u8 partition staging
-    leaf_hist: jnp.ndarray   # [L, F, B, 4] per-leaf histograms (HBM resident)
-    leaf_start: jnp.ndarray  # [L] i32 segment starts
-    leaf_nrows: jnp.ndarray  # [L] i32 segment raw row counts
+    leaf_hist: jnp.ndarray   # [L, F, B, 4] per-leaf GLOBAL histograms
+    leaf_hist_loc: jnp.ndarray  # [L, F, B, 4] shard-local (data-parallel;
+    #                             dummy [1,1,1,1] on the serial path)
+    leaf_start: jnp.ndarray  # [L] i32 shard-local segment starts
+    leaf_nrows: jnp.ndarray  # [L] i32 shard-local segment raw row counts
+    leaf_nrows_g: jnp.ndarray  # [L] i32 GLOBAL raw row counts
     # tree arrays under construction
     split_feature: jnp.ndarray
     split_bin: jnp.ndarray
@@ -153,16 +156,22 @@ def grow_tree_compact(
 
     W = params.bitset_words
     zero = jnp.asarray(0, i32)
+    ax = params.axis_name
 
     # ---- root ----
     if params.fused_block:
         # hist-only mode of the fused Mosaic kernel (ops/fused_split.py)
-        work, scratch, root_hist = fused_split(
+        work, scratch, root_loc = fused_split(
             work, scratch, jnp.asarray(1, i32), zero, jnp.asarray(n, i32),
             zero, zero, zero, zero, zero, zero,
-            jnp.zeros((W,), jnp.uint32), layout, B, params.fused_block, W)
+            jnp.zeros((W,), jnp.uint32), layout, B, params.fused_block, W,
+            interpret=params.fused_interpret)
     else:
-        root_hist = seg_hist(work, jnp.asarray(0, i32), jnp.asarray(n, i32))
+        root_loc = seg_hist(work, jnp.asarray(0, i32), jnp.asarray(n, i32))
+    # data-parallel: histograms psum over the mesh axis (reference: the
+    # ReduceScatter of per-feature histograms, data_parallel_tree_learner
+    # .cpp:223-300); split decisions then replicate bit-identically
+    root_hist = lax.psum(root_loc, ax) if ax else root_loc
     # every feature's bins sum to the global totals (each row lands in
     # exactly one bin per feature), so feature 0 gives the root sums
     root_g = root_hist[0, :, 0].sum()
@@ -180,14 +189,21 @@ def grow_tree_compact(
                     cegb_coupled * jnp.logical_not(cegb_used0),
                     jax.random.fold_in(extra_key, 0))
 
+    n_g = (n * lax.psum(jnp.asarray(1, i32), ax)) if ax \
+        else jnp.asarray(n, i32)
     st = CompactState(
         done=jnp.asarray(False),
         num_nodes=jnp.asarray(0, i32),
         work=work,
         scratch=scratch,
         leaf_hist=jnp.zeros((L, F, B, 4), jnp.float32).at[0].set(root_hist),
+        leaf_hist_loc=(jnp.zeros((L, F, B, 4), jnp.float32).at[0]
+                       .set(root_loc) if ax
+                       else jnp.zeros((1, 1, 1, 1), jnp.float32)),
         leaf_start=jnp.zeros((L,), i32),
         leaf_nrows=jnp.zeros((L,), i32).at[0].set(n),
+        leaf_nrows_g=(jnp.zeros((L,), i32).at[0].set(n_g) if ax
+                      else jnp.zeros((1,), i32)),
         split_feature=jnp.full((L - 1,), -1, i32),
         split_bin=jnp.zeros((L - 1,), i32),
         cat_bitset=jnp.zeros((L - 1, W), jnp.uint32),
@@ -341,10 +357,28 @@ def grow_tree_compact(
         # not-applied case instead zeroes the loop trip counts, so the same
         # program runs with empty partition/histogram walks.
         s_ = st.leaf_start[best_leaf]
-        m_ = st.leaf_nrows[best_leaf]
-        n_right = m_ - n_left
-        m_eff = jnp.where(applied, m_, 0)
-        n_left_eff = jnp.where(applied, n_left, 0)
+        m_loc = st.leaf_nrows[best_leaf]
+        if ax:
+            # global split decision, LOCAL partition offsets: this shard's
+            # left count comes from its own histogram (reference keeps
+            # global_data_count_in_leaf_ beside the local partition,
+            # data_parallel_tree_learner.cpp:300-340)
+            m_g = st.leaf_nrows_g[best_leaf]
+            parent_loc = st.leaf_hist_loc[best_leaf]
+            n_left_loc = left_rows_of_split(
+                parent_loc, f_, b_, dl, nan_bin_arr[f_], is_cat_arr[f_],
+                bits)
+        else:
+            m_g = m_loc
+            parent_loc = None
+            n_left_loc = n_left
+        n_right_g = m_g - n_left
+        n_right_loc = m_loc - n_left_loc
+        # the GLOBALLY smaller child is streamed on every shard, so the
+        # psum-ed histograms all describe the same child
+        left_smaller = n_left <= n_right_g
+        m_eff = jnp.where(applied, m_loc, 0)
+        n_left_eff = jnp.where(applied, n_left_loc, 0)
 
         # stable partition of the parent's contiguous segment
         # (reference: DataPartition::Split / cuda_data_partition.cu:907)
@@ -354,7 +388,9 @@ def grow_tree_compact(
             work, scratch, hist_small_fused = fused_split(
                 st.work, st.scratch, jnp.asarray(0, i32), s_, m_eff,
                 n_left_eff, f_, b_, dl, nan_bin_arr[f_], is_cat_arr[f_],
-                bits, layout, B, params.fused_block, W)
+                bits, layout, B, params.fused_block, W,
+                interpret=params.fused_interpret,
+                smaller_left=left_smaller.astype(i32))
         else:
             work, scratch = partition_segment(
                 st.work, st.scratch, s_, m_eff, n_left_eff, f_, b_, dl,
@@ -362,23 +398,31 @@ def grow_tree_compact(
         leaf_start = st.leaf_start.at[best_leaf].set(
             jnp.where(applied, s_, st.leaf_start[best_leaf]))
         leaf_start = leaf_start.at[new_leaf].set(
-            jnp.where(applied, s_ + n_left, leaf_start[new_leaf]))
+            jnp.where(applied, s_ + n_left_loc, leaf_start[new_leaf]))
         leaf_nrows = st.leaf_nrows.at[best_leaf].set(
-            jnp.where(applied, n_left, st.leaf_nrows[best_leaf]))
+            jnp.where(applied, n_left_loc, st.leaf_nrows[best_leaf]))
         leaf_nrows = leaf_nrows.at[new_leaf].set(
-            jnp.where(applied, n_right, leaf_nrows[new_leaf]))
+            jnp.where(applied, n_right_loc, leaf_nrows[new_leaf]))
+        if ax:
+            leaf_nrows_g = st.leaf_nrows_g.at[best_leaf].set(
+                jnp.where(applied, n_left, st.leaf_nrows_g[best_leaf]))
+            leaf_nrows_g = leaf_nrows_g.at[new_leaf].set(
+                jnp.where(applied, n_right_g, leaf_nrows_g[new_leaf]))
+        else:
+            leaf_nrows_g = st.leaf_nrows_g
 
         # one streamed pass over the SMALLER child only; the larger child
         # is parent - smaller (reference: SubtractHistogramForLeaf,
         # cuda_histogram_constructor.cu:723)
         parent_hist = st.leaf_hist[best_leaf]
-        left_smaller = n_left <= n_right
         if params.fused_block:
-            hist_small = hist_small_fused
+            hist_small_loc = hist_small_fused
         else:
-            s_small = jnp.where(left_smaller, s_, s_ + n_left)
-            m_small = jnp.where(left_smaller, n_left_eff, m_eff - n_left_eff)
-            hist_small = seg_hist(work, s_small, m_small)
+            s_small = jnp.where(left_smaller, s_, s_ + n_left_loc)
+            m_small = jnp.where(left_smaller, n_left_eff,
+                                m_eff - n_left_eff)
+            hist_small_loc = seg_hist(work, s_small, m_small)
+        hist_small = lax.psum(hist_small_loc, ax) if ax else hist_small_loc
         hist_large = parent_hist - hist_small
         hist_left = jnp.where(left_smaller, hist_small, hist_large)
         hist_right = jnp.where(left_smaller, hist_large, hist_small)
@@ -386,6 +430,16 @@ def grow_tree_compact(
             jnp.where(applied, hist_left, parent_hist))
         leaf_hist = leaf_hist.at[new_leaf].set(
             jnp.where(applied, hist_right, leaf_hist[new_leaf]))
+        if ax:
+            large_loc = parent_loc - hist_small_loc
+            left_loc = jnp.where(left_smaller, hist_small_loc, large_loc)
+            right_loc = jnp.where(left_smaller, large_loc, hist_small_loc)
+            leaf_hist_loc = st.leaf_hist_loc.at[best_leaf].set(
+                jnp.where(applied, left_loc, parent_loc))
+            leaf_hist_loc = leaf_hist_loc.at[new_leaf].set(
+                jnp.where(applied, right_loc, leaf_hist_loc[new_leaf]))
+        else:
+            leaf_hist_loc = st.leaf_hist_loc
 
         fm_l = node_feature_mask(
             feat_mask, used_child, inter_sets,
@@ -433,8 +487,10 @@ def grow_tree_compact(
             work=work,
             scratch=scratch,
             leaf_hist=leaf_hist,
+            leaf_hist_loc=leaf_hist_loc,
             leaf_start=leaf_start,
             leaf_nrows=leaf_nrows,
+            leaf_nrows_g=leaf_nrows_g,
             split_feature=split_feature,
             split_bin=split_bin,
             cat_bitset=cat_bitset,

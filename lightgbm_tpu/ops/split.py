@@ -185,6 +185,22 @@ def go_left_pred(col: jnp.ndarray, bin_: jnp.ndarray, default_left,
     )
 
 
+def left_rows_of_split(hist: jnp.ndarray, feature, bin_, default_left,
+                       nan_bin, is_cat, cat_bitset) -> jnp.ndarray:
+    """Raw rows routed left by an already-decided split, recovered from a
+    histogram's raw-count channel (every row of a bin routes identically).
+
+    The data-parallel compact grower uses this to derive the SHARD-LOCAL
+    left count from the shard-local histogram while the split decision
+    itself comes from the psum-ed global histogram (reference:
+    DataParallelTreeLearner keeps global_data_count_in_leaf_ beside the
+    local partition, data_parallel_tree_learner.cpp:300-340)."""
+    raw = hist[feature, :, 3]                                  # [B]
+    bins = jnp.arange(hist.shape[1], dtype=jnp.int32)
+    gl = go_left_pred(bins, bin_, default_left, nan_bin, is_cat, cat_bitset)
+    return jnp.sum(raw * gl).astype(jnp.int32)
+
+
 def go_left_scalar_np(col: int, bin_: int, default_left: bool, nan_bin: int,
                       is_cat: bool, cat_bitset) -> bool:
     """Numpy scalar twin of go_left_pred for host-side consumers (TreeSHAP);

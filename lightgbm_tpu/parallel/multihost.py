@@ -89,6 +89,105 @@ def infer_process_id(machines: List[str]) -> Optional[int]:
     return None
 
 
+def pool_bin_sample(sample):
+    """Pool bin-construction samples across processes so every rank builds
+    IDENTICAL bin mappers from the global distribution (reference:
+    ConstructBinMappersFromTextData gathers per-rank samples and syncs the
+    resulting mappers, src/io/dataset_loader.cpp:1070; without this two
+    hosts would bin their local shards differently and train a silently
+    wrong model)."""
+    import jax
+    import numpy as np
+    if jax.process_count() <= 1:
+        return sample
+    from jax.experimental import multihost_utils as mu
+    counts = mu.process_allgather(
+        np.asarray([sample.shape[0]], np.int64)).reshape(-1)
+    m = int(counts.max())
+    padded = np.zeros((m, sample.shape[1]), sample.dtype)
+    padded[:sample.shape[0]] = sample
+    gathered = np.asarray(mu.process_allgather(padded))   # [P, m, F]
+    return np.concatenate(
+        [gathered[p, :int(c)] for p, c in enumerate(counts)], axis=0)
+
+
+def gather_metadata(md, n_local: int):
+    """Concatenate per-process Metadata into the global Metadata, in process
+    order (the same order jax.make_array_from_process_local_data lays out
+    the feature rows). Requires equal per-process row counts."""
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils as mu
+    from ..io.dataset import Metadata
+
+    counts = mu.process_allgather(
+        np.asarray([n_local], np.int64)).reshape(-1)
+    if int(counts.min()) != int(counts.max()):
+        raise ValueError(
+            "multi-host training needs the same row count on every process "
+            f"(got {counts.tolist()}); pre-partition the data evenly "
+            "(reference: pre_partition / CheckOrPartition, dataset.h:110)")
+    n_global = int(counts.sum())
+    out = Metadata(n_global)
+    for field in ("label", "weight", "init_score", "position"):
+        v = getattr(md, field)
+        flags = mu.process_allgather(
+            np.asarray([0 if v is None else 1], np.int64)).reshape(-1)
+        if int(flags.max()) == 0:
+            continue
+        if v is None:
+            raise ValueError(
+                f"metadata field {field} set on some processes but not here")
+        v = np.asarray(v)
+        if v.ndim == 2:
+            # [n_local, K] init scores: concatenate along rows
+            g = np.asarray(mu.process_allgather(v))      # [P, n_local, K]
+            setattr(out, field, g.reshape(-1, v.shape[1]))
+        elif v.size != n_local:
+            # flat class-major [K*n_local] (the reference Metadata layout,
+            # src/io/metadata.cpp init_score_): gather per class so the
+            # global vector stays class-major
+            kk = v.size // n_local
+            g = np.asarray(mu.process_allgather(
+                v.reshape(kk, n_local)))                 # [P, K, n_local]
+            setattr(out, field,
+                    np.concatenate(list(g), axis=1).reshape(-1))
+        else:
+            setattr(out, field,
+                    np.asarray(mu.process_allgather(v)).reshape(-1))
+    if md.query_boundaries is not None:
+        raise NotImplementedError(
+            "ranking groups are not supported with multi-host training yet")
+    return out
+
+
+def to_host(arr):
+    """Fetch a (possibly non-addressable) jax.Array as host numpy.
+
+    Multi-process: sharded global arrays are not fully addressable from one
+    process; allgather them (metrics and model pulls are host-side)."""
+    import jax
+    import numpy as np
+    if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+        if arr.is_fully_replicated:
+            return np.asarray(arr.addressable_data(0))
+        from jax.experimental import multihost_utils as mu
+        return np.asarray(mu.process_allgather(arr, tiled=True))
+    return np.asarray(arr)
+
+
+def maybe_init_distributed(params) -> bool:
+    """Bootstrap multi-process training when num_machines > 1 (alias-aware).
+
+    Must run before dataset construction (bin-mapper sync) and before any
+    backend-initializing JAX call."""
+    from ..config import Config
+    cfg = Config(params) if isinstance(params, dict) else params
+    if int(cfg.get("num_machines", 1) or 1) > 1:
+        return init_distributed(cfg)
+    return False
+
+
 def init_distributed(config) -> bool:
     """Initialize JAX multi-process training when num_machines > 1.
 
