@@ -39,7 +39,95 @@ def make_higgs_like(n, f, seed=7):
     return X, y
 
 
+def make_allstate_like(n, f, card=8, seed=7):
+    """Sparse one-hot blocks (Allstate F=4228 shape) — exercises EFB.
+
+    Generated group by group to avoid a dense [n, f] float64 intermediate."""
+    rng = np.random.RandomState(seed)
+    X = np.zeros((n, f), np.float32)
+    logits = 0.5 * rng.randn(n)
+    off = 0
+    while off < f:
+        w = min(card, f - off)           # remainder becomes a smaller group
+        cats = rng.randint(0, w, size=n)
+        X[np.arange(n), off + cats] = 1.0
+        wg = rng.randn(w) * 0.3
+        logits += wg[cats]
+        off += w
+    y = (logits > 0).astype(np.float64)
+    return X, y
+
+
+def make_msltr_like(n, f, docs_per_query=120, seed=7):
+    """MS-LTR-shaped ranking data: graded labels 0-4, query groups
+    (BASELINE.md MS-LTR row: 2.27M docs x 137 features,
+    ref docs/Experiments.rst:117)."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    w = rng.randn(f) / np.sqrt(f)
+    rel = X @ w + 0.8 * rng.randn(n)
+    # graded relevance by global quantiles
+    qs = np.quantile(rel, [0.55, 0.75, 0.9, 0.97])
+    y = np.digitize(rel, qs).astype(np.float64)
+    n_q = n // docs_per_query
+    group = np.full(n_q, docs_per_query, np.int64)
+    rest = n - n_q * docs_per_query
+    if rest:
+        group = np.concatenate([group, [rest]])
+    return X, y, group
+
+
+def run_ranking_bench():
+    """Lambdarank at MS-LTR scale: pair-block chunking + NDCG under load."""
+    import jax
+    jax.config.update("jax_compilation_cache_dir", os.environ.get(
+        "BENCH_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_bench_cache")))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    import lightgbm_tpu as lgb
+
+    rows = int(float(os.environ.get("BENCH_ROWS", 2_270_000)))
+    feats = int(os.environ.get("BENCH_FEATURES", 137))
+    iters = int(os.environ.get("BENCH_ITERS", 10))
+    X, y, group = make_msltr_like(rows, feats)
+    params = {
+        "objective": "lambdarank", "metric": "ndcg", "eval_at": [10],
+        "num_leaves": int(os.environ.get("BENCH_NUM_LEAVES", 255)),
+        "max_bin": int(os.environ.get("BENCH_MAX_BIN", 255)),
+        "learning_rate": 0.1, "min_data_in_leaf": 50, "verbosity": -1,
+        "stop_check_freq": 10_000,
+    }
+    ds = lgb.Dataset(X, label=y, group=group, params=params)
+    bst = lgb.Booster(params, ds)
+    t0 = time.time()
+    for _ in range(WARMUP):
+        bst.update()
+    bst._gbdt._flush_trees()
+    warm = time.time() - t0
+    t0 = time.time()
+    for _ in range(iters):
+        bst.update()
+    bst._gbdt._flush_trees()
+    dt = time.time() - t0
+    (_, name, ndcg, _), = bst.eval_train()
+    sys.stderr.write(f"[bench-ranking] rows={rows} features={feats} "
+                     f"warmup={warm:.1f}s train({iters})={dt:.1f}s "
+                     f"{name}={ndcg:.5f}\n")
+    # MS-LTR CPU baseline: ref Experiments.rst:117 xgb_hist/LightGBM table
+    # does not publish iters/sec for MS-LTR; report absolute throughput
+    print(json.dumps({
+        "metric": f"synthetic-msltr{rows // 1_000_000}M-"
+                  f"{params['num_leaves']}leaf lambdarank throughput",
+        "value": round(iters / dt, 3),
+        "unit": "iters/sec/chip",
+        "vs_baseline": round(float(ndcg), 5),
+    }))
+
+
 def main():
+    if os.environ.get("BENCH_RANKING", "") == "1":
+        return run_ranking_bench()
     import jax
     # persistent compile cache: the full-config tree program takes ~2 min to
     # compile cold; warm runs of the bench (and of users' jobs) skip it
@@ -53,7 +141,11 @@ def main():
     import lightgbm_tpu as lgb
 
     dev = jax.devices()[0]
-    X, y = make_higgs_like(ROWS, FEATURES)
+    sparse = os.environ.get("BENCH_SPARSE", "") == "1"
+    if sparse:
+        X, y = make_allstate_like(ROWS, FEATURES)
+    else:
+        X, y = make_higgs_like(ROWS, FEATURES)
 
     params = {
         "objective": "binary",
@@ -66,6 +158,10 @@ def main():
         # bench runs sync-free; one stop check at the end
         "stop_check_freq": 10_000,
     }
+    if sparse:
+        # binary one-hot features: a small sample fully determines the bins,
+        # and the host-side mapper loop over F=4228 dominates construct time
+        params["bin_construct_sample_cnt"] = 20_000
     t0 = time.time()
     ds = lgb.Dataset(X, label=y, params=params)
     ds.construct()
@@ -101,8 +197,9 @@ def main():
         f"leaves={NUM_LEAVES} bins={MAX_BIN}\n"
         f"[bench] construct={construct_s:.1f}s warmup({WARMUP})={warmup_s:.1f}s "
         f"compile~={compile_s:.1f}s train({ITERS})={train_s:.1f}s auc={auc}\n")
+    shape = "allstate" if sparse else "higgs"
     print(json.dumps({
-        "metric": f"synthetic-higgs{ROWS // 1_000_000}M-"
+        "metric": f"synthetic-{shape}{ROWS // 1_000_000}M-"
                   f"{NUM_LEAVES}leaf boosting throughput",
         "value": round(iters_per_sec, 3),
         "unit": "iters/sec/chip",

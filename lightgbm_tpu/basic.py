@@ -70,7 +70,7 @@ class Dataset:
         "max_bin", "min_data_in_bin", "bin_construct_sample_cnt",
         "use_missing", "zero_as_missing", "data_random_seed",
         "feature_pre_filter", "max_bin_by_feature", "linear_tree",
-        "forcedbins_filename")
+        "forcedbins_filename", "enable_bundle")
 
     def _update_params(self, params: Optional[Dict[str, Any]]) -> "Dataset":
         """Merge binning params from a Booster into a not-yet-constructed
@@ -154,6 +154,7 @@ class Dataset:
             or bool(cfg.get("linear_tree", False)),
             forcedbins_filename=str(cfg.get("forcedbins_filename", "") or ""),
             max_bin_by_feature=cfg.get("max_bin_by_feature"),
+            enable_bundle=bool(cfg.get("enable_bundle", True)),
         )
         md = self._inner.metadata
         if self.label is not None:
@@ -166,6 +167,76 @@ class Dataset:
         if self.free_raw_data:
             self.data = None
         return self
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        """Row-subset Dataset sharing this dataset's bin mappers
+        (reference: Dataset.subset, python-package basic.py ->
+        LGBM_DatasetGetSubset, c_api.cpp; used by cv folds and sklearn).
+
+        The parent must be constructed; the subset re-uses its binned rows
+        directly (no re-binning), so bin boundaries match exactly."""
+        self.construct()
+        # sorted unique indices: group reconstruction and row extraction
+        # must agree on order (the reference sorts used_indices the same way)
+        idx = np.unique(np.asarray(used_indices, np.int64).reshape(-1))
+        inner = self._inner
+        sub = Dataset.__new__(Dataset)
+        sub.data = None
+        sub.label = None
+        sub.reference = self
+        sub.weight = None
+        sub.group = None
+        sub.init_score = None
+        sub.feature_name = self.feature_name
+        sub.categorical_feature = self.categorical_feature
+        sub.params = copy.deepcopy(params or self.params)
+        sub.free_raw_data = self.free_raw_data
+        sub.position = None
+        sub.used_indices = idx
+        si = BinnedDataset()
+        si.binned = inner.binned[idx]
+        si.bundle_info = inner.bundle_info
+        si.mappers = inner.mappers
+        si.feature_names = inner.feature_names
+        si.max_num_bins = inner.max_num_bins
+        si.num_data = len(idx)
+        si.num_total_features = inner.num_total_features
+        si.used_features = inner.used_features
+        si.categorical_features = inner.categorical_features
+        if inner.raw_data is not None:
+            si.raw_data = inner.raw_data[idx]
+        md = Metadata(len(idx))
+        src = inner.metadata
+        if src.label is not None:
+            md.set_label(src.label[idx])
+        if src.weight is not None:
+            md.set_weight(src.weight[idx])
+        if src.init_score is not None:
+            isc = np.asarray(src.init_score)
+            md.set_init_score(isc[idx] if isc.ndim == 2
+                              else (isc[idx] if isc.size == src.num_data
+                                    else isc.reshape(-1, src.num_data)
+                                    [:, idx].reshape(-1)))
+        if src.position is not None:
+            md.set_position(src.position[idx])
+        if src.query_boundaries is not None:
+            # rebuild per-query sizes from the selected rows; a subset that
+            # splits a query apart cannot keep valid ranking structure
+            # (reference: Metadata partitioning, CheckOrPartition)
+            qb = src.query_boundaries
+            qid = np.searchsorted(qb, idx, side="right") - 1
+            sizes = np.bincount(qid, minlength=len(qb) - 1)
+            full = np.diff(qb)
+            partial = (sizes > 0) & (sizes != full)
+            if partial.any():
+                raise ValueError(
+                    "Dataset.subset would split query groups "
+                    f"{np.nonzero(partial)[0][:5].tolist()}...; ranking "
+                    "subsets must select whole queries")
+            md.set_group(sizes[sizes > 0])
+        si.metadata = md
+        sub._inner = si
+        return sub
 
     def create_valid(self, data, label=None, weight=None, group=None,
                      init_score=None, params=None, position=None) -> "Dataset":

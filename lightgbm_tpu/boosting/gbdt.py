@@ -433,6 +433,11 @@ class GBDT:
         self._pad = pad
         self.num_data = self._n_real + pad
 
+        # EFB: configurations the bundle-space growers can't serve unbundle
+        # HERE, before any device placement, so every learner's layout logic
+        # below sees a plain dense matrix (bundling is lossless)
+        self._efb_precheck(train_set, cfg, tree_learner)
+
         binned_np = train_set.binned
         if pad:
             binned_np = np.pad(binned_np, ((0, pad), (0, 0)))
@@ -658,6 +663,7 @@ class GBDT:
             grower == "compact"
             or (grower == "auto" and self._n_real >= 65536))
         self._compact = None          # lazy _CompactTrainState
+        self._setup_efb(train_set)
         md = train_set.metadata if not pad else _pad_metadata(
             train_set.metadata, self.num_data)
         if self._multiproc:
@@ -665,7 +671,7 @@ class GBDT:
             # process (metrics, averages and objectives are global state)
             from ..parallel.multihost import gather_metadata
             md = gather_metadata(train_set.metadata, train_set.num_data)
-        self._global_md = md
+        self._global_md = md if self._multiproc else None
         if self.objective is not None:
             self.objective.init(md, self.num_data)
 
@@ -799,6 +805,23 @@ class GBDT:
         self._cx_weight = k + gcols + 1 if has_w else None
         self._cx_rowid = e - 1
         gp = self.grower_params
+        if gp.fused_block:
+            # kernel scoped-VMEM buffers scale with block_size * num_cols
+            # and the histogram accumulator with num_cols * num_bins; scale
+            # the block down for wide records and fall back to the XLA walk
+            # when the histogram alone would blow the ~16MB scoped limit
+            c_rec = layout.num_cols
+            bs = min(gp.fused_block, max(32, (49152 // c_rec) // 32 * 32))
+            f_hist_bytes = layout.num_features * \
+                -(-int(self.grower_params.num_bins) // 128) * 128 * 32
+            if f_hist_bytes > 6 << 20:
+                log.warning("fused kernel disabled: histogram accumulator "
+                            f"needs {f_hist_bytes >> 20}MB VMEM; using the "
+                            "XLA compact walk")
+                bs = 0
+            if bs != gp.fused_block:
+                gp = gp._replace(fused_block=bs)
+                self.grower_params = gp
         # the fused kernel's aligned block writes may overrun a segment end
         # by up to one block + one alignment tile
         pad = max(gp.part_block, gp.hist_block, gp.fused_block + 32)
@@ -908,6 +931,7 @@ class GBDT:
         quant_stoch = self._quant_stochastic
         const_hess = bool(getattr(obj, "is_constant_hessian", False))
         feature_contri = self._feature_contri
+        efb = self._efb
         sc_off = layout.extra_off            # K score columns live first
         lbl_off = layout.extra_off + 4 * self._cx_label
         w_off = (layout.extra_off + 4 * self._cx_weight
@@ -977,7 +1001,7 @@ class GBDT:
                 work, scratch, num_bins_arr, nan_bin_arr, has_nan_arr,
                 is_cat_arr, feat_mask, layout, gp, n,
                 mono_types, inter_sets, bynode_key, cegb_coupled, cegb_used,
-                extra_key, feature_contri)
+                extra_key, feature_contri, efb)
             if use_cegb:
                 cegb_used = _tree_used_features(tree, layout.num_features,
                                                 cegb_used)
@@ -1082,7 +1106,9 @@ class GBDT:
 
     def _cegb_state(self) -> jax.Array:
         if self._cegb_used is None:
-            self._cegb_used = jnp.zeros((int(self.binned.shape[1]),), bool)
+            self._cegb_used = jnp.zeros(
+                (int(self.binned.shape[1])
+                 + self.grower_params.efb_virtual,), bool)
         return self._cegb_used
 
     def _compact_gradients(self):
@@ -1176,6 +1202,7 @@ class GBDT:
 
     def set_train_metrics(self, metrics: Sequence[Metric]) -> None:
         for m in metrics:
+            # multi-host: metrics need the GLOBAL gathered metadata
             m.init(getattr(self, "_global_md", None)
                    or self.train_set.metadata, self._n_real)
         self.train_metrics = list(metrics)
@@ -1207,6 +1234,176 @@ class GBDT:
             g, h = self._grad_fn(score[0])
             return g[None, :], h[None, :]
         return self._grad_fn(score)
+
+    def _efb_precheck(self, train_set, cfg, tree_learner) -> None:
+        """Unbundle an EFB dataset when this configuration won't use the
+        bundle-space compact grower (mirrors the can_compact conditions in
+        _setup_train plus the bundle-incompatible knobs). Runs BEFORE device
+        placement so every learner sees a plain dense matrix."""
+        binfo = getattr(train_set, "bundle_info", None)
+        if binfo is None:
+            return
+        obj = self.objective
+        grower = str(cfg.get("tpu_grower", "auto")).lower()
+        n = train_set.num_data
+        compact_possible = (
+            tree_learner in ("serial", "data")
+            and not self._multiproc
+            and obj is not None
+            and getattr(obj, "row_elementwise", True)
+            and not getattr(obj, "is_stochastic", False)
+            and int(train_set.max_num_bins) <= 256
+            and float(cfg.get("pos_bagging_fraction", 1.0)) >= 1.0
+            and float(cfg.get("neg_bagging_fraction", 1.0)) >= 1.0
+            and not bool(cfg.get("bagging_by_query", False))
+            and train_set.metadata.query_boundaries is None
+            and not bool(cfg.get("linear_tree", False))
+            and not str(cfg.get("forcedsplits_filename", "") or "")
+            and grower != "masked"
+            and (grower == "compact" or n >= 65536)
+            and not (self.mesh is not None and obj.renew_leaves))
+        knobs_ok = (
+            cfg.get("monotone_constraints") is None
+            and cfg.get("interaction_constraints") is None
+            and cfg.get("feature_contri") is None
+            and float(cfg.get("cegb_penalty_split", 0) or 0) == 0.0
+            and cfg.get("cegb_penalty_feature_coupled") is None
+            and cfg.get("cegb_penalty_feature_lazy") is None)
+        if compact_possible and knobs_ok:
+            return
+        log.warning(
+            "EFB bundles are not supported by this configuration; "
+            "unbundling the dataset (set enable_bundle=false to skip "
+            "bundling entirely)")
+        from ..io.efb import unbundle
+        dbins = np.array([m.default_bin for m in train_set.mappers],
+                         np.int32)
+        train_set.binned = unbundle(
+            np.asarray(train_set.binned), binfo, dbins,
+            train_set.feature_num_bins())
+        train_set.bundle_info = None
+
+    def _setup_efb(self, train_set: BinnedDataset) -> None:
+        """Wire an EFB-bundled dataset (io/efb.py) into the learner.
+
+        Scan space = stored columns + one VIRTUAL feature per bundled
+        original (its histogram is synthesized from its bundle column's bin
+        range, ops/split.py extend_hist_efb); routing space = stored columns
+        (bundled splits carry a ready bitset). Tree arrays record ORIGINAL
+        feature ids, so model text and raw-data prediction never see bundles
+        (reference analogue: FeatureGroup keeps group bins while SplitInfo
+        carries the real feature, include/LightGBM/feature_group.h)."""
+        self._efb = None
+        binfo = getattr(train_set, "bundle_info", None)
+        if binfo is None:
+            return
+        if self.mesh is not None and self.tree_learner not in ("data",):
+            raise ValueError(
+                "EFB-bundled datasets support the serial and data-parallel "
+                "learners; construct the Dataset with enable_bundle=false "
+                f"for tree_learner={self.tree_learner}")
+        bad = [name for flag, name in (
+            (self._mono_types is not None, "monotone_constraints"),
+            (self._inter_sets is not None, "interaction_constraints"),
+            (self._use_cegb, "cegb penalties"),
+            (self._feature_contri is not None, "feature_contri"),
+            (self._forced_splits is not None, "forcedsplits"),
+            (self._linear, "linear_tree"),
+        ) if flag]
+        if bad or not self._use_compact:
+            # graceful fallback: bundling is lossless, so reconstruct the
+            # dense binned matrix and train unbundled (reference analogue:
+            # EFB is construction-time there too, but its learners all read
+            # FeatureGroups; ours only the compact grower does)
+            why = ", ".join(bad) if bad else "the masked grower"
+            log.warning(f"EFB bundles are not supported with {why}; "
+                        "unbundling the dataset (set enable_bundle=false to "
+                        "skip bundling entirely)")
+            from ..io.efb import unbundle
+            dbins = np.array([m.default_bin for m in train_set.mappers],
+                             np.int32)
+            dense = unbundle(np.asarray(train_set.binned), binfo, dbins,
+                             train_set.feature_num_bins())
+            train_set.binned = dense
+            train_set.bundle_info = None
+            # rebuild the device matrix exactly as _setup_train placed it
+            if self._pad:
+                dense = np.pad(dense, ((0, self._pad), (0, 0)))
+            if self.mesh is not None:
+                from ..parallel.mesh import row_sharding_2d
+                if self._multiproc:
+                    self.binned = jax.make_array_from_process_local_data(
+                        row_sharding_2d(self.mesh), dense)
+                else:
+                    self.binned = jax.device_put(dense,
+                                                 row_sharding_2d(self.mesh))
+            else:
+                self.binned = jnp.asarray(dense)
+            return
+        C = binfo.n_columns
+        mappers = train_set.mappers
+        orig_nb = train_set.feature_num_bins()
+        orig_nan = train_set.feature_nan_bins()
+        orig_cat = train_set.feature_is_categorical()
+        orig_has_nan = np.array(
+            [m.missing_type == 2 and not m.is_categorical for m in mappers],
+            bool)
+        orig_dbin = np.array([m.default_bin for m in mappers], np.int32)
+        nontrivial = np.array([not m.is_trivial for m in mappers], bool)
+        bundled = np.nonzero(binfo.offset_of >= 0)[0]
+        passthrough = np.nonzero(binfo.offset_of < 0)[0]
+        Fb = len(bundled)
+
+        def colv(vals, fill):
+            vals = np.asarray(vals)
+            v = np.full(C, fill, vals.dtype)
+            v[binfo.col_of[passthrough]] = vals[passthrough]
+            return v
+
+        self.num_bins_arr = jnp.asarray(np.concatenate(
+            [binfo.num_column_bins, orig_nb[bundled]]).astype(np.int32))
+        self.nan_bin_arr = jnp.asarray(np.concatenate(
+            [colv(orig_nan, 0), orig_nan[bundled]]).astype(np.int32))
+        self.has_nan_arr = jnp.asarray(np.concatenate(
+            [colv(orig_has_nan, False), np.zeros(Fb, bool)]))
+        self.is_cat_arr = jnp.asarray(np.concatenate(
+            [colv(orig_cat, False), np.zeros(Fb, bool)]))
+        # bundle columns themselves never win a split
+        self.base_feat_mask = np.concatenate(
+            [colv(nontrivial, False), np.ones(Fb, bool)])
+        orig_of_col = np.full(C, -1, np.int32)
+        orig_of_col[binfo.col_of[passthrough]] = passthrough
+        self._efb = tuple(jnp.asarray(a) for a in (
+            np.concatenate([np.arange(C, dtype=np.int32),
+                            binfo.col_of[bundled]]),          # col_of_ext
+            np.concatenate([colv(orig_cat, False),
+                            np.ones(Fb, bool)]),              # route_cat_ext
+            np.concatenate([np.full(C, -1, np.int32),
+                            binfo.offset_of[bundled]]),       # off_ext
+            np.concatenate([np.zeros(C, np.int32),
+                            orig_nb[bundled]]),               # nb_ext
+            np.concatenate([np.zeros(C, np.int32),
+                            orig_dbin[bundled]]),             # dbin_ext
+            np.concatenate([orig_of_col,
+                            bundled.astype(np.int32)]),       # orig_of_ext
+        ))
+        # per-ORIGINAL routing (valid scoring / DART / rollback replay)
+        # and plain per-original arrays for prediction (prediction rows are
+        # binned per ORIGINAL feature, never bundled)
+        self._orig_nan_arr = jnp.asarray(orig_nan.astype(np.int32))
+        self._orig_cat_arr = jnp.asarray(orig_cat)
+        self._route_nan = self._orig_nan_arr
+        self._route_cat = jnp.asarray(orig_cat | (binfo.offset_of >= 0))
+        self._route_col = jnp.asarray(binfo.col_of.astype(np.int32))
+        self._num_orig_features = train_set.num_total_features
+        self.grower_params = self.grower_params._replace(
+            efb_virtual=Fb, efb_bmax=int(orig_nb[bundled].max()))
+
+    def _route_args(self):
+        """(nan_bin, is_cat[, col_of]) arrays for route_one_tree."""
+        if self._efb is not None:
+            return (self._route_nan, self._route_cat, self._route_col)
+        return (self.nan_bin_arr, self.is_cat_arr)
 
     def _feature_mask(self) -> jnp.ndarray:
         """Per-tree column sampling (reference: ColSampler, col_sampler.hpp)."""
@@ -1350,8 +1547,7 @@ class GBDT:
             vleaf = route_one_tree(
                 vs.binned, tree.split_feature, tree.split_bin,
                 tree.cat_bitset, tree.default_left, tree.left_child,
-                tree.right_child, tree.num_nodes, self.nan_bin_arr,
-                self.is_cat_arr)
+                tree.right_child, tree.num_nodes, *self._route_args())
             vdelta = linear_leaf_outputs(
                 host, vs.dataset.raw_data, np.asarray(vleaf)[: vs.n_real])
             vs.score = vs.score.at[cur_tree_id, : vs.n_real].add(
@@ -1434,8 +1630,7 @@ class GBDT:
             leaf = route_one_tree(
                 vs.binned, tree.split_feature, tree.split_bin,
                 tree.cat_bitset, tree.default_left, tree.left_child,
-                tree.right_child, tree.num_nodes, self.nan_bin_arr,
-                self.is_cat_arr)
+                tree.right_child, tree.num_nodes, *self._route_args())
             vs.score = vs.score.at[cur_tree_id].set(
                 _add_leaf_outputs(vs.score[cur_tree_id], tree.leaf_value, leaf))
 
@@ -1460,7 +1655,7 @@ class GBDT:
             if train:
                 leaf = route_one_tree(
                     self._routing_binned(), sf, sb, cb, dl, lc, rc, nn,
-                    self.nan_bin_arr, self.is_cat_arr)
+                    *self._route_args())
                 delta = linear_leaf_outputs(
                     host, self.train_set.raw_data, np.asarray(leaf)) * factor
                 self.train_score = self.train_score.at[cur_tree_id].add(
@@ -1469,7 +1664,7 @@ class GBDT:
                 for vs in self.valid_sets:
                     vleaf = route_one_tree(
                         vs.binned, sf, sb, cb, dl, lc, rc, nn,
-                        self.nan_bin_arr, self.is_cat_arr)
+                        *self._route_args())
                     vdelta = linear_leaf_outputs(
                         host, vs.dataset.raw_data,
                         np.asarray(vleaf)[: vs.n_real]) * factor
@@ -1477,14 +1672,14 @@ class GBDT:
                         jnp.asarray(vdelta, jnp.float32))
             return
         if train:
-            leaf = route_one_tree(self._routing_binned(), sf, sb, cb, dl, lc,
-                                  rc, nn, self.nan_bin_arr, self.is_cat_arr)
+            leaf = route_one_tree(self._routing_binned(), sf, sb, cb, dl,
+                                  lc, rc, nn, *self._route_args())
             self.train_score = self.train_score.at[cur_tree_id].set(
                 _add_leaf_outputs(self.train_score[cur_tree_id], lv, leaf))
         if valid:
             for vs in self.valid_sets:
-                vleaf = route_one_tree(vs.binned, sf, sb, cb, dl, lc, rc, nn,
-                                       self.nan_bin_arr, self.is_cat_arr)
+                vleaf = route_one_tree(vs.binned, sf, sb, cb, dl, lc, rc,
+                                       nn, *self._route_args())
                 vs.score = vs.score.at[cur_tree_id].set(
                     _add_leaf_outputs(vs.score[cur_tree_id], lv, vleaf))
 
@@ -1601,8 +1796,12 @@ class GBDT:
             n = binned.shape[0]
             return np.zeros((self.num_tree_per_iteration, n), np.float32)
         trees = self.device_trees(num_iteration, start_iteration)
+        # prediction inputs are binned per ORIGINAL feature (no bundling)
+        nan_a, cat_a = ((self._orig_nan_arr, self._orig_cat_arr)
+                        if self._efb is not None
+                        else (self.nan_bin_arr, self.is_cat_arr))
         raw = predict_raw(
-            jnp.asarray(binned), trees, self.nan_bin_arr, self.is_cat_arr,
+            jnp.asarray(binned), trees, nan_a, cat_a,
             jnp.asarray(self.num_tree_per_iteration, jnp.int32),
             self.num_tree_per_iteration,
             early_stop_margin=(early_stop[0] if early_stop else 0.0),
@@ -1666,8 +1865,11 @@ class GBDT:
         from ..ops.predict import predict_leaf_index
         binned = self.bin_matrix(arr)
         trees = self.device_trees(num_iteration, start_iteration)
+        nan_a, cat_a = ((self._orig_nan_arr, self._orig_cat_arr)
+                        if self._efb is not None
+                        else (self.nan_bin_arr, self.is_cat_arr))
         leaves = predict_leaf_index(
-            jnp.asarray(binned), trees, self.nan_bin_arr, self.is_cat_arr)
+            jnp.asarray(binned), trees, nan_a, cat_a)
         return np.asarray(leaves).T
 
     @property
@@ -1677,7 +1879,8 @@ class GBDT:
     # -- feature importance (reference: GBDT::FeatureImportance, gbdt.cpp) ---
     def feature_importance(self, importance_type: str = "split",
                            iteration: Optional[int] = None) -> np.ndarray:
-        num_features = int(self.binned.shape[1]) if hasattr(self, "binned") \
+        num_features = getattr(self, "_num_orig_features", None) \
+            or int(self.binned.shape[1]) if hasattr(self, "binned") \
             else max((int(m.split_feature.max(initial=-1)) + 1)
                      for m in self.models) if self.models else 0
         out = np.zeros(num_features, np.float64)

@@ -123,9 +123,65 @@ def run(argv=None) -> int:
         print(f"predictions saved to {output_result}")
         return 0
 
-    if task in ("convert_model", "refit"):
-        print(f"task={task} is not implemented yet", file=sys.stderr)
-        return 1
+    if task == "save_binary":
+        # (reference: kSaveBinary, application.cpp — bins the train data and
+        # writes <data>.bin for fast reloads)
+        data = params.pop("data", None)
+        if not data:
+            print("task=save_binary needs data=<file>", file=sys.stderr)
+            return 1
+        ds, _ = _load_dataset(params, data)
+        ds._update_params(params)
+        ds.construct()
+        out = params.pop("output_model", data + ".bin")
+        ds._inner.save_binary(out)
+        print(f"binary dataset saved to {out}")
+        return 0
+
+    if task == "refit":
+        # (reference: KRefitTree, application.cpp:268 — re-learn leaf values
+        # on new data with refit_decay_rate, tree structure unchanged)
+        data = params.pop("data", None)
+        input_model = params.pop("input_model", None)
+        if not data or not input_model:
+            print("task=refit needs data=<file> input_model=<model>",
+                  file=sys.stderr)
+            return 1
+        from .io.loader import load_text_file
+        X, label, _, _, _ = load_text_file(
+            data,
+            has_header=str(params.get("header", "false")).lower()
+            in ("true", "1"),
+            label_column=params.get("label_column", "0"))
+        bst = lgb.Booster(model_file=input_model)
+        decay = float(params.get("refit_decay_rate", 0.9))
+        bst = bst.refit(X, label, decay_rate=decay)
+        output_model = params.get("output_model", "LightGBM_model.txt")
+        bst.save_model(output_model)
+        print(f"refitted model saved to {output_model}")
+        return 0
+
+    if task == "convert_model":
+        # (reference: kConvertModel, application.cpp:215 -> Tree::ToIfElse)
+        input_model = params.pop("input_model", None)
+        if not input_model:
+            print("task=convert_model needs input_model=<model>",
+                  file=sys.stderr)
+            return 1
+        lang = params.get("convert_model_language", "cpp")
+        if lang not in ("cpp", "c++", ""):
+            print(f"convert_model_language={lang} is not supported (cpp "
+                  "only)", file=sys.stderr)
+            return 1
+        out = params.get("convert_model", "gbdt_prediction.cpp")
+        from .model_io import LoadedGBDT
+        with open(input_model) as fh:
+            code = LoadedGBDT(fh.read()).to_if_else()
+        with open(out, "w") as fh:
+            fh.write(code)
+        print(f"if-else model written to {out}")
+        return 0
+
     print(f"unknown task: {task}", file=sys.stderr)
     return 1
 

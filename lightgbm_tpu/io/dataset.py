@@ -129,7 +129,9 @@ class BinnedDataset:
     """
 
     def __init__(self):
-        self.binned: Optional[np.ndarray] = None   # [N, F] uint8/uint16
+        # [N, n_columns] uint8/uint16; n_columns == F unless EFB bundled
+        self.binned: Optional[np.ndarray] = None
+        self.bundle_info = None                    # io/efb.py BundleInfo
         self.mappers: List[BinMapper] = []
         self.feature_names: List[str] = []
         self.metadata: Optional[Metadata] = None
@@ -178,6 +180,16 @@ class BinnedDataset:
             used_features=np.asarray(self.used_features, np.int64),
             categorical_features=np.asarray(self.categorical_features,
                                             np.int64),
+            bundle_col_of=(np.asarray(self.bundle_info.col_of, np.int64)
+                           if self.bundle_info is not None
+                           else np.zeros(0, np.int64)),
+            bundle_offset_of=(np.asarray(self.bundle_info.offset_of, np.int64)
+                              if self.bundle_info is not None
+                              else np.zeros(0, np.int64)),
+            bundle_col_bins=(np.asarray(self.bundle_info.num_column_bins,
+                                        np.int64)
+                             if self.bundle_info is not None
+                             else np.zeros(0, np.int64)),
             mappers=np.frombuffer(
                 json.dumps(mapper_blobs, allow_nan=False).encode(), np.uint8),
             label=md.label if md.label is not None else np.zeros(0),
@@ -207,6 +219,15 @@ class BinnedDataset:
         ds.num_total_features = int(z["num_total_features"])
         ds.used_features = [int(i) for i in z["used_features"]]
         ds.categorical_features = [int(i) for i in z["categorical_features"]]
+        if "bundle_col_of" in z and z["bundle_col_of"].size:
+            from .efb import BundleInfo
+            col_of = z["bundle_col_of"].astype(np.int32)
+            off_of = z["bundle_offset_of"].astype(np.int32)
+            ds.bundle_info = BundleInfo(
+                col_of=col_of, offset_of=off_of,
+                num_column_bins=z["bundle_col_bins"].astype(np.int32),
+                n_columns=int(z["bundle_col_bins"].size),
+                n_bundled=int((off_of >= 0).sum()))
         blobs = json.loads(z["mappers"].tobytes().decode())
         for blob in blobs:
             blob["bin_upper_bounds"] = np.asarray(
@@ -242,6 +263,7 @@ class BinnedDataset:
         keep_raw: bool = False,
         forcedbins_filename: str = "",
         max_bin_by_feature: Optional[Sequence[int]] = None,
+        enable_bundle: bool = True,
     ) -> "BinnedDataset":
         arr = _to_2d_float(data)
         n, f = arr.shape
@@ -330,6 +352,31 @@ class BinnedDataset:
             if m.is_trivial:
                 continue
             binned[:, j] = m.value_to_bin(arr[:, j]).astype(dtype)
+        # Exclusive Feature Bundling: pack mutually-exclusive sparse features
+        # into shared columns (reference: FeatureGroup / Dataset::Construct
+        # FindGroups, include/LightGBM/feature_group.h). The growers then see
+        # n_columns ( << F on one-hot-wide data) storage columns.
+        if reference is not None:
+            info = reference.bundle_info
+            if info is not None:
+                binned = _apply_bundles(binned, info, ds)
+        elif enable_bundle and ds.max_num_bins <= 256:
+            from .efb import build_bundle_info, plan_bundles
+            dbins = np.array([m.default_bin for m in ds.mappers], np.int32)
+            nbins = np.array([m.num_bins for m in ds.mappers], np.int32)
+            ok = np.array(
+                [(not m.is_categorical) and m.missing_type != MISSING_NAN
+                 and not m.is_trivial for m in ds.mappers], bool)
+            srows = min(n, 50_000)
+            bundles = plan_bundles(binned[:srows], nbins, dbins, ok,
+                                   max_bin=max_bin)
+            if bundles:
+                info = build_bundle_info(bundles, nbins, f)
+                ds.bundle_info = info
+                binned = _apply_bundles(binned, info, ds)
+                log.info(
+                    f"EFB: bundled {info.n_bundled} of {f} features into "
+                    f"{info.n_columns} stored columns")
         ds.binned = binned
         ds.metadata = Metadata(n)
         if keep_raw:
@@ -353,6 +400,19 @@ class BinnedDataset:
 
     def feature_is_categorical(self) -> np.ndarray:
         return np.array([m.is_categorical for m in self.mappers], dtype=bool)
+
+
+def _apply_bundles(binned, info, ds):
+    from .efb import bundle_matrix
+    dbins = np.array([m.default_bin for m in ds.mappers], np.int32)
+    out = bundle_matrix(binned, info, dbins)
+    if out is None:
+        log.warning("EFB: feature conflict outside the planning sample; "
+                    "keeping the dense matrix")
+        ds.bundle_info = None
+        return binned
+    ds.bundle_info = info
+    return out
 
 
 def _resolve_categorical(

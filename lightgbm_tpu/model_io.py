@@ -441,6 +441,92 @@ class LoadedGBDT:
     def current_iteration(self) -> int:
         return len(self.models) // max(self.num_tree_per_iteration, 1)
 
+    def to_if_else(self) -> str:
+        """C++ if-else prediction code for the whole model (reference:
+        Tree::ToIfElse src/io/tree.cpp, surfaced by task=convert_model with
+        convert_model_language=cpp, application.cpp:215). Leaf values are
+        post-shrinkage, so summing tree outputs reproduces predict_raw."""
+        out = [
+            "// generated by lightgbm_tpu task=convert_model",
+            "#include <cmath>",
+            "#include <cstdint>",
+            "",
+            "namespace lightgbm_tpu_model {",
+            "",
+            "static inline bool CatInSet(const uint32_t* w, int n, "
+            "double v) {",
+            "  if (std::isnan(v) || v < 0) return false;",
+            "  int iv = static_cast<int>(v);",
+            "  if (iv >= 32 * n) return false;",
+            "  return (w[iv / 32] >> (iv % 32)) & 1u;",
+            "}",
+            "",
+        ]
+
+        def emit_node(t, node, depth, lines):
+            ind = "  " * (depth + 1)
+            if node < 0:
+                leaf = -(node + 1)
+                lines.append(f"{ind}return {float(t.leaf_value[leaf])!r};")
+                return
+            f = int(t.split_feature[node])
+            dt = int(t.decision_type[node])
+            if dt & 1:
+                ci = int(t.threshold[node])
+                lo = int(t.cat_boundaries[ci])
+                hi = int(t.cat_boundaries[ci + 1])
+                words = ", ".join(f"{int(w)}u"
+                                  for w in t.cat_threshold[lo:hi])
+                lines.append(
+                    f"{ind}static const uint32_t cats_{node}[] = "
+                    f"{{{words}}};")
+                cond = (f"CatInSet(cats_{node}, {hi - lo}, x[{f}])")
+            else:
+                default_left = "true" if dt & 2 else "false"
+                missing_type = (dt >> 2) & 3
+                thr = repr(float(t.threshold[node]))
+                if missing_type == 2:      # NaN
+                    cond = (f"(std::isnan(x[{f}]) ? {default_left} : "
+                            f"(x[{f}] <= {thr}))")
+                elif missing_type == 1:    # zero-as-missing
+                    cond = (f"((std::isnan(x[{f}]) || std::fabs(x[{f}]) "
+                            f"<= 1e-35) ? {default_left} : "
+                            f"(x[{f}] <= {thr}))")
+                else:
+                    cond = (f"((std::isnan(x[{f}]) ? 0.0 : x[{f}]) "
+                            f"<= {thr})")
+            lines.append(f"{ind}if ({cond}) {{")
+            emit_node(t, int(t.left_child[node]), depth + 1, lines)
+            lines.append(f"{ind}}} else {{")
+            emit_node(t, int(t.right_child[node]), depth + 1, lines)
+            lines.append(f"{ind}}}")
+
+        for i, t in enumerate(self.models):
+            out.append(f"double PredictTree{i}(const double* x) {{")
+            if t.num_nodes == 0:
+                out.append(f"  return {float(t.leaf_value[0])!r};")
+            else:
+                lines: List[str] = []
+                emit_node(t, 0, 0, lines)
+                out.extend(lines)
+            out.append("}")
+            out.append("")
+        k = max(self.num_tree_per_iteration, 1)
+        out.append(f"const int kNumClass = {k};")
+        out.append(f"const int kNumTrees = {len(self.models)};")
+        out.append("")
+        out.append("void Predict(const double* x, double* output) {")
+        out.append("  for (int c = 0; c < kNumClass; ++c) output[c] = 0.0;")
+        for i in range(len(self.models)):
+            out.append(f"  output[{i % k}] += PredictTree{i}(x);")
+        if self.average_output:
+            out.append(f"  for (int c = 0; c < kNumClass; ++c) "
+                       f"output[c] /= {max(len(self.models) // k, 1)};")
+        out.append("}")
+        out.append("")
+        out.append("}  // namespace lightgbm_tpu_model")
+        return "\n".join(out) + "\n"
+
     def predict_raw_matrix(self, arr: np.ndarray,
                            num_iteration: Optional[int] = None,
                            start_iteration: int = 0,

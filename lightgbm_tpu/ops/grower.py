@@ -89,6 +89,10 @@ class GrowerParams(NamedTuple):
     # kernel's streaming block size (multiple of 32)
     fused_block: int = 0
     fused_interpret: bool = False   # Pallas interpret mode (CPU tests)
+    # EFB (io/efb.py): the scan axis extends past the stored columns with
+    # one virtual feature per bundled original (0 = bundling off)
+    efb_virtual: int = 0
+    efb_bmax: int = 0
 
     def split_params(self) -> SplitParams:
         return SplitParams(

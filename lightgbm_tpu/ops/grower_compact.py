@@ -42,7 +42,8 @@ from .compact import (RowLayout, partition_segment, segment_histogram,
                       segments_to_leaf_vectors)
 from .fused_split import fused_split
 from .grower import GrowerParams, TreeArrays, _NEG_INF
-from .split import best_split, child_output, leaf_output, left_rows_of_split
+from .split import (apply_efb_bitset, best_split, child_output,
+                    extend_hist_efb, leaf_output, left_rows_of_split)
 
 
 class CompactState(NamedTuple):
@@ -50,9 +51,12 @@ class CompactState(NamedTuple):
     num_nodes: jnp.ndarray
     work: jnp.ndarray        # [N + pad, C] u8 row records (shard-local)
     scratch: jnp.ndarray     # [N + pad, C] u8 partition staging
-    leaf_hist: jnp.ndarray   # [L, F, B, 4] per-leaf GLOBAL histograms
-    leaf_hist_loc: jnp.ndarray  # [L, F, B, 4] shard-local (data-parallel;
-    #                             dummy [1,1,1,1] on the serial path)
+    # per-leaf histograms are stored FLAT [L, F, B*4]: a trailing dim of 4
+    # would be tiled to 128 lanes in HBM (f32 T(8,128) on the minor dims),
+    # inflating the cache 32x — 17.7GB at F=529. Views reshape per split.
+    leaf_hist: jnp.ndarray   # [L, F, B*4] per-leaf GLOBAL histograms
+    leaf_hist_loc: jnp.ndarray  # [L, F, B*4] shard-local (data-parallel;
+    #                             dummy [1,1,1] on the serial path)
     leaf_start: jnp.ndarray  # [L] i32 shard-local segment starts
     leaf_nrows: jnp.ndarray  # [L] i32 shard-local segment raw row counts
     leaf_nrows_g: jnp.ndarray  # [L] i32 GLOBAL raw row counts
@@ -114,6 +118,8 @@ def grow_tree_compact(
     cegb_used0: jnp.ndarray = None,
     extra_key: jnp.ndarray = None,
     feature_contri: jnp.ndarray = None,
+    efb=None,   # (col_of_ext, route_cat_ext, off_ext, nb_ext, dbin_ext,
+    #              orig_of_ext) — see io/efb.py / gbdt._setup_efb
 ):
     """Grow one tree; returns (TreeArrays, row_leaf [N], work', scratch',
     leaf_start [L], leaf_nrows [L]) — per-row outputs in the post-tree
@@ -122,30 +128,39 @@ def grow_tree_compact(
     n = n_real
     L = params.num_leaves
     B = params.num_bins
-    F = layout.num_features
+    F = layout.num_features          # stored columns (histogram space)
+    F_scan = F + params.efb_virtual  # + virtual EFB features (scan space)
     feat_info = (num_bins_arr, nan_bin_arr, has_nan_arr, is_cat_arr)
     sp_params = params.split_params()
     i32 = jnp.int32
 
     if mono_types is None:
-        mono_types = jnp.zeros((F,), jnp.int8)
+        mono_types = jnp.zeros((F_scan,), jnp.int8)
     if inter_sets is None:
-        inter_sets = jnp.zeros((0, F), bool)
+        inter_sets = jnp.zeros((0, F_scan), bool)
     if bynode_key is None:
         bynode_key = jax.random.PRNGKey(0)
     if cegb_coupled is None:
-        cegb_coupled = jnp.zeros((F,), jnp.float32)
+        cegb_coupled = jnp.zeros((F_scan,), jnp.float32)
     if cegb_used0 is None:
-        cegb_used0 = jnp.zeros((F,), bool)
+        cegb_used0 = jnp.zeros((F_scan,), bool)
     if extra_key is None:
         extra_key = jax.random.PRNGKey(6)
     big = jnp.float32(3.4e38)
 
     def leaf_best(hist, pg, ph, pc, depth, fm, cmn, cmx, po, cegb_pen=None,
                   ek=None):
+        if params.efb_virtual:
+            # scan axis = stored columns + one virtual row per bundled
+            # original feature (io/efb.py)
+            hist = extend_hist_efb(hist, efb, params.efb_virtual,
+                                   params.efb_bmax)
         sp = best_split(hist, pg, ph, pc, *feat_info, fm, sp_params,
                         mono_types, cmn, cmx, po, depth, cegb_pen, ek,
                         feature_contri)
+        if params.efb_virtual:
+            # a bundled winner routes as a ready-made bitset on its column
+            sp = apply_efb_bitset(sp, efb, F, B)
         depth_ok = jnp.logical_or(params.max_depth <= 0,
                                   depth < params.max_depth)
         return sp._replace(gain=jnp.where(depth_ok, sp.gain, _NEG_INF))
@@ -179,7 +194,7 @@ def grow_tree_compact(
     root_c = root_hist[0, :, 2].sum()
     from .grower import node_feature_mask
     root_fm = node_feature_mask(
-        feat_mask, jnp.zeros((F,), bool), inter_sets,
+        feat_mask, jnp.zeros((F_scan,), bool), inter_sets,
         jax.random.fold_in(bynode_key, 0), params)
     # path smoothing at the root smooths toward the root's own output
     # (reference: GetParentOutput, serial_tree_learner.cpp:1005-1016)
@@ -196,10 +211,11 @@ def grow_tree_compact(
         num_nodes=jnp.asarray(0, i32),
         work=work,
         scratch=scratch,
-        leaf_hist=jnp.zeros((L, F, B, 4), jnp.float32).at[0].set(root_hist),
-        leaf_hist_loc=(jnp.zeros((L, F, B, 4), jnp.float32).at[0]
-                       .set(root_loc) if ax
-                       else jnp.zeros((1, 1, 1, 1), jnp.float32)),
+        leaf_hist=jnp.zeros((L, F, B * 4), jnp.float32).at[0]
+        .set(root_hist.reshape(F, B * 4)),
+        leaf_hist_loc=(jnp.zeros((L, F, B * 4), jnp.float32).at[0]
+                       .set(root_loc.reshape(F, B * 4)) if ax
+                       else jnp.zeros((1, 1, 1), jnp.float32)),
         leaf_start=jnp.zeros((L,), i32),
         leaf_nrows=jnp.zeros((L,), i32).at[0].set(n),
         leaf_nrows_g=(jnp.zeros((L,), i32).at[0].set(n_g) if ax
@@ -234,7 +250,7 @@ def grow_tree_compact(
         leaf_out=jnp.zeros((L,), jnp.float32).at[0].set(root_out),
         leaf_cmin=jnp.full((L,), -3.4e38, jnp.float32),
         leaf_cmax=jnp.full((L,), 3.4e38, jnp.float32),
-        leaf_used=jnp.zeros((L, F), bool),
+        leaf_used=jnp.zeros((L, F_scan), bool),
         leaf_pout=jnp.zeros((L,), jnp.float32).at[0].set(root_out),
         cegb_used=cegb_used0,
     )
@@ -257,9 +273,21 @@ def grow_tree_compact(
         n_left = st.bs_left_rows[best_leaf]
         bits = st.bs_bitset[best_leaf]
         catl2 = st.bs_cat_l2[best_leaf]
+        if params.efb_virtual:
+            # EFB: the scan index translates to (stored column, routing
+            # mode, original feature id); bundled winners carry a ready
+            # bitset (apply_efb_bitset) and route like categorical splits
+            f_col = efb[0][f_]
+            f_cat = efb[1][f_]
+            f_orig = efb[5][f_]
+        else:
+            f_col = f_
+            f_cat = is_cat_arr[f_]
+            f_orig = f_
 
         # ---- record split; wire tree structure ----
-        split_feature = st.split_feature.at[node].set(jnp.where(applied, f_, -1))
+        split_feature = st.split_feature.at[node].set(
+            jnp.where(applied, f_orig, -1))
         split_bin = st.split_bin.at[node].set(jnp.where(applied, b_, 0))
         cat_bitset = st.cat_bitset.at[node].set(jnp.where(applied, bits, 0))
         split_gain = st.split_gain.at[node].set(
@@ -344,12 +372,12 @@ def grow_tree_compact(
             jnp.where(applied, cmax_l, cmaxp))
         leaf_cmax = leaf_cmax.at[new_leaf].set(
             jnp.where(applied, cmax_r, leaf_cmax[new_leaf]))
-        used_child = st.leaf_used[best_leaf] | (jnp.arange(F) == f_)
+        used_child = st.leaf_used[best_leaf] | (jnp.arange(F_scan) == f_)
         leaf_used = st.leaf_used.at[best_leaf].set(
             jnp.where(applied, used_child, st.leaf_used[best_leaf]))
         leaf_used = leaf_used.at[new_leaf].set(
             jnp.where(applied, used_child, leaf_used[new_leaf]))
-        cegb_used = st.cegb_used | (applied & (jnp.arange(F) == f_))
+        cegb_used = st.cegb_used | (applied & (jnp.arange(F_scan) == f_))
 
         # ---- physical partition + children histograms + best splits ----
         # NO lax.cond around the heavy buffers: a cond output forces XLA to
@@ -364,10 +392,9 @@ def grow_tree_compact(
             # global_data_count_in_leaf_ beside the local partition,
             # data_parallel_tree_learner.cpp:300-340)
             m_g = st.leaf_nrows_g[best_leaf]
-            parent_loc = st.leaf_hist_loc[best_leaf]
+            parent_loc = st.leaf_hist_loc[best_leaf].reshape(F, B, 4)
             n_left_loc = left_rows_of_split(
-                parent_loc, f_, b_, dl, nan_bin_arr[f_], is_cat_arr[f_],
-                bits)
+                parent_loc, f_col, b_, dl, nan_bin_arr[f_], f_cat, bits)
         else:
             m_g = m_loc
             parent_loc = None
@@ -387,14 +414,14 @@ def grow_tree_compact(
             # in a single streamed walk (ops/fused_split.py)
             work, scratch, hist_small_fused = fused_split(
                 st.work, st.scratch, jnp.asarray(0, i32), s_, m_eff,
-                n_left_eff, f_, b_, dl, nan_bin_arr[f_], is_cat_arr[f_],
+                n_left_eff, f_col, b_, dl, nan_bin_arr[f_], f_cat,
                 bits, layout, B, params.fused_block, W,
                 interpret=params.fused_interpret,
                 smaller_left=left_smaller.astype(i32))
         else:
             work, scratch = partition_segment(
-                st.work, st.scratch, s_, m_eff, n_left_eff, f_, b_, dl,
-                nan_bin_arr[f_], is_cat_arr[f_], bits, params.part_block)
+                st.work, st.scratch, s_, m_eff, n_left_eff, f_col, b_, dl,
+                nan_bin_arr[f_], f_cat, bits, params.part_block)
         leaf_start = st.leaf_start.at[best_leaf].set(
             jnp.where(applied, s_, st.leaf_start[best_leaf]))
         leaf_start = leaf_start.at[new_leaf].set(
@@ -414,7 +441,7 @@ def grow_tree_compact(
         # one streamed pass over the SMALLER child only; the larger child
         # is parent - smaller (reference: SubtractHistogramForLeaf,
         # cuda_histogram_constructor.cu:723)
-        parent_hist = st.leaf_hist[best_leaf]
+        parent_hist = st.leaf_hist[best_leaf].reshape(F, B, 4)
         if params.fused_block:
             hist_small_loc = hist_small_fused
         else:
@@ -427,17 +454,20 @@ def grow_tree_compact(
         hist_left = jnp.where(left_smaller, hist_small, hist_large)
         hist_right = jnp.where(left_smaller, hist_large, hist_small)
         leaf_hist = st.leaf_hist.at[best_leaf].set(
-            jnp.where(applied, hist_left, parent_hist))
+            jnp.where(applied, hist_left, parent_hist).reshape(F, B * 4))
         leaf_hist = leaf_hist.at[new_leaf].set(
-            jnp.where(applied, hist_right, leaf_hist[new_leaf]))
+            jnp.where(applied, hist_right.reshape(F, B * 4),
+                      leaf_hist[new_leaf]))
         if ax:
             large_loc = parent_loc - hist_small_loc
             left_loc = jnp.where(left_smaller, hist_small_loc, large_loc)
             right_loc = jnp.where(left_smaller, large_loc, hist_small_loc)
             leaf_hist_loc = st.leaf_hist_loc.at[best_leaf].set(
-                jnp.where(applied, left_loc, parent_loc))
+                jnp.where(applied, left_loc, parent_loc)
+                .reshape(F, B * 4))
             leaf_hist_loc = leaf_hist_loc.at[new_leaf].set(
-                jnp.where(applied, right_loc, leaf_hist_loc[new_leaf]))
+                jnp.where(applied, right_loc.reshape(F, B * 4),
+                          leaf_hist_loc[new_leaf]))
         else:
             leaf_hist_loc = st.leaf_hist_loc
 

@@ -16,7 +16,7 @@ XLA program (scan over trees) with zero host syncs.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -59,8 +59,13 @@ def route_one_tree(
     num_nodes: jax.Array,
     nan_bin_arr: jax.Array,   # [F] i32
     is_cat_arr: jax.Array,    # [F] bool
+    col_of: Optional[jax.Array] = None,   # [F] i32: EFB feature -> column
 ) -> jax.Array:
-    """Return the leaf index [N] each row lands in for one tree."""
+    """Return the leaf index [N] each row lands in for one tree.
+
+    ``col_of`` translates original feature ids to stored-column ids when the
+    binned matrix is EFB-bundled (io/efb.py); bundled features must then have
+    is_cat_arr True (they route by the bitset the grower recorded)."""
     from .split import go_left_pred
 
     n = binned.shape[0]
@@ -74,7 +79,8 @@ def route_one_tree(
         safe_f = jnp.maximum(f, 0)
         t = split_bin[k]
         dl = default_left[k]
-        fcol = jnp.take(binned, safe_f, axis=1).astype(jnp.int32)
+        col = safe_f if col_of is None else col_of[safe_f]
+        fcol = jnp.take(binned, col, axis=1).astype(jnp.int32)
         nb = nan_bin_arr[safe_f]
         iscat = is_cat_arr[safe_f]
         go_left = go_left_pred(fcol, t, dl, nb, iscat, cat_bitset[k])

@@ -201,6 +201,57 @@ def left_rows_of_split(hist: jnp.ndarray, feature, bin_, default_left,
     return jnp.sum(raw * gl).astype(jnp.int32)
 
 
+def extend_hist_efb(hist: jnp.ndarray, efb, n_virtual: int, bmax: int
+                    ) -> jnp.ndarray:
+    """Append virtual per-feature histogram rows for EFB-bundled features.
+
+    ``hist`` is [C, B, K] over STORED columns (passthrough features and
+    bundle columns). Each bundled original feature's non-default bins live
+    at ``offset+1 .. offset+nb`` of its bundle column; its default-bin mass
+    is the leaf total minus the range sum (reference: FixHistogram /
+    sum_of_hessian bookkeeping, include/LightGBM/bin.h). The scan then
+    treats virtual rows as ordinary numerical features.
+    """
+    col_of_ext, off_ext, nb_ext, dbin_ext = efb[0], efb[2], efb[3], efb[4]
+    C, B, K = hist.shape
+    bcol = col_of_ext[C:]                  # [Fb]
+    off = off_ext[C:]
+    nb = nb_ext[C:]
+    dbin = dbin_ext[C:]
+    j = jnp.arange(bmax, dtype=jnp.int32)[None, :]          # [1, Bmax]
+    idx = jnp.minimum(off[:, None] + 1 + j, B - 1)
+    gathered = hist[bcol[:, None], idx, :]                  # [Fb, Bmax, K]
+    gathered = gathered * (j < nb[:, None])[:, :, None]
+    totals = hist[0].sum(axis=0)                            # [K] leaf totals
+    default = totals[None, :] - gathered.sum(axis=1)        # [Fb, K]
+    virtual = gathered.at[jnp.arange(n_virtual), dbin].add(default)
+    virtual = jnp.pad(virtual, ((0, 0), (0, B - bmax), (0, 0)))
+    return jnp.concatenate([hist, virtual], axis=0)
+
+
+def apply_efb_bitset(sp: "SplitResult", efb, n_cols: int, B: int
+                     ) -> "SplitResult":
+    """Translate a winning split on a VIRTUAL (bundled) feature into a
+    bundle-column bitset so every router (partition, fused kernel,
+    route_one_tree) treats it as a ready-made categorical-style split:
+    left = {v in (off, off+1+t]} | {v outside the member's range, when the
+    member's default bin <= t}."""
+    off_ext, nb_ext, dbin_ext = efb[2], efb[3], efb[4]
+    f = sp.feature
+    bundled = f >= n_cols
+    o = off_ext[f]
+    nb = nb_ext[f]
+    d = dbin_ext[f]
+    v = jnp.arange(B, dtype=jnp.int32)
+    in_r = jnp.logical_and(v > o, v <= o + nb)
+    left = jnp.logical_or(
+        jnp.logical_and(in_r, v <= o + 1 + sp.bin),
+        jnp.logical_and(jnp.logical_not(in_r), d <= sp.bin))
+    bits = pack_bin_bitset(left)
+    return sp._replace(
+        cat_bitset=jnp.where(bundled, bits, sp.cat_bitset))
+
+
 def go_left_scalar_np(col: int, bin_: int, default_left: bool, nan_bin: int,
                       is_cat: bool, cat_bitset) -> bool:
     """Numpy scalar twin of go_left_pred for host-side consumers (TreeSHAP);

@@ -276,3 +276,22 @@ class TestPredictionExtras:
                            pred_early_stop_margin=1e9,
                            pred_early_stop_freq=5)
         np.testing.assert_allclose(same, full, rtol=1e-6)
+
+
+class TestSubset:
+    def test_subset_trains_with_shared_bins(self):
+        from utils import binary_data
+        import lightgbm_tpu as lgb
+        X, y = binary_data()
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 31})
+        ds.construct()
+        idx = np.arange(0, len(y), 2)
+        sub = ds.subset(idx)
+        assert sub._inner.num_data == len(idx)
+        # mappers shared: binning identical to the parent's rows
+        np.testing.assert_array_equal(sub._inner.binned,
+                                      ds._inner.binned[idx])
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbose": -1, "min_data_in_leaf": 5}, sub, 5)
+        from sklearn.metrics import roc_auc_score
+        assert roc_auc_score(y[idx], bst.predict(X[idx])) > 0.9
