@@ -637,19 +637,29 @@ class GBDT:
                 and not self._multiproc
                 and not (self.objective is not None
                          and self.objective.renew_leaves)))
+        # non-row-elementwise objectives (lambdarank: gradients couple rows
+        # of a query) still run compact when K == 1: gradients compute
+        # on-device in ORIGINAL row order (scatter by the carried row-id
+        # column) and feed the step externally — see _rank_grads_fn
+        obj_re = (getattr(self.objective, "row_elementwise", True)
+                  if self.objective is not None else False)
+        goss = (str(cfg.get("data_sample_strategy", "bagging")).lower()
+                == "goss"
+                or str(cfg.get("boosting", "gbdt")).lower() == "goss")
+        self._ext_grads = (
+            not obj_re and int(cfg.get("num_class", 1) or 1) == 1
+            and not goss and not bool(cfg.get("use_quantized_grad", False)))
         can_compact = (
             mesh_compact_ok
             and self.objective is not None
-            and getattr(self.objective, "row_elementwise", True)
+            and (obj_re or self._ext_grads)
             and not getattr(self.objective, "is_stochastic", False)
             and int(train_set.max_num_bins) <= 256
             and self.num_data < (1 << 24)
-            # balanced / by-query bagging and query-structured train metrics
-            # index rows in the original order
+            # balanced / by-query bagging index rows in the original order
             and float(cfg.get("pos_bagging_fraction", 1.0)) >= 1.0
             and float(cfg.get("neg_bagging_fraction", 1.0)) >= 1.0
             and not bool(cfg.get("bagging_by_query", False))
-            and train_set.metadata.query_boundaries is None
         )
         if grower == "compact" and not can_compact:
             log.warning("tpu_grower=compact requires a serial learner and a "
@@ -870,6 +880,36 @@ class GBDT:
             **shards,
         }
 
+    def _rank_grads_fn(self):
+        """Jitted: bounded objective gradients for non-row-elementwise
+        objectives (lambdarank), returned in the compact grower's CURRENT
+        permuted row order. One device scatter/gather pair by the carried
+        row-id column — no host round trip (reference: the rank objective
+        always sees original query-contiguous rows, rank_objective.hpp:25)."""
+        c = self._compact
+        if c.get("rank_grad_fn") is None:
+            obj = self.objective
+            layout = c["layout"]
+            S, nl, pr = c["S"], c["nl"], c["pad_rows"]
+            nm = self.num_data
+            off = layout.extra_off + 4 * self._cx_rowid
+
+            def fn(work, scores_cur):
+                from ..ops.compact import _u8_to_f32
+                rows = (work.reshape(S, nl + pr, -1)[:, :nl]
+                        .reshape(S * nl, -1) if S > 1 else work[:nm])
+                rid = _u8_to_f32(rows[:, off:off + 4]).astype(jnp.int32)
+                s_orig = jnp.zeros_like(scores_cur).at[:, rid].set(scores_cur)
+                g, h = obj.get_gradients(s_orig[0])
+                return g[rid], h[rid]
+
+            # position-bias objectives update host state (pos_biases) inside
+            # get_gradients — run those eagerly, never under jit
+            eager = (getattr(obj, "is_stochastic", False)
+                     or getattr(obj, "positions", None) is not None)
+            c["rank_grad_fn"] = fn if eager else jax.jit(fn)
+        return c["rank_grad_fn"]
+
     def _compact_rows(self, work):
         """The row records in current order, per-shard pad rows stripped."""
         c = self._compact
@@ -947,8 +987,11 @@ class GBDT:
         gx_off = (layout.extra_off + 4 * self._cx_grads
                   if self._cx_grads is not None else None)
 
+        ext_grads = getattr(self, "_ext_grads", False)
+
         def step(work, scratch, scores, bag_w, use_stored_bag, feat_mask,
-                 shrinkage, bynode_key, cegb_used, quant_key, extra_key, k):
+                 shrinkage, bynode_key, cegb_used, quant_key, extra_key,
+                 ext_g=None, ext_h=None, *, k):
             pad_n = work.shape[0] - n
 
             w_col = jnp.where(use_stored_bag, col(work, layout.cnt_off),
@@ -961,7 +1004,11 @@ class GBDT:
             label = col(work, lbl_off)
             weight = col(work, w_off) if w_off is not None else None
             class_grads = []
-            if k_total == 1:
+            if ext_grads:
+                # gradients arrive pre-computed in the CURRENT row order
+                # (lambdarank couples rows of a query; _rank_grads_fn)
+                g_k, h_k = ext_g, ext_h
+            elif k_total == 1:
                 g, h = _bound_gradients(obj, k_total, scores, label, weight)
                 if use_quant:
                     g, h = _quantize_gradients(
@@ -1080,6 +1127,8 @@ class GBDT:
         rep = P()
         in_specs = (row2, row2, krow, P(DATA_AXIS), rep, rep, rep, rep,
                     rep, rep, rep)
+        if ext_grads:
+            in_specs = in_specs + (P(DATA_AXIS), P(DATA_AXIS))
         # outputs: (tree pytree — replicated, work, scratch, scores,
         # cegb_used); specs are pytree prefixes
         out_specs = (rep, row2, row2, krow, rep)
@@ -1156,6 +1205,12 @@ class GBDT:
         feat_mask = self._feature_mask()
         first_iter = self.num_total_trees < self.num_tree_per_iteration
         k_total = self.num_tree_per_iteration
+        ext_args = ()
+        if getattr(self, "_ext_grads", False):
+            # lambdarank-style coupled gradients: computed once per
+            # iteration in original query order, permuted to current order
+            ext_args = tuple(self._rank_grads_fn()(
+                c["work"], self.train_score))
         for k in range(k_total):
             # trees after the first in an iteration reuse the stored bag
             # (same bag for all trees of one iteration, like the reference)
@@ -1169,7 +1224,7 @@ class GBDT:
                 self._cegb_state(),
                 jax.random.fold_in(self._quant_key, self.iter_),
                 jax.random.fold_in(self._extra_key, self.num_total_trees),
-                k=k)
+                *ext_args, k=k)
             c["work"], c["scratch"] = work, scratch
             c["epoch"] += 1
             self.train_score = scores
@@ -1187,6 +1242,28 @@ class GBDT:
 
     def add_valid(self, valid_set: BinnedDataset, name: str,
                   metrics: Sequence[Metric]) -> None:
+        # the valid matrix must be in the SAME column space the booster
+        # routes in: a bundle-layout mismatch (e.g. the valid rows hit a
+        # feature conflict and stayed dense, or the train side unbundled)
+        # would silently corrupt validation scores
+        vb = getattr(valid_set, "bundle_info", None)
+        if self._efb is not None:
+            if vb is None or (valid_set.binned.shape[1]
+                              != int(self.binned.shape[1])):
+                raise ValueError(
+                    f"validation set '{name}' is not in the training data's "
+                    "EFB bundle layout (a feature conflict outside the "
+                    "training rows?); rebuild both with enable_bundle=false")
+        elif vb is not None:
+            from ..io.efb import unbundle
+            log.warning(f"validation set '{name}': unbundling to match the "
+                        "unbundled training layout")
+            dbins = np.array([m.default_bin for m in valid_set.mappers],
+                             np.int32)
+            valid_set.binned = unbundle(
+                np.asarray(valid_set.binned), vb, dbins,
+                valid_set.feature_num_bins())
+            valid_set.bundle_info = None
         vs = _ValidSet(valid_set, self.num_tree_per_iteration, name,
                        mesh=self.mesh if self.tree_learner != "feature"
                        else None)
@@ -1709,34 +1786,15 @@ class GBDT:
     def eval_train(self) -> List[Tuple[str, str, float, bool]]:
         if self._compact is not None and self.train_metrics:
             # train scores live in the compact grower's permuted row order;
-            # give the metrics matching label/weight views
+            # un-permute them back to the ORIGINAL order so every metric —
+            # including query-structured NDCG/MAP — sees its own layout
+            # (pad rows carry ids >= n_real and drop out of the slice)
             perm = self._compact_perm()
-            # mesh row-count padding: pad rows carry ids >= n_real; clamp
-            # the index and zero their metric weight instead
-            valid = perm < self._n_real
-            safe = np.minimum(perm, self._n_real - 1)
-            padded = not bool(valid.all())
-            swaps = []
-            for m in self.train_metrics:
-                lbl = getattr(m, "label", None)
-                wgt = getattr(m, "weight", None)
-                swaps.append((m, lbl, wgt))
-                if lbl is not None:
-                    m.label = np.asarray(lbl)[safe]
-                if wgt is not None:
-                    m.weight = np.asarray(wgt)[safe] * valid
-                elif padded and hasattr(m, "weight"):
-                    m.weight = valid.astype(np.float64)
-            try:
-                return self._eval("training", _to_host(self.train_score),
-                                  self.train_metrics,
-                                  n_real=self.num_data)
-            finally:
-                for m, lbl, wgt in swaps:
-                    if lbl is not None:
-                        m.label = lbl
-                    if hasattr(m, "weight"):
-                        m.weight = wgt
+            raw = _to_host(self.train_score)
+            unperm = np.empty_like(raw)
+            unperm[:, perm] = raw
+            return self._eval("training", unperm[:, :self._n_real],
+                              self.train_metrics)
         return self._eval("training", _to_host(self.train_score),
                           self.train_metrics)
 

@@ -50,7 +50,12 @@ _K_PAD = 8
 
 def _hist_kernel(bins_ref, ch_ref, out_ref, *, num_bins: int, f_chunk: int,
                  mode: str):
-    """One grid step: accumulate a row-block into the [F*B, KP] histogram."""
+    """One grid step: accumulate a row-block into the [KP, F*B] histogram.
+
+    The output is CHANNEL-major: [KP, F*B] keeps the lane dimension wide
+    (F*B) instead of padding an 8-lane channel dimension to 128, so the
+    VMEM-resident accumulator costs 8 x F*B x 4B (1.1MB at F=137, B=256)
+    rather than 32x that."""
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -79,14 +84,14 @@ def _hist_kernel(bins_ref, ch_ref, out_ref, *, num_bins: int, f_chunk: int,
         oh = jnp.concatenate(
             [(bins[:, fc + j:fc + j + 1] == iota_b).astype(oh_dtype)
              for j in range(w)], axis=1)
-        # MXU contraction over rows: [W*B, R] x [R, KP] -> [W*B, KP]
+        # MXU contraction over rows: [KP, R] x [R, W*B] -> [KP, W*B]
         part = lax.dot_general(
-            oh, ch,
+            ch, oh,
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=precision,
         )
-        out_ref[fc * b:(fc + w) * b, :] += part
+        out_ref[:, fc * b:(fc + w) * b] += part
 
 
 @functools.partial(
@@ -108,7 +113,10 @@ def pallas_histogram(
     if mode == "split":
         if 2 * k > _K_PAD:
             raise ValueError(f"mode='split' supports K<={_K_PAD // 2}, got {k}")
-        hi = channels.astype(jnp.bfloat16).astype(jnp.float32)
+        # reduce_precision, NOT a bf16 cast round-trip: under
+        # --xla_allow_excess_precision (set on TPU by default) XLA elides
+        # f32->bf16->f32 as identity, which silently folds lo to zero
+        hi = lax.reduce_precision(channels, exponent_bits=8, mantissa_bits=7)
         lo = channels - hi
         channels = jnp.concatenate([hi, lo], axis=1)  # [N, 2K]
 
@@ -136,11 +144,11 @@ def pallas_histogram(
             pl.BlockSpec((row_block, f), lambda i: (i, 0)),
             pl.BlockSpec((row_block, _K_PAD), lambda i: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((f * b, _K_PAD), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((f * b, _K_PAD), jnp.float32),
+        out_specs=pl.BlockSpec((_K_PAD, f * b), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((_K_PAD, f * b), jnp.float32),
         interpret=interpret,
     )(binned, channels)
-    out = out.reshape(f, b, _K_PAD)[:f_in]
+    out = jnp.transpose(out.reshape(_K_PAD, f, b), (1, 2, 0))[:f_in]
     if mode == "split":
         return out[:, :, :k] + out[:, :, k:2 * k]
     return out[:, :, :k]
