@@ -299,6 +299,9 @@ def _find_bin_with_forced(values, total_sample_cnt, max_bin, min_data_in_bin,
         # keep the base mapper's resolution profile: sample the complement at
         # evenly spaced positions — the sorted prefix would concentrate every
         # remaining bin at the low end of the feature range
+        # len(leftover) > budget makes the linspace spacing strictly > 1, so
+        # consecutive rounded indices are always distinct — exactly `budget`
+        # bounds survive (no collision top-up needed)
         pick = np.linspace(0, len(leftover) - 1, budget).round().astype(int)
         greedy = leftover[np.unique(pick)]
     else:

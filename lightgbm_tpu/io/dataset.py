@@ -163,7 +163,12 @@ class BinnedDataset:
             "cat_to_bin": {str(k): int(v) for k, v in m.cat_to_bin.items()},
             "bin_to_cat": [int(x) for x in m.bin_to_cat],
             "default_bin": int(m.default_bin),
-            "min_value": float(m.min_value), "max_value": float(m.max_value),
+            # same finite-check encoding as bin_upper_bounds: +/-inf feature
+            # values flow into min/max and would make json.dumps raise
+            "min_value": (float(m.min_value) if np.isfinite(m.min_value)
+                          else str(float(m.min_value))),
+            "max_value": (float(m.max_value) if np.isfinite(m.max_value)
+                          else str(float(m.max_value))),
         } for m in self.mappers]
         md = self.metadata
         # np.savez appends '.npz' to bare paths; write via a handle so the
@@ -235,6 +240,8 @@ class BinnedDataset:
             blob["cat_to_bin"] = {int(k): int(v)
                                   for k, v in blob["cat_to_bin"].items()}
             blob["bin_to_cat"] = np.asarray(blob["bin_to_cat"], np.int64)
+            blob["min_value"] = float(blob["min_value"])
+            blob["max_value"] = float(blob["max_value"])
         ds.mappers = [BinMapper(**blob) for blob in blobs]
         md = Metadata(ds.num_data)
         for name in ("label", "weight", "init_score", "position"):

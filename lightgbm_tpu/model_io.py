@@ -450,6 +450,7 @@ class LoadedGBDT:
             "// generated by lightgbm_tpu task=convert_model",
             "#include <cmath>",
             "#include <cstdint>",
+            "#include <limits>",
             "",
             "namespace lightgbm_tpu_model {",
             "",
@@ -463,11 +464,23 @@ class LoadedGBDT:
             "",
         ]
 
+        def cpp_double(x) -> str:
+            # non-finite values must compile as C++ (bare `inf`/`nan` tokens
+            # do not; the reference Tree::ToIfElse always emits literals)
+            x = float(x)
+            if x != x:
+                return "std::numeric_limits<double>::quiet_NaN()"
+            if x == float("inf"):
+                return "std::numeric_limits<double>::infinity()"
+            if x == float("-inf"):
+                return "-std::numeric_limits<double>::infinity()"
+            return repr(x)
+
         def emit_node(t, node, depth, lines):
             ind = "  " * (depth + 1)
             if node < 0:
                 leaf = -(node + 1)
-                lines.append(f"{ind}return {float(t.leaf_value[leaf])!r};")
+                lines.append(f"{ind}return {cpp_double(t.leaf_value[leaf])};")
                 return
             f = int(t.split_feature[node])
             dt = int(t.decision_type[node])
@@ -484,7 +497,7 @@ class LoadedGBDT:
             else:
                 default_left = "true" if dt & 2 else "false"
                 missing_type = (dt >> 2) & 3
-                thr = repr(float(t.threshold[node]))
+                thr = cpp_double(t.threshold[node])
                 if missing_type == 2:      # NaN
                     cond = (f"(std::isnan(x[{f}]) ? {default_left} : "
                             f"(x[{f}] <= {thr}))")
@@ -504,7 +517,7 @@ class LoadedGBDT:
         for i, t in enumerate(self.models):
             out.append(f"double PredictTree{i}(const double* x) {{")
             if t.num_nodes == 0:
-                out.append(f"  return {float(t.leaf_value[0])!r};")
+                out.append(f"  return {cpp_double(t.leaf_value[0])};")
             else:
                 lines: List[str] = []
                 emit_node(t, 0, 0, lines)

@@ -18,21 +18,34 @@ whole walk:
     double-buffered DMA;
   * each block stably partitions via ONE dest-indexed one-hot MXU matmul
     (dest = carry_offset + rank, so the carry append costs nothing extra);
-  * left rows flush to `work` in place (the left write cursor can never
-    overtake the read cursor), right rows flush to `scratch` at their final
-    offsets and are copied back after the walk;
+  * left rows flush in place into the PARENT's residency array (the left
+    write cursor can never overtake the read cursor); right rows flush to
+    the OTHER array at the same global offsets (dual residency);
   * the SMALLER child's histogram accumulates in VMEM whenever that stream
     flushes a full block — histogram work is n_smaller rows exactly, like the
     reference's smaller-leaf trick (serial_tree_learner.cpp:404);
   * `mode=1` turns the kernel into a plain segment histogram (used for the
     root), skipping all partition work.
 
+Dual residency (round 4): every leaf segment owns the SAME address range
+[start, start+count) in both arrays but is live in exactly one of them,
+tracked by a per-leaf side bit. A split reads the parent from its side,
+keeps the left child there, and writes the right child to the other array —
+whose bytes in that range are dead by induction (they were the parent's
+range). This removes the whole copy-back pass of the previous design, which
+re-streamed the entire right child (read scratch + read work + blend +
+write) after every split — about a third of the old kernel's DMA traffic.
+The grower merges the two arrays once per tree (ops/grower_compact.py).
+
 Alignment: Mosaic requires dynamic DMA offsets provably divisible by the
 sublane tiling (8 rows; 32 covers int8 packing), so the segment start is
 rounded down to 32 and the `phi` pre-segment rows ride the left stream as
 preserved head rows (they rank first in block 0, flush back to their original
-slots, and are masked out of the histogram). All DMA offsets in the kernel
-are of the form `32*t + k*BS`, which the compiler can prove aligned.
+slots, and are masked out of the histogram). The right stream's first block
+similarly spans `psi` pre-rows and its last block may overrun the segment —
+both are read-modify-write blended against the destination array so live
+neighbour segments resident there survive. All DMA offsets in the kernel are
+of the form `32*t + k*BS`, which the compiler can prove aligned.
 
 Numerics: row bytes move through the permutation matmul as (byte - 128) int8
 values at 2x the bf16 MXU rate (one-hot contraction, i32 accumulate — exact;
@@ -63,10 +76,10 @@ _A = 32  # row alignment every DMA offset is provably divisible by
 
 # sp scalar-prefetch vector layout (i32[16])
 _MODE, _BASE_T, _PHI, _COUNT, _NLEFT, _FEAT, _BIN, _DLEFT, _NANBIN, _ISCAT, \
-    _SMALLER_L, _RBASE_T, _PSI = range(13)
+    _SMALLER_L, _RBASE_T, _PSI, _SIDE = range(14)
 
 # smem bookkeeping slots
-_LCNT, _RCNT, _LF, _RF, _CBW = range(5)
+_LCNT, _RCNT, _LF, _RF = range(4)
 
 
 def _round_up(x: int, m: int) -> int:
@@ -88,9 +101,10 @@ def _assemble_f32(blk_i32, off: int):
 
 
 def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
-                  hist_ref, sem_in, sem_l, sem_r, sem_cw, inbuf, lcarry,
-                  rcarry, lstage, rstage, cbstage, smem, *, layout: RowLayout,
-                  num_bins: int, bs: int, bitset_words: int, use_int8: bool):
+                  hist_ref, sem_in, sem_l, sem_r, sem_rmw, inbuf, lcarry,
+                  rcarry, lstage, rstage, rmwbuf, smem, *, layout: RowLayout,
+                  num_bins: int, bs: int, bitset_words: int, use_int8: bool,
+                  interpret: bool):
     F = layout.num_features
     C = layout.num_cols
     B = num_bins
@@ -110,6 +124,7 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
     smaller_left = sp_ref[_SMALLER_L]
     rbase = sp_ref[_RBASE_T] * _A
     psi = sp_ref[_PSI]
+    side = sp_ref[_SIDE]
 
     start = base + phi
     span = phi + count
@@ -120,9 +135,9 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
     smem[_RCNT] = psi
     smem[_LF] = 0
     smem[_RF] = 0
-    smem[_CBW] = 0
     lcarry[:, :] = jnp.zeros_like(lcarry)
     rcarry[:, :] = jnp.zeros_like(rcarry)
+    rmwbuf[:, :] = jnp.zeros_like(rmwbuf)
 
     iota = lax.broadcasted_iota(i32, (bs, 1), 0)[:, 0]
     lane = lax.broadcasted_iota(i32, (bs, C), 1)
@@ -145,10 +160,39 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
             return jnp.where(lane == C - 1, 0, fixed)
         return c[:bs].astype(i32)
 
-    def read_dma(i, slot):
-        return pltpu.make_async_copy(
-            work_out.at[pl.ds(base + i * bs, bs), :], inbuf.at[slot],
-            sem_in.at[slot])
+    def start_read(i, slot):
+        """Issue the parent-segment block read from its residency array."""
+        @pl.when(side == 0)
+        def _():
+            pltpu.make_async_copy(
+                work_out.at[pl.ds(base + i * bs, bs), :], inbuf.at[slot],
+                sem_in.at[slot]).start()
+
+        @pl.when(side != 0)
+        def _():
+            pltpu.make_async_copy(
+                scr_out.at[pl.ds(base + i * bs, bs), :], inbuf.at[slot],
+                sem_in.at[slot]).start()
+
+    def wait_read(slot):
+        # wait is by semaphore + transfer size; the source ref is a stand-in
+        pltpu.make_async_copy(
+            work_out.at[pl.ds(0, bs), :], inbuf.at[slot],
+            sem_in.at[slot]).wait()
+
+    def rmw_read(off):
+        """Synchronously fetch one block of the right-destination array."""
+        @pl.when(side == 0)
+        def _():
+            pltpu.make_async_copy(
+                scr_out.at[pl.ds(off, bs), :], rmwbuf, sem_rmw).start()
+
+        @pl.when(side != 0)
+        def _():
+            pltpu.make_async_copy(
+                work_out.at[pl.ds(off, bs), :], rmwbuf, sem_rmw).start()
+        pltpu.make_async_copy(
+            work_out.at[pl.ds(0, bs), :], rmwbuf, sem_rmw).wait()
 
     def hist_accum(rows_u8, mask_f32):
         """Accumulate masked rows of a [BS, C] u8 buffer into hist_ref."""
@@ -159,8 +203,17 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
         h = _assemble_f32(rows, layout.hess_off) * m
         cw = _assemble_f32(rows, layout.cnt_off)
         inbag = jnp.where(cw != 0.0, m, 0.0)
-        ghi = g.astype(jnp.bfloat16).astype(jnp.float32)
-        hhi = h.astype(jnp.bfloat16).astype(jnp.float32)
+        if interpret:
+            # interpret mode traces through XLA, where
+            # --xla_allow_excess_precision elides f32->bf16->f32 as identity
+            # (zeroing the lo channels); reduce_precision is not elidable
+            ghi = lax.reduce_precision(g, exponent_bits=8, mantissa_bits=7)
+            hhi = lax.reduce_precision(h, exponent_bits=8, mantissa_bits=7)
+        else:
+            # Mosaic has no reduce_precision lowering and does not elide the
+            # round-trip today (verified on v5e)
+            ghi = g.astype(jnp.bfloat16).astype(jnp.float32)
+            hhi = h.astype(jnp.bfloat16).astype(jnp.float32)
         chans = [ghi, hhi, inbag, m, g - ghi, h - hhi,
                  jnp.zeros_like(g), jnp.zeros_like(g)]
         lane8 = lax.broadcasted_iota(i32, (bs, 8), 1)
@@ -185,19 +238,30 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
         """Write one full block via the stream's staging ring; maybe hist."""
         stage, sem, cslot = ((lstage, sem_l, _LF) if stream == 0
                              else (rstage, sem_r, _RF))
-        ref = work_out if stream == 0 else scr_out
+        # left stream writes the parent's residency array, right the other
+        to_work = (side == 0) if stream == 0 else (side != 0)
         cnt = smem[cslot]
         slot = lax.rem(cnt, 2)
 
         @pl.when(cnt >= 2)
         def _():
             pltpu.make_async_copy(
-                stage.at[slot], ref.at[pl.ds(0, bs), :], sem.at[slot]).wait()
+                stage.at[slot], work_out.at[pl.ds(0, bs), :],
+                sem.at[slot]).wait()
 
         stage[slot] = data_u8
-        pltpu.make_async_copy(
-            stage.at[slot], ref.at[pl.ds(hbm_base, bs), :],
-            sem.at[slot]).start()
+
+        @pl.when(to_work)
+        def _():
+            pltpu.make_async_copy(
+                stage.at[slot], work_out.at[pl.ds(hbm_base, bs), :],
+                sem.at[slot]).start()
+
+        @pl.when(jnp.logical_not(to_work))
+        def _():
+            pltpu.make_async_copy(
+                stage.at[slot], scr_out.at[pl.ds(hbm_base, bs), :],
+                sem.at[slot]).start()
 
         @pl.when(do_hist)
         def _():
@@ -207,29 +271,28 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
     def drain(stream):
         stage, sem, cslot = ((lstage, sem_l, _LF) if stream == 0
                              else (rstage, sem_r, _RF))
-        ref = work_out if stream == 0 else scr_out
         cnt = smem[cslot]
         for back in (2, 1):
             @pl.when(cnt >= back)
             def _():
                 slot = lax.rem(cnt - back, 2)
                 pltpu.make_async_copy(
-                    stage.at[slot], ref.at[pl.ds(0, bs), :],
+                    stage.at[slot], work_out.at[pl.ds(0, bs), :],
                     sem.at[slot]).wait()
 
     # ---------------- main walk ----------------
     @pl.when(nblocks > 0)
     def _():
-        read_dma(0, 0).start()
+        start_read(0, 0)
 
     def body(i, _):
         slot = lax.rem(i, 2)
 
         @pl.when(i + 1 < nblocks)
         def _():
-            read_dma(i + 1, lax.rem(i + 1, 2)).start()
+            start_read(i + 1, lax.rem(i + 1, 2))
 
-        read_dma(i, slot).wait()
+        wait_read(slot)
         blk_u8 = inbuf[slot]
         blk = blk_u8.astype(i32)
         g_idx = base + i * bs + iota
@@ -319,9 +382,18 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
             @pl.when(new_r >= bs)
             def _():
                 rf = smem[_RF]
+
+                @pl.when(rf == 0)
+                def _():
+                    # RMW blend: the psi pre-rows belong to a segment that
+                    # may be live in the destination array
+                    rmw_read(rbase)
+                keep = jnp.logical_and(rf == 0, iota < psi)
+                data = jnp.where(keep[:, None], rmwbuf[:, :].astype(i32),
+                                 carry_block_i32(rcarry))
                 h0 = jnp.where(rf == 0, psi, 0)
                 stage_flush(
-                    1, carry_block_i32(rcarry).astype(jnp.uint8),
+                    1, data.astype(jnp.uint8),
                     rbase + rf * bs, smaller_left == 0,
                     (iota >= h0).astype(jnp.float32))
                 rcarry[:, :] = jnp.concatenate(
@@ -341,10 +413,19 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
         def _():
             lf = smem[_LF]
             # RMW blend: rows beyond lcnt may belong to a live neighbour
-            d = pltpu.make_async_copy(
-                work_out.at[pl.ds(base + lf * bs, bs), :], inbuf.at[0],
-                sem_in.at[0])
-            d.start(); d.wait()
+            # (read from the parent's own residency array — lefts stay there)
+            @pl.when(side == 0)
+            def _():
+                pltpu.make_async_copy(
+                    work_out.at[pl.ds(base + lf * bs, bs), :], inbuf.at[0],
+                    sem_in.at[0]).start()
+
+            @pl.when(side != 0)
+            def _():
+                pltpu.make_async_copy(
+                    scr_out.at[pl.ds(base + lf * bs, bs), :], inbuf.at[0],
+                    sem_in.at[0]).start()
+            wait_read(0)
             blend = jnp.where(
                 (iota < lcnt)[:, None], carry_block_i32(lcarry),
                 inbuf[0].astype(i32)).astype(jnp.uint8)
@@ -356,56 +437,19 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
         @pl.when(rcnt > 0)
         def _():
             rf = smem[_RF]
-            # full-block write: overrun lands in scratch garbage (safe)
+            # RMW blend against the destination array: the psi head rows
+            # (rf == 0) and everything beyond rcnt may be live neighbours
+            rmw_read(rbase + rf * bs)
             h0 = jnp.where(rf == 0, psi, 0)
-            mask = jnp.logical_and(iota >= h0, iota < rcnt)
-            stage_flush(1, carry_block_i32(rcarry).astype(jnp.uint8),
+            valid = jnp.logical_and(iota >= h0, iota < rcnt)
+            data = jnp.where(valid[:, None], carry_block_i32(rcarry),
+                             rmwbuf[:, :].astype(i32))
+            stage_flush(1, data.astype(jnp.uint8),
                         rbase + rf * bs, smaller_left == 0,
-                        mask.astype(jnp.float32))
+                        valid.astype(jnp.float32))
 
         drain(0)
         drain(1)
-
-        # ---------------- copy-back of the right stream ----------------
-        n_right = count - n_left
-        span_r = psi + n_right
-        nb_cb = (span_r + bs - 1) // bs
-
-        def cb_body(k, _):
-            win = rbase + k * bs
-            d1 = pltpu.make_async_copy(
-                scr_out.at[pl.ds(win, bs), :], inbuf.at[0], sem_in.at[0])
-            d2 = pltpu.make_async_copy(
-                work_out.at[pl.ds(win, bs), :], inbuf.at[1], sem_in.at[1])
-            d1.start(); d2.start(); d1.wait(); d2.wait()
-            g = win + iota
-            keep = jnp.logical_and(g >= start + n_left, g < start + count)
-            out = jnp.where(keep[:, None], inbuf[0].astype(i32),
-                            inbuf[1].astype(i32)).astype(jnp.uint8)
-            cw = smem[_CBW]
-            slot = lax.rem(cw, 2)
-
-            @pl.when(cw >= 2)
-            def _():
-                pltpu.make_async_copy(
-                    cbstage.at[slot], work_out.at[pl.ds(0, bs), :],
-                    sem_cw.at[slot]).wait()
-            cbstage[slot] = out
-            pltpu.make_async_copy(
-                cbstage.at[slot], work_out.at[pl.ds(win, bs), :],
-                sem_cw.at[slot]).start()
-            smem[_CBW] = cw + 1
-            return 0
-
-        lax.fori_loop(0, nb_cb, cb_body, 0)
-        cw = smem[_CBW]
-        for back in (2, 1):
-            @pl.when(cw >= back)
-            def _():
-                pltpu.make_async_copy(
-                    cbstage.at[lax.rem(cw - back, 2)],
-                    work_out.at[pl.ds(0, bs), :],
-                    sem_cw.at[lax.rem(cw - back, 2)]).wait()
 
 
 @functools.partial(
@@ -431,6 +475,7 @@ def fused_split(
     bitset_words: int = 8,
     interpret: bool = False,
     smaller_left=None,
+    side=None,                  # i32: 0 = parent lives in work, 1 = scratch
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One fused split. Returns (work', scratch', hist_smaller [F, B, 4]).
 
@@ -440,6 +485,10 @@ def fused_split(
     ``smaller_left`` overrides which side's histogram is accumulated —
     the data-parallel learner must histogram the GLOBALLY smaller child on
     every shard even where it is locally the larger one.
+
+    ``side`` selects the parent's residency array (dual residency, see the
+    module docstring): the left child stays there, the right child lands in
+    the other array at the same global offsets.
     """
     F = layout.num_features
     C = layout.num_cols
@@ -465,11 +514,13 @@ def fused_split(
         smaller_left = (n_left_eff <= n_right).astype(i32)
     smaller_left = jnp.where(mode == 1, jnp.asarray(1, i32),
                              smaller_left.astype(i32))
+    if side is None:
+        side = jnp.asarray(0, i32)
     sp = jnp.stack([
         mode.astype(i32), base_t, phi, count, n_left_eff,
         feature.astype(i32), bin_.astype(i32), default_left.astype(i32),
         nan_bin.astype(i32), is_cat.astype(i32), smaller_left, rbase_t, psi,
-        jnp.asarray(0, i32), jnp.asarray(0, i32), jnp.asarray(0, i32)])
+        side.astype(i32), jnp.asarray(0, i32), jnp.asarray(0, i32)])
 
     bs = block_size
     W = bitset_words
@@ -478,7 +529,7 @@ def fused_split(
     carry_t = jnp.int32 if use_int8 else jnp.float32
     kernel = functools.partial(
         _fused_kernel, layout=layout, num_bins=B, bs=bs, bitset_words=W,
-        use_int8=use_int8)
+        use_int8=use_int8, interpret=interpret)
 
     work_o, scr_o, hist8 = pl.pallas_call(
         kernel,
@@ -494,13 +545,13 @@ def fused_split(
                 pltpu.SemaphoreType.DMA((2,)),      # sem_in
                 pltpu.SemaphoreType.DMA((2,)),      # sem_l
                 pltpu.SemaphoreType.DMA((2,)),      # sem_r
-                pltpu.SemaphoreType.DMA((2,)),      # sem_cw
+                pltpu.SemaphoreType.DMA,            # sem_rmw
                 pltpu.VMEM((2, bs, C), jnp.uint8),  # inbuf
                 pltpu.VMEM((2 * bs, C), carry_t),   # lcarry
                 pltpu.VMEM((2 * bs, C), carry_t),   # rcarry
                 pltpu.VMEM((2, bs, C), jnp.uint8),  # lstage
                 pltpu.VMEM((2, bs, C), jnp.uint8),  # rstage
-                pltpu.VMEM((2, bs, C), jnp.uint8),  # cbstage
+                pltpu.VMEM((bs, C), jnp.uint8),     # rmwbuf
                 pltpu.SMEM((8,), jnp.int32),
             ],
         ),

@@ -60,6 +60,9 @@ class CompactState(NamedTuple):
     leaf_start: jnp.ndarray  # [L] i32 shard-local segment starts
     leaf_nrows: jnp.ndarray  # [L] i32 shard-local segment raw row counts
     leaf_nrows_g: jnp.ndarray  # [L] i32 GLOBAL raw row counts
+    leaf_side: jnp.ndarray   # [L] i32 residency array of each segment
+    #                          (0 = work, 1 = scratch; fused path only —
+    #                          dual residency, ops/fused_split.py)
     # tree arrays under construction
     split_feature: jnp.ndarray
     split_bin: jnp.ndarray
@@ -220,6 +223,7 @@ def grow_tree_compact(
         leaf_nrows=jnp.zeros((L,), i32).at[0].set(n),
         leaf_nrows_g=(jnp.zeros((L,), i32).at[0].set(n_g) if ax
                       else jnp.zeros((1,), i32)),
+        leaf_side=jnp.zeros((L,), i32),
         split_feature=jnp.full((L - 1,), -1, i32),
         split_bin=jnp.zeros((L - 1,), i32),
         cat_bitset=jnp.zeros((L - 1, W), jnp.uint32),
@@ -409,15 +413,18 @@ def grow_tree_compact(
 
         # stable partition of the parent's contiguous segment
         # (reference: DataPartition::Split / cuda_data_partition.cu:907)
+        side_p = st.leaf_side[best_leaf]
         if params.fused_block:
             # one fused Mosaic kernel: partition + smaller-child histogram
-            # in a single streamed walk (ops/fused_split.py)
+            # in a single streamed walk (ops/fused_split.py); the left child
+            # stays in the parent's residency array, the right child lands
+            # in the other one (dual residency — no copy-back pass)
             work, scratch, hist_small_fused = fused_split(
                 st.work, st.scratch, jnp.asarray(0, i32), s_, m_eff,
                 n_left_eff, f_col, b_, dl, nan_bin_arr[f_], f_cat,
                 bits, layout, B, params.fused_block, W,
                 interpret=params.fused_interpret,
-                smaller_left=left_smaller.astype(i32))
+                smaller_left=left_smaller.astype(i32), side=side_p)
         else:
             work, scratch = partition_segment(
                 st.work, st.scratch, s_, m_eff, n_left_eff, f_col, b_, dl,
@@ -437,6 +444,11 @@ def grow_tree_compact(
                 jnp.where(applied, n_right_g, leaf_nrows_g[new_leaf]))
         else:
             leaf_nrows_g = st.leaf_nrows_g
+        if params.fused_block:
+            leaf_side = st.leaf_side.at[new_leaf].set(
+                jnp.where(applied, 1 - side_p, st.leaf_side[new_leaf]))
+        else:
+            leaf_side = st.leaf_side
 
         # one streamed pass over the SMALLER child only; the larger child
         # is parent - smaller (reference: SubtractHistogramForLeaf,
@@ -521,6 +533,7 @@ def grow_tree_compact(
             leaf_start=leaf_start,
             leaf_nrows=leaf_nrows,
             leaf_nrows_g=leaf_nrows_g,
+            leaf_side=leaf_side,
             split_feature=split_feature,
             split_bin=split_bin,
             cat_bitset=cat_bitset,
@@ -556,6 +569,17 @@ def grow_tree_compact(
         )
 
     st = lax.fori_loop(0, L - 1, body, st)
+
+    if params.fused_block:
+        # dual residency: consolidate scratch-resident segments back into
+        # work once per tree (the old design copy-backed after EVERY split,
+        # re-streaming the whole right child each time)
+        _, row_side = segments_to_leaf_vectors(
+            st.leaf_start, st.leaf_nrows, st.leaf_side.astype(jnp.float32), n)
+        in_scratch = jnp.zeros((st.work.shape[0],), bool) \
+            .at[:n].set(row_side > 0.5)
+        st = st._replace(
+            work=jnp.where(in_scratch[:, None], st.scratch, st.work))
 
     leaf_value = st.leaf_out
     tree = TreeArrays(

@@ -139,15 +139,30 @@ def gather_metadata(md, n_local: int):
             raise ValueError(
                 f"metadata field {field} set on some processes but not here")
         v = np.asarray(v)
+        # agree on the class-major layout BEFORE branching: every process
+        # must run the same collective sequence, so shape validation is
+        # itself a collective (kk = -1 marks an indivisible local size)
+        if v.ndim == 2:
+            kk = -2  # [n_local, K] row-major layout
+        elif n_local > 0 and v.size % n_local == 0:
+            kk = v.size // n_local
+        else:
+            kk = -1
+        kks = mu.process_allgather(np.asarray([kk], np.int64)).reshape(-1)
+        if int(kks.min()) != int(kks.max()) or kk == -1:
+            raise ValueError(
+                f"metadata field {field}: inconsistent per-process shapes "
+                f"(local size {v.size} for {n_local} rows; gathered layout "
+                f"codes {sorted(set(int(x) for x in kks))}; expected "
+                "n_local or an exact class-major multiple on every process)")
         if v.ndim == 2:
             # [n_local, K] init scores: concatenate along rows
             g = np.asarray(mu.process_allgather(v))      # [P, n_local, K]
             setattr(out, field, g.reshape(-1, v.shape[1]))
-        elif v.size != n_local:
+        elif kk != 1:
             # flat class-major [K*n_local] (the reference Metadata layout,
             # src/io/metadata.cpp init_score_): gather per class so the
             # global vector stays class-major
-            kk = v.size // n_local
             g = np.asarray(mu.process_allgather(
                 v.reshape(kk, n_local)))                 # [P, K, n_local]
             setattr(out, field,

@@ -49,6 +49,15 @@ def _run_fused(work0, layout, b, mode, start, count, n_left, feat, bin_,
         jnp.asarray(is_cat, i32), bits, layout, b, bs, 8, interpret=True)
 
 
+def _merged(wf, sf, start, count, n_left):
+    """Dual residency: the right child lives in the scratch array at its
+    final offsets; merge for comparison against the single-array reference."""
+    out = np.asarray(wf).copy()
+    rs, re = start + n_left, start + count
+    out[rs:re] = np.asarray(sf)[rs:re]
+    return out
+
+
 def _run_ref(work0, b, layout, start, count, n_left, feat, bin_,
              default_left=False, nan_bin=0, is_cat=False, bits=None):
     bits = (jnp.zeros((8,), jnp.uint32) if bits is None
@@ -76,11 +85,12 @@ class TestFusedSplit:
         feat, bin_ = 2, 100
         sub = work0[start:start + count, feat]
         n_left = int((sub <= bin_).sum())
-        wf, _, hf = _run_fused(work0, layout, b, 0, start, count, n_left,
-                               feat, bin_)
+        wf, sf, hf = _run_fused(work0, layout, b, 0, start, count, n_left,
+                                feat, bin_)
         wr, href = _run_ref(work0, b, layout, start, count, n_left, feat,
                             bin_)
-        np.testing.assert_array_equal(np.asarray(wf)[:n], wr[:n])
+        wm = _merged(wf, sf, start, count, n_left)
+        np.testing.assert_array_equal(wm[:n], wr[:n])
         hf = np.asarray(hf)
         np.testing.assert_array_equal(hf[:, :, 2:], href[:, :, 2:])
         np.testing.assert_allclose(hf[:, :, :2], href[:, :, :2], atol=2e-2)
@@ -92,11 +102,12 @@ class TestFusedSplit:
         col = work0[:, feat]
         gl = (col <= bin_) | (col == nan_bin)
         n_left = int(gl.sum())
-        wf, _, _ = _run_fused(work0, layout, b, 0, 0, n, n_left, feat, bin_,
-                              default_left=1, nan_bin=nan_bin)
+        wf, sf, _ = _run_fused(work0, layout, b, 0, 0, n, n_left, feat, bin_,
+                               default_left=1, nan_bin=nan_bin)
         wr, _ = _run_ref(work0, b, layout, 0, n, n_left, feat, bin_,
                          default_left=True, nan_bin=nan_bin)
-        np.testing.assert_array_equal(np.asarray(wf)[:n], wr[:n])
+        np.testing.assert_array_equal(_merged(wf, sf, 0, n, n_left)[:n],
+                                      wr[:n])
 
     def test_categorical_bitset(self, rng):
         n, f, b = 1500, 4, 256
@@ -108,11 +119,12 @@ class TestFusedSplit:
         col = work0[:, feat]
         gl = (bits[col // 32] >> (col % 32)) & 1
         n_left = int(gl.sum())
-        wf, _, _ = _run_fused(work0, layout, b, 0, 0, n, n_left, feat, 0,
-                              is_cat=1, bits=bits)
+        wf, sf, _ = _run_fused(work0, layout, b, 0, 0, n, n_left, feat, 0,
+                               is_cat=1, bits=bits)
         wr, _ = _run_ref(work0, b, layout, 0, n, n_left, feat, 0,
                          is_cat=True, bits=bits)
-        np.testing.assert_array_equal(np.asarray(wf)[:n], wr[:n])
+        np.testing.assert_array_equal(_merged(wf, sf, 0, n, n_left)[:n],
+                                      wr[:n])
 
     def test_mode1_root_histogram(self, rng):
         n, f, b = 2500, 5, 256
@@ -132,8 +144,14 @@ class TestFusedSplit:
         start, count = 600, 700
         sub = work0[start:start + count, 0]
         n_left = int((sub <= 40).sum())
-        wf, _, _ = _run_fused(work0, layout, b, 0, start, count, n_left, 0, 40)
+        wf, sf, _ = _run_fused(work0, layout, b, 0, start, count, n_left,
+                               0, 40)
         wf = np.asarray(wf)
         np.testing.assert_array_equal(wf[:start], work0[:start])
         np.testing.assert_array_equal(wf[start + count:n],
                                       work0[start + count:n])
+        # the left child stays in place in the parent's array
+        np.testing.assert_array_equal(wf[start:start + n_left],
+                                      _run_ref(work0, b, layout, start,
+                                               count, n_left, 0, 40)[0]
+                                      [start:start + n_left])
