@@ -70,7 +70,7 @@ class Dataset:
         "max_bin", "min_data_in_bin", "bin_construct_sample_cnt",
         "use_missing", "zero_as_missing", "data_random_seed",
         "feature_pre_filter", "max_bin_by_feature", "linear_tree",
-        "forcedbins_filename", "enable_bundle")
+        "forcedbins_filename", "enable_bundle", "max_conflict_rate")
 
     def _update_params(self, params: Optional[Dict[str, Any]]) -> "Dataset":
         """Merge binning params from a Booster into a not-yet-constructed
@@ -155,6 +155,8 @@ class Dataset:
             forcedbins_filename=str(cfg.get("forcedbins_filename", "") or ""),
             max_bin_by_feature=cfg.get("max_bin_by_feature"),
             enable_bundle=bool(cfg.get("enable_bundle", True)),
+            max_conflict_rate=float(
+                cfg.get("max_conflict_rate", 1e-4)),
         )
         md = self._inner.metadata
         if self.label is not None:

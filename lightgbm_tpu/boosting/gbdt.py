@@ -634,9 +634,17 @@ class GBDT:
         mesh_compact_ok = (
             self.mesh is None
             or (self.tree_learner == "data"
-                and not self._multiproc
                 and not (self.objective is not None
                          and self.objective.renew_leaves)))
+        # exact-count ceiling: histogram count channels ride f32, exact for
+        # integers < 2^24; the partition-critical counts are SHARD-LOCAL
+        # under the data-parallel learner (n_left_loc from the shard's own
+        # histogram), so the bound applies per shard, not globally. Global
+        # psum-ed counts only feed constraints (min_data) and the
+        # smaller-side election, where +-2^-24 relative is harmless.
+        n_shards = (len(self.mesh.devices.ravel())
+                    if self.mesh is not None and self.tree_learner == "data"
+                    else 1)
         # non-row-elementwise objectives (lambdarank: gradients couple rows
         # of a query) still run compact when K == 1: gradients compute
         # on-device in ORIGINAL row order (scatter by the carried row-id
@@ -655,7 +663,7 @@ class GBDT:
             and (obj_re or self._ext_grads)
             and not getattr(self.objective, "is_stochastic", False)
             and int(train_set.max_num_bins) <= 256
-            and self.num_data < (1 << 24)
+            and -(-self.num_data // n_shards) < (1 << 24)
             # balanced / by-query bagging index rows in the original order
             and float(cfg.get("pos_bagging_fraction", 1.0)) >= 1.0
             and float(cfg.get("neg_bagging_fraction", 1.0)) >= 1.0
@@ -671,7 +679,12 @@ class GBDT:
             and self._forced_splits is None
         self._use_compact = can_compact and (
             grower == "compact"
-            or (grower == "auto" and self._n_real >= 65536))
+            # bundled datasets always prefer the compact grower: the
+            # bundle-space scan/routing lives there, and the masked grower
+            # would otherwise unbundle back to the dense width
+            or (grower == "auto"
+                and (self._n_real >= 65536
+                     or getattr(train_set, "bundle_info", None) is not None)))
         self._compact = None          # lazy _CompactTrainState
         self._setup_efb(train_set)
         md = train_set.metadata if not pad else _pad_metadata(
@@ -1322,7 +1335,6 @@ class GBDT:
             return
         obj = self.objective
         grower = str(cfg.get("tpu_grower", "auto")).lower()
-        n = train_set.num_data
         compact_possible = (
             tree_learner in ("serial", "data")
             and not self._multiproc
@@ -1337,7 +1349,9 @@ class GBDT:
             and not bool(cfg.get("linear_tree", False))
             and not str(cfg.get("forcedsplits_filename", "") or "")
             and grower != "masked"
-            and (grower == "compact" or n >= 65536)
+            # a bundled dataset always routes to the compact grower under
+            # grower=auto (see _setup_train), at any row count
+            and grower in ("compact", "auto")
             and not (self.mesh is not None and obj.renew_leaves))
         knobs_ok = (
             cfg.get("monotone_constraints") is None

@@ -271,6 +271,7 @@ class BinnedDataset:
         forcedbins_filename: str = "",
         max_bin_by_feature: Optional[Sequence[int]] = None,
         enable_bundle: bool = True,
+        max_conflict_rate: float = 1e-4,
     ) -> "BinnedDataset":
         arr = _to_2d_float(data)
         n, f = arr.shape
@@ -376,7 +377,8 @@ class BinnedDataset:
                  and not m.is_trivial for m in ds.mappers], bool)
             srows = min(n, 50_000)
             bundles = plan_bundles(binned[:srows], nbins, dbins, ok,
-                                   max_bin=max_bin)
+                                   max_bin=max_bin,
+                                   max_conflict_rate=max_conflict_rate)
             if bundles:
                 info = build_bundle_info(bundles, nbins, f)
                 ds.bundle_info = info

@@ -26,7 +26,10 @@ original feature ids / thresholds (so model text and raw-data prediction are
 bundling-agnostic).
 
 Bundled features are restricted to numerical, no-NaN (missing none/zero)
-mappers; everything else passes through as its own column.
+mappers; everything else passes through as its own column. Packing allows a
+bounded conflict count per bundle (reference: total_sample_cnt/10000,
+src/io/dataset.cpp:115) — conflicting rows keep the first-placed member's
+value; max_conflict_rate=0 recovers exact conflict-free bundling.
 """
 from __future__ import annotations
 
@@ -57,15 +60,16 @@ def plan_bundles(
     default_bins: np.ndarray,       # [F] per-feature default (zero) bin
     bundleable: np.ndarray,         # [F] bool: numerical, no-NaN, non-cat
     max_bin: int = 255,
-    max_conflict_rate: float = 0.0,
+    max_conflict_rate: float = 1e-4,
     min_features: int = 256,
 ) -> Optional[List[List[int]]]:
-    """Greedy conflict-free packing of sparse features into bundles.
+    """Greedy bounded-conflict packing of sparse features into bundles.
 
     Reference: Dataset::Construct FindGroups — greedy graph coloring over
-    the feature conflict graph, bounded by max_conflict_rate. Here v1 packs
-    only EXACTLY exclusive features (conflict 0), which is the lossless case
-    (bundled training == dense training bit-for-bit on the sample).
+    the feature conflict graph with a per-group conflict budget of
+    ``total_sample_cnt / 10000`` and a per-feature cap of half its nonzeros
+    (src/io/dataset.cpp:115,163). max_conflict_rate = 0 recovers the exact
+    (lossless) conflict-free packing.
 
     Returns bundles as lists of original feature ids (only multi-member
     bundles), or None when bundling is not worthwhile.
@@ -81,30 +85,39 @@ def plan_bundles(
     if len(cand) < min_features:
         return None
     # greedy first-fit by descending nonzero count (reference sorts the same
-    # way); exclusivity checked against the bundle's combined occupancy
+    # way); conflicts checked against the bundle's combined occupancy
     order = cand[np.argsort(-counts[cand], kind="stable")]
     budget = max_bin  # u8 storage: one column holds at most max_bin+1 values
+    conflict_budget = int(s * max_conflict_rate)
     bundles: List[List[int]] = []
     occupancy: List[np.ndarray] = []
     used_bins: List[int] = []
+    conflicts_used: List[int] = []
     for j in order:
         nb = int(num_bins[j])
+        nz_j = int(counts[j])
         placed = False
         for bi in range(len(bundles)):
             if used_bins[bi] + nb > budget:
                 continue
-            conflict = np.logical_and(occupancy[bi], nonzero[:, j]).sum()
-            if conflict > max_conflict_rate * s:
+            conflict = int(np.logical_and(occupancy[bi],
+                                          nonzero[:, j]).sum())
+            # the bundle's remaining budget AND half this feature's
+            # nonzeros (reference: cnt <= cur_non_zero_cnt / 2)
+            if conflict > min(conflict_budget - conflicts_used[bi],
+                              nz_j // 2):
                 continue
             bundles[bi].append(int(j))
             occupancy[bi] |= nonzero[:, j]
             used_bins[bi] += nb
+            conflicts_used[bi] += conflict
             placed = True
             break
         if not placed:
             bundles.append([int(j)])
             occupancy.append(nonzero[:, j].copy())
             used_bins.append(nb)
+            conflicts_used.append(0)
     bundles = [b for b in bundles if len(b) > 1]
     n_bundled = sum(len(b) for b in bundles)
     if n_bundled < min_features:
@@ -145,10 +158,12 @@ def build_bundle_info(bundles: List[List[int]], num_bins: np.ndarray,
 
 def unbundle(bundled: np.ndarray, info: BundleInfo, default_bins: np.ndarray,
              num_bins: np.ndarray) -> np.ndarray:
-    """Exact inverse of bundle_matrix: reconstruct the dense [N, F] binned
+    """Inverse of bundle_matrix: reconstruct the dense [N, F] binned
     matrix. The graceful fallback when a bundled dataset meets a learner
-    configuration the bundle-space growers don't support (conflict-free
-    bundling is lossless, so this is exact)."""
+    configuration the bundle-space growers don't support. Exact for
+    conflict-free plans; under bounded-conflict bundling, rows that lost a
+    member's bin to a conflict come back at that member's default bin (the
+    same information loss the reference accepts)."""
     n = bundled.shape[0]
     f = len(info.col_of)
     out = np.zeros((n, f), bundled.dtype)
@@ -169,27 +184,40 @@ def unbundle(bundled: np.ndarray, info: BundleInfo, default_bins: np.ndarray,
 def bundle_matrix(binned: np.ndarray, info: BundleInfo,
                   default_bins: np.ndarray) -> Optional[np.ndarray]:
     """Re-encode the dense [N, F] binned matrix into [N, n_columns], or None
-    when a conflict appears outside the planning sample (caller keeps dense).
+    when far more conflicts appear than planned (caller keeps dense).
+
+    Conflicting rows (two members nonzero) keep the FIRST-placed member's
+    value — the planning order, matching the reference's bounded-conflict
+    semantics (a conflicting row simply loses the later feature's bin,
+    src/io/dataset.cpp FindGroups). With a conflict-free plan this is exact.
 
     (When constructing from raw columns the caller can stream feature by
     feature instead of materializing [N, F] first; this dense variant serves
     the in-memory path.)"""
     n = binned.shape[0]
     out = np.zeros((n, info.n_columns), np.uint8)
-    for j in range(binned.shape[1]):
+    conflicts = 0
+    # iterate in PLACEMENT order (ascending offset within each column) so a
+    # conflicting row keeps the FIRST-PLACED member's value, matching the
+    # planner's conflict accounting and the reference's drop order
+    order = np.lexsort((info.offset_of, info.col_of))
+    for j in order:
         c = info.col_of[j]
         if info.offset_of[j] < 0:
             out[:, c] = binned[:, j]
         else:
             col = binned[:, j]
-            nz = col != default_bins[j]
-            enc = info.offset_of[j] + 1 + col[nz]
-            if enc.size and int(enc.max()) > 255:
+            if int(info.offset_of[j]) + 1 + int(col.max(initial=0)) > 255:
                 raise ValueError("bundle exceeded u8 bin budget")
-            # exclusivity was planned on a SAMPLE; verify it on every row —
-            # a late conflict would silently corrupt bins (the lossless
-            # contract), so the caller falls back to the dense matrix
-            if np.any(out[nz, c] != 0):
-                return None
-            out[nz, c] = enc.astype(np.uint8)
+            nz = col != default_bins[j]
+            # planning used a SAMPLE; on the full data conflicting rows
+            # keep the earlier member (first-writer wins)
+            write = nz & (out[:, c] == 0)
+            conflicts += int(nz.sum()) - int(write.sum())
+            out[write, c] = (info.offset_of[j] + 1
+                             + col[write].astype(np.int64)).astype(np.uint8)
+    if conflicts > max(n // 100, 1):
+        # the sample badly under-estimated conflicts; bundling this data
+        # would distort far more rows than the planner allowed
+        return None
     return out

@@ -79,7 +79,7 @@ def _xla_histogram(binned, channels, num_bins: int):
     return hist
 
 
-def _resolve_impl(impl: str, num_bins: int) -> str:
+def _resolve_impl(impl: str, num_bins: int, num_features: int = 0) -> str:
     """Resolve 'auto' to a concrete implementation.
 
     Measured on v5e (2026-07, 1M rows x 28 features): at B=256 the Mosaic
@@ -88,11 +88,15 @@ def _resolve_impl(impl: str, num_bins: int) -> str:
     bandwidth-bound); at B<=64 the XLA path is competitive (~0.45 Telem/s)
     because the one-hot is 4x smaller. Pallas needs the per-feature one-hot
     width to tile cleanly into 128 lanes, so it takes over at B >= 128.
+    Wide F*B makes the Mosaic kernel's unrolled chunk loop spill registers
+    past the VMEM budget (F=320 at B=256 wants 149MB of spill slots on
+    v5e) — those configs stay on the XLA path.
     """
     if impl != "auto":
         return impl
     from .pallas_histogram import pallas_available
-    if num_bins >= 128 and pallas_available():
+    if (num_bins >= 128 and pallas_available()
+            and num_features * num_bins <= 50_000):
         return "pallas"
     return "xla"
 
@@ -105,7 +109,7 @@ def histogram_block(
 ) -> jax.Array:             # [F, B, K] f32
     """Histogram of one already-sliced row block (no psum, no jit wrapper —
     call sites are inside jitted loops)."""
-    impl = _resolve_impl(impl, num_bins)
+    impl = _resolve_impl(impl, num_bins, binned.shape[1])
     if impl == "pallas":
         from .pallas_histogram import pallas_histogram
         return pallas_histogram(binned, channels, num_bins)

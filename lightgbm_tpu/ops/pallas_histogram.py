@@ -55,7 +55,13 @@ def _hist_kernel(bins_ref, ch_ref, out_ref, *, num_bins: int, f_chunk: int,
     The output is CHANNEL-major: [KP, F*B] keeps the lane dimension wide
     (F*B) instead of padding an 8-lane channel dimension to 128, so the
     VMEM-resident accumulator costs 8 x F*B x 4B (1.1MB at F=137, B=256)
-    rather than 32x that."""
+    rather than 32x that.
+
+    The unrolled chunk loop makes the register allocator spill the one-hot
+    temporaries to the VMEM stack when F*B is large (measured on v5e at
+    B=256: F=200 compiles, F=320 wants 149MB of spill slots against the
+    128MB budget); the auto dispatch (ops/histogram.py _resolve_impl)
+    routes such configs to the XLA path instead."""
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -109,6 +115,11 @@ def pallas_histogram(
     n, f_in = binned.shape
     k = channels.shape[1]
     b = num_bins
+    # Mosaic VMEM scales ~ row_block * F * B * 0.83B (measured on v5e:
+    # 138.7MB at [2048, 320] x B=256 against the 128MB budget); clamp the
+    # row block so wide-F configs compile instead of OOMing vmem
+    rb_cap = max(128, (121_000_000 // max(1, f_in * b)) // 128 * 128)
+    row_block = min(row_block, rb_cap)
 
     if mode == "split":
         if 2 * k > _K_PAD:

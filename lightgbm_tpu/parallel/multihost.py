@@ -143,7 +143,7 @@ def gather_metadata(md, n_local: int):
         # must run the same collective sequence, so shape validation is
         # itself a collective (kk = -1 marks an indivisible local size)
         if v.ndim == 2:
-            kk = -2  # [n_local, K] row-major layout
+            kk = -(10 + v.shape[1])  # [n_local, K] row-major layout
         elif n_local > 0 and v.size % n_local == 0:
             kk = v.size // n_local
         else:
@@ -170,9 +170,41 @@ def gather_metadata(md, n_local: int):
         else:
             setattr(out, field,
                     np.asarray(mu.process_allgather(v)).reshape(-1))
-    if md.query_boundaries is not None:
-        raise NotImplementedError(
-            "ranking groups are not supported with multi-host training yet")
+    # ranking groups: queries must never straddle processes — each rank
+    # holds whole queries and the global boundary vector concatenates with
+    # running row offsets (the reference's partition contract:
+    # Metadata::CheckOrPartition keeps query blocks intact,
+    # src/io/metadata.cpp; dataset.h:110). Validation is COLLECTIVE: every
+    # process runs the same allgather sequence and raises together, never
+    # leaving a peer blocked inside a collective.
+    if md.query_boundaries is None:
+        qstat, sizes = 0, np.zeros((0,), np.int64)   # no groups here
+    else:
+        qb = np.asarray(md.query_boundaries, np.int64)
+        ok = qb[-1] == n_local
+        qstat = 1 if ok else 2                       # 2 = straddling rows
+        sizes = np.diff(qb) if ok else np.zeros((0,), np.int64)
+    qstats = mu.process_allgather(
+        np.asarray([qstat], np.int64)).reshape(-1)
+    if int(qstats.max()) > 0:
+        if int(qstats.min()) == 0 or int(qstats.max()) == 2:
+            raise ValueError(
+                "ranking groups are inconsistent across processes "
+                f"(per-rank states {qstats.tolist()}: 0=missing, 1=ok, "
+                "2=group sizes do not cover the local rows); every process "
+                "needs `group` sizes summing to its local row count — "
+                "queries must not straddle processes")
+        nq = mu.process_allgather(
+            np.asarray([sizes.size], np.int64)).reshape(-1)
+        m = int(nq.max())
+        padded = np.zeros((m,), np.int64)
+        padded[:sizes.size] = sizes
+        g = np.asarray(mu.process_allgather(padded))       # [P, m]
+        all_sizes = np.concatenate(
+            [g[p, :int(c)] for p, c in enumerate(nq)])
+        out.group = all_sizes
+        out.query_boundaries = np.concatenate(
+            [[0], np.cumsum(all_sizes)]).astype(np.int64)
     return out
 
 

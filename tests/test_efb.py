@@ -95,3 +95,40 @@ class TestEFB:
                              monotone_constraints=[1] * X.shape[1]), ds, 2)
         assert ds._inner.bundle_info is None       # fell back to dense
         assert np.isfinite(bst.predict(X[:50])).all()
+
+    def test_bounded_conflict_bundling(self):
+        # reference: FindGroups packs features whose conflicts stay under
+        # total_sample_cnt/10000 per group (src/io/dataset.cpp:115); rows
+        # with two nonzero members keep the first-placed member's value
+        from lightgbm_tpu.io.efb import build_bundle_info, plan_bundles
+        rng = np.random.RandomState(0)
+        n, groups, card = 20000, 40, 8
+        cats = rng.randint(0, card, size=(n, groups))
+        X = np.zeros((n, groups * card), np.float32)
+        for g in range(groups):
+            X[np.arange(n), g * card + cats[:, g]] = 1.0
+        # sprinkle conflicts: a few rows get a SECOND hot feature per block
+        for g in range(groups):
+            rows = rng.choice(n, size=n // 15000, replace=False)
+            X[rows, g * card + rng.randint(0, card)] = 1.0
+        sb = (X > 0).astype(np.uint8)
+        nbins = np.full(X.shape[1], 2, np.int32)
+        dbins = np.zeros(X.shape[1], np.int32)
+        ok = np.ones(X.shape[1], bool)
+        none = plan_bundles(sb, nbins, dbins, ok, max_conflict_rate=0.0,
+                            min_features=8)
+        some = plan_bundles(sb, nbins, dbins, ok, max_conflict_rate=1e-4,
+                            min_features=8)
+        n_none = sum(len(b) for b in none) if none else 0
+        n_some = sum(len(b) for b in some) if some else 0
+        assert n_some > n_none, (n_some, n_none)
+
+        # end-to-end: training on conflicted one-hot data still bundles and
+        # stays accurate
+        w = rng.randn(X.shape[1]) * 0.5
+        y = ((X @ w + 0.4 * rng.randn(n)) > 0).astype(np.float64)
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train(dict(PARAMS, num_leaves=15), ds, 6)
+        info = ds._inner.bundle_info
+        assert info is not None and info.n_columns < X.shape[1] // 2
+        assert roc_auc_score(y, bst.predict(X)) > 0.75

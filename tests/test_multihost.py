@@ -40,6 +40,26 @@ bst = lgb.train(params, lgb.Dataset(Xl, label=yl), 5)
 bst.save_model(os.path.join(outdir, f"model_{rank}.txt"))
 np.save(os.path.join(outdir, f"pred_{rank}.npy"), bst.predict(X[:500]))
 print("rank", rank, "done")
+
+# compact (physically partitioned) grower under the multi-host mesh:
+# per-process shard-local segments, psum-ed histograms
+bst2 = lgb.train({**params, "tpu_grower": "compact"},
+                 lgb.Dataset(Xl, label=yl), 5)
+bst2.save_model(os.path.join(outdir, f"model_compact_{rank}.txt"))
+np.save(os.path.join(outdir, f"pred_compact_{rank}.npy"),
+        bst2.predict(X[:500]))
+print("rank", rank, "compact done")
+
+# multi-host lambdarank: whole queries per process, boundaries gathered
+# with running offsets (Metadata::CheckOrPartition contract)
+yr = (np.clip(X[:, 0] + 0.4 * rng.randn(N), -2, 2) > 0.5).astype(np.float64)
+yrl = yr[rank * half:(rank + 1) * half]
+group = np.full(half // 50, 50, np.int64)
+bst3 = lgb.train({**params, "objective": "lambdarank",
+                  "lambdarank_truncation_level": 20},
+                 lgb.Dataset(Xl, label=yrl, group=group), 5)
+bst3.save_model(os.path.join(outdir, f"model_rank_{rank}.txt"))
+print("rank", rank, "lambdarank done")
 """
 
 
@@ -78,6 +98,12 @@ def test_two_process_training_identical_models(tmp_path):
     m0 = (tmp_path / "model_0.txt").read_text()
     m1 = (tmp_path / "model_1.txt").read_text()
     assert m0 == m1, "ranks produced different models"
+    mc0 = (tmp_path / "model_compact_0.txt").read_text()
+    mc1 = (tmp_path / "model_compact_1.txt").read_text()
+    assert mc0 == mc1, "compact grower ranks produced different models"
+    mr0 = (tmp_path / "model_rank_0.txt").read_text()
+    mr1 = (tmp_path / "model_rank_1.txt").read_text()
+    assert mr0 == mr1, "lambdarank ranks produced different models"
 
     # golden: the same global data trained in ONE process
     import jax
