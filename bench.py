@@ -77,6 +77,18 @@ def make_msltr_like(n, f, docs_per_query=120, seed=7):
     return X, y, group
 
 
+def _record_shape(key, payload):
+    rec_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_SHAPES.json")
+    rec = {}
+    if os.path.exists(rec_path):
+        with open(rec_path) as fh:
+            rec = json.load(fh)
+    rec[key] = payload
+    with open(rec_path, "w") as fh:
+        json.dump(rec, fh, indent=1, sort_keys=True)
+
+
 def run_ranking_bench():
     """Lambdarank at MS-LTR scale: pair-block chunking + NDCG under load."""
     import jax
@@ -114,6 +126,10 @@ def run_ranking_bench():
     sys.stderr.write(f"[bench-ranking] rows={rows} features={feats} "
                      f"warmup={warm:.1f}s train({iters})={dt:.1f}s "
                      f"{name}={ndcg:.5f}\n")
+    _record_shape("ranking", {
+        "rows": rows, "features": feats, "leaves": params["num_leaves"],
+        "iters_per_sec": round(iters / dt, 3), "ndcg": round(float(ndcg), 5),
+    })
     # MS-LTR CPU baseline: ref Experiments.rst:117 xgb_hist/LightGBM table
     # does not publish iters/sec for MS-LTR; report absolute throughput
     print(json.dumps({
@@ -198,6 +214,15 @@ def main():
         f"[bench] construct={construct_s:.1f}s warmup({WARMUP})={warmup_s:.1f}s "
         f"compile~={compile_s:.1f}s train({ITERS})={train_s:.1f}s auc={auc}\n")
     shape = "allstate" if sparse else "higgs"
+    # every run also records its result in BENCH_SHAPES.json so the sparse
+    # and ranking shape numbers live in files, not prose (run the other
+    # shapes via BENCH_SPARSE=1 / BENCH_RANKING=1)
+    _record_shape(shape, {
+        "rows": ROWS, "features": FEATURES, "leaves": NUM_LEAVES,
+        "bins": MAX_BIN, "iters_per_sec": round(iters_per_sec, 3),
+        "construct_s": round(construct_s, 1),
+        "compile_s": round(compile_s, 1), "auc": auc,
+    })
     print(json.dumps({
         "metric": f"synthetic-{shape}{ROWS // 1_000_000}M-"
                   f"{NUM_LEAVES}leaf boosting throughput",

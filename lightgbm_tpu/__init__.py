@@ -24,6 +24,9 @@ from .engine import CVBooster, cv, train
 __version__ = "0.1.0"
 
 __all__ = [
+    "DaskLGBMClassifier",
+    "DaskLGBMRegressor",
+    "DaskLGBMRanker",
     "Dataset", "Booster", "Config",
     "train", "cv", "CVBooster",
     "early_stopping", "log_evaluation", "record_evaluation", "reset_parameter",
@@ -31,6 +34,7 @@ __all__ = [
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "plot_importance", "plot_metric", "plot_split_value_histogram",
     "plot_tree", "create_tree_digraph",
+    "register_parser",
 ]
 
 _PLOTTING = ("plot_importance", "plot_metric", "plot_split_value_histogram",
@@ -42,6 +46,12 @@ def __getattr__(name):
     if name in ("LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"):
         from . import sklearn as _sk
         return getattr(_sk, name)
+    if name in ("DaskLGBMClassifier", "DaskLGBMRegressor", "DaskLGBMRanker"):
+        from . import dask as _dk
+        return getattr(_dk, name)
+    if name == "register_parser":
+        from .io.loader import register_parser
+        return register_parser
     if name in _PLOTTING:
         from . import plotting as _pl
         return getattr(_pl, name)

@@ -506,12 +506,14 @@ class GBDT:
             cfg.get("interaction_constraints"), nf)
         self._mono_types = (jnp.asarray(fpad(mono_np, 0))
                             if mono_np is not None else None)
-        if mono_np is not None and \
-                str(cfg.get("monotone_constraints_method", "basic")) != "basic":
+        mono_method = str(cfg.get("monotone_constraints_method", "basic"))
+        self._mono_intermediate = (mono_np is not None
+                                   and mono_method in ("intermediate",
+                                                       "advanced"))
+        if mono_np is not None and mono_method == "advanced":
             log.warning(
-                "monotone_constraints_method="
-                f"{cfg.get('monotone_constraints_method')!r} is not "
-                "implemented; using the 'basic' method")
+                "monotone_constraints_method='advanced' is not implemented; "
+                "using the 'intermediate' method")
         if inter_np is not None and self._f_pad:
             inter_np = np.pad(inter_np, ((0, 0), (0, self._f_pad)))
         self._inter_sets = (jnp.asarray(inter_np) if inter_np is not None
@@ -522,14 +524,39 @@ class GBDT:
         # feature costs are paid once per model, so the used-feature set
         # persists across trees
         tradeoff = float(cfg.get("cegb_tradeoff", 1.0))
+
+        def _vec(v):
+            # config files / CLI deliver vector params as comma strings
+            if isinstance(v, str):
+                return [float(t) for t in v.split(",") if t.strip()]
+            return list(v)
+
         coupled = cfg.get("cegb_penalty_feature_coupled")
         split_pen = float(cfg.get("cegb_penalty_split", 0.0))
         self._use_cegb = split_pen > 0.0 or coupled is not None
-        if cfg.get("cegb_penalty_feature_lazy") is not None:
-            log.warning("cegb_penalty_feature_lazy is not implemented; "
-                        "only split and coupled penalties apply")
+        lazy = cfg.get("cegb_penalty_feature_lazy")
+        if lazy is not None:
+            lz = np.asarray(_vec(lazy), np.float32)
+            if lz.size != nf:
+                raise ValueError(
+                    "cegb_penalty_feature_lazy must have one entry per "
+                    f"feature ({nf}), got {lz.size}")
+            # on-demand (lazy) per-row feature costs; the charged-rows
+            # bitmap costs F*N bytes on device, so bound it
+            if nf * self.num_data > (1 << 32):
+                raise ValueError(
+                    "cegb_penalty_feature_lazy needs an [F, N] charged-rows "
+                    f"bitmap; {nf}x{self.num_data} exceeds the supported "
+                    "size")
+            self._cegb_lazy = jnp.asarray(
+                fpad(tradeoff * lz, 0.0)) if self._f_pad else \
+                jnp.asarray(tradeoff * lz)
+            self._use_cegb = True
+        else:
+            self._cegb_lazy = None
+        self._cegb_charged = None  # lazily a [F, N] bool device array
         if coupled is not None:
-            cp = np.asarray(list(coupled), np.float32)
+            cp = np.asarray(_vec(coupled), np.float32)
             if cp.size != nf:
                 raise ValueError(
                     "cegb_penalty_feature_coupled must have one entry per "
@@ -600,6 +627,7 @@ class GBDT:
             any_cat=bool(np.any(train_set.feature_is_categorical())),
             use_monotone=mono_np is not None,
             monotone_penalty=float(cfg.get("monotone_penalty", 0.0)),
+            mono_intermediate=self._mono_intermediate,
             path_smooth=float(cfg.get("path_smooth", 0.0)),
             use_interaction=inter_np is not None,
             bynode_fraction=float(cfg.get("feature_fraction_bynode", 1.0)),
@@ -668,6 +696,9 @@ class GBDT:
             and float(cfg.get("pos_bagging_fraction", 1.0)) >= 1.0
             and float(cfg.get("neg_bagging_fraction", 1.0)) >= 1.0
             and not bool(cfg.get("bagging_by_query", False))
+            # lazy CEGB tracks charged rows in ORIGINAL row order; the
+            # compact grower permutes rows, so it runs masked
+            and cfg.get("cegb_penalty_feature_lazy") is None
         )
         if grower == "compact" and not can_compact:
             log.warning("tpu_grower=compact requires a serial learner and a "
@@ -686,6 +717,13 @@ class GBDT:
                 and (self._n_real >= 65536
                      or getattr(train_set, "bundle_info", None) is not None)))
         self._compact = None          # lazy _CompactTrainState
+        if self._mono_intermediate and not self._use_compact:
+            log.warning(
+                "monotone_constraints_method='intermediate' runs on the "
+                "compact grower only; this configuration uses the masked "
+                "grower with the 'basic' method")
+            self.grower_params = self.grower_params._replace(
+                mono_intermediate=False)
         self._setup_efb(train_set)
         md = train_set.metadata if not pad else _pad_metadata(
             train_set.metadata, self.num_data)
@@ -751,7 +789,7 @@ class GBDT:
 
         def step(binned, score_k, grad_k, hess_k, mask, feat_mask,
                  shrinkage, bynode_key, cegb_used, true_grad_k, true_hess_k,
-                 extra_key):
+                 extra_key, cegb_charged):
             # binned is an argument, not a closure: multi-process global
             # arrays cannot be captured as jit constants
             # grad_k/hess_k arrive already quantized when use_quantized_grad
@@ -759,11 +797,21 @@ class GBDT:
             # GradientDiscretizer); true_* carry the originals for renewal
             g = grad_k * mask
             h = hess_k * mask
-            tree, row_leaf = grow_tree(
-                binned, g, h, mask, num_bins_arr, nan_bin_arr, has_nan_arr,
-                is_cat_arr, feat_mask, grower_params, mono_types,
-                inter_sets, bynode_key, cegb_coupled, cegb_used,
-                extra_key, feature_contri, self._forced_splits)
+            if use_lazy:
+                tree, row_leaf, cegb_charged = grow_tree(
+                    binned, g, h, mask, num_bins_arr, nan_bin_arr,
+                    has_nan_arr, is_cat_arr, feat_mask, grower_params,
+                    mono_types, inter_sets, bynode_key, cegb_coupled,
+                    cegb_used, extra_key, feature_contri,
+                    self._forced_splits, cegb_lazy=self._cegb_lazy,
+                    cegb_charged0=cegb_charged)
+            else:
+                tree, row_leaf = grow_tree(
+                    binned, g, h, mask, num_bins_arr, nan_bin_arr,
+                    has_nan_arr, is_cat_arr, feat_mask, grower_params,
+                    mono_types, inter_sets, bynode_key, cegb_coupled,
+                    cegb_used, extra_key, feature_contri,
+                    self._forced_splits)
             if use_cegb:
                 cegb_used = _tree_used_features(tree, binned.shape[1],
                                                 cegb_used)
@@ -795,8 +843,9 @@ class GBDT:
                 leaf_value=lv * shrinkage,
                 internal_value=tree.internal_value * shrinkage)
             new_score = score_k + tree.leaf_value[row_leaf]
-            return tree, row_leaf, new_score, cegb_used
+            return tree, row_leaf, new_score, cegb_used, cegb_charged
 
+        use_lazy = self._cegb_lazy is not None
         return jax.jit(step)
 
     # -- compact (physically partitioned) serial path ------------------------
@@ -1172,6 +1221,18 @@ class GBDT:
                 (int(self.binned.shape[1])
                  + self.grower_params.efb_virtual,), bool)
         return self._cegb_used
+
+    def _cegb_charged_state(self) -> jax.Array:
+        """Lazy-penalty charged-rows bitmap, persisted across the whole
+        model (reference: feature_used_in_data_ is filled once and never
+        reset, cost_effective_gradient_boosting.hpp:62)."""
+        if self._cegb_charged is None:
+            f = int(self.binned.shape[1])
+            n = (int(self.binned.shape[0])
+                 if self._cegb_lazy is not None else 1)
+            fdim = f if self._cegb_lazy is not None else 1
+            self._cegb_charged = jnp.zeros((fdim, n), bool)
+        return self._cegb_charged
 
     def _compact_gradients(self):
         """Gradients in the current (permuted) row order, for GOSS ranking."""
@@ -1564,7 +1625,8 @@ class GBDT:
                 bool(getattr(self.objective, "is_constant_hessian", False)))
 
         for cur_tree_id in range(k):
-            tree, row_leaf, new_score, self._cegb_used = self._step_fn(
+            (tree, row_leaf, new_score, self._cegb_used,
+             self._cegb_charged) = self._step_fn(
                 self.binned,
                 self.train_score[cur_tree_id], grad[cur_tree_id],
                 hess[cur_tree_id], mask, feat_mask,
@@ -1572,7 +1634,8 @@ class GBDT:
                 jax.random.fold_in(self._bynode_key, self.num_total_trees),
                 self._cegb_state(),
                 true_grad[cur_tree_id], true_hess[cur_tree_id],
-                jax.random.fold_in(self._extra_key, self.num_total_trees))
+                jax.random.fold_in(self._extra_key, self.num_total_trees),
+                self._cegb_charged_state())
             if self._linear:
                 split_ok = self._linear_tree_iter(
                     tree, row_leaf, true_grad[cur_tree_id],

@@ -42,6 +42,7 @@ def _load_dataset(params, data_path: str):
         label_column=params.get("label_column", "0"),
         weight_column=params.get("weight_column", ""),
         group_column=params.get("group_column", ""),
+        parser_config_file=str(params.get("parser_config_file", "") or ""),
         ignore_column=params.get("ignore_column", ""),
     )
     if weight is None:

@@ -274,9 +274,7 @@ METRIC_ALIASES: Dict[str, str] = {
 # rejects inconsistent configs outright, src/io/config.cpp:286). Entries are
 # removed from this set as the corresponding feature lands.
 UNIMPLEMENTED_PARAMS: Dict[str, str] = {
-    "auc_mu_weights": "weighted auc_mu",
     "two_round": "two-round file loading",
-    "parser_config_file": "custom parsers",
     "pre_partition": "pre-partitioned distributed data",
 }
 

@@ -8,35 +8,101 @@ every host with ``num_machines``/``machines`` set (see
 ``lightgbm_tpu.parallel.multihost``) and the data-parallel learner shards
 rows over all chips of all hosts — no separate scheduler process is needed.
 
-These classes exist so code written against the reference's Dask API fails
-with a actionable message rather than an AttributeError. If dask is
-installed, ``DaskLGBM*`` could be implemented as thin wrappers that gather
-partitions per host and call the multihost path; this environment does not
-ship dask, so they raise.
+These wrappers therefore take the opposite shape from the reference's: a
+Dask collection is MATERIALIZED on the training host (the TPU client
+process already addresses every local chip; multi-host pods run one client
+per host anyway) and handed to the sklearn estimators. That preserves the
+reference's Dask API for code migrating over, while the heavy lifting —
+sharding rows across accelerators — happens in the device mesh rather
+than in the task graph. When dask is not installed the methods raise an
+actionable error.
 """
 from __future__ import annotations
 
+from .sklearn import LGBMClassifier, LGBMRanker, LGBMRegressor
+
 _MSG = (
-    "Dask orchestration is not available in lightgbm_tpu. On TPU pods use "
-    "jax multi-process training instead: run the same script on every host "
-    "with params={'tree_learner': 'data', 'num_machines': N, "
+    "dask is not installed. On TPU pods use jax multi-process training "
+    "instead: run the same script on every host with "
+    "params={'tree_learner': 'data', 'num_machines': N, "
     "'machines': 'host1:port,host2:port,...'} (see "
     "lightgbm_tpu.parallel.multihost)."
 )
 
 
-class _DaskUnavailable:
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError(_MSG)
+def _materialize(part):
+    """Dask collection -> local numpy/pandas (no-op for local data)."""
+    if part is None:
+        return None
+    if hasattr(part, "compute"):
+        return part.compute()
+    return part
 
 
-class DaskLGBMClassifier(_DaskUnavailable):
+def _require_dask():
+    try:
+        import dask  # noqa: F401
+    except ImportError as exc:
+        raise NotImplementedError(_MSG) from exc
+
+
+def _wrap_array(out):
+    try:
+        import dask.array as da
+        import numpy as np
+        return da.from_array(np.asarray(out))
+    except Exception:  # pragma: no cover - dask missing mid-flight
+        return out
+
+
+class _DaskMixin:
+    """fit/predict accept Dask arrays/dataframes/series; the collection is
+    gathered to the client and training shards rows over the device mesh
+    (``tree_learner=data``) — the reference's per-worker socket topology
+    has no TPU equivalent worth emulating (SURVEY §7)."""
+
+    def fit(self, X, y, sample_weight=None, init_score=None, **kwargs):
+        _require_dask()
+        for key in ("group", "eval_sample_weight", "eval_init_score",
+                    "eval_group"):
+            if key in kwargs and kwargs[key] is not None:
+                v = kwargs[key]
+                kwargs[key] = ([_materialize(p) for p in v]
+                               if isinstance(v, (list, tuple)) else
+                               _materialize(v))
+        if kwargs.get("eval_set") is not None:
+            kwargs["eval_set"] = [
+                (_materialize(vx), _materialize(vy))
+                for vx, vy in kwargs["eval_set"]]
+        return super().fit(
+            _materialize(X), _materialize(y),
+            sample_weight=_materialize(sample_weight),
+            init_score=_materialize(init_score), **kwargs)
+
+    def predict(self, X, **kwargs):
+        _require_dask()
+        return _wrap_array(super().predict(_materialize(X), **kwargs))
+
+    def to_local(self):
+        """The reference's DaskLGBM*.to_local(): the plain estimator."""
+        local_cls = next(
+            c for c in type(self).__mro__
+            if not (issubclass(c, _DaskMixin) or c is _DaskMixin))
+        out = local_cls(**self.get_params())
+        out.__dict__.update(dict(self.__dict__))
+        return out
+
+
+class DaskLGBMClassifier(_DaskMixin, LGBMClassifier):
+    def predict_proba(self, X, **kwargs):
+        _require_dask()
+        return _wrap_array(
+            LGBMClassifier.predict_proba(self, _materialize(X), **kwargs))
+
+
+class DaskLGBMRegressor(_DaskMixin, LGBMRegressor):
     pass
 
 
-class DaskLGBMRegressor(_DaskUnavailable):
-    pass
-
-
-class DaskLGBMRanker(_DaskUnavailable):
+class DaskLGBMRanker(_DaskMixin, LGBMRanker):
     pass

@@ -39,6 +39,79 @@ def _parse_column_spec(spec: str, names) -> Optional[int]:
     return int(spec)
 
 
+# -- custom parser plugins (reference: pluggable ParserFactory via
+# parser_config_file, src/io/parser.cpp Parser::CreateParser) ----------------
+# The reference loads native parser plugins from a shared library named in a
+# JSON config file; here plugins are PYTHON callables registered by name —
+# the TPU build has no C ABI to load from, and a callable covers the same
+# role (turn one text line into (features, label)).
+_PARSER_REGISTRY = {}
+
+
+def register_parser(name: str, fn) -> None:
+    """Register a custom line parser: ``fn(line: str) -> (values, label)``
+    where ``values`` is a float sequence. Select it with
+    ``parser_config_file`` pointing at JSON ``{"className": "<name>"}``
+    (the reference's key for its plugin class)."""
+    _PARSER_REGISTRY[str(name)] = fn
+
+
+def _load_with_plugin(path: str, has_header: bool, parser_config_file: str,
+                      weight_column: str = "", group_column: str = "",
+                      ignore_column: str = ""):
+    import json
+    with open(parser_config_file) as fh:
+        cfg = json.load(fh)
+    name = str(cfg.get("className", cfg.get("parser", "")))
+    if name not in _PARSER_REGISTRY:
+        raise ValueError(
+            f"parser_config_file names parser {name!r} but no such parser "
+            "is registered; call lightgbm_tpu.register_parser(name, fn)")
+    fn = _PARSER_REGISTRY[name]
+    xs, ys = [], []
+    with open(path) as fh:
+        if has_header:
+            fh.readline()
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            vals, label = fn(line)
+            xs.append(np.asarray(vals, np.float64))
+            ys.append(np.nan if label is None else float(label))
+    X = np.vstack(xs)
+    y = np.asarray(ys, np.float64)
+    if np.isnan(y).all():
+        y = None
+    # weight/group/ignore column specs index the PARSED value columns (the
+    # reference's plugin parser feeds the normal column pipeline)
+    weight = group = None
+    drop = []
+
+    def idx_of(spec):
+        return int(spec) if str(spec).strip() != "" else None
+
+    wi = idx_of(weight_column)
+    gi = idx_of(group_column)
+    if wi is not None:
+        weight = X[:, wi]
+        drop.append(wi)
+    if gi is not None:
+        gid = X[:, gi].astype(np.int64)
+        # contiguous query-id column -> group sizes
+        change = np.nonzero(np.diff(gid))[0]
+        bounds = np.concatenate([[0], change + 1, [len(gid)]])
+        group = np.diff(bounds).astype(np.int64)
+        drop.append(gi)
+    for spec in str(ignore_column).split(","):
+        if spec.strip() != "":
+            drop.append(int(spec))
+    if drop:
+        keep = [j for j in range(X.shape[1]) if j not in set(drop)]
+        X = X[:, keep]
+    return X, y, weight, group, None
+
+
 def load_text_file(
     path: str,
     has_header: bool = False,
@@ -46,11 +119,15 @@ def load_text_file(
     weight_column: str = "",
     group_column: str = "",
     ignore_column: str = "",
+    parser_config_file: str = "",
 ) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray],
            Optional[np.ndarray], Optional[list]]:
     """Returns (X, label, weight, group_sizes, feature_names)."""
     if not os.path.exists(path):
         raise FileNotFoundError(path)
+    if parser_config_file:
+        return _load_with_plugin(path, has_header, parser_config_file,
+                                 weight_column, group_column, ignore_column)
     with open(path) as f:
         first = f.readline()
     fmt = _detect_format(path, first if not has_header else "")

@@ -294,6 +294,23 @@ class AucMuMetric(Metric):
     def __init__(self, config):
         super().__init__(config)
         self.num_class = int(config.get("num_class", 1))
+        k = self.num_class
+        w = config.get("auc_mu_weights")
+        if w is not None:
+            if isinstance(w, str):
+                # config files / CLI deliver the matrix as a comma string
+                w = [float(t) for t in w.split(",") if t.strip()]
+            arr = np.asarray(list(w), np.float64).reshape(-1)
+            if arr.size != k * k:
+                raise ValueError(
+                    f"auc_mu_weights must have num_class^2 = {k * k} "
+                    f"entries, got {arr.size}")
+            self.W = arr.reshape(k, k).copy()
+        else:
+            self.W = np.ones((k, k), np.float64)
+        # the diagonal is always zero (reference: Config::GetAucMuWeights,
+        # src/io/config.cpp:224)
+        np.fill_diagonal(self.W, 0.0)
 
     def eval(self, raw_score, convert):
         raw = np.asarray(raw_score)                        # [K, N]
@@ -305,7 +322,12 @@ class AucMuMetric(Metric):
                 sel = (idx == a) | (idx == b)
                 if sel.sum() == 0 or (idx[sel] == a).all() or (idx[sel] == b).all():
                     continue
-                s = raw[a, sel] - raw[b, sel]
+                # partition-weighted separating direction (reference:
+                # multiclass_metric.hpp:250-265; Kleiman & Page AUC-mu):
+                # v = W[a] - W[b], decision value (v[a]-v[b]) * (v . scores)
+                v = self.W[a] - self.W[b]
+                t1 = v[a] - v[b]
+                s = t1 * (v @ raw[:, sel])
                 y = (idx[sel] == a).astype(np.float64)
                 w = self.weight[sel] if self.weight is not None else None
                 aucs.append(_auc(y, s, w))

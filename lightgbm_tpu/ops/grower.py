@@ -74,6 +74,10 @@ class GrowerParams(NamedTuple):
     # constraints / per-node sampling (statics; defaults compile away)
     use_monotone: bool = False
     monotone_penalty: float = 0.0
+    # intermediate monotone method (reference: IntermediateLeafConstraints,
+    # monotone_constraints.hpp:516) — compact grower only; the masked
+    # grower keeps the basic method
+    mono_intermediate: bool = False
     path_smooth: float = 0.0
     use_interaction: bool = False
     bynode_fraction: float = 1.0
@@ -172,6 +176,8 @@ class GrowerState(NamedTuple):
     leaf_grad: jax.Array
     leaf_hess: jax.Array
     leaf_cnt: jax.Array
+    # lazy CEGB charged-rows bitmap [F, N] (dummy [1, 1] when off)
+    cegb_charged: jax.Array
     # per-leaf cached best splits
     bs_gain: jax.Array
     bs_feature: jax.Array
@@ -250,10 +256,19 @@ def grow_tree(
     extra_key: Optional[jax.Array] = None,     # PRNG key (extra_trees)
     feature_contri: Optional[jax.Array] = None,  # [F] gain multipliers
     forced: Optional[tuple] = None,   # (leaf[J], feature[J], bin[J]) arrays
+    cegb_lazy: Optional[jax.Array] = None,     # [F] tradeoff*lazy costs
+    cegb_charged0: Optional[jax.Array] = None,  # [F, N] bool (persisted)
 ):
-    """Grow one tree; returns (TreeArrays, row_leaf [N] i32)."""
+    """Grow one tree; returns (TreeArrays, row_leaf [N] i32), plus the
+    updated [F, N] charged-rows bitmap when ``cegb_lazy`` is set (lazy
+    feature penalties persist per (row, feature) across the whole model —
+    reference: feature_used_in_data_, cost_effective_gradient_boosting
+    .hpp:62,125)."""
     n, f = binned.shape
     L = params.num_leaves
+    use_lazy = cegb_lazy is not None
+    if use_lazy and cegb_charged0 is None:
+        cegb_charged0 = jnp.zeros((f, n), bool)
     B = params.num_bins
     ax = params.axis_name
     feat_info = (num_bins_arr, nan_bin_arr, has_nan_arr, is_cat_arr)
@@ -288,13 +303,16 @@ def grow_tree(
         extra_key = jax.random.PRNGKey(6)
     big = jnp.float32(3.4e38)
 
-    # batched best-split over the two fresh children (one fused scan)
+    # batched best-split over the two fresh children (one fused scan);
+    # cegb_pen is per-child [2, F] (lazy costs differ between children)
     def two_best_splits(h2, pg2, ph2, pc2, fm2, depth, cmin2, cmax2, pout2,
-                        cegb_pen, ek2):
-        fn = lambda h, pg, ph, pc, fm, cmn, cmx, po, ek: _leaf_best_split(
-            h, pg, ph, pc, feat_info, fm, depth, params, mono_types,
-            cmn, cmx, po, cegb_pen, ek, feature_contri)
-        return jax.vmap(fn)(h2, pg2, ph2, pc2, fm2, cmin2, cmax2, pout2, ek2)
+                        cegb_pen2, ek2):
+        fn = lambda h, pg, ph, pc, fm, cmn, cmx, po, pen, ek: \
+            _leaf_best_split(
+                h, pg, ph, pc, feat_info, fm, depth, params, mono_types,
+                cmn, cmx, po, pen, ek, feature_contri)
+        return jax.vmap(fn)(h2, pg2, ph2, pc2, fm2, cmin2, cmax2, pout2,
+                            cegb_pen2, ek2)
 
     # ---- root ----
     root_g = grad.sum()
@@ -311,11 +329,20 @@ def grow_tree(
     # path smoothing at the root smooths toward the root's own output
     # (reference: GetParentOutput, serial_tree_learner.cpp:1005-1016)
     root_out = leaf_output(root_g, root_h, params.split_params())
+    bag = (cnt_weight != 0.0).astype(jnp.float32)
+    if use_lazy:
+        # on-demand (lazy) feature costs: penalty * bagged rows of the leaf
+        # not yet charged for the feature (reference:
+        # CalculateOndemandCosts, cost_effective_gradient_boosting.hpp:139)
+        u_root = jnp.logical_not(cegb_charged0).astype(jnp.float32) @ bag
+        pen_root = (cegb_coupled * jnp.logical_not(cegb_used0)
+                    + cegb_lazy * u_root)
+    else:
+        pen_root = cegb_coupled * jnp.logical_not(cegb_used0)
     sp0 = _leaf_best_split(
         root_hist, root_g, root_h, root_c, feat_info, root_fm,
         jnp.asarray(0, jnp.int32), params, mono_types,
-        -big, big, root_out,
-        cegb_coupled * jnp.logical_not(cegb_used0),
+        -big, big, root_out, pen_root,
         jax.random.fold_in(extra_key, 0), feature_contri,
     )
 
@@ -324,6 +351,8 @@ def grow_tree(
     leaf_hist0 = jnp.zeros((L, f, B, 3), jnp.float32).at[0].set(root_hist)
     st = GrowerState(
         done=jnp.asarray(False),
+        cegb_charged=(cegb_charged0 if use_lazy
+                      else jnp.zeros((1, 1), bool)),
         num_nodes=jnp.asarray(0, i32),
         row_leaf=jnp.zeros((n,), i32),
         leaf_hist=leaf_hist0,
@@ -522,6 +551,16 @@ def grow_tree(
         leaf_used = leaf_used.at[new_leaf].set(
             jnp.where(applied, used_child, leaf_used[new_leaf]))
         cegb_used = st.cegb_used | (applied & (jnp.arange(f) == f_))
+        if use_lazy:
+            # charge every bagged row of the parent for the split feature
+            # (reference: UpdateLeafBestSplits runs BEFORE the partition,
+            # serial_tree_learner.cpp:768 — the parent's full row set)
+            in_parent = ((row_leaf == best_leaf) | (row_leaf == new_leaf)) \
+                & (cnt_weight != 0.0)
+            cegb_charged = st.cegb_charged.at[f_].set(
+                st.cegb_charged[f_] | (applied & in_parent))
+        else:
+            cegb_charged = st.cegb_charged
 
         # ---- children histograms + best splits (skipped when done) ----
         bs_arrays = (st.leaf_hist, st.bs_gain, st.bs_feature, st.bs_bin,
@@ -562,12 +601,23 @@ def grow_tree(
             fm_r = node_feature_mask(
                 feat_mask, used_child, inter_sets,
                 jax.random.fold_in(bynode_key, 2 * k + 2), params)
+            pen_base = cegb_coupled * jnp.logical_not(cegb_used)
+            if use_lazy:
+                unch = jnp.logical_not(cegb_charged).astype(jnp.float32)
+                bagm = cnt_weight != 0.0
+                u_l = unch @ ((row_leaf == best_leaf) & bagm) \
+                    .astype(jnp.float32)
+                u_r = unch @ ((row_leaf == new_leaf) & bagm) \
+                    .astype(jnp.float32)
+                pen2 = jnp.stack([pen_base + cegb_lazy * u_l,
+                                  pen_base + cegb_lazy * u_r])
+            else:
+                pen2 = jnp.stack([pen_base, pen_base])
             sp = two_best_splits(
                 h2, jnp.stack([lg, rg]), jnp.stack([lh, rh]),
                 jnp.stack([lc, rc]), jnp.stack([fm_l, fm_r]), d_child,
                 jnp.stack([cmin_l, cmin_r]), jnp.stack([cmax_l, cmax_r]),
-                jnp.stack([lw, rw]),
-                cegb_coupled * jnp.logical_not(cegb_used),
+                jnp.stack([lw, rw]), pen2,
                 jnp.stack([jax.random.fold_in(extra_key, 2 * k + 1),
                            jax.random.fold_in(extra_key, 2 * k + 2)]))
             bs_gain = bs_gain.at[best_leaf].set(sp.gain[0]).at[new_leaf].set(sp.gain[1])
@@ -590,6 +640,7 @@ def grow_tree(
 
         return GrowerState(
             done=done,
+            cegb_charged=cegb_charged,
             num_nodes=st.num_nodes + jnp.where(applied, 1, 0).astype(i32),
             row_leaf=row_leaf,
             leaf_hist=leaf_hist,
@@ -649,4 +700,6 @@ def grow_tree(
         num_leaves=st.num_nodes + 1,
         num_nodes=st.num_nodes,
     )
+    if use_lazy:
+        return tree, st.row_leaf, st.cegb_charged
     return tree, st.row_leaf

@@ -63,6 +63,13 @@ class CompactState(NamedTuple):
     leaf_side: jnp.ndarray   # [L] i32 residency array of each segment
     #                          (0 = work, 1 = scratch; fused path only —
     #                          dual residency, ops/fused_split.py)
+    # intermediate monotone method state (dummies when off; reference:
+    # IntermediateLeafConstraints, monotone_constraints.hpp:516)
+    leaf_in_mono: jnp.ndarray   # [L] bool: leaf under a monotone split
+    node_parent: jnp.ndarray    # [L-1] i32 parent node (-1 = root)
+    node_is_cat: jnp.ndarray    # [L-1] bool categorical split
+    leaf_fmask: jnp.ndarray     # [L, F_scan] bool: scan-time feature masks
+    #                             (rescans must reuse the original draw)
     # tree arrays under construction
     split_feature: jnp.ndarray
     split_bin: jnp.ndarray
@@ -224,6 +231,15 @@ def grow_tree_compact(
         leaf_nrows_g=(jnp.zeros((L,), i32).at[0].set(n_g) if ax
                       else jnp.zeros((1,), i32)),
         leaf_side=jnp.zeros((L,), i32),
+        leaf_in_mono=(jnp.zeros((L,), bool) if params.mono_intermediate
+                      else jnp.zeros((1,), bool)),
+        node_parent=(jnp.full((L - 1,), -1, i32) if params.mono_intermediate
+                     else jnp.zeros((1,), i32)),
+        node_is_cat=(jnp.zeros((L - 1,), bool) if params.mono_intermediate
+                     else jnp.zeros((1,), bool)),
+        leaf_fmask=(jnp.zeros((L, F_scan), bool).at[0].set(root_fm)
+                    if params.mono_intermediate
+                    else jnp.zeros((1, 1), bool)),
         split_feature=jnp.full((L - 1,), -1, i32),
         split_bin=jnp.zeros((L - 1,), i32),
         cat_bitset=jnp.zeros((L - 1, W), jnp.uint32),
@@ -359,12 +375,30 @@ def grow_tree_compact(
         iscat_split = is_cat_arr[f_]
         if params.use_monotone:
             mt = mono_types[f_].astype(jnp.int32)
-            mid = 0.5 * (lw + rw)
             act = applied & jnp.logical_not(iscat_split)
-            cmax_l = jnp.where(act & (mt > 0), jnp.minimum(cmaxp, mid), cmaxp)
-            cmin_l = jnp.where(act & (mt < 0), jnp.maximum(cminp, mid), cminp)
-            cmin_r = jnp.where(act & (mt > 0), jnp.maximum(cminp, mid), cminp)
-            cmax_r = jnp.where(act & (mt < 0), jnp.minimum(cmaxp, mid), cmaxp)
+            if params.mono_intermediate:
+                # intermediate method: children bound by the SIBLING's
+                # actual output, not the midpoint (reference:
+                # UpdateConstraintsWithOutputs, monotone_constraints
+                # .hpp:546-560)
+                cmax_l = jnp.where(act & (mt > 0),
+                                   jnp.minimum(cmaxp, rw), cmaxp)
+                cmin_l = jnp.where(act & (mt < 0),
+                                   jnp.maximum(cminp, rw), cminp)
+                cmin_r = jnp.where(act & (mt > 0),
+                                   jnp.maximum(cminp, lw), cminp)
+                cmax_r = jnp.where(act & (mt < 0),
+                                   jnp.minimum(cmaxp, lw), cmaxp)
+            else:
+                mid = 0.5 * (lw + rw)
+                cmax_l = jnp.where(act & (mt > 0),
+                                   jnp.minimum(cmaxp, mid), cmaxp)
+                cmin_l = jnp.where(act & (mt < 0),
+                                   jnp.maximum(cminp, mid), cminp)
+                cmin_r = jnp.where(act & (mt > 0),
+                                   jnp.maximum(cminp, mid), cminp)
+                cmax_r = jnp.where(act & (mt < 0),
+                                   jnp.minimum(cmaxp, mid), cmaxp)
         else:
             cmax_l = cmax_r = cmaxp
             cmin_l = cmin_r = cminp
@@ -523,6 +557,212 @@ def grow_tree_compact(
             bs_catl2 = bs_catl2.at[leaf].set(
                 jnp.where(applied, sp.is_cat_l2, bs_catl2[leaf]))
 
+        if params.mono_intermediate:
+            # ---- intermediate monotone: tighten contiguous leaves ----
+            # (reference: IntermediateLeafConstraints::Update +
+            # GoUpToFindLeavesToUpdate / GoDownToFindLeavesToUpdate,
+            # src/treelearner/monotone_constraints.hpp:560-858). Walk up
+            # from the new split; at every monotone ancestor whose opposite
+            # branch is still contiguous, walk down it and clamp each
+            # contiguous leaf's bound against the new children's ACTUAL
+            # outputs; leaves whose bounds changed get their cached best
+            # split recomputed (it may now violate the tighter bound).
+            mono_i32 = mono_types.astype(i32)
+            mt_i = mono_i32[f_]
+            in_mono_here = jnp.logical_or(mt_i != 0,
+                                          st.leaf_in_mono[best_leaf])
+            eff = jnp.logical_and(applied, in_mono_here)
+            leaf_in_mono = st.leaf_in_mono.at[best_leaf].set(
+                jnp.where(applied, in_mono_here,
+                          st.leaf_in_mono[best_leaf]))
+            leaf_in_mono = leaf_in_mono.at[new_leaf].set(
+                jnp.where(applied, in_mono_here, leaf_in_mono[new_leaf]))
+            node_parent = st.node_parent.at[node].set(
+                jnp.where(applied, p, st.node_parent[node]))
+            node_is_cat = st.node_is_cat.at[node].set(
+                jnp.where(applied, iscat_split, st.node_is_cat[node]))
+            leaf_fmask = st.leaf_fmask.at[best_leaf].set(
+                jnp.where(applied, fm_l, st.leaf_fmask[best_leaf]))
+            leaf_fmask = leaf_fmask.at[new_leaf].set(
+                jnp.where(applied, fm_r, leaf_fmask[new_leaf]))
+
+            arangeL = jnp.arange(L, dtype=i32)
+            thr_split = b_
+            lo_out = jnp.minimum(lw, rw)
+            hi_out = jnp.maximum(lw, rw)
+
+            def up_cond(c):
+                return c[1] >= 0
+
+            def up_body(c):
+                (cur, par, d, n_pend, feats_u, thrs_u, wasr_u, pend_root,
+                 pend_umax, pend_d) = c
+                pf = split_feature[par]
+                pt = split_bin[par]
+                p_num = jnp.logical_not(node_is_cat[par])
+                mt_p = mono_i32[pf]
+                is_right = right_child[par] == cur
+                # contiguity optimization: a second climb on the same side
+                # of the same feature cannot reach new contiguous leaves
+                clash = jnp.any((feats_u == pf) & (wasr_u == is_right)
+                                & (arangeL < d))
+                opp_should = p_num & jnp.logical_not(clash)
+                do_pend = opp_should & (mt_p != 0)
+                left_is_cur = left_child[par] == cur
+                opp = jnp.where(left_is_cur, right_child[par],
+                                left_child[par])
+                umax = jnp.where(mt_p < 0, left_is_cur,
+                                 jnp.logical_not(left_is_cur))
+                ip = jnp.minimum(n_pend, L - 1)
+                pend_root = pend_root.at[ip].set(
+                    jnp.where(do_pend, opp, pend_root[ip]))
+                pend_umax = pend_umax.at[ip].set(
+                    jnp.where(do_pend, umax, pend_umax[ip]))
+                pend_d = pend_d.at[ip].set(
+                    jnp.where(do_pend, d, pend_d[ip]))
+                n_pend = n_pend + do_pend.astype(i32)
+                idx = jnp.minimum(d, L - 1)
+                feats_u = feats_u.at[idx].set(
+                    jnp.where(opp_should, pf, feats_u[idx]))
+                thrs_u = thrs_u.at[idx].set(
+                    jnp.where(opp_should, pt, thrs_u[idx]))
+                wasr_u = wasr_u.at[idx].set(
+                    jnp.where(opp_should, is_right, wasr_u[idx]))
+                d = d + opp_should.astype(i32)
+                return (par, node_parent[par], d, n_pend, feats_u, thrs_u,
+                        wasr_u, pend_root, pend_umax, pend_d)
+
+            up0 = (node, jnp.where(eff, p, jnp.asarray(-1, i32)),
+                   jnp.asarray(0, i32), jnp.asarray(0, i32),
+                   jnp.full((L,), -1, i32), jnp.zeros((L,), i32),
+                   jnp.zeros((L,), bool), jnp.zeros((L,), i32),
+                   jnp.zeros((L,), bool), jnp.zeros((L,), i32))
+            (_, _, _, n_pend, feats_u, thrs_u, wasr_u, pend_root,
+             pend_umax, pend_d) = lax.while_loop(up_cond, up_body, up0)
+
+            def down_one(j, carry):
+                lcm0, lcx0, rs0 = carry
+                dj = pend_d[j]
+                umax = pend_umax[j]
+                mask_u = arangeL < dj
+
+                def d_cond(s):
+                    return s[0] > 0
+
+                def d_body(s):
+                    sp_, st_n, st_ul, st_ur, lcm, lcx, rs = s
+                    sp_ = sp_ - 1
+                    nd = st_n[sp_]
+                    ul = st_ul[sp_]
+                    ur = st_ur[sp_]
+                    is_leaf = nd < 0
+                    leafi = jnp.maximum(-(nd + 1), 0)
+                    both = jnp.logical_and(ul, ur)
+                    # update_max clamps with the SMALLER contiguous output,
+                    # update_min with the larger (reference minmax pair)
+                    bnd_max = jnp.where(both, lo_out, jnp.where(ur, rw, lw))
+                    bnd_min = jnp.where(both, hi_out, jnp.where(ur, rw, lw))
+                    gain_ok = bs_gain[leafi] > _NEG_INF / 2
+                    newmax = jnp.minimum(lcx[leafi], bnd_max)
+                    newmin = jnp.maximum(lcm[leafi], bnd_min)
+                    chg = jnp.where(umax, newmax < lcx[leafi],
+                                    newmin > lcm[leafi])
+                    upd = is_leaf & gain_ok
+                    lcx = lcx.at[leafi].set(
+                        jnp.where(upd & umax, newmax, lcx[leafi]))
+                    lcm = lcm.at[leafi].set(
+                        jnp.where(upd & jnp.logical_not(umax), newmin,
+                                  lcm[leafi]))
+                    rs = rs.at[leafi].set(rs[leafi] | (upd & chg))
+                    ndi = jnp.maximum(nd, 0)
+                    nf_n = split_feature[ndi]
+                    nt_n = split_bin[ndi]
+                    n_num = jnp.logical_not(node_is_cat[ndi])
+                    same = (feats_u == nf_n) & mask_u
+                    kg_r = jnp.logical_not(jnp.any(
+                        same & (nt_n >= thrs_u)
+                        & jnp.logical_not(wasr_u))) | jnp.logical_not(n_num)
+                    kg_l = jnp.logical_not(jnp.any(
+                        same & (nt_n <= thrs_u) & wasr_u)) \
+                        | jnp.logical_not(n_num)
+                    ul4r = jnp.logical_not(n_num & (nf_n == f_)
+                                           & (nt_n >= thr_split))
+                    ur4l = jnp.logical_not(n_num & (nf_n == f_)
+                                           & (nt_n <= thr_split))
+                    push_l = jnp.logical_not(is_leaf) & kg_l
+                    st_n = st_n.at[sp_].set(
+                        jnp.where(push_l, left_child[ndi], st_n[sp_]))
+                    st_ul = st_ul.at[sp_].set(
+                        jnp.where(push_l, ul, st_ul[sp_]))
+                    st_ur = st_ur.at[sp_].set(
+                        jnp.where(push_l, ur & ur4l, st_ur[sp_]))
+                    sp_ = sp_ + push_l.astype(i32)
+                    push_r = jnp.logical_not(is_leaf) & kg_r
+                    st_n = st_n.at[sp_].set(
+                        jnp.where(push_r, right_child[ndi], st_n[sp_]))
+                    st_ul = st_ul.at[sp_].set(
+                        jnp.where(push_r, ul & ul4r, st_ul[sp_]))
+                    st_ur = st_ur.at[sp_].set(
+                        jnp.where(push_r, ur, st_ur[sp_]))
+                    sp_ = sp_ + push_r.astype(i32)
+                    return (sp_, st_n, st_ul, st_ur, lcm, lcx, rs)
+
+                out = lax.while_loop(
+                    d_cond, d_body,
+                    (jnp.asarray(1, i32),
+                     jnp.zeros((2 * L,), i32).at[0].set(pend_root[j]),
+                     jnp.zeros((2 * L,), bool).at[0].set(True),
+                     jnp.zeros((2 * L,), bool).at[0].set(True),
+                     lcm0, lcx0, rs0))
+                return out[4], out[5], out[6]
+
+            leaf_cmin, leaf_cmax, resc = lax.fori_loop(
+                0, n_pend, down_one,
+                (leaf_cmin, leaf_cmax, jnp.zeros((L,), bool)))
+
+            # rescan every leaf whose bounds tightened — its cached split
+            # may now be invalid (reference: leaves_to_update_ re-entering
+            # FindBestSplitsFromHistograms)
+            pen_cur = cegb_coupled * jnp.logical_not(cegb_used)
+
+            def rescan_body(i, carry):
+                (g_a, f_a, b_a, d_a, lg_a, lh_a, lc_a, lr_a, bb_a,
+                 cl_a, cmn_a, cmx_a) = carry
+
+                def do(_):
+                    sp = leaf_best(
+                        leaf_hist[i].reshape(F, B, 4), leaf_grad[i],
+                        leaf_hess[i], leaf_cnt[i], leaf_depth[i],
+                        leaf_fmask[i], cmn_a[i], cmx_a[i], leaf_pout[i],
+                        pen_cur, jax.random.fold_in(extra_key, 3 * L + i))
+                    return (sp.gain, sp.feature, sp.bin, sp.default_left,
+                            sp.left_grad, sp.left_hess, sp.left_count,
+                            sp.left_rows.astype(i32), sp.cat_bitset,
+                            sp.is_cat_l2)
+
+                def dont(_):
+                    return (g_a[i], f_a[i], b_a[i], d_a[i], lg_a[i],
+                            lh_a[i], lc_a[i], lr_a[i], bb_a[i], cl_a[i])
+
+                vals = lax.cond(resc[i], do, dont, 0)
+                return (g_a.at[i].set(vals[0]), f_a.at[i].set(vals[1]),
+                        b_a.at[i].set(vals[2]), d_a.at[i].set(vals[3]),
+                        lg_a.at[i].set(vals[4]), lh_a.at[i].set(vals[5]),
+                        lc_a.at[i].set(vals[6]), lr_a.at[i].set(vals[7]),
+                        bb_a.at[i].set(vals[8]), cl_a.at[i].set(vals[9]),
+                        cmn_a, cmx_a)
+
+            (bs_gain, bs_feature, bs_bin, bs_dl, bs_lg, bs_lh, bs_lc,
+             bs_lr, bs_bits, bs_catl2, leaf_cmin, leaf_cmax) = lax.fori_loop(
+                0, L, rescan_body,
+                (bs_gain, bs_feature, bs_bin, bs_dl, bs_lg, bs_lh, bs_lc,
+                 bs_lr, bs_bits, bs_catl2, leaf_cmin, leaf_cmax))
+        else:
+            leaf_in_mono = st.leaf_in_mono
+            node_parent = st.node_parent
+            node_is_cat = st.node_is_cat
+            leaf_fmask = st.leaf_fmask
+
         return CompactState(
             done=done,
             num_nodes=st.num_nodes + jnp.where(applied, 1, 0).astype(i32),
@@ -566,6 +806,10 @@ def grow_tree_compact(
             leaf_used=leaf_used,
             leaf_pout=leaf_pout,
             cegb_used=cegb_used,
+            leaf_in_mono=leaf_in_mono,
+            node_parent=node_parent,
+            node_is_cat=node_is_cat,
+            leaf_fmask=leaf_fmask,
         )
 
     st = lax.fori_loop(0, L - 1, body, st)

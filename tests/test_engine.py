@@ -431,6 +431,38 @@ class TestConstraints:
         grid = np.column_stack([np.linspace(0, 1, 200), np.zeros(200)])
         assert (np.diff(bst.predict(grid)) <= 1e-9).all()
 
+    def test_monotone_intermediate(self):
+        # intermediate method (reference: IntermediateLeafConstraints,
+        # monotone_constraints.hpp:516): sibling-output bounds + the
+        # contiguous-leaf walk must keep monotonicity while fitting better
+        # than the conservative basic method
+        import lightgbm_tpu as lgb
+        rng = np.random.RandomState(0)
+        n = 8000
+        X = rng.randn(n, 4).astype(np.float32)
+        y = (2.0 * X[:, 0] - 1.5 * X[:, 1]
+             + 0.5 * np.sin(3 * X[:, 2]) + 0.3 * rng.randn(n))
+        base = {"objective": "regression", "verbosity": -1,
+                "num_leaves": 31, "tpu_grower": "compact",
+                "monotone_constraints": [1, -1, 0, 0],
+                "min_data_in_leaf": 20}
+        mse = {}
+        for meth in ("basic", "intermediate"):
+            bst = lgb.train(dict(base, monotone_constraints_method=meth),
+                            lgb.Dataset(X, label=y), 25)
+            probe = np.tile(X[:40], (21, 1, 1))
+            sweep = np.linspace(-3, 3, 21)
+            for f, sign in ((0, 1), (1, -1)):
+                pv = probe.copy()
+                pv[:, :, f] = sweep[:, None]
+                pr = bst.predict(pv.reshape(-1, 4)).reshape(21, 40)
+                assert (sign * np.diff(pr, axis=0) >= -1e-9).all(), \
+                    (meth, f)
+            mse[meth] = float(np.mean((bst.predict(X) - y) ** 2))
+        # the whole point of the intermediate method: tighter-but-valid
+        # bounds recover accuracy the basic method gives up
+        assert mse["intermediate"] <= mse["basic"] + 1e-9, mse
+
     def test_interaction_constraints(self):
         import lightgbm_tpu as lgb
         from tests.utils import FAST_PARAMS, regression_data
@@ -563,6 +595,35 @@ class TestCEGB:
         assert nfeat(pen) < nfeat(plain)
         # still learns with the features it pays for
         assert np.mean((pen.predict(X) - y) ** 2) < np.var(y)
+
+    def test_lazy_penalty_charges_rows_once(self):
+        # reference: CalculateOndemandCosts / feature_used_in_data_,
+        # cost_effective_gradient_boosting.hpp:139,125 — per-(row, feature)
+        # costs paid once; heavy penalties concentrate the model on free
+        # features, near-zero penalties change nothing
+        import lightgbm_tpu as lgb
+        rng = np.random.RandomState(0)
+        n = 5000
+        X = rng.randn(n, 6).astype(np.float32)
+        y = (X[:, 0] + 0.8 * X[:, 1] + 0.5 * X[:, 2]
+             + 0.5 * rng.randn(n) > 0).astype(np.float64)
+        base = {"objective": "binary", "verbosity": -1, "num_leaves": 15,
+                "min_data_in_leaf": 20}
+
+        def nfeat(bst):
+            return int((bst.feature_importance(
+                importance_type="split") > 0).sum())
+
+        plain = lgb.train(base, lgb.Dataset(X, label=y), 8)
+        pen = lgb.train(dict(base, cegb_tradeoff=1.0,
+                             cegb_penalty_feature_lazy=[0.0] + [5.0] * 5),
+                        lgb.Dataset(X, label=y), 8)
+        assert nfeat(pen) < nfeat(plain)
+        tiny = lgb.train(dict(base, cegb_tradeoff=1.0,
+                              cegb_penalty_feature_lazy=[1e-9] * 6),
+                         lgb.Dataset(X, label=y), 8)
+        np.testing.assert_allclose(tiny.predict(X), plain.predict(X),
+                                   atol=1e-5)
 
     def test_split_penalty_prunes(self):
         import lightgbm_tpu as lgb
