@@ -541,13 +541,16 @@ class GBDT:
                 raise ValueError(
                     "cegb_penalty_feature_lazy must have one entry per "
                     f"feature ({nf}), got {lz.size}")
-            # on-demand (lazy) per-row feature costs; the charged-rows
-            # bitmap costs F*N bytes on device, so bound it
-            if nf * self.num_data > (1 << 32):
+            # on-demand (lazy) per-row feature costs: the [F, N] bool
+            # bitmap plus its transient f32 cast in the per-split matvec
+            # cost ~5 bytes per element on device — bound well inside HBM
+            nf_pad = nf + self._f_pad
+            if nf_pad * self.num_data > (1 << 30):
                 raise ValueError(
                     "cegb_penalty_feature_lazy needs an [F, N] charged-rows "
-                    f"bitmap; {nf}x{self.num_data} exceeds the supported "
-                    "size")
+                    f"bitmap (~5 bytes/element transient); "
+                    f"{nf_pad}x{self.num_data} exceeds the supported size "
+                    "(2^30 elements)")
             self._cegb_lazy = jnp.asarray(
                 fpad(tradeoff * lz, 0.0)) if self._f_pad else \
                 jnp.asarray(tradeoff * lz)
@@ -877,6 +880,18 @@ class GBDT:
         self._cx_weight = k + gcols + 1 if has_w else None
         self._cx_rowid = e - 1
         gp = self.grower_params
+        if gp.fused_block and gp.efb_virtual:
+            # KNOWN ISSUE: the fused Mosaic kernel faults the TPU worker on
+            # EFB-bundled datasets with deep trees (reproduced at F=532
+            # bundle columns, bs=64, num_leaves=255; dense wide records and
+            # small trees are fine, and the kernel passes standalone stress
+            # at the same shape — the trigger needs the full grower
+            # context). Until root-caused, bundled datasets use the XLA
+            # compact walk.
+            log.warning("fused kernel disabled for EFB-bundled datasets "
+                        "(known TPU fault); using the XLA compact walk")
+            gp = gp._replace(fused_block=0)
+            self.grower_params = gp
         if gp.fused_block:
             # kernel scoped-VMEM buffers scale with block_size * num_cols
             # and the histogram accumulator with num_cols * num_bins; scale

@@ -49,10 +49,10 @@ def _require_dask():
 def _wrap_array(out):
     try:
         import dask.array as da
-        import numpy as np
-        return da.from_array(np.asarray(out))
-    except Exception:  # pragma: no cover - dask missing mid-flight
+    except ImportError:  # pragma: no cover - dask missing mid-flight
         return out
+    import numpy as np
+    return da.from_array(np.asarray(out))
 
 
 class _DaskMixin:

@@ -89,7 +89,16 @@ def _load_with_plugin(path: str, has_header: bool, parser_config_file: str,
     drop = []
 
     def idx_of(spec):
-        return int(spec) if str(spec).strip() != "" else None
+        spec = str(spec).strip()
+        if spec == "":
+            return None
+        if not spec.lstrip("-").isdigit():
+            # custom parsers produce unnamed columns; name-based specs
+            # cannot resolve here (_parse_column_spec needs a header)
+            raise ValueError(
+                f"column spec {spec!r} is not supported with a custom "
+                "parser; use a 0-based column index")
+        return int(spec)
 
     wi = idx_of(weight_column)
     gi = idx_of(group_column)
@@ -104,8 +113,9 @@ def _load_with_plugin(path: str, has_header: bool, parser_config_file: str,
         group = np.diff(bounds).astype(np.int64)
         drop.append(gi)
     for spec in str(ignore_column).split(","):
-        if spec.strip() != "":
-            drop.append(int(spec))
+        j = idx_of(spec)
+        if j is not None:
+            drop.append(j)
     if drop:
         keep = [j for j in range(X.shape[1]) if j not in set(drop)]
         X = X[:, keep]

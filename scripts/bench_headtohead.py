@@ -102,12 +102,25 @@ def _train(side, shape, params, Xtr, ytr, Xho, group=None):
     return bst, ITERS / dt, warm, pred
 
 
+def _flush(out):
+    # write after every shape: a crash (e.g. the TPU tunnel restarting
+    # mid-run) must not lose completed measurements
+    path = os.path.join(ROOT, "BENCH_COMPARE.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=1, sort_keys=True)
+
+
 def main():
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                           os.path.join(ROOT, ".jax_bench_cache"))
     shapes = os.environ.get("H2H_SHAPES", "higgs,sparse,ranking").split(",")
-    out = {"host_cpus": os.cpu_count(), "iters": ITERS, "leaves": LEAVES,
+    out = {"host_cpus": os.cpu_count(), "leaves": LEAVES,
            "bins": BINS, "shapes": {}}
+    path = os.path.join(ROOT, "BENCH_COMPARE.json")
+    if os.path.exists(path):
+        with open(path) as fh:
+            prev = json.load(fh)
+        out["shapes"].update(prev.get("shapes", {}))
     base = {"objective": "binary", "num_leaves": LEAVES, "max_bin": BINS,
             "learning_rate": 0.1, "verbose": -1, "min_data_in_leaf": 100}
 
@@ -124,7 +137,9 @@ def main():
             print(f"higgs {side}: {res[side]}", flush=True)
         res["auc_delta"] = round(res["tpu"]["holdout_auc"]
                                  - res["ref"]["holdout_auc"], 6)
-        out["shapes"]["higgs"] = {"rows": n, "features": 28, **res}
+        out["shapes"]["higgs"] = {"rows": n, "features": 28,
+                                   "iters": ITERS, **res}
+        _flush(out)
 
     if "sparse" in shapes:
         n = int(float(os.environ.get("H2H_SPARSE_ROWS", 500_000)))
@@ -140,7 +155,8 @@ def main():
         res["auc_delta"] = round(res["tpu"]["holdout_auc"]
                                  - res["ref"]["holdout_auc"], 6)
         out["shapes"]["sparse"] = {"rows": n, "features": Xtr.shape[1],
-                                   **res}
+                                   "iters": ITERS, **res}
+        _flush(out)
 
     if "ranking" in shapes:
         n = int(float(os.environ.get("H2H_RANK_ROWS", 2_270_000)))
@@ -161,11 +177,11 @@ def main():
         res["ndcg_delta"] = round(res["tpu"]["holdout_ndcg10"]
                                   - res["ref"]["holdout_ndcg10"], 6)
         out["shapes"]["ranking"] = {"rows": len(ytr),
-                                    "features": Xtr.shape[1], **res}
+                                    "features": Xtr.shape[1],
+                                    "iters": ITERS, **res}
+        _flush(out)
 
-    path = os.path.join(ROOT, "BENCH_COMPARE.json")
-    with open(path, "w") as fh:
-        json.dump(out, fh, indent=1, sort_keys=True)
+    _flush(out)
     print(json.dumps(out))
 
 

@@ -204,10 +204,10 @@ class TestConfigWarnings:
         _log.set_verbosity(1)  # earlier tests may have silenced warnings
         with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
             Config({"two_round": True,
-                    "parser_config_file": "p.conf"})
+                    "pre_partition": True})
         text = caplog.text
         for name in ("two_round",
-                     "parser_config_file"):
+                     "pre_partition"):
             assert f"{name}=" in text and "NOT implemented" in text, \
                 f"no warning for {name}: {text!r}"
 
