@@ -348,6 +348,8 @@ class _ValidSet:
 
 
 class GBDT:
+    _supports_lazy_cegb = True
+
     """Gradient Boosted Decision Trees (reference: class GBDT, gbdt.h)."""
 
     boosting_type = "gbdt"
@@ -535,6 +537,13 @@ class GBDT:
         split_pen = float(cfg.get("cegb_penalty_split", 0.0))
         self._use_cegb = split_pen > 0.0 or coupled is not None
         lazy = cfg.get("cegb_penalty_feature_lazy")
+        if lazy is not None and not self._supports_lazy_cegb:
+            # RF (and any other subclass that opts out) must decline BEFORE
+            # the bitmap size check / EFB precheck act on the parameter
+            log.warning("cegb_penalty_feature_lazy is not supported with "
+                        f"boosting={self.boosting_type}; the lazy penalty "
+                        "is ignored")
+            lazy = None
         if lazy is not None:
             lz = np.asarray(_vec(lazy), np.float32)
             if lz.size != nf:
@@ -701,7 +710,8 @@ class GBDT:
             and not bool(cfg.get("bagging_by_query", False))
             # lazy CEGB tracks charged rows in ORIGINAL row order; the
             # compact grower permutes rows, so it runs masked
-            and cfg.get("cegb_penalty_feature_lazy") is None
+            and (cfg.get("cegb_penalty_feature_lazy") is None
+                 or not self._supports_lazy_cegb)
         )
         if grower == "compact" and not can_compact:
             log.warning("tpu_grower=compact requires a serial learner and a "
@@ -1435,7 +1445,8 @@ class GBDT:
             and cfg.get("feature_contri") is None
             and float(cfg.get("cegb_penalty_split", 0) or 0) == 0.0
             and cfg.get("cegb_penalty_feature_coupled") is None
-            and cfg.get("cegb_penalty_feature_lazy") is None)
+            and (cfg.get("cegb_penalty_feature_lazy") is None
+                 or not self._supports_lazy_cegb))
         if compact_possible and knobs_ok:
             return
         log.warning(

@@ -16,6 +16,8 @@ from .gbdt import GBDT, HostTree
 
 
 class RF(GBDT):
+    _supports_lazy_cegb = False
+
     boosting_type = "rf"
     average_output = True
 
@@ -27,11 +29,6 @@ class RF(GBDT):
                     "Random forest needs bagging (bagging_freq > 0 and "
                     "0 < bagging_fraction < 1) and/or feature_fraction < 1")
         super().__init__(config, train_set, objective)
-        if self._cegb_lazy is not None:
-            log.warning("cegb_penalty_feature_lazy is not "
-                        "supported with boosting=rf; the lazy "
-                        "penalty is ignored")
-            self._cegb_lazy = None
         self.shrinkage_rate = 1.0
         self._const_grad = None
 

@@ -92,12 +92,12 @@ def _load_with_plugin(path: str, has_header: bool, parser_config_file: str,
         spec = str(spec).strip()
         if spec == "":
             return None
-        if not spec.lstrip("-").isdigit():
-            # custom parsers produce unnamed columns; name-based specs
-            # cannot resolve here (_parse_column_spec needs a header)
+        if not spec.isdigit():
+            # custom parsers produce unnamed columns; name-based (and
+            # negative) specs cannot resolve here
             raise ValueError(
                 f"column spec {spec!r} is not supported with a custom "
-                "parser; use a 0-based column index")
+                "parser; use a non-negative 0-based column index")
         return int(spec)
 
     wi = idx_of(weight_column)

@@ -608,6 +608,18 @@ class LambdarankNDCG(Objective):
         # lambda applies to the HIGHER-labeled doc of the pair
         i_high = g_i > g_j
         ds_high = jnp.where(i_high, s_i - s_j, s_j - s_i)
+        if self.norm:
+            # score-distance regularization (reference: "regular the
+            # delta_pair_NDCG by score distance",
+            # rank_objective.hpp:242-244): applied when the query's best
+            # and worst scores differ
+            n_valid = jnp.sum(m_s.astype(jnp.int32), axis=1)
+            best = s_s[:, 0]
+            worst = jnp.take_along_axis(
+                s_s, jnp.maximum(n_valid - 1, 0)[:, None], axis=1)[:, 0]
+            delta_ndcg = jnp.where(
+                (best != worst)[:, None, None],
+                delta_ndcg / (0.01 + jnp.abs(ds_high)), delta_ndcg)
         p = jax.nn.sigmoid(sig * ds_high)
         lam_h = sig * (p - 1.0) * delta_ndcg           # <= 0, on higher doc
         hes = sig * sig * p * (1.0 - p) * delta_ndcg

@@ -106,8 +106,10 @@ def _flush(out):
     # write after every shape: a crash (e.g. the TPU tunnel restarting
     # mid-run) must not lose completed measurements
     path = os.path.join(ROOT, "BENCH_COMPARE.json")
-    with open(path, "w") as fh:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
         json.dump(out, fh, indent=1, sort_keys=True)
+    os.replace(tmp, path)
 
 
 def main():
@@ -118,9 +120,12 @@ def main():
            "bins": BINS, "shapes": {}}
     path = os.path.join(ROOT, "BENCH_COMPARE.json")
     if os.path.exists(path):
-        with open(path) as fh:
-            prev = json.load(fh)
-        out["shapes"].update(prev.get("shapes", {}))
+        try:
+            with open(path) as fh:
+                prev = json.load(fh)
+            out["shapes"].update(prev.get("shapes", {}))
+        except ValueError:
+            pass  # truncated file from a crashed run; start fresh
     base = {"objective": "binary", "num_leaves": LEAVES, "max_bin": BINS,
             "learning_rate": 0.1, "verbose": -1, "min_data_in_leaf": 100}
 
