@@ -17,6 +17,7 @@ Run from the repo root:  python scripts/gen_interop_goldens.py
 import json
 import os
 import sys
+import zlib
 
 import numpy as np
 
@@ -66,11 +67,24 @@ def _categorical_case(rng):
                   "min_data_per_group": 10, "cat_smooth": 2.0}
 
 
+def _ranking_case(rng):
+    n, q = 1000, 20
+    X = rng.randn(n, 5)
+    w = rng.randn(5) * 0.6
+    sc = X @ w + rng.randn(n)
+    y = np.clip(np.digitize(sc, [-1.0, 0.3, 1.2, 2.2]), 0, 4).astype(
+        np.float64)
+    return X, y, {"objective": "lambdarank", "metric": "ndcg",
+                  "group": np.full(n // q, q, np.int64),
+                  "lambdarank_truncation_level": 15}
+
+
 CASES = {
     "binary_nan": _binary_case,
     "regression": _regression_case,
     "multiclass": _multiclass_case,
     "categorical": _categorical_case,
+    "ranking": _ranking_case,
 }
 
 BASE = {"verbosity": -1, "num_leaves": 15, "max_bin": 63,
@@ -81,21 +95,26 @@ BASE = {"verbosity": -1, "num_leaves": 15, "max_bin": 63,
 def main():
     report = {}
     for name, make in CASES.items():
-        rng = np.random.RandomState(hash(name) % (2 ** 31))
+        # stable per-case seed: str hash() is salted per process
+        rng = np.random.RandomState(
+            zlib.crc32(name.encode()) % (2 ** 31))
         X, y, extra = make(rng)
         params = dict(BASE, **extra)
         cat = params.pop("categorical_feature", "auto")
+        group = params.pop("group", None)
 
         # ---- reference model + predictions -> goldens
         ds = real_lgb.Dataset(X, label=y, categorical_feature=cat,
-                              free_raw_data=False)
+                              group=group, free_raw_data=False)
         ref = real_lgb.train(params, ds, 12)
         ref_pred = ref.predict(X)
         model_path = os.path.join(GOLDEN, f"{name}.model.txt")
         ref.save_model(model_path)
+        extra_arrays = ({"group": group} if group is not None else {})
         np.savez_compressed(os.path.join(GOLDEN, f"{name}.npz"),
                             X=X.astype(np.float64), y=y,
-                            pred=np.asarray(ref_pred, np.float64))
+                            pred=np.asarray(ref_pred, np.float64),
+                            **extra_arrays)
 
         # ---- direction 1: reference model loaded by lightgbm_tpu
         ours = tpu_lgb.Booster(model_file=model_path)
@@ -103,7 +122,8 @@ def main():
         d1 = float(np.max(np.abs(ours_pred - ref_pred)))
 
         # ---- direction 2: lightgbm_tpu model loaded by the reference lib
-        tpu_ds = tpu_lgb.Dataset(X, label=y, categorical_feature=cat)
+        tpu_ds = tpu_lgb.Dataset(X, label=y, categorical_feature=cat,
+                                 group=group)
         tpu_bst = tpu_lgb.train(params, tpu_ds, 12)
         tpu_pred = np.asarray(tpu_bst.predict(X), np.float64)
         tpu_model = os.path.join(GOLDEN, f"{name}.tpu_model.txt")
@@ -121,6 +141,20 @@ def main():
         elif params["objective"] == "binary":
             q_ref = float(np.mean((ref_pred > 0.5) == y))
             q_tpu = float(np.mean((tpu_pred > 0.5) == y))
+        elif params["objective"] == "lambdarank":
+            # uniform groups by construction; derive the size from the
+            # group array saved alongside the goldens
+            def _ndcg5(p, qsz=int(group[0])):
+                rel = y.reshape(-1, qsz)
+                o = np.argsort(-p.reshape(-1, qsz), axis=1)
+                g = np.take_along_axis(2.0 ** rel - 1, o, axis=1)[:, :5]
+                dsc = 1.0 / np.log2(np.arange(2, 7))
+                ig = np.sort(2.0 ** rel - 1, 1)[:, ::-1][:, :5]
+                return float(np.mean((g * dsc).sum(1)
+                                     / np.maximum((ig * dsc).sum(1),
+                                                  1e-12)))
+            q_ref = _ndcg5(np.asarray(ref_pred))
+            q_tpu = _ndcg5(np.asarray(tpu_pred))
         else:
             q_ref = float(np.mean((ref_pred - y) ** 2))
             q_tpu = float(np.mean((tpu_pred - y) ** 2))

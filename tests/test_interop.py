@@ -14,7 +14,8 @@ import pytest
 import lightgbm_tpu as lgb
 
 GOLDEN = os.path.join(os.path.dirname(__file__), "golden")
-CASES = ["binary_nan", "regression", "multiclass", "categorical"]
+CASES = ["binary_nan", "regression", "multiclass", "categorical",
+         "ranking"]
 
 
 def _load(name):
