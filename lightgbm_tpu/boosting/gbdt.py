@@ -19,6 +19,7 @@ Layout decisions (vs the reference):
 from __future__ import annotations
 
 import functools
+import os
 
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -348,9 +349,9 @@ class _ValidSet:
 
 
 class GBDT:
-    _supports_lazy_cegb = True
-
     """Gradient Boosted Decision Trees (reference: class GBDT, gbdt.h)."""
+
+    _supports_lazy_cegb = True
 
     boosting_type = "gbdt"
     # RF overrides: average outputs instead of summing
@@ -890,17 +891,23 @@ class GBDT:
         self._cx_weight = k + gcols + 1 if has_w else None
         self._cx_rowid = e - 1
         gp = self.grower_params
-        if gp.fused_block and gp.efb_virtual:
-            # KNOWN ISSUE: the fused Mosaic kernel faults the TPU worker on
-            # EFB-bundled datasets with deep trees (reproduced at F=532
-            # bundle columns, bs=64, num_leaves=255; dense wide records and
-            # small trees are fine, and the kernel passes standalone stress
-            # at the same shape — the trigger needs the full grower
-            # context). Until root-caused, bundled datasets use the XLA
-            # compact walk.
-            log.warning("fused kernel disabled for EFB-bundled datasets "
-                        "(known TPU fault); using the XLA compact walk")
-            gp = gp._replace(fused_block=0)
+        force_efb_fused = os.environ.get("LGBM_TPU_FORCE_FUSED_EFB", "") == "1"
+        if os.environ.get("LGBM_TPU_FUSED_DUAL", "") == "0":
+            gp = gp._replace(fused_dual=False)
+            self.grower_params = gp
+        if gp.fused_block and gp.efb_virtual and gp.fused_dual \
+                and not force_efb_fused:
+            # KNOWN ISSUE: the DUAL-RESIDENCY fused kernel faults the TPU
+            # worker on EFB-bundled datasets with deep trees (reproduced at
+            # F=532 bundle columns, bs=64, num_leaves=255; dense wide
+            # records and small trees are fine, and the kernel passes
+            # standalone stress at the same shape — the trigger needs the
+            # full grower context). Until root-caused, bundled datasets run
+            # the fused kernel in its copy-back variant (round-3 design,
+            # ~1/3 more DMA per split but no dual-residency machinery).
+            log.info("EFB-bundled dataset: using the copy-back fused kernel "
+                     "variant (dual residency has an open TPU fault there)")
+            gp = gp._replace(fused_dual=False)
             self.grower_params = gp
         if gp.fused_block:
             # kernel scoped-VMEM buffers scale with block_size * num_cols

@@ -39,6 +39,10 @@ def _materialize(part):
     return part
 
 
+def _is_dask(x) -> bool:
+    return hasattr(x, "compute")
+
+
 def _require_dask():
     try:
         import dask  # noqa: F401
@@ -46,7 +50,11 @@ def _require_dask():
         raise NotImplementedError(_MSG) from exc
 
 
-def _wrap_array(out):
+def _wrap_array(out, was_dask: bool):
+    """Match the reference's contract: the output collection type follows
+    the input (dask in -> dask out, local in -> local out)."""
+    if not was_dask:
+        return out
     try:
         import dask.array as da
     except ImportError:  # pragma: no cover - dask missing mid-flight
@@ -62,7 +70,8 @@ class _DaskMixin:
     has no TPU equivalent worth emulating (SURVEY §7)."""
 
     def fit(self, X, y, sample_weight=None, init_score=None, **kwargs):
-        _require_dask()
+        if any(_is_dask(v) for v in (X, y, sample_weight, init_score)):
+            _require_dask()
         for key in ("group", "eval_sample_weight", "eval_init_score",
                     "eval_group"):
             if key in kwargs and kwargs[key] is not None:
@@ -80,8 +89,10 @@ class _DaskMixin:
             init_score=_materialize(init_score), **kwargs)
 
     def predict(self, X, **kwargs):
-        _require_dask()
-        return _wrap_array(super().predict(_materialize(X), **kwargs))
+        if _is_dask(X):
+            _require_dask()
+        return _wrap_array(super().predict(_materialize(X), **kwargs),
+                           _is_dask(X))
 
     def to_local(self):
         """The reference's DaskLGBM*.to_local(): the plain estimator."""
@@ -95,9 +106,11 @@ class _DaskMixin:
 
 class DaskLGBMClassifier(_DaskMixin, LGBMClassifier):
     def predict_proba(self, X, **kwargs):
-        _require_dask()
+        if _is_dask(X):
+            _require_dask()
         return _wrap_array(
-            LGBMClassifier.predict_proba(self, _materialize(X), **kwargs))
+            LGBMClassifier.predict_proba(self, _materialize(X), **kwargs),
+            _is_dask(X))
 
 
 class DaskLGBMRegressor(_DaskMixin, LGBMRegressor):

@@ -367,7 +367,7 @@ class BinnedDataset:
         if reference is not None:
             info = reference.bundle_info
             if info is not None:
-                binned = _apply_bundles(binned, info, ds)
+                binned = _apply_bundles(binned, info, ds, max_conflict_rate)
         elif enable_bundle and ds.max_num_bins <= 256:
             from .efb import build_bundle_info, plan_bundles
             dbins = np.array([m.default_bin for m in ds.mappers], np.int32)
@@ -382,7 +382,7 @@ class BinnedDataset:
             if bundles:
                 info = build_bundle_info(bundles, nbins, f)
                 ds.bundle_info = info
-                binned = _apply_bundles(binned, info, ds)
+                binned = _apply_bundles(binned, info, ds, max_conflict_rate)
                 log.info(
                     f"EFB: bundled {info.n_bundled} of {f} features into "
                     f"{info.n_columns} stored columns")
@@ -411,10 +411,10 @@ class BinnedDataset:
         return np.array([m.is_categorical for m in self.mappers], dtype=bool)
 
 
-def _apply_bundles(binned, info, ds):
+def _apply_bundles(binned, info, ds, max_conflict_rate=1e-4):
     from .efb import bundle_matrix
     dbins = np.array([m.default_bin for m in ds.mappers], np.int32)
-    out = bundle_matrix(binned, info, dbins)
+    out = bundle_matrix(binned, info, dbins, max_conflict_rate)
     if out is None:
         log.warning("EFB: feature conflict outside the planning sample; "
                     "keeping the dense matrix")

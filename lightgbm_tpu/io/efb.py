@@ -37,6 +37,8 @@ from typing import List, NamedTuple, Optional
 
 import numpy as np
 
+from ..utils import log
+
 
 class BundleInfo(NamedTuple):
     """Static bundle layout (host-side; device arrays built by the GBDT)."""
@@ -182,7 +184,8 @@ def unbundle(bundled: np.ndarray, info: BundleInfo, default_bins: np.ndarray,
 
 
 def bundle_matrix(binned: np.ndarray, info: BundleInfo,
-                  default_bins: np.ndarray) -> Optional[np.ndarray]:
+                  default_bins: np.ndarray,
+                  max_conflict_rate: float = 1e-4) -> Optional[np.ndarray]:
     """Re-encode the dense [N, F] binned matrix into [N, n_columns], or None
     when far more conflicts appear than planned (caller keeps dense).
 
@@ -216,8 +219,17 @@ def bundle_matrix(binned: np.ndarray, info: BundleInfo,
             conflicts += int(nz.sum()) - int(write.sum())
             out[write, c] = (info.offset_of[j] + 1
                              + col[write].astype(np.int64)).astype(np.uint8)
-    if conflicts > max(n // 100, 1):
-        # the sample badly under-estimated conflicts; bundling this data
-        # would distort far more rows than the planner allowed
+    # the planner budgeted max_conflict_rate * sample rows PER bundle; allow
+    # the same rate on the full data (x4 slack for sampling noise) before
+    # declaring the sample unrepresentative and keeping the dense matrix
+    n_bundle_cols = len(
+        {int(c) for c, o in zip(info.col_of, info.offset_of) if o >= 0})
+    # rate 0 is the lossless contract: ANY conflict falls back to dense
+    allowed = (max(int(4 * max_conflict_rate * n * max(n_bundle_cols, 1)), 16)
+               if max_conflict_rate > 0 else 0)
+    if conflicts > allowed:
         return None
+    if conflicts:
+        log.info(f"EFB: {conflicts} conflicting rows on the full data "
+                 f"(allowed {allowed})")
     return out

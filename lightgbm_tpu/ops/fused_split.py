@@ -79,7 +79,7 @@ _MODE, _BASE_T, _PHI, _COUNT, _NLEFT, _FEAT, _BIN, _DLEFT, _NANBIN, _ISCAT, \
     _SMALLER_L, _RBASE_T, _PSI, _SIDE = range(14)
 
 # smem bookkeeping slots
-_LCNT, _RCNT, _LF, _RF = range(4)
+_LCNT, _RCNT, _LF, _RF, _CBW = range(5)
 
 
 def _round_up(x: int, m: int) -> int:
@@ -101,10 +101,17 @@ def _assemble_f32(blk_i32, off: int):
 
 
 def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
-                  hist_ref, sem_in, sem_l, sem_r, sem_rmw, inbuf, lcarry,
-                  rcarry, lstage, rstage, rmwbuf, smem, *, layout: RowLayout,
+                  hist_ref, sem_in, sem_l, sem_r, sem_aux, inbuf, lcarry,
+                  rcarry, lstage, rstage, auxbuf, smem, *, layout: RowLayout,
                   num_bins: int, bs: int, bitset_words: int, use_int8: bool,
-                  interpret: bool):
+                  interpret: bool, dual: bool):
+    # dual=True: dual residency — rights land LIVE in the other array at the
+    #   same offsets (RMW blends protect neighbour segments; auxbuf=[bs,C]
+    #   rmw buffer, sem_aux=single DMA sem). The grower merges once per tree.
+    # dual=False: copy-back — side must be 0, rights stage through scratch
+    #   (garbage there is dead) and a copy-back epilogue blends them into
+    #   work (auxbuf=[2,bs,C] staging ring, sem_aux=(2,) DMA sems). This is
+    #   the round-3 behavior, kept as a bisect probe and safe fallback.
     F = layout.num_features
     C = layout.num_cols
     B = num_bins
@@ -135,9 +142,10 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
     smem[_RCNT] = psi
     smem[_LF] = 0
     smem[_RF] = 0
+    smem[_CBW] = 0
     lcarry[:, :] = jnp.zeros_like(lcarry)
     rcarry[:, :] = jnp.zeros_like(rcarry)
-    rmwbuf[:, :] = jnp.zeros_like(rmwbuf)
+    auxbuf[...] = jnp.zeros_like(auxbuf)
 
     iota = lax.broadcasted_iota(i32, (bs, 1), 0)[:, 0]
     lane = lax.broadcasted_iota(i32, (bs, C), 1)
@@ -162,6 +170,12 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
 
     def start_read(i, slot):
         """Issue the parent-segment block read from its residency array."""
+        if not dual:
+            pltpu.make_async_copy(
+                work_out.at[pl.ds(base + i * bs, bs), :], inbuf.at[slot],
+                sem_in.at[slot]).start()
+            return
+
         @pl.when(side == 0)
         def _():
             pltpu.make_async_copy(
@@ -181,18 +195,19 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
             sem_in.at[slot]).wait()
 
     def rmw_read(off):
-        """Synchronously fetch one block of the right-destination array."""
+        """Synchronously fetch one block of the right-destination array
+        (dual residency only — the destination may hold live neighbours)."""
         @pl.when(side == 0)
         def _():
             pltpu.make_async_copy(
-                scr_out.at[pl.ds(off, bs), :], rmwbuf, sem_rmw).start()
+                scr_out.at[pl.ds(off, bs), :], auxbuf, sem_aux).start()
 
         @pl.when(side != 0)
         def _():
             pltpu.make_async_copy(
-                work_out.at[pl.ds(off, bs), :], rmwbuf, sem_rmw).start()
+                work_out.at[pl.ds(off, bs), :], auxbuf, sem_aux).start()
         pltpu.make_async_copy(
-            work_out.at[pl.ds(0, bs), :], rmwbuf, sem_rmw).wait()
+            work_out.at[pl.ds(0, bs), :], auxbuf, sem_aux).wait()
 
     def hist_accum(rows_u8, mask_f32):
         """Accumulate masked rows of a [BS, C] u8 buffer into hist_ref."""
@@ -382,16 +397,20 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
             @pl.when(new_r >= bs)
             def _():
                 rf = smem[_RF]
-
-                @pl.when(rf == 0)
-                def _():
-                    # RMW blend: the psi pre-rows belong to a segment that
-                    # may be live in the destination array
-                    rmw_read(rbase)
-                keep = jnp.logical_and(rf == 0, iota < psi)
-                data = jnp.where(keep[:, None], rmwbuf[:, :].astype(i32),
-                                 carry_block_i32(rcarry))
                 h0 = jnp.where(rf == 0, psi, 0)
+                if dual:
+                    @pl.when(rf == 0)
+                    def _():
+                        # RMW blend: the psi pre-rows belong to a segment
+                        # that may be live in the destination array
+                        rmw_read(rbase)
+                    keep = jnp.logical_and(rf == 0, iota < psi)
+                    data = jnp.where(keep[:, None], auxbuf[:, :].astype(i32),
+                                     carry_block_i32(rcarry))
+                else:
+                    # copy-back mode: the psi head slots land in dead
+                    # scratch bytes; no blend needed
+                    data = carry_block_i32(rcarry)
                 stage_flush(
                     1, data.astype(jnp.uint8),
                     rbase + rf * bs, smaller_left == 0,
@@ -414,17 +433,23 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
             lf = smem[_LF]
             # RMW blend: rows beyond lcnt may belong to a live neighbour
             # (read from the parent's own residency array — lefts stay there)
-            @pl.when(side == 0)
-            def _():
+            start_read_at = base + lf * bs
+            if not dual:
                 pltpu.make_async_copy(
-                    work_out.at[pl.ds(base + lf * bs, bs), :], inbuf.at[0],
+                    work_out.at[pl.ds(start_read_at, bs), :], inbuf.at[0],
                     sem_in.at[0]).start()
+            else:
+                @pl.when(side == 0)
+                def _():
+                    pltpu.make_async_copy(
+                        work_out.at[pl.ds(start_read_at, bs), :],
+                        inbuf.at[0], sem_in.at[0]).start()
 
-            @pl.when(side != 0)
-            def _():
-                pltpu.make_async_copy(
-                    scr_out.at[pl.ds(base + lf * bs, bs), :], inbuf.at[0],
-                    sem_in.at[0]).start()
+                @pl.when(side != 0)
+                def _():
+                    pltpu.make_async_copy(
+                        scr_out.at[pl.ds(start_read_at, bs), :],
+                        inbuf.at[0], sem_in.at[0]).start()
             wait_read(0)
             blend = jnp.where(
                 (iota < lcnt)[:, None], carry_block_i32(lcarry),
@@ -437,13 +462,18 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
         @pl.when(rcnt > 0)
         def _():
             rf = smem[_RF]
-            # RMW blend against the destination array: the psi head rows
-            # (rf == 0) and everything beyond rcnt may be live neighbours
-            rmw_read(rbase + rf * bs)
             h0 = jnp.where(rf == 0, psi, 0)
             valid = jnp.logical_and(iota >= h0, iota < rcnt)
-            data = jnp.where(valid[:, None], carry_block_i32(rcarry),
-                             rmwbuf[:, :].astype(i32))
+            if dual:
+                # RMW blend against the destination array: the psi head rows
+                # (rf == 0) and everything beyond rcnt may be live neighbours
+                rmw_read(rbase + rf * bs)
+                data = jnp.where(valid[:, None], carry_block_i32(rcarry),
+                                 auxbuf[:, :].astype(i32))
+            else:
+                # copy-back mode: full-block write, overrun lands in dead
+                # scratch bytes
+                data = carry_block_i32(rcarry)
             stage_flush(1, data.astype(jnp.uint8),
                         rbase + rf * bs, smaller_left == 0,
                         valid.astype(jnp.float32))
@@ -451,11 +481,58 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
         drain(0)
         drain(1)
 
+        if not dual:
+            # ------------- copy-back of the right stream -------------
+            # blend the scratch-staged right rows into work over the exact
+            # row range; neighbours resident in work survive bit-for-bit
+            n_right_cb = count - n_left
+            nb_cb = (psi + n_right_cb + bs - 1) // bs
+
+            def cb_body(t, _):
+                win = rbase + t * bs
+                d1 = pltpu.make_async_copy(
+                    scr_out.at[pl.ds(win, bs), :], inbuf.at[0], sem_in.at[0])
+                d2 = pltpu.make_async_copy(
+                    work_out.at[pl.ds(win, bs), :], inbuf.at[1], sem_in.at[1])
+                d1.start()
+                d2.start()
+                d1.wait()
+                d2.wait()
+                g = win + iota
+                keep = jnp.logical_and(g >= start + n_left,
+                                       g < start + count)
+                out = jnp.where(keep[:, None], inbuf[0].astype(i32),
+                                inbuf[1].astype(i32)).astype(jnp.uint8)
+                cw = smem[_CBW]
+                slot = lax.rem(cw, 2)
+
+                @pl.when(cw >= 2)
+                def _():
+                    pltpu.make_async_copy(
+                        auxbuf.at[slot], work_out.at[pl.ds(0, bs), :],
+                        sem_aux.at[slot]).wait()
+                auxbuf[slot] = out
+                pltpu.make_async_copy(
+                    auxbuf.at[slot], work_out.at[pl.ds(win, bs), :],
+                    sem_aux.at[slot]).start()
+                smem[_CBW] = cw + 1
+                return 0
+
+            lax.fori_loop(0, nb_cb, cb_body, 0)
+            cw = smem[_CBW]
+            for back in (2, 1):
+                @pl.when(cw >= back)
+                def _():
+                    pltpu.make_async_copy(
+                        auxbuf.at[lax.rem(cw - back, 2)],
+                        work_out.at[pl.ds(0, bs), :],
+                        sem_aux.at[lax.rem(cw - back, 2)]).wait()
+
 
 @functools.partial(
     jax.jit,
     static_argnames=("layout", "num_bins", "block_size", "bitset_words",
-                     "interpret"))
+                     "interpret", "dual"))
 def fused_split(
     work: jnp.ndarray,          # [N + pad, C] u8, C % 128 == 0
     scratch: jnp.ndarray,       # [N + pad, C] u8
@@ -476,6 +553,7 @@ def fused_split(
     interpret: bool = False,
     smaller_left=None,
     side=None,                  # i32: 0 = parent lives in work, 1 = scratch
+    dual: bool = True,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One fused split. Returns (work', scratch', hist_smaller [F, B, 4]).
 
@@ -489,6 +567,13 @@ def fused_split(
     ``side`` selects the parent's residency array (dual residency, see the
     module docstring): the left child stays there, the right child lands in
     the other array at the same global offsets.
+
+    ``dual=False`` selects the copy-back variant: every segment lives in
+    ``work`` (side must be 0), rights stage through scratch and a copy-back
+    epilogue re-streams them into work. ~1/3 more DMA per split, but no RMW
+    blends and no side-dependent DMA — the round-3 design, kept as a safe
+    fallback while the dual-residency fault on EFB-bundled deep trees is
+    open (see boosting/gbdt._setup_compact_state).
     """
     F = layout.num_features
     C = layout.num_cols
@@ -529,7 +614,7 @@ def fused_split(
     carry_t = jnp.int32 if use_int8 else jnp.float32
     kernel = functools.partial(
         _fused_kernel, layout=layout, num_bins=B, bs=bs, bitset_words=W,
-        use_int8=use_int8, interpret=interpret)
+        use_int8=use_int8, interpret=interpret, dual=dual)
 
     work_o, scr_o, hist8 = pl.pallas_call(
         kernel,
@@ -545,13 +630,17 @@ def fused_split(
                 pltpu.SemaphoreType.DMA((2,)),      # sem_in
                 pltpu.SemaphoreType.DMA((2,)),      # sem_l
                 pltpu.SemaphoreType.DMA((2,)),      # sem_r
-                pltpu.SemaphoreType.DMA,            # sem_rmw
+                # dual: single rmw sem + [bs, C] rmw buffer;
+                # copy-back: (2,) staging sems + [2, bs, C] staging ring
+                (pltpu.SemaphoreType.DMA if dual
+                 else pltpu.SemaphoreType.DMA((2,))),       # sem_aux
                 pltpu.VMEM((2, bs, C), jnp.uint8),  # inbuf
                 pltpu.VMEM((2 * bs, C), carry_t),   # lcarry
                 pltpu.VMEM((2 * bs, C), carry_t),   # rcarry
                 pltpu.VMEM((2, bs, C), jnp.uint8),  # lstage
                 pltpu.VMEM((2, bs, C), jnp.uint8),  # rstage
-                pltpu.VMEM((bs, C), jnp.uint8),     # rmwbuf
+                (pltpu.VMEM((bs, C), jnp.uint8) if dual
+                 else pltpu.VMEM((2, bs, C), jnp.uint8)),   # auxbuf
                 pltpu.SMEM((8,), jnp.int32),
             ],
         ),

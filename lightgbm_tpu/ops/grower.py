@@ -93,6 +93,10 @@ class GrowerParams(NamedTuple):
     # kernel's streaming block size (multiple of 32)
     fused_block: int = 0
     fused_interpret: bool = False   # Pallas interpret mode (CPU tests)
+    # dual-residency segments (round 4). False = copy-back variant: all
+    # segments stay in work, rights re-stream through scratch — slower, but
+    # immune to the open dual+EFB TPU fault (ops/fused_split.py docstring)
+    fused_dual: bool = True
     # EFB (io/efb.py): the scan axis extends past the stored columns with
     # one virtual feature per bundled original (0 = bundling off)
     efb_virtual: int = 0

@@ -190,7 +190,7 @@ def grow_tree_compact(
             work, scratch, jnp.asarray(1, i32), zero, jnp.asarray(n, i32),
             zero, zero, zero, zero, zero, zero,
             jnp.zeros((W,), jnp.uint32), layout, B, params.fused_block, W,
-            interpret=params.fused_interpret)
+            interpret=params.fused_interpret, dual=params.fused_dual)
     else:
         root_loc = seg_hist(work, jnp.asarray(0, i32), jnp.asarray(n, i32))
     # data-parallel: histograms psum over the mesh axis (reference: the
@@ -458,7 +458,8 @@ def grow_tree_compact(
                 n_left_eff, f_col, b_, dl, nan_bin_arr[f_], f_cat,
                 bits, layout, B, params.fused_block, W,
                 interpret=params.fused_interpret,
-                smaller_left=left_smaller.astype(i32), side=side_p)
+                smaller_left=left_smaller.astype(i32), side=side_p,
+                dual=params.fused_dual)
         else:
             work, scratch = partition_segment(
                 st.work, st.scratch, s_, m_eff, n_left_eff, f_col, b_, dl,
@@ -478,7 +479,7 @@ def grow_tree_compact(
                 jnp.where(applied, n_right_g, leaf_nrows_g[new_leaf]))
         else:
             leaf_nrows_g = st.leaf_nrows_g
-        if params.fused_block:
+        if params.fused_block and params.fused_dual:
             leaf_side = st.leaf_side.at[new_leaf].set(
                 jnp.where(applied, 1 - side_p, st.leaf_side[new_leaf]))
         else:
@@ -734,7 +735,8 @@ def grow_tree_compact(
                         leaf_hist[i].reshape(F, B, 4), leaf_grad[i],
                         leaf_hess[i], leaf_cnt[i], leaf_depth[i],
                         leaf_fmask[i], cmn_a[i], cmx_a[i], leaf_pout[i],
-                        pen_cur, jax.random.fold_in(extra_key, 3 * L + i))
+                        pen_cur,
+                        jax.random.fold_in(extra_key, (3 + k) * L + i))
                     return (sp.gain, sp.feature, sp.bin, sp.default_left,
                             sp.left_grad, sp.left_hess, sp.left_count,
                             sp.left_rows.astype(i32), sp.cat_bitset,
@@ -814,10 +816,10 @@ def grow_tree_compact(
 
     st = lax.fori_loop(0, L - 1, body, st)
 
-    if params.fused_block:
+    if params.fused_block and params.fused_dual:
         # dual residency: consolidate scratch-resident segments back into
-        # work once per tree (the old design copy-backed after EVERY split,
-        # re-streaming the whole right child each time)
+        # work once per tree (the copy-back variant does this after EVERY
+        # split, re-streaming the whole right child each time)
         _, row_side = segments_to_leaf_vectors(
             st.leaf_start, st.leaf_nrows, st.leaf_side.astype(jnp.float32), n)
         in_scratch = jnp.zeros((st.work.shape[0],), bool) \

@@ -96,6 +96,22 @@ class TestEFB:
         assert ds._inner.bundle_info is None       # fell back to dense
         assert np.isfinite(bst.predict(X[:50])).all()
 
+    def test_fused_copyback_efb_parity(self):
+        # the fused kernel on EFB-bundled data auto-selects the copy-back
+        # variant (dual residency has an open TPU fault there, gbdt
+        # _setup_compact_state); interpret mode runs the same program on CPU
+        # and must match the XLA-walk compact grower
+        X, y = _onehot_data(n=3000, seed=13)
+        base = dict(PARAMS, num_leaves=31, min_data_in_leaf=5)
+        b_xla = lgb.train(dict(base), lgb.Dataset(X, label=y), 4)
+        b_fus = lgb.train(dict(base, tpu_fused="on", tpu_fused_interpret=True,
+                               tpu_fused_block=128),
+                          lgb.Dataset(X, label=y), 4)
+        gp = b_fus._gbdt.grower_params
+        assert gp.fused_block and not gp.fused_dual   # copy-back selected
+        np.testing.assert_allclose(b_xla.predict(X[:800]),
+                                   b_fus.predict(X[:800]), atol=2e-4)
+
     def test_bounded_conflict_bundling(self):
         # reference: FindGroups packs features whose conflicts stay under
         # total_sample_cnt/10000 per group (src/io/dataset.cpp:115); rows

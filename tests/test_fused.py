@@ -37,7 +37,8 @@ def _make_work(rng, n, f, b, extra=1):
 
 
 def _run_fused(work0, layout, b, mode, start, count, n_left, feat, bin_,
-               default_left=0, nan_bin=0, is_cat=0, bits=None, bs=128):
+               default_left=0, nan_bin=0, is_cat=0, bits=None, bs=128,
+               dual=True):
     bits = (jnp.zeros((8,), jnp.uint32) if bits is None
             else jnp.asarray(bits, jnp.uint32))
     return fused_split(
@@ -46,15 +47,18 @@ def _run_fused(work0, layout, b, mode, start, count, n_left, feat, bin_,
         jnp.asarray(count, i32), jnp.asarray(n_left, i32),
         jnp.asarray(feat, i32), jnp.asarray(bin_, i32),
         jnp.asarray(default_left, i32), jnp.asarray(nan_bin, i32),
-        jnp.asarray(is_cat, i32), bits, layout, b, bs, 8, interpret=True)
+        jnp.asarray(is_cat, i32), bits, layout, b, bs, 8, interpret=True,
+        dual=dual)
 
 
-def _merged(wf, sf, start, count, n_left):
+def _merged(wf, sf, start, count, n_left, dual=True):
     """Dual residency: the right child lives in the scratch array at its
-    final offsets; merge for comparison against the single-array reference."""
+    final offsets; merge for comparison against the single-array reference.
+    The copy-back variant (dual=False) already holds everything in work."""
     out = np.asarray(wf).copy()
-    rs, re = start + n_left, start + count
-    out[rs:re] = np.asarray(sf)[rs:re]
+    if dual:
+        rs, re = start + n_left, start + count
+        out[rs:re] = np.asarray(sf)[rs:re]
     return out
 
 
@@ -77,19 +81,20 @@ def _run_ref(work0, b, layout, start, count, n_left, feat, bin_,
 
 
 class TestFusedSplit:
+    @pytest.mark.parametrize("dual", [True, False])
     @pytest.mark.parametrize("start,count", [(0, 3000), (37, 2219), (96, 128),
                                              (500, 1), (200, 0)])
-    def test_partition_and_hist_parity(self, rng, start, count):
+    def test_partition_and_hist_parity(self, rng, start, count, dual):
         n, f, b = 3000, 5, 256
         layout, work0 = _make_work(rng, n, f, b)
         feat, bin_ = 2, 100
         sub = work0[start:start + count, feat]
         n_left = int((sub <= bin_).sum())
         wf, sf, hf = _run_fused(work0, layout, b, 0, start, count, n_left,
-                                feat, bin_)
+                                feat, bin_, dual=dual)
         wr, href = _run_ref(work0, b, layout, start, count, n_left, feat,
                             bin_)
-        wm = _merged(wf, sf, start, count, n_left)
+        wm = _merged(wf, sf, start, count, n_left, dual)
         np.testing.assert_array_equal(wm[:n], wr[:n])
         hf = np.asarray(hf)
         np.testing.assert_array_equal(hf[:, :, 2:], href[:, :, 2:])
@@ -109,7 +114,8 @@ class TestFusedSplit:
         np.testing.assert_array_equal(_merged(wf, sf, 0, n, n_left)[:n],
                                       wr[:n])
 
-    def test_categorical_bitset(self, rng):
+    @pytest.mark.parametrize("dual", [True, False])
+    def test_categorical_bitset(self, rng, dual):
         n, f, b = 1500, 4, 256
         layout, work0 = _make_work(rng, n, f, b)
         feat = 3
@@ -120,10 +126,10 @@ class TestFusedSplit:
         gl = (bits[col // 32] >> (col % 32)) & 1
         n_left = int(gl.sum())
         wf, sf, _ = _run_fused(work0, layout, b, 0, 0, n, n_left, feat, 0,
-                               is_cat=1, bits=bits)
+                               is_cat=1, bits=bits, dual=dual)
         wr, _ = _run_ref(work0, b, layout, 0, n, n_left, feat, 0,
                          is_cat=True, bits=bits)
-        np.testing.assert_array_equal(_merged(wf, sf, 0, n, n_left)[:n],
+        np.testing.assert_array_equal(_merged(wf, sf, 0, n, n_left, dual)[:n],
                                       wr[:n])
 
     def test_mode1_root_histogram(self, rng):
@@ -138,14 +144,15 @@ class TestFusedSplit:
         np.testing.assert_array_equal(hf[:, :, 2:], href[:, :, 2:])
         np.testing.assert_allclose(hf[:, :, :2], href[:, :, :2], atol=2e-2)
 
-    def test_untouched_outside_segment(self, rng):
+    @pytest.mark.parametrize("dual", [True, False])
+    def test_untouched_outside_segment(self, rng, dual):
         n, f, b = 2000, 4, 128
         layout, work0 = _make_work(rng, n, f, b)
         start, count = 600, 700
         sub = work0[start:start + count, 0]
         n_left = int((sub <= 40).sum())
         wf, sf, _ = _run_fused(work0, layout, b, 0, start, count, n_left,
-                               0, 40)
+                               0, 40, dual=dual)
         wf = np.asarray(wf)
         np.testing.assert_array_equal(wf[:start], work0[:start])
         np.testing.assert_array_equal(wf[start + count:n],
