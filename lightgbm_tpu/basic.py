@@ -687,33 +687,47 @@ class Booster:
 
     def _predict_contrib(self, arr, num_iteration):
         """Exact TreeSHAP contributions [N, K*(F+1)] (reference:
-        PredictContrib -> Tree::TreeSHAP, src/io/tree.cpp)."""
-        from .ops.treeshap import booster_contrib
+        PredictContrib -> Tree::TreeSHAP, src/io/tree.cpp).
+
+        Trained boosters route in bin space (bit-identical to training);
+        loaded models and continue-training bases route on the model text's
+        raw-value thresholds, like the reference's dataset-free path.
+        Linear trees attribute their constant leaf outputs, matching the
+        reference (TreeSHAP reads leaf_value_, never leaf coefficients)."""
+        from .ops.treeshap import booster_contrib, loaded_booster_contrib
         g = self._gbdt
+        k = max(g.num_tree_per_iteration, 1)
         if not hasattr(g, "bin_matrix"):
-            raise NotImplementedError(
-                "pred_contrib on loaded models: retrain or load with a "
-                "training dataset attached")
-        if getattr(g, "_linear", False):
-            raise NotImplementedError(
-                "pred_contrib is not supported with linear_tree")
-        if getattr(self, "_pre_model", None) is not None:
-            raise NotImplementedError(
-                "pred_contrib on continue-trained boosters is not "
-                "supported yet")
+            # model-only path (Booster(model_file=...))
+            models = g.models
+            if num_iteration is not None and num_iteration > 0:
+                models = models[: num_iteration * k]
+            return loaded_booster_contrib(models, arr, k,
+                                          g.max_feature_idx + 1)
+        pre = getattr(self, "_pre_model", None)
+        pre_cut, own_cut = self._split_iteration_window(num_iteration, pre)
         g._flush_trees()
         models = g.models
-        if num_iteration is not None and num_iteration > 0:
-            models = models[: num_iteration * g.num_tree_per_iteration]
+        if own_cut is not None:
+            models = models[: own_cut * k]
         binned = np.asarray(g.bin_matrix(arr))
         nan_bin = np.asarray(g.nan_bin_arr)
         is_cat = np.asarray(g.is_cat_arr)
 
         from .ops.split import go_left_scalar_np
-        return booster_contrib(models, binned, nan_bin, is_cat,
-                               go_left_scalar_np,
-                               g.num_tree_per_iteration,
-                               int(binned.shape[1]))
+        out = booster_contrib(models, binned, nan_bin, is_cat,
+                              go_left_scalar_np,
+                              g.num_tree_per_iteration,
+                              int(binned.shape[1]))
+        if pre is not None:
+            # continue-trained: SHAP is additive over trees, so the loaded
+            # base model's contributions (raw-space routing) sum in
+            pre_models = pre.models
+            if pre_cut is not None:
+                pre_models = pre_models[: pre_cut * k]
+            out = out + loaded_booster_contrib(
+                pre_models, arr, k, int(binned.shape[1]))
+        return out
 
     # -- model IO ------------------------------------------------------------
     def model_to_string(self, num_iteration: Optional[int] = None,
@@ -727,12 +741,20 @@ class Booster:
         pre = getattr(self, "_pre_model", None)
         if pre is None:
             return booster_to_string(self, num_iteration)
-        pre_cut = own_cut = None
-        if num_iteration is not None and num_iteration > 0:
-            pre_cut = min(num_iteration, pre.current_iteration)
-            own_cut = max(num_iteration - pre.current_iteration, 0)
+        pre_cut, own_cut = self._split_iteration_window(num_iteration, pre)
         text = booster_to_string(self, own_cut)
         return merge_model_texts(pre, text, pre_num_iteration=pre_cut)
+
+    @staticmethod
+    def _split_iteration_window(num_iteration, pre):
+        """Split a leading num_iteration window across a loaded base model
+        and the booster's own trees: (pre_cut, own_cut), None = all."""
+        if num_iteration is None or num_iteration <= 0:
+            return None, None
+        if pre is None:
+            return None, num_iteration
+        return (min(num_iteration, pre.current_iteration),
+                max(num_iteration - pre.current_iteration, 0))
 
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0,

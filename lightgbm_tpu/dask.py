@@ -51,8 +51,11 @@ def _require_dask():
 
 
 def _wrap_array(out, was_dask: bool):
-    """Match the reference's contract: the output collection type follows
-    the input (dask in -> dask out, local in -> local out)."""
+    """dask in -> dask out; local in -> local out. (Deliberate deviation
+    from the reference, which raises TypeError on non-Dask inputs
+    (ref: python-package/lightgbm/dask.py _predict): accepting local data
+    keeps these wrappers usable on a single TPU host where materialized
+    training is the documented design, see the module docstring.)"""
     if not was_dask:
         return out
     try:

@@ -279,7 +279,8 @@ class LoadedTree:
                  "num_leaves", "num_cat", "split_feature", "split_gain",
                  "threshold", "decision_type", "left_child", "right_child",
                  "leaf_value", "leaf_weight", "leaf_count", "internal_value",
-                 "cat_boundaries", "cat_threshold", "shrinkage", "num_nodes")
+                 "internal_count", "cat_boundaries", "cat_threshold",
+                 "shrinkage", "num_nodes")
 
     def route(self, x: np.ndarray) -> np.ndarray:
         """Leaf index per row; float64-exact level-synchronous routing."""
@@ -414,6 +415,7 @@ class LoadedGBDT:
             t.leaf_weight = _arr(d, "leaf_weight", np.float64, nl)
             t.leaf_count = _arr(d, "leaf_count", np.float64, nl)
             t.internal_value = _arr(d, "internal_value", np.float64, nn)
+            t.internal_count = _arr(d, "internal_count", np.float64, nn)
             t.cat_boundaries = _arr(d, "cat_boundaries", np.int64,
                                     1 + t.num_cat) if t.num_cat else np.zeros(1, np.int64)
             t.cat_threshold = _arr(d, "cat_threshold", np.uint32, 0) \

@@ -601,6 +601,10 @@ def fused_split(
                              smaller_left.astype(i32))
     if side is None:
         side = jnp.asarray(0, i32)
+    if not dual:
+        # the copy-back variant's invariant is that every segment lives in
+        # work; enforce it here rather than trusting distant callers
+        side = jnp.zeros_like(jnp.asarray(side, i32))
     sp = jnp.stack([
         mode.astype(i32), base_t, phi, count, n_left_eff,
         feature.astype(i32), bin_.astype(i32), default_left.astype(i32),

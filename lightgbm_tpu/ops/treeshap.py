@@ -190,3 +190,82 @@ def booster_contrib(models, binned: np.ndarray, nan_bin, is_cat,
                 m.leaf_value, m.internal_count, m.leaf_count, m.num_nodes,
                 out[r, cls], depth, ev)
     return out.reshape(n, k * (num_features + 1))
+
+
+# ---------------------------------------------------------------------------
+# Model-only path: SHAP from the parsed model text alone (raw-value
+# thresholds), no training dataset required — the reference computes
+# pred_contrib the same way on loaded models (Tree::PredictContrib routes on
+# raw feature values, include/LightGBM/tree.h:668).
+# ---------------------------------------------------------------------------
+def _loaded_go_left(t, node: int, row: np.ndarray) -> bool:
+    """Scalar raw-space routing; MUST mirror model_io.LoadedTree.route."""
+    f = int(t.split_feature[node])
+    v = float(row[f])
+    dt = int(t.decision_type[node])
+    if dt & 1:  # categorical
+        ci = int(t.threshold[node])
+        lo, hi = int(t.cat_boundaries[ci]), int(t.cat_boundaries[ci + 1])
+        words = t.cat_threshold[lo:hi]
+        iv = int(v) if np.isfinite(v) else -1
+        if not (0 <= iv < 32 * len(words)):
+            return False
+        return bool((int(words[iv // 32]) >> (iv % 32)) & 1)
+    default_left = bool(dt & 2)
+    missing_type = (dt >> 2) & 3
+    isnan = np.isnan(v)
+    if missing_type != 2 and isnan:
+        v = 0.0
+    if missing_type == 1:
+        miss = abs(v) <= 1e-35
+    elif missing_type == 2:
+        miss = isnan
+    else:
+        miss = False
+    return default_left if miss else bool(v <= float(t.threshold[node]))
+
+
+def _loaded_tree_depth(t) -> int:
+    """Max leaf depth (internal nodes on the path) of a LoadedTree."""
+    if t.num_nodes == 0:
+        return 0
+    best = 0
+    stack = [(0, 1)]
+    while stack:
+        node, d = stack.pop()
+        for child in (int(t.left_child[node]), int(t.right_child[node])):
+            if child < 0:
+                best = max(best, d)
+            else:
+                stack.append((child, d + 1))
+    return best
+
+
+def loaded_booster_contrib(models, X: np.ndarray,
+                           num_tree_per_iteration: int,
+                           num_features: int) -> np.ndarray:
+    """SHAP contributions [N, K*(F+1)] from parsed model-text trees.
+
+    Linear trees attribute their constant leaf outputs, exactly like the
+    reference (TreeSHAP reads leaf_value_, never the leaf coefficients —
+    src/io/tree.cpp)."""
+    X = np.ascontiguousarray(X, np.float64)
+    n = X.shape[0]
+    k = max(num_tree_per_iteration, 1)
+    out = np.zeros((n, k, num_features + 1))
+    for t_idx, t in enumerate(models):
+        cls = t_idx % k
+        depth = _loaded_tree_depth(t)
+        ev = tree_expected_value(t.left_child, t.right_child, t.leaf_value,
+                                 t.internal_count, t.leaf_count, t.num_nodes)
+        for r in range(n):
+            row = X[r]
+
+            def go_left(node: int) -> bool:
+                return _loaded_go_left(t, node, row)
+
+            tree_shap_one_row(
+                go_left, t.split_feature, t.left_child, t.right_child,
+                t.leaf_value, t.internal_count, t.leaf_count, t.num_nodes,
+                out[r, cls], depth, ev)
+    return out.reshape(n, k * (num_features + 1))

@@ -252,6 +252,45 @@ class TestPredictionExtras:
         imp = np.abs(contrib[:, :-1]).mean(0)
         assert imp.max() > 0
 
+    def test_pred_contrib_model_only(self, tmp_path):
+        # SHAP on a Booster(model_file=...) with no dataset attached: the
+        # model-only raw-threshold path must agree with the trained-booster
+        # bin-space path (reference computes contribs from tree arrays
+        # alone, Tree::PredictContrib tree.h:668)
+        X, y = binary_data(n=300)
+        X = X.copy()
+        X[::7, 0] = np.nan                      # exercise missing routing
+        bst = lgb.train(_params(objective="binary"), lgb.Dataset(X, label=y), 6)
+        want = bst.predict(X[:30], pred_contrib=True)
+        path = tmp_path / "m.txt"
+        bst.save_model(str(path))
+        loaded = lgb.Booster(model_file=str(path))
+        got = loaded.predict(X[:30], pred_contrib=True)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        # local accuracy holds on the loaded path too
+        raw = loaded.predict(X[:30], raw_score=True)
+        np.testing.assert_allclose(got.sum(axis=1), raw, rtol=1e-4, atol=1e-4)
+
+    def test_pred_contrib_linear_tree(self):
+        # matches the reference: TreeSHAP attributes the constant leaf
+        # outputs (leaf_value_), never the leaf coefficients (tree.cpp)
+        X, y = binary_data(n=300)
+        bst = lgb.train(_params(objective="regression", linear_tree=True),
+                        lgb.Dataset(X, label=y.astype(np.float64)), 4)
+        contrib = bst.predict(X[:20], pred_contrib=True)
+        assert contrib.shape == (20, X.shape[1] + 1)
+        assert np.isfinite(contrib).all()
+
+    def test_pred_contrib_continue_trained(self):
+        X, y = binary_data(n=300)
+        b1 = lgb.train(_params(objective="binary"), lgb.Dataset(X, label=y), 4)
+        b2 = lgb.train(_params(objective="binary"), lgb.Dataset(X, label=y), 3,
+                       init_model=b1)
+        contrib = b2.predict(X[:25], pred_contrib=True)
+        raw = b2.predict(X[:25], raw_score=True)
+        np.testing.assert_allclose(contrib.sum(axis=1), raw,
+                                   rtol=1e-4, atol=1e-4)
+
     def test_pred_contrib_multiclass(self):
         X, y = multiclass_data()
         bst = lgb.train(_params(objective="multiclass", num_class=3),

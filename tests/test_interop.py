@@ -85,3 +85,21 @@ def test_live_ours_in_reference(name):
     np.testing.assert_allclose(
         np.asarray(theirs.predict(X), np.float64),
         np.asarray(ours.predict(X), np.float64), rtol=1e-5, atol=2e-6)
+
+
+@pytest.mark.skipif(not os.path.isdir(_REF_LIB),
+                    reason="reference LightGBM build not present")
+@pytest.mark.parametrize("name", ["binary_nan", "regression", "multiclass"])
+def test_live_pred_contrib_parity(name):
+    """Model-only TreeSHAP parity: the same reference-trained model text,
+    loaded dataset-free in both libraries, must attribute identically
+    (reference: Tree::PredictContrib, include/LightGBM/tree.h:668)."""
+    import sys
+    sys.path.insert(0, os.path.abspath(_REF_LIB))
+    import lightgbm as real_lgb
+    X, _, _, model_text = _load(name)
+    theirs = real_lgb.Booster(model_str=model_text)
+    ours = lgb.Booster(model_str=model_text)
+    ref = np.asarray(theirs.predict(X[:50], pred_contrib=True), np.float64)
+    got = np.asarray(ours.predict(X[:50], pred_contrib=True), np.float64)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-6)
