@@ -697,6 +697,7 @@ class Booster:
         from .ops.treeshap import booster_contrib, loaded_booster_contrib
         g = self._gbdt
         k = max(g.num_tree_per_iteration, 1)
+        arr = np.atleast_2d(np.asarray(arr, np.float64))
         if not hasattr(g, "bin_matrix"):
             # model-only path (Booster(model_file=...))
             models = g.models
@@ -711,8 +712,15 @@ class Booster:
         if own_cut is not None:
             models = models[: own_cut * k]
         binned = np.asarray(g.bin_matrix(arr))
-        nan_bin = np.asarray(g.nan_bin_arr)
-        is_cat = np.asarray(g.is_cat_arr)
+        # tree split_feature holds ORIGINAL feature ids; under EFB the
+        # gbdt's nan/cat arrays are column-space, so route with the
+        # original-space twins like every other prediction path
+        if getattr(g, "_efb", None) is not None:
+            nan_bin = np.asarray(g._orig_nan_arr)
+            is_cat = np.asarray(g._orig_cat_arr)
+        else:
+            nan_bin = np.asarray(g.nan_bin_arr)
+            is_cat = np.asarray(g.is_cat_arr)
 
         from .ops.split import go_left_scalar_np
         out = booster_contrib(models, binned, nan_bin, is_cat,

@@ -91,35 +91,52 @@ def plan_bundles(
     order = cand[np.argsort(-counts[cand], kind="stable")]
     budget = max_bin  # u8 storage: one column holds at most max_bin+1 values
     conflict_budget = int(s * max_conflict_rate)
+    # feature-major f32 copy: the conflict counts against ALL open bundles
+    # batch into one BLAS matvec per feature (the bundle-by-bundle bool-AND
+    # loop was O(F^2 * S) python-side and dominated wide-data construct)
+    nzT = np.ascontiguousarray(nonzero.T[order])               # [J, S] bool
     bundles: List[List[int]] = []
-    occupancy: List[np.ndarray] = []
-    used_bins: List[int] = []
-    conflicts_used: List[int] = []
-    for j in order:
+    nb_alloc = 256
+    # past this many open bundles the data clearly isn't bundling; give up
+    # rather than let the occupancy matrix grow toward F x S (cap sized to
+    # a ~512MB occupancy budget)
+    nb_cap = max(1024, (512 << 20) // (4 * max(s, 1)))
+    occ = np.zeros((nb_alloc, s), np.float32)       # [NB, S] occupancy
+    used_bins = np.zeros(nb_alloc, np.int64)
+    conflicts_used = np.zeros(nb_alloc, np.int64)
+    for ji, j in enumerate(order):
         nb = int(num_bins[j])
         nz_j = int(counts[j])
+        nbundles = len(bundles)
         placed = False
-        for bi in range(len(bundles)):
-            if used_bins[bi] + nb > budget:
-                continue
-            conflict = int(np.logical_and(occupancy[bi],
-                                          nonzero[:, j]).sum())
-            # the bundle's remaining budget AND half this feature's
-            # nonzeros (reference: cnt <= cur_non_zero_cnt / 2)
-            if conflict > min(conflict_budget - conflicts_used[bi],
-                              nz_j // 2):
-                continue
-            bundles[bi].append(int(j))
-            occupancy[bi] |= nonzero[:, j]
-            used_bins[bi] += nb
-            conflicts_used[bi] += conflict
-            placed = True
-            break
+        if nbundles:
+            conflict = occ[:nbundles] @ nzT[ji].astype(np.float32)  # [NB]
+            ok = (used_bins[:nbundles] + nb <= budget) & (
+                conflict <= np.minimum(
+                    conflict_budget - conflicts_used[:nbundles], nz_j // 2))
+            hits = np.nonzero(ok)[0]
+            if len(hits):
+                # first-fit, like the reference's FindGroups scan order
+                bi = int(hits[0])
+                bundles[bi].append(int(j))
+                np.maximum(occ[bi], nzT[ji], out=occ[bi])
+                used_bins[bi] += nb
+                conflicts_used[bi] += int(conflict[bi])
+                placed = True
         if not placed:
+            if nbundles >= nb_cap:
+                return None
+            if nbundles == nb_alloc:
+                nb_alloc *= 2
+                occ = np.concatenate(
+                    [occ, np.zeros((nb_alloc - nbundles, s), np.float32)])
+                used_bins = np.concatenate(
+                    [used_bins, np.zeros(nbundles, np.int64)])
+                conflicts_used = np.concatenate(
+                    [conflicts_used, np.zeros(nbundles, np.int64)])
             bundles.append([int(j)])
-            occupancy.append(nonzero[:, j].copy())
-            used_bins.append(nb)
-            conflicts_used.append(0)
+            occ[nbundles] = nzT[ji]
+            used_bins[nbundles] = nb
     bundles = [b for b in bundles if len(b) > 1]
     n_bundled = sum(len(b) for b in bundles)
     if n_bundled < min_features:

@@ -282,6 +282,35 @@ class LoadedTree:
                  "internal_count", "cat_boundaries", "cat_threshold",
                  "shrinkage", "num_nodes")
 
+    def decision_scalar(self, node: int, row: np.ndarray) -> bool:
+        """One node's go-left decision for one raw-value row; MUST agree
+        with ``route`` (tests pin the two together). Used by the
+        model-only TreeSHAP path (ops/treeshap.py)."""
+        f = int(self.split_feature[node])
+        v = float(row[f])
+        dt = int(self.decision_type[node])
+        if dt & 1:  # categorical
+            ci = int(self.threshold[node])
+            lo = int(self.cat_boundaries[ci])
+            hi = int(self.cat_boundaries[ci + 1])
+            words = self.cat_threshold[lo:hi]
+            iv = int(v) if np.isfinite(v) else -1
+            if not (0 <= iv < 32 * len(words)):
+                return False
+            return bool((int(words[iv // 32]) >> (iv % 32)) & 1)
+        default_left = bool(dt & 2)
+        missing_type = (dt >> 2) & 3
+        isnan = np.isnan(v)
+        if missing_type != 2 and isnan:
+            v = 0.0
+        if missing_type == 1:
+            miss = abs(v) <= 1e-35
+        elif missing_type == 2:
+            miss = isnan
+        else:
+            miss = False
+        return default_left if miss else bool(v <= float(self.threshold[node]))
+
     def route(self, x: np.ndarray) -> np.ndarray:
         """Leaf index per row; float64-exact level-synchronous routing."""
         n = x.shape[0]

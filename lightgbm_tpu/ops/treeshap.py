@@ -198,33 +198,6 @@ def booster_contrib(models, binned: np.ndarray, nan_bin, is_cat,
 # pred_contrib the same way on loaded models (Tree::PredictContrib routes on
 # raw feature values, include/LightGBM/tree.h:668).
 # ---------------------------------------------------------------------------
-def _loaded_go_left(t, node: int, row: np.ndarray) -> bool:
-    """Scalar raw-space routing; MUST mirror model_io.LoadedTree.route."""
-    f = int(t.split_feature[node])
-    v = float(row[f])
-    dt = int(t.decision_type[node])
-    if dt & 1:  # categorical
-        ci = int(t.threshold[node])
-        lo, hi = int(t.cat_boundaries[ci]), int(t.cat_boundaries[ci + 1])
-        words = t.cat_threshold[lo:hi]
-        iv = int(v) if np.isfinite(v) else -1
-        if not (0 <= iv < 32 * len(words)):
-            return False
-        return bool((int(words[iv // 32]) >> (iv % 32)) & 1)
-    default_left = bool(dt & 2)
-    missing_type = (dt >> 2) & 3
-    isnan = np.isnan(v)
-    if missing_type != 2 and isnan:
-        v = 0.0
-    if missing_type == 1:
-        miss = abs(v) <= 1e-35
-    elif missing_type == 2:
-        miss = isnan
-    else:
-        miss = False
-    return default_left if miss else bool(v <= float(t.threshold[node]))
-
-
 def _loaded_tree_depth(t) -> int:
     """Max leaf depth (internal nodes on the path) of a LoadedTree."""
     if t.num_nodes == 0:
@@ -262,7 +235,7 @@ def loaded_booster_contrib(models, X: np.ndarray,
             row = X[r]
 
             def go_left(node: int) -> bool:
-                return _loaded_go_left(t, node, row)
+                return t.decision_scalar(node, row)
 
             tree_shap_one_row(
                 go_left, t.split_feature, t.left_child, t.right_child,

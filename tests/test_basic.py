@@ -271,6 +271,55 @@ class TestPredictionExtras:
         raw = loaded.predict(X[:30], raw_score=True)
         np.testing.assert_allclose(got.sum(axis=1), raw, rtol=1e-4, atol=1e-4)
 
+    def test_loaded_scalar_decision_matches_route(self):
+        # decision_scalar (TreeSHAP) and route (predict) must agree node by
+        # node on the same loaded model — pins the two implementations
+        X, y = binary_data(n=400)
+        X = X.copy()
+        X[::5, 1] = np.nan
+        bst = lgb.train(_params(objective="binary"), lgb.Dataset(X, label=y), 5)
+        loaded = lgb.Booster(model_str=bst.model_to_string())
+        for t in loaded._gbdt.models:
+            leaves_vec = t.route(X[:60])
+            for r in range(60):
+                node = 0
+                while node >= 0:
+                    node = (t.left_child[node]
+                            if t.decision_scalar(node, X[r])
+                            else t.right_child[node])
+                assert -(node + 1) == leaves_vec[r]
+
+    def test_pred_contrib_single_row_and_efb(self):
+        # 1-D input works on the model-only path, and EFB-bundled training
+        # routes SHAP with ORIGINAL-space nan/cat arrays
+        rng = np.random.RandomState(5)
+        n, groups, card = 2000, 40, 8
+        cats = rng.randint(0, card, size=(n, groups))
+        X = np.zeros((n, groups * card), np.float32)
+        for g in range(groups):
+            X[np.arange(n), g * card + cats[:, g]] = 1.0
+        w = rng.randn(X.shape[1]) * 0.5
+        y = ((X @ w) > 0).astype(np.float64)
+        # dense NaN-bearing passthrough features: their column index differs
+        # from their original index under EFB, so routing with column-space
+        # nan arrays would misattribute
+        dense = rng.randn(n, 3).astype(np.float32)
+        dense[::4] = np.nan
+        X = np.concatenate([dense, X], axis=1)
+        y = ((np.nan_to_num(dense[:, 0]) + X[:, 3:] @ w) > 0).astype(
+            np.float64)
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train(_params(objective="binary", num_leaves=15), ds, 4)
+        assert ds._inner.bundle_info is not None      # EFB active
+        contrib = bst.predict(X[:15], pred_contrib=True)
+        raw = bst.predict(X[:15], raw_score=True)
+        np.testing.assert_allclose(contrib.sum(axis=1), raw,
+                                   rtol=1e-4, atol=1e-4)
+        loaded = lgb.Booster(model_str=bst.model_to_string())
+        one = loaded.predict(X[0], pred_contrib=True)     # 1-D input
+        np.testing.assert_allclose(np.atleast_2d(one)[0], contrib[0],
+                                   rtol=1e-4, atol=1e-4)
+
     def test_pred_contrib_linear_tree(self):
         # matches the reference: TreeSHAP attributes the constant leaf
         # outputs (leaf_value_), never the leaf coefficients (tree.cpp)
