@@ -10,7 +10,7 @@ Public surface mirrors the reference's Python package
 (python-package/lightgbm/__init__.py): ``Dataset``, ``Booster``, ``train``,
 ``cv``, callbacks, and sklearn-style estimators.
 """
-from .basic import Booster, Dataset
+from .basic import Booster, Dataset, Sequence
 from .callback import (
     EarlyStopException,
     early_stopping,
@@ -27,7 +27,7 @@ __all__ = [
     "DaskLGBMClassifier",
     "DaskLGBMRegressor",
     "DaskLGBMRanker",
-    "Dataset", "Booster", "Config",
+    "Dataset", "Booster", "Config", "Sequence",
     "train", "cv", "CVBooster",
     "early_stopping", "log_evaluation", "record_evaluation", "reset_parameter",
     "EarlyStopException",

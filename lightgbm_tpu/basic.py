@@ -14,7 +14,8 @@ from __future__ import annotations
 
 import copy
 import json
-from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Union
+from typing import Any, Callable, Dict, Iterable, List, Optional, Union
+from typing import Sequence as _Seq
 
 import numpy as np
 
@@ -25,6 +26,34 @@ from .objectives import create_objective
 from .utils import log
 
 _ArrayLike = Any
+
+
+class Sequence:
+    """Generic random-access data interface for streaming Dataset
+    construction (reference: lightgbm.Sequence, python-package/lightgbm/
+    basic.py:915). Subclasses implement ``__getitem__`` (int -> one row
+    [F]; slice -> batch [K, F]) and ``__len__``; ``batch_size`` controls
+    the streaming read granularity. The raw [N, F] matrix is never
+    materialized — sampling uses random row access, construction reads
+    ``batch_size`` rows at a time."""
+
+    batch_size = 4096
+
+    def __getitem__(self, idx):  # pragma: no cover - abstract
+        raise NotImplementedError("Sequence subclasses implement __getitem__")
+
+    def __len__(self):  # pragma: no cover - abstract
+        raise NotImplementedError("Sequence subclasses implement __len__")
+
+
+def _as_sequences(data):
+    """data as a list of Sequence objects, or None when not Sequence-like."""
+    if isinstance(data, Sequence):
+        return [data]
+    if isinstance(data, (list, tuple)) and data \
+            and all(isinstance(s, Sequence) for s in data):
+        return list(data)
+    return None
 
 
 class Dataset:
@@ -43,8 +72,8 @@ class Dataset:
         weight: Optional[_ArrayLike] = None,
         group: Optional[_ArrayLike] = None,
         init_score: Optional[_ArrayLike] = None,
-        feature_name: Union[str, Sequence[str]] = "auto",
-        categorical_feature: Union[str, Sequence] = "auto",
+        feature_name: Union[str, _Seq[str]] = "auto",
+        categorical_feature: Union[str, _Seq] = "auto",
         params: Optional[Dict[str, Any]] = None,
         free_raw_data: bool = True,
         position: Optional[_ArrayLike] = None,
@@ -137,6 +166,29 @@ class Dataset:
             None if self.feature_name == "auto" else list(self.feature_name))
         cat = (None if self.categorical_feature == "auto"
                else self.categorical_feature)
+        seqs = _as_sequences(self.data)
+        if seqs is not None:
+            self._inner = BinnedDataset.construct_from_sequences(
+                seqs,
+                max_bin=cfg.max_bin,
+                min_data_in_bin=cfg.min_data_in_bin,
+                bin_construct_sample_cnt=cfg.bin_construct_sample_cnt,
+                use_missing=cfg.use_missing,
+                zero_as_missing=cfg.zero_as_missing,
+                categorical_feature=cat,
+                feature_names=feature_names,
+                data_random_seed=cfg.get("data_random_seed", 1),
+                reference=ref_inner,
+                forcedbins_filename=str(
+                    cfg.get("forcedbins_filename", "") or ""),
+                max_bin_by_feature=cfg.get("max_bin_by_feature"),
+                enable_bundle=bool(cfg.get("enable_bundle", True)),
+                max_conflict_rate=float(cfg.get("max_conflict_rate", 1e-4)),
+            )
+            self._finish_metadata()
+            if self.free_raw_data:
+                self.data = None
+            return self
         self._inner = BinnedDataset.construct(
             self.data,
             max_bin=cfg.max_bin,
@@ -158,6 +210,12 @@ class Dataset:
             max_conflict_rate=float(
                 cfg.get("max_conflict_rate", 1e-4)),
         )
+        self._finish_metadata()
+        if self.free_raw_data:
+            self.data = None
+        return self
+
+    def _finish_metadata(self) -> None:
         md = self._inner.metadata
         if self.label is not None:
             md.set_label(_maybe_series(self.label))
@@ -166,9 +224,6 @@ class Dataset:
             md.set_group(self.group)
         md.set_init_score(self.init_score)
         md.set_position(self.position)
-        if self.free_raw_data:
-            self.data = None
-        return self
 
     def subset(self, used_indices, params=None) -> "Dataset":
         """Row-subset Dataset sharing this dataset's bin mappers
@@ -324,6 +379,9 @@ class Dataset:
     def num_data(self) -> int:
         if self._inner is not None:
             return self._inner.num_data
+        seqs = _as_sequences(self.data)
+        if seqs is not None:
+            return int(sum(len(s) for s in seqs))
         arr = np.asarray(self.data if not hasattr(self.data, "values")
                          else self.data.values)
         return arr.shape[0]
@@ -331,6 +389,11 @@ class Dataset:
     def num_feature(self) -> int:
         if self._inner is not None:
             return self._inner.num_total_features
+        seqs = _as_sequences(self.data)
+        if seqs is not None:
+            probe = next((s for s in seqs if len(s)), None)
+            return (int(np.asarray(probe[0]).reshape(-1).shape[0])
+                    if probe is not None else 0)
         arr = np.asarray(self.data if not hasattr(self.data, "values")
                          else self.data.values)
         return arr.shape[1] if arr.ndim == 2 else 1

@@ -895,6 +895,13 @@ class GBDT:
         if os.environ.get("LGBM_TPU_FUSED_DUAL", "") == "0":
             gp = gp._replace(fused_dual=False)
             self.grower_params = gp
+        if os.environ.get("LGBM_TPU_FUSED_HIST_DEBUG", ""):
+            hd = os.environ["LGBM_TPU_FUSED_HIST_DEBUG"]
+            log.warning(f"LGBM_TPU_FUSED_HIST_DEBUG={hd}: fused kernel "
+                        "histogram work altered - results are INVALID "
+                        "(timing bisect)")
+            gp = gp._replace(fused_hist_debug=hd)
+            self.grower_params = gp
         if gp.fused_block and gp.efb_virtual and gp.fused_dual \
                 and not force_efb_fused:
             # KNOWN ISSUE: the DUAL-RESIDENCY fused kernel faults the TPU
@@ -916,8 +923,12 @@ class GBDT:
             # when the histogram alone would blow the ~16MB scoped limit
             c_rec = layout.num_cols
             bs = min(gp.fused_block, max(32, (49152 // c_rec) // 32 * 32))
-            f_hist_bytes = layout.num_features * \
-                -(-int(self.grower_params.num_bins) // 128) * 128 * 32
+            if os.environ.get("LGBM_TPU_FUSED_BS", ""):
+                bs = int(os.environ["LGBM_TPU_FUSED_BS"])  # perf experiments
+            from ..ops.fused_split import _hist_packing
+            _, f_pad, _ = _hist_packing(layout.num_features,
+                                        int(self.grower_params.num_bins))
+            f_hist_bytes = f_pad * int(self.grower_params.num_bins) * 32
             if f_hist_bytes > 6 << 20:
                 log.warning("fused kernel disabled: histogram accumulator "
                             f"needs {f_hist_bytes >> 20}MB VMEM; using the "

@@ -313,45 +313,9 @@ class BinnedDataset:
             from ..parallel.multihost import pool_bin_sample
             sample = pool_bin_sample(sample)
             total_sample_cnt = len(sample)
-            # user-forced bin boundaries, JSON list of {"feature": i,
-            # "bin_upper_bound": [...]} (reference: forcedbins_filename,
-            # DatasetLoader::GetForcedBins dataset_loader.cpp:1493)
-            forced: Dict[int, np.ndarray] = {}
-            if forcedbins_filename:
-                import json as _json
-                with open(forcedbins_filename) as fh:
-                    for entry in _json.load(fh):
-                        forced[int(entry["feature"])] = np.asarray(
-                            entry["bin_upper_bound"], np.float64)
-            if max_bin_by_feature is not None \
-                    and len(max_bin_by_feature) != f:
-                raise ValueError(
-                    "max_bin_by_feature needs one entry per feature")
-            mappers: List[BinMapper] = []
-            for j in range(f):
-                col = sample[:, j]
-                mb = (int(max_bin_by_feature[j])
-                      if max_bin_by_feature is not None else max_bin)
-                if j in cat_idx:
-                    m = find_bin_categorical(col, mb, min_data_in_bin)
-                else:
-                    m = find_bin_numerical(
-                        col,
-                        total_sample_cnt,
-                        mb,
-                        min_data_in_bin,
-                        use_missing=use_missing,
-                        zero_as_missing=zero_as_missing,
-                        forced_bounds=forced.get(j),
-                    )
-                mappers.append(m)
-            ds.mappers = mappers
-            ds.used_features = [j for j, m in enumerate(mappers) if not m.is_trivial]
-            if not ds.used_features:
-                log.warning("all features are constant; no informative splits possible")
-            # pad the bin axis to a shape-stable max_bin+1 so the jitted tree
-            # grower's compile key doesn't depend on the realized bin counts
-            ds.max_num_bins = max(max_bin + 1, 2)
+            _fit_mappers(ds, sample, f, cat_idx, max_bin, min_data_in_bin,
+                         use_missing, zero_as_missing, forcedbins_filename,
+                         max_bin_by_feature)
 
         # bin all columns
         dtype = np.uint8 if ds.max_num_bins <= 256 else np.uint16
@@ -369,18 +333,9 @@ class BinnedDataset:
             if info is not None:
                 binned = _apply_bundles(binned, info, ds, max_conflict_rate)
         elif enable_bundle and ds.max_num_bins <= 256:
-            from .efb import build_bundle_info, plan_bundles
-            dbins = np.array([m.default_bin for m in ds.mappers], np.int32)
-            nbins = np.array([m.num_bins for m in ds.mappers], np.int32)
-            ok = np.array(
-                [(not m.is_categorical) and m.missing_type != MISSING_NAN
-                 and not m.is_trivial for m in ds.mappers], bool)
             srows = min(n, 50_000)
-            bundles = plan_bundles(binned[:srows], nbins, dbins, ok,
-                                   max_bin=max_bin,
-                                   max_conflict_rate=max_conflict_rate)
-            if bundles:
-                info = build_bundle_info(bundles, nbins, f)
+            info = _plan_efb(ds, binned[:srows], max_bin, max_conflict_rate)
+            if info is not None:
                 ds.bundle_info = info
                 binned = _apply_bundles(binned, info, ds, max_conflict_rate)
                 log.info(
@@ -390,6 +345,158 @@ class BinnedDataset:
         ds.metadata = Metadata(n)
         if keep_raw:
             ds.raw_data = arr
+        return ds
+
+    @staticmethod
+    def construct_from_sequences(
+        seqs: List[Any],
+        *,
+        max_bin: int = 255,
+        min_data_in_bin: int = 3,
+        bin_construct_sample_cnt: int = 200000,
+        use_missing: bool = True,
+        zero_as_missing: bool = False,
+        categorical_feature: Optional[Sequence[Union[int, str]]] = None,
+        feature_names: Optional[Sequence[str]] = None,
+        data_random_seed: int = 1,
+        reference: Optional["BinnedDataset"] = None,
+        forcedbins_filename: str = "",
+        max_bin_by_feature: Optional[Sequence[int]] = None,
+        enable_bundle: bool = True,
+        max_conflict_rate: float = 1e-4,
+    ) -> "BinnedDataset":
+        """Streaming construction from Sequence objects (random row access
+        + batched range reads): the raw [N, F] float matrix is NEVER
+        materialized — peak host memory is the packed bin matrix plus one
+        batch (reference: Sequence-based construction,
+        python-package/lightgbm/basic.py Sequence +
+        Dataset::PushOneRow/FinishLoad, include/LightGBM/dataset.h:583)."""
+        lens = [len(s) for s in seqs]
+        n = int(sum(lens))
+        if n == 0:
+            raise ValueError("empty Sequence data")
+        probe = next(s for s, m in zip(seqs, lens) if m > 0)
+        first = np.asarray(probe[0], np.float64).reshape(-1)
+        f = first.shape[0]
+        ds = BinnedDataset()
+        ds.num_data = n
+        ds.num_total_features = f
+        ds.feature_names = (list(feature_names) if feature_names is not None
+                            else [f"Column_{j}" for j in range(f)])
+        if len(ds.feature_names) != f:
+            raise ValueError("feature_names length mismatch")
+
+        offsets = np.cumsum([0] + lens)
+        if reference is not None:
+            if f != reference.num_total_features:
+                raise ValueError(
+                    f"validation data has {f} features, training data had "
+                    f"{reference.num_total_features}")
+            ds.mappers = reference.mappers
+            ds.max_num_bins = reference.max_num_bins
+            ds.used_features = reference.used_features
+            ds.categorical_features = reference.categorical_features
+            info = reference.bundle_info
+        else:
+            cat_idx = _resolve_categorical(categorical_feature,
+                                           ds.feature_names)
+            ds.categorical_features = sorted(cat_idx)
+            s_cnt = min(n, bin_construct_sample_cnt)
+            rng = np.random.RandomState(data_random_seed)
+            idx = np.sort(rng.choice(n, size=s_cnt, replace=False)) \
+                if s_cnt < n else np.arange(n)
+            sample = np.empty((s_cnt, f), np.float64)
+            si = np.searchsorted(offsets, idx, side="right") - 1
+            pos = 0
+            for sq_i, sq in enumerate(seqs):
+                local = (idx[si == sq_i] - offsets[sq_i]).astype(np.int64)
+                if not len(local):
+                    continue
+                m = len(sq)
+                if len(local) * 3 >= m:
+                    # dense sample: batched slice reads + subset (one
+                    # storage round trip per batch, not per row)
+                    bs0 = int(getattr(sq, "batch_size", 4096) or 4096)
+                    for a in range(0, m, bs0):
+                        sel = local[(local >= a) & (local < a + bs0)]
+                        if not len(sel):
+                            continue
+                        batch = np.asarray(sq[a:min(a + bs0, m)],
+                                           np.float64).reshape(-1, f)
+                        take = batch[sel - a]
+                        sample[pos:pos + len(take)] = take
+                        pos += len(take)
+                else:
+                    for i in local:
+                        sample[pos] = np.asarray(
+                            sq[int(i)], np.float64).reshape(-1)
+                        pos += 1
+            from ..parallel.multihost import pool_bin_sample
+            sample = pool_bin_sample(sample)
+            _fit_mappers(ds, sample, f, cat_idx, max_bin, min_data_in_bin,
+                         use_missing, zero_as_missing, forcedbins_filename,
+                         max_bin_by_feature)
+            info = None
+            if enable_bundle and ds.max_num_bins <= 256:
+                # cap the planning sample like the in-memory path: the
+                # planner's occupancy matrix scales with sample rows
+                sb = _bin_chunk(ds.mappers, sample[:50_000], np.uint8)
+                info = _plan_efb(ds, sb, max_bin, max_conflict_rate)
+
+        dtype = np.uint8 if ds.max_num_bins <= 256 else np.uint16
+        dbins_all = np.array([m.default_bin for m in ds.mappers], np.int32)
+
+        def stream(binfo):
+            from .efb import bundle_chunk
+            cols = binfo.n_columns if binfo is not None else f
+            out = np.zeros((n, cols), dtype)
+            conflicts = 0
+            pos = 0
+            for sq in seqs:
+                bs = int(getattr(sq, "batch_size", 4096) or 4096)
+                m = len(sq)
+                for a in range(0, m, bs):
+                    raw = np.asarray(sq[a:min(a + bs, m)], np.float64)
+                    if raw.ndim == 1:
+                        raw = raw.reshape(1, -1)
+                    if raw.shape[1] != f:
+                        raise ValueError(
+                            f"Sequence batch has {raw.shape[1]} features, "
+                            f"expected {f}")
+                    if raw.shape[0] != min(a + bs, m) - a:
+                        raise ValueError(
+                            "Sequence slice returned "
+                            f"{raw.shape[0]} rows for a "
+                            f"{min(a + bs, m) - a}-row range")
+                    chunk = _bin_chunk(ds.mappers, raw, dtype)
+                    k = chunk.shape[0]
+                    if binfo is not None:
+                        enc, cf = bundle_chunk(chunk, binfo, dbins_all)
+                        conflicts += cf
+                        out[pos:pos + k] = enc
+                    else:
+                        out[pos:pos + k] = chunk
+                    pos += k
+            if pos != n:
+                raise ValueError(
+                    f"Sequences yielded {pos} rows, __len__ promised {n}")
+            return out, conflicts
+
+        out, conflicts = stream(info)
+        if info is not None and reference is None:
+            from .efb import conflict_allowance
+            if conflicts > conflict_allowance(info, n, max_conflict_rate):
+                log.warning("EFB: feature conflict outside the planning "
+                            "sample; keeping the dense matrix")
+                info = None
+                out, _ = stream(None)
+            else:
+                log.info(
+                    f"EFB: bundled {info.n_bundled} of {f} features into "
+                    f"{info.n_columns} stored columns (streaming)")
+        ds.bundle_info = info
+        ds.binned = out
+        ds.metadata = Metadata(n)
         return ds
 
     # -- views for the tree learner ----------------------------------------
@@ -409,6 +516,78 @@ class BinnedDataset:
 
     def feature_is_categorical(self) -> np.ndarray:
         return np.array([m.is_categorical for m in self.mappers], dtype=bool)
+
+
+def _plan_efb(ds, sample_binned, max_bin, max_conflict_rate):
+    """Plan Exclusive Feature Bundling from a binned sample; returns
+    BundleInfo or None (shared by the in-memory and streaming paths)."""
+    from .efb import build_bundle_info, plan_bundles
+    dbins = np.array([m.default_bin for m in ds.mappers], np.int32)
+    nbins = np.array([m.num_bins for m in ds.mappers], np.int32)
+    ok = np.array(
+        [(not m.is_categorical) and m.missing_type != MISSING_NAN
+         and not m.is_trivial for m in ds.mappers], bool)
+    bundles = plan_bundles(sample_binned, nbins, dbins, ok, max_bin=max_bin,
+                           max_conflict_rate=max_conflict_rate)
+    if not bundles:
+        return None
+    return build_bundle_info(bundles, nbins, ds.num_total_features)
+
+
+def _bin_chunk(mappers, arr: np.ndarray, dtype) -> np.ndarray:
+    """Bin a raw [K, F] float chunk with fitted mappers."""
+    out = np.zeros(arr.shape, dtype=dtype)
+    for j, m in enumerate(mappers):
+        if m.is_trivial:
+            continue
+        out[:, j] = m.value_to_bin(arr[:, j]).astype(dtype)
+    return out
+
+
+def _fit_mappers(ds, sample, f, cat_idx, max_bin, min_data_in_bin,
+                 use_missing, zero_as_missing, forcedbins_filename,
+                 max_bin_by_feature):
+    """Fit per-feature BinMappers from a sample (shared by the in-memory
+    and streaming construction paths)."""
+    total_sample_cnt = len(sample)
+    # user-forced bin boundaries, JSON list of {"feature": i,
+    # "bin_upper_bound": [...]} (reference: forcedbins_filename,
+    # DatasetLoader::GetForcedBins dataset_loader.cpp:1493)
+    forced: Dict[int, np.ndarray] = {}
+    if forcedbins_filename:
+        import json as _json
+        with open(forcedbins_filename) as fh:
+            for entry in _json.load(fh):
+                forced[int(entry["feature"])] = np.asarray(
+                    entry["bin_upper_bound"], np.float64)
+    if max_bin_by_feature is not None and len(max_bin_by_feature) != f:
+        raise ValueError("max_bin_by_feature needs one entry per feature")
+    mappers: List[BinMapper] = []
+    for j in range(f):
+        col = sample[:, j]
+        mb = (int(max_bin_by_feature[j])
+              if max_bin_by_feature is not None else max_bin)
+        if j in cat_idx:
+            m = find_bin_categorical(col, mb, min_data_in_bin)
+        else:
+            m = find_bin_numerical(
+                col,
+                total_sample_cnt,
+                mb,
+                min_data_in_bin,
+                use_missing=use_missing,
+                zero_as_missing=zero_as_missing,
+                forced_bounds=forced.get(j),
+            )
+        mappers.append(m)
+    ds.mappers = mappers
+    ds.used_features = [j for j, m in enumerate(mappers) if not m.is_trivial]
+    if not ds.used_features:
+        log.warning("all features are constant; no informative splits "
+                    "possible")
+    # pad the bin axis to a shape-stable max_bin+1 so the jitted tree
+    # grower's compile key doesn't depend on the realized bin counts
+    ds.max_num_bins = max(max_bin + 1, 2)
 
 
 def _apply_bundles(binned, info, ds, max_conflict_rate=1e-4):

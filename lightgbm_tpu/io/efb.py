@@ -97,10 +97,10 @@ def plan_bundles(
     nzT = np.ascontiguousarray(nonzero.T[order])               # [J, S] bool
     bundles: List[List[int]] = []
     nb_alloc = 256
-    # past this many open bundles the data clearly isn't bundling; give up
-    # rather than let the occupancy matrix grow toward F x S (cap sized to
-    # a ~512MB occupancy budget)
-    nb_cap = max(1024, (512 << 20) // (4 * max(s, 1)))
+    # stop OPENING bundles once the occupancy matrix would pass ~512MB
+    # (features past the cap stay unbundled; already-planned bundles keep
+    # accepting members)
+    nb_cap = max(64, (512 << 20) // (4 * s))
     occ = np.zeros((nb_alloc, s), np.float32)       # [NB, S] occupancy
     used_bins = np.zeros(nb_alloc, np.int64)
     conflicts_used = np.zeros(nb_alloc, np.int64)
@@ -125,7 +125,7 @@ def plan_bundles(
                 placed = True
         if not placed:
             if nbundles >= nb_cap:
-                return None
+                continue
             if nbundles == nb_alloc:
                 nb_alloc *= 2
                 occ = np.concatenate(
@@ -200,26 +200,20 @@ def unbundle(bundled: np.ndarray, info: BundleInfo, default_bins: np.ndarray,
     return out
 
 
-def bundle_matrix(binned: np.ndarray, info: BundleInfo,
-                  default_bins: np.ndarray,
-                  max_conflict_rate: float = 1e-4) -> Optional[np.ndarray]:
-    """Re-encode the dense [N, F] binned matrix into [N, n_columns], or None
-    when far more conflicts appear than planned (caller keeps dense).
+def bundle_chunk(binned: np.ndarray, info: BundleInfo,
+                 default_bins: np.ndarray):
+    """Re-encode one [K, F] binned chunk into ([K, n_columns] u8,
+    conflict count). Row-local, so streaming construction applies it
+    chunk by chunk (reference: PushOneRow per-group push,
+    include/LightGBM/feature_group.h).
 
-    Conflicting rows (two members nonzero) keep the FIRST-placed member's
-    value — the planning order, matching the reference's bounded-conflict
-    semantics (a conflicting row simply loses the later feature's bin,
-    src/io/dataset.cpp FindGroups). With a conflict-free plan this is exact.
-
-    (When constructing from raw columns the caller can stream feature by
-    feature instead of materializing [N, F] first; this dense variant serves
-    the in-memory path.)"""
+    Iterates features in PLACEMENT order (ascending offset within each
+    column) so a conflicting row keeps the FIRST-PLACED member's value,
+    matching the planner's conflict accounting and the reference's drop
+    order."""
     n = binned.shape[0]
     out = np.zeros((n, info.n_columns), np.uint8)
     conflicts = 0
-    # iterate in PLACEMENT order (ascending offset within each column) so a
-    # conflicting row keeps the FIRST-PLACED member's value, matching the
-    # planner's conflict accounting and the reference's drop order
     order = np.lexsort((info.offset_of, info.col_of))
     for j in order:
         c = info.col_of[j]
@@ -236,14 +230,39 @@ def bundle_matrix(binned: np.ndarray, info: BundleInfo,
             conflicts += int(nz.sum()) - int(write.sum())
             out[write, c] = (info.offset_of[j] + 1
                              + col[write].astype(np.int64)).astype(np.uint8)
-    # the planner budgeted max_conflict_rate * sample rows PER bundle; allow
-    # the same rate on the full data (x4 slack for sampling noise) before
-    # declaring the sample unrepresentative and keeping the dense matrix
+    return out, conflicts
+
+
+def conflict_allowance(info: BundleInfo, n: int,
+                       max_conflict_rate: float) -> int:
+    """Full-data conflict budget: the planner allowed max_conflict_rate *
+    sample rows PER bundle, so grant the same rate over n rows (x4 slack
+    for sampling noise). Rate 0 is the lossless contract — ANY conflict
+    must fall back to dense."""
+    if max_conflict_rate <= 0:
+        return 0
     n_bundle_cols = len(
         {int(c) for c, o in zip(info.col_of, info.offset_of) if o >= 0})
-    # rate 0 is the lossless contract: ANY conflict falls back to dense
-    allowed = (max(int(4 * max_conflict_rate * n * max(n_bundle_cols, 1)), 16)
-               if max_conflict_rate > 0 else 0)
+    return max(int(4 * max_conflict_rate * n * max(n_bundle_cols, 1)), 16)
+
+
+def bundle_matrix(binned: np.ndarray, info: BundleInfo,
+                  default_bins: np.ndarray,
+                  max_conflict_rate: float = 1e-4) -> Optional[np.ndarray]:
+    """Re-encode the dense [N, F] binned matrix into [N, n_columns], or None
+    when far more conflicts appear than planned (caller keeps dense).
+
+    Conflicting rows (two members nonzero) keep the FIRST-placed member's
+    value — the planning order, matching the reference's bounded-conflict
+    semantics (a conflicting row simply loses the later feature's bin,
+    src/io/dataset.cpp FindGroups). With a conflict-free plan this is exact.
+
+    (When constructing from raw columns the caller can stream feature by
+    feature instead of materializing [N, F] first; this dense variant serves
+    the in-memory path.)"""
+    n = binned.shape[0]
+    out, conflicts = bundle_chunk(binned, info, default_bins)
+    allowed = conflict_allowance(info, n, max_conflict_rate)
     if conflicts > allowed:
         return None
     if conflicts:

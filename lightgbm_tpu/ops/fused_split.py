@@ -57,6 +57,7 @@ split as ops/pallas_histogram.py: counts exact, grad/hess ~2^-17 relative.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Tuple
 
 import jax
@@ -86,6 +87,26 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+def _hist_packing(f: int, b: int):
+    """Histogram lane packing: (bin stride per feature, padded feature
+    count, matmul group width in features).
+
+    Bin counts that tile 128 lanes exactly (64/32/16/128/256...) pack
+    tightly — at B <= 64 that fits 2+ features per lane tile (the
+    reference's GPU learner defaults to 63 bins for the same reason,
+    ref: docs/GPU-Performance.rst:133). Awkward bin counts whose
+    lcm(b, 128) exceeds the 512-lane matmul target fall back to
+    128-padded strides so the one-hot operand stays bounded."""
+    align = 128 // math.gcd(b, 128)
+    stride = b
+    if align * b > 512:
+        stride = _round_up(b, 128)
+        align = 1
+    f_pad = _round_up(f, align)
+    group = align * max(1, 512 // (align * stride))
+    return stride, f_pad, group
+
+
 def _assemble_f32(blk_i32, off: int):
     """4 u8 lanes at static offset ``off`` -> f32 column [BS, 1].
 
@@ -104,7 +125,8 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
                   hist_ref, sem_in, sem_l, sem_r, sem_aux, inbuf, lcarry,
                   rcarry, lstage, rstage, auxbuf, smem, *, layout: RowLayout,
                   num_bins: int, bs: int, bitset_words: int, use_int8: bool,
-                  interpret: bool, dual: bool):
+                  interpret: bool, dual: bool,
+                  hist_debug: str = ""):
     # dual=True: dual residency — rights land LIVE in the other array at the
     #   same offsets (RMW blends protect neighbour segments; auxbuf=[bs,C]
     #   rmw buffer, sem_aux=single DMA sem). The grower merges once per tree.
@@ -115,7 +137,7 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
     F = layout.num_features
     C = layout.num_cols
     B = num_bins
-    Bk = _round_up(B, 128)
+    BS_, F_pad, _ = _hist_packing(F, B)   # BS_: bin stride per feature
     i32 = jnp.int32
 
     mode = sp_ref[_MODE]
@@ -154,7 +176,7 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
     # strict lower triangular: ranks via MXU (int8 runs at 2x bf16 rate)
     lt = (io2 > jo2).astype(jnp.int8 if use_int8 else jnp.bfloat16)
     iota4 = lax.broadcasted_iota(i32, (4 * bs, bs), 0)
-    iota_b = lax.broadcasted_iota(i32, (bs, Bk), 1)
+    iota_b = lax.broadcasted_iota(i32, (bs, BS_), 1)
 
     def carry_block_i32(c):
         """First BS carry rows as exact [BS, C] i32 byte values.
@@ -211,6 +233,8 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
 
     def hist_accum(rows_u8, mask_f32):
         """Accumulate masked rows of a [BS, C] u8 buffer into hist_ref."""
+        if hist_debug == "off":
+            return  # timing bisect: histograms disabled (results invalid)
         rows = rows_u8.astype(i32)
         bins = rows[:, :F]
         m = mask_f32[:, None]                              # [BS, 1]
@@ -236,17 +260,33 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
         for k, c in enumerate(chans):
             ch8 = ch8 + jnp.where(lane8 == k, c, 0.0)
         ch8 = ch8.astype(jnp.bfloat16)
-        w = max(1, min(F, 512 // Bk))
+        if hist_debug == "assembly":
+            # consume ch8 with one cheap matmul; skip the one-hot loop
+            ones = jnp.ones((bs, 128), jnp.bfloat16)
+            hist_ref[:, 0:128] += lax.dot_general(
+                ch8, ones, dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return
+        if hist_debug == "matmul":
+            # constant channels: skip the byte assembly's cost, keep the
+            # full one-hot loop below
+            ch8 = jnp.ones((bs, 8), jnp.bfloat16)
+        # tightly packed: each feature spans B lanes (not 128-padded), so
+        # B <= 64 fits 2+ features per lane tile; group widths and offsets
+        # stay 128-aligned via the align unit from _hist_packing
+        _, _, w = _hist_packing(F, B)   # group width (features)
+        zero_col = jnp.full((bs, 1), -1, i32)   # matches no bin lane
         fc = 0
-        while fc < F:
-            wc = min(w, F - fc)
+        while fc < F_pad:
+            wc = min(w, F_pad - fc)
             oh = jnp.concatenate(
-                [(bins[:, fc + j:fc + j + 1] == iota_b).astype(jnp.bfloat16)
-                 for j in range(wc)], axis=1)            # [BS, wc*Bk]
+                [((bins[:, fc + j:fc + j + 1] if fc + j < F else zero_col)
+                  == iota_b).astype(jnp.bfloat16)
+                 for j in range(wc)], axis=1)            # [BS, wc*B]
             part = lax.dot_general(
                 ch8, oh, dimension_numbers=(((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)      # [8, wc*Bk]
-            hist_ref[:, fc * Bk:(fc + wc) * Bk] += part
+                preferred_element_type=jnp.float32)      # [8, wc*B]
+            hist_ref[:, fc * BS_:(fc + wc) * BS_] += part
             fc += wc
 
     def stage_flush(stream, data_u8, hbm_base, do_hist, hist_mask):
@@ -532,7 +572,7 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
 @functools.partial(
     jax.jit,
     static_argnames=("layout", "num_bins", "block_size", "bitset_words",
-                     "interpret", "dual"))
+                     "interpret", "dual", "hist_debug"))
 def fused_split(
     work: jnp.ndarray,          # [N + pad, C] u8, C % 128 == 0
     scratch: jnp.ndarray,       # [N + pad, C] u8
@@ -554,6 +594,7 @@ def fused_split(
     smaller_left=None,
     side=None,                  # i32: 0 = parent lives in work, 1 = scratch
     dual: bool = True,
+    hist_debug: str = "",       # timing bisect only (see GrowerParams)
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One fused split. Returns (work', scratch', hist_smaller [F, B, 4]).
 
@@ -582,7 +623,7 @@ def fused_split(
     if block_size % _A:
         raise ValueError(f"block_size must be a multiple of {_A}")
     B = num_bins
-    Bk = _round_up(B, 128)
+    BS_, F_pad, _ = _hist_packing(F, B)
     i32 = jnp.int32
 
     start = start.astype(i32)
@@ -618,7 +659,8 @@ def fused_split(
     carry_t = jnp.int32 if use_int8 else jnp.float32
     kernel = functools.partial(
         _fused_kernel, layout=layout, num_bins=B, bs=bs, bitset_words=W,
-        use_int8=use_int8, interpret=interpret, dual=dual)
+        use_int8=use_int8, interpret=interpret, dual=dual,
+        hist_debug=hist_debug)
 
     work_o, scr_o, hist8 = pl.pallas_call(
         kernel,
@@ -651,14 +693,14 @@ def fused_split(
         out_shape=[
             jax.ShapeDtypeStruct(work.shape, work.dtype),
             jax.ShapeDtypeStruct(scratch.shape, scratch.dtype),
-            jax.ShapeDtypeStruct((8, F * Bk), jnp.float32),
+            jax.ShapeDtypeStruct((8, F_pad * BS_), jnp.float32),
         ],
         input_output_aliases={2: 0, 3: 1},
         compiler_params=pltpu.CompilerParams(has_side_effects=True),
         interpret=interpret,
     )(sp, cat_bitset, work, scratch)
 
-    hist8 = hist8.reshape(8, F, Bk)[:, :, :B]
+    hist8 = hist8.reshape(8, F_pad, BS_)[:, :F, :B]
     hist = jnp.transpose(hist8[:4] + hist8[4:], (1, 2, 0))  # [F, B, 4]
     return work_o, scr_o, hist
 

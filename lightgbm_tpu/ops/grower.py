@@ -97,6 +97,12 @@ class GrowerParams(NamedTuple):
     # segments stay in work, rights re-stream through scratch — slower, but
     # immune to the open dual+EFB TPU fault (ops/fused_split.py docstring)
     fused_dual: bool = True
+    # timing bisect only (LGBM_TPU_FUSED_HIST_DEBUG=off|assembly|matmul):
+    # disable all hist work / run channel assembly only / run one-hot
+    # matmuls with constant channels — results are INVALID, timings
+    # decompose the fused kernel's histogram cost
+    fused_hist_debug: str = ""
+
     # EFB (io/efb.py): the scan axis extends past the stored columns with
     # one virtual feature per bundled original (0 = bundling off)
     efb_virtual: int = 0

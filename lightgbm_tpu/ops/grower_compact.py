@@ -190,7 +190,8 @@ def grow_tree_compact(
             work, scratch, jnp.asarray(1, i32), zero, jnp.asarray(n, i32),
             zero, zero, zero, zero, zero, zero,
             jnp.zeros((W,), jnp.uint32), layout, B, params.fused_block, W,
-            interpret=params.fused_interpret, dual=params.fused_dual)
+            interpret=params.fused_interpret, dual=params.fused_dual,
+            hist_debug=params.fused_hist_debug)
     else:
         root_loc = seg_hist(work, jnp.asarray(0, i32), jnp.asarray(n, i32))
     # data-parallel: histograms psum over the mesh axis (reference: the
@@ -459,7 +460,7 @@ def grow_tree_compact(
                 bits, layout, B, params.fused_block, W,
                 interpret=params.fused_interpret,
                 smaller_left=left_smaller.astype(i32), side=side_p,
-                dual=params.fused_dual)
+                dual=params.fused_dual, hist_debug=params.fused_hist_debug)
         else:
             work, scratch = partition_segment(
                 st.work, st.scratch, s_, m_eff, n_left_eff, f_col, b_, dl,

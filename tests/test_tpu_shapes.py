@@ -1,0 +1,46 @@
+"""TPU-hardware regression tests for shapes that only fault on real Mosaic.
+
+The round-4 fused+EFB fault (dual-residency kernel crashing the TPU worker
+on EFB-bundled 255-leaf trees) was invisible to the CPU suite because
+interpret mode never triggered it. These tests run the failing shape in a
+fresh subprocess against the real TPU backend (the in-process suite is
+pinned to CPU by conftest) and are skipped where no TPU is attached.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tpu_platform() -> str:
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=120, cwd=_ROOT)
+        return out.stdout.strip().splitlines()[-1] if out.stdout else ""
+    except Exception:
+        return ""
+
+
+_PLATFORM = _tpu_platform()
+
+
+@pytest.mark.skipif(_PLATFORM not in ("tpu", "axon"),
+                    reason="needs a real TPU backend (Mosaic)")
+def test_fused_efb_deep_tree_shape():
+    """The Allstate-like shape: ~4228 one-hot features EFB-bundled to ~529
+    columns, 255 leaves, fused kernel on. Round 4's dual-residency kernel
+    reproducibly crashed the TPU worker here; the copy-back variant must
+    train it to completion (BENCH_SHAPES.json 'allstate')."""
+    env = dict(os.environ, REPRO_ROWS="60000", REPRO_ITERS="2",
+               REPRO_LEAVES="255")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "scripts", "repro_fused_efb.py")],
+        capture_output=True, text=True, timeout=1500, env=env, cwd=_ROOT)
+    assert "REPRO_OK" in out.stdout, (
+        f"fused EFB deep-tree training did not complete\n"
+        f"stdout:\n{out.stdout[-3000:]}\nstderr:\n{out.stderr[-3000:]}")
