@@ -775,6 +775,7 @@ class GBDT:
             if md.weight is not None else None)
         self._grad_fn = None
         self._step_fn = None
+        self._comm_hlo: Dict[str, str] = {}
 
     def _build_step_fn(self):
         """One fused, jitted train step per tree: mask gradients, grow, renew,
@@ -860,7 +861,22 @@ class GBDT:
             return tree, row_leaf, new_score, cegb_used, cegb_charged
 
         use_lazy = self._cegb_lazy is not None
-        return jax.jit(step)
+        jitted = jax.jit(step)
+        if os.environ.get("LGBM_TPU_COMM_ACCOUNTING", "") == "1":
+            outer = jitted
+
+            def capture(*args):
+                if "step" not in self._comm_hlo:
+                    self._comm_hlo["step"] = \
+                        outer.lower(*args).compile().as_text()
+                return outer(*args)
+            return capture
+        return jitted
+
+    # comm-volume accounting (dryrun_multichip): compiled-HLO text of the
+    # train-step programs, captured when LGBM_TPU_COMM_ACCOUNTING=1 so the
+    # dryrun can parse the collectives XLA actually inserted
+    _comm_hlo: Dict[str, str]
 
     # -- compact (physically partitioned) serial path ------------------------
     def _setup_compact_state(self) -> None:
@@ -926,9 +942,9 @@ class GBDT:
             if os.environ.get("LGBM_TPU_FUSED_BS", ""):
                 bs = int(os.environ["LGBM_TPU_FUSED_BS"])  # perf experiments
             from ..ops.fused_split import _hist_packing
-            _, f_pad, _ = _hist_packing(layout.num_features,
-                                        int(self.grower_params.num_bins))
-            f_hist_bytes = f_pad * int(self.grower_params.num_bins) * 32
+            stride, f_pad, _ = _hist_packing(
+                layout.num_features, int(self.grower_params.num_bins))
+            f_hist_bytes = f_pad * stride * 32
             if f_hist_bytes > 6 << 20:
                 log.warning("fused kernel disabled: histogram accumulator "
                             f"needs {f_hist_bytes >> 20}MB VMEM; using the "
@@ -1244,6 +1260,9 @@ class GBDT:
                 fns[k] = jax.jit(
                     smap(functools.partial(step, k=k), in_specs, out_specs),
                     donate_argnums=(0, 1))
+                if os.environ.get("LGBM_TPU_COMM_ACCOUNTING", "") == "1":
+                    self._comm_hlo[f"compact_step_k{k}"] = \
+                        fns[k].lower(*args).compile().as_text()
             return fns[k](*args)
 
         return dispatch

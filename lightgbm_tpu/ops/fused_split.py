@@ -80,7 +80,7 @@ _MODE, _BASE_T, _PHI, _COUNT, _NLEFT, _FEAT, _BIN, _DLEFT, _NANBIN, _ISCAT, \
     _SMALLER_L, _RBASE_T, _PSI, _SIDE = range(14)
 
 # smem bookkeeping slots
-_LCNT, _RCNT, _LF, _RF, _CBW = range(5)
+_LCNT, _RCNT, _LF, _RF, _CBW, _PEND = range(6)
 
 
 def _round_up(x: int, m: int) -> int:
@@ -123,8 +123,9 @@ def _assemble_f32(blk_i32, off: int):
 
 def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
                   hist_ref, sem_in, sem_l, sem_r, sem_aux, inbuf, lcarry,
-                  rcarry, lstage, rstage, auxbuf, smem, *, layout: RowLayout,
-                  num_bins: int, bs: int, bitset_words: int, use_int8: bool,
+                  rcarry, lstage, rstage, auxbuf, pendbuf, pendch, smem, *,
+                  layout: RowLayout, num_bins: int, bs: int,
+                  bitset_words: int, use_int8: bool,
                   interpret: bool, dual: bool,
                   hist_debug: str = ""):
     # dual=True: dual residency — rights land LIVE in the other array at the
@@ -158,6 +159,17 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
     start = base + phi
     span = phi + count
     nblocks = (span + bs - 1) // bs
+    n_rows = work_out.shape[0]          # static padded row count
+
+    def clamp_base(b):
+        """Clamp a 32-aligned row base into [0, n_rows - bs], keeping the
+        provable alignment Mosaic's DMA checker needs (t * 32 form).
+        Defense-in-depth: a split whose scan-side n_left disagrees with the
+        kernel's own routing (garbage histograms, or a latent scan bug) must
+        corrupt data at worst — never DMA outside the arrays and fault the
+        worker."""
+        cap_t = (n_rows - bs) // _A
+        return jnp.clip(b // _A, 0, cap_t) * _A
 
     hist_ref[:, :] = jnp.zeros_like(hist_ref)
     smem[_LCNT] = 0
@@ -165,6 +177,7 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
     smem[_LF] = 0
     smem[_RF] = 0
     smem[_CBW] = 0
+    smem[_PEND] = 0
     lcarry[:, :] = jnp.zeros_like(lcarry)
     rcarry[:, :] = jnp.zeros_like(rcarry)
     auxbuf[...] = jnp.zeros_like(auxbuf)
@@ -176,7 +189,6 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
     # strict lower triangular: ranks via MXU (int8 runs at 2x bf16 rate)
     lt = (io2 > jo2).astype(jnp.int8 if use_int8 else jnp.bfloat16)
     iota4 = lax.broadcasted_iota(i32, (4 * bs, bs), 0)
-    iota_b = lax.broadcasted_iota(i32, (bs, BS_), 1)
 
     def carry_block_i32(c):
         """First BS carry rows as exact [BS, C] i32 byte values.
@@ -219,6 +231,8 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
     def rmw_read(off):
         """Synchronously fetch one block of the right-destination array
         (dual residency only — the destination may hold live neighbours)."""
+        off = clamp_base(off)
+
         @pl.when(side == 0)
         def _():
             pltpu.make_async_copy(
@@ -231,12 +245,10 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
         pltpu.make_async_copy(
             work_out.at[pl.ds(0, bs), :], auxbuf, sem_aux).wait()
 
-    def hist_accum(rows_u8, mask_f32):
-        """Accumulate masked rows of a [BS, C] u8 buffer into hist_ref."""
-        if hist_debug == "off":
-            return  # timing bisect: histograms disabled (results invalid)
+    def assemble_ch8(rows_u8, mask_f32):
+        """Masked rows of a [BS, C] u8 buffer -> the [BS, 8] bf16 channel
+        operand (grad-hi, hess-hi, in-bag, raw, grad-lo, hess-lo, 0, 0)."""
         rows = rows_u8.astype(i32)
-        bins = rows[:, :F]
         m = mask_f32[:, None]                              # [BS, 1]
         g = _assemble_f32(rows, layout.grad_off) * m
         h = _assemble_f32(rows, layout.hess_off) * m
@@ -259,22 +271,26 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
         ch8 = jnp.zeros((bs, 8), jnp.float32)
         for k, c in enumerate(chans):
             ch8 = ch8 + jnp.where(lane8 == k, c, 0.0)
-        ch8 = ch8.astype(jnp.bfloat16)
-        if hist_debug == "assembly":
-            # consume ch8 with one cheap matmul; skip the one-hot loop
-            ones = jnp.ones((bs, 128), jnp.bfloat16)
-            hist_ref[:, 0:128] += lax.dot_general(
-                ch8, ones, dimension_numbers=(((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            return
-        if hist_debug == "matmul":
-            # constant channels: skip the byte assembly's cost, keep the
-            # full one-hot loop below
-            ch8 = jnp.ones((bs, 8), jnp.bfloat16)
+        return ch8.astype(jnp.bfloat16)
+
+    def hist_matmuls(rows_u8, ch8):
+        """One-hot contraction of a block's bins against its channel
+        operand, accumulated into hist_ref.
+
+        The one-hot for a whole feature group is built with ONE
+        constant-index lane gather + ONE compare, not a per-feature
+        single-lane broadcast loop (measured on v5e: 28 per-feature
+        broadcasts cost ~5us/block regardless of B — lane relayouts
+        dominate, not one-hot element count)."""
+        bins = rows_u8.astype(i32)[:, :F]
         # tightly packed: each feature spans B lanes (not 128-padded), so
         # B <= 64 fits 2+ features per lane tile; group widths and offsets
         # stay 128-aligned via the align unit from _hist_packing
+        # (a jnp.repeat-based batched lane spread was tried and lowers to
+        # far slower relayouts on this Mosaic toolchain: 0.54 vs 1.07 it/s
+        # on the 10.5M higgs bench)
         _, _, w = _hist_packing(F, B)   # group width (features)
+        iota_b = lax.broadcasted_iota(i32, (bs, BS_), 1)
         zero_col = jnp.full((bs, 1), -1, i32)   # matches no bin lane
         fc = 0
         while fc < F_pad:
@@ -282,12 +298,64 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
             oh = jnp.concatenate(
                 [((bins[:, fc + j:fc + j + 1] if fc + j < F else zero_col)
                   == iota_b).astype(jnp.bfloat16)
-                 for j in range(wc)], axis=1)            # [BS, wc*B]
+                 for j in range(wc)], axis=1)            # [BS, wc*BS_]
             part = lax.dot_general(
                 ch8, oh, dimension_numbers=(((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)      # [8, wc*B]
+                preferred_element_type=jnp.float32)      # [8, wc*BS_]
             hist_ref[:, fc * BS_:(fc + wc) * BS_] += part
             fc += wc
+
+    def hist_accum(rows_u8, mask_f32):
+        """Software-pipelined histogram push: the block's channel operand
+        is assembled NOW (a long serial VPU chain), but its matmuls run on
+        the NEXT push — so the MXU never stalls waiting on a freshly
+        computed ch8 (measured on v5e at 10.5M rows: the synchronous
+        VPU->MXU edge costs ~0.6 s/tree, ~60%% of tree time)."""
+        if hist_debug == "off":
+            return  # timing bisect: histograms disabled (results invalid)
+        if hist_debug == "assembly":
+            ch8 = assemble_ch8(rows_u8, mask_f32)
+            ones = jnp.ones((bs, 128), jnp.bfloat16)
+            hist_ref[:, 0:128] += lax.dot_general(
+                ch8, ones, dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return
+        if hist_debug == "matmul":
+            hist_matmuls(rows_u8, jnp.ones((bs, 8), jnp.bfloat16))
+            return
+        if hist_debug == "matmul2":
+            # data-dependent but trivially cheap ch8: defeats constant
+            # folding/hoisting so the matmuls' true cost is measured
+            cheap = (rows_u8[:, :8].astype(i32) + 1).astype(jnp.bfloat16)
+            hist_matmuls(rows_u8, cheap)
+            return
+        if hist_debug == "sync":
+            # the pre-pipelining behavior (timing comparison)
+            hist_matmuls(rows_u8, assemble_ch8(rows_u8, mask_f32))
+            return
+
+        # double-buffered pending slots: the matmuls read slot p while the
+        # assembly writes slot 1-p, so there is no write-after-read hazard
+        # forcing the two engine streams to serialize
+        pushes = smem[_PEND]
+        cur = lax.rem(pushes, 2)
+
+        @pl.when(pushes >= 1)
+        def _():
+            hist_matmuls(pendbuf[1 - cur], pendch[1 - cur])
+        pendch[cur] = assemble_ch8(rows_u8, mask_f32)
+        pendbuf[cur] = rows_u8
+        smem[_PEND] = pushes + 1
+
+    def hist_drain():
+        """Flush the deferred histogram block (end of kernel)."""
+        pushes = smem[_PEND]
+
+        @pl.when(pushes >= 1)
+        def _():
+            last = lax.rem(pushes - 1, 2)
+            hist_matmuls(pendbuf[last], pendch[last])
+            smem[_PEND] = 0
 
     def stage_flush(stream, data_u8, hbm_base, do_hist, hist_mask):
         """Write one full block via the stream's staging ring; maybe hist."""
@@ -305,6 +373,7 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
                 sem.at[slot]).wait()
 
         stage[slot] = data_u8
+        hbm_base = clamp_base(hbm_base)
 
         @pl.when(to_work)
         def _():
@@ -529,7 +598,7 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
             nb_cb = (psi + n_right_cb + bs - 1) // bs
 
             def cb_body(t, _):
-                win = rbase + t * bs
+                win = clamp_base(rbase + t * bs)
                 d1 = pltpu.make_async_copy(
                     scr_out.at[pl.ds(win, bs), :], inbuf.at[0], sem_in.at[0])
                 d2 = pltpu.make_async_copy(
@@ -567,6 +636,9 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
                         auxbuf.at[lax.rem(cw - back, 2)],
                         work_out.at[pl.ds(0, bs), :],
                         sem_aux.at[lax.rem(cw - back, 2)]).wait()
+
+    # deferred histogram block from the software pipeline (both modes)
+    hist_drain()
 
 
 @functools.partial(
@@ -626,9 +698,14 @@ def fused_split(
     BS_, F_pad, _ = _hist_packing(F, B)
     i32 = jnp.int32
 
-    start = start.astype(i32)
-    count = count.astype(i32)
-    n_left = n_left.astype(i32)
+    # scalar sanitization (defense-in-depth, no effect on legit inputs):
+    # bounds the kernel's block-loop trip counts and read windows even if a
+    # caller hands a segment produced from corrupt histograms
+    n_rows = work.shape[0]
+    start = jnp.clip(start.astype(i32), 0, n_rows - _A)
+    count = jnp.clip(count.astype(i32), 0,
+                     jnp.maximum(n_rows - block_size - start, 0))
+    n_left = jnp.clip(n_left.astype(i32), 0, count)
     n_left_eff = jnp.where(mode == 1, count, n_left)
     base_t = start // _A
     phi = start - base_t * _A
@@ -687,6 +764,8 @@ def fused_split(
                 pltpu.VMEM((2, bs, C), jnp.uint8),  # rstage
                 (pltpu.VMEM((bs, C), jnp.uint8) if dual
                  else pltpu.VMEM((2, bs, C), jnp.uint8)),   # auxbuf
+                pltpu.VMEM((2, bs, C), jnp.uint8),  # pendbuf (hist pipe)
+                pltpu.VMEM((2, bs, 8), jnp.bfloat16),  # pendch
                 pltpu.SMEM((8,), jnp.int32),
             ],
         ),

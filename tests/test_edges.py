@@ -1,0 +1,172 @@
+"""Edge-coverage: interaction combinations and parameter validation the
+reference's test_engine.py exercises heavily (missing-type x categorical x
+monotone x EFB x continued-training), asserting behavior — not just "runs".
+"""
+import numpy as np
+import pytest
+from sklearn.metrics import roc_auc_score
+
+import lightgbm_tpu as lgb
+
+
+def _mixed_data(n=3000, seed=0, nan_frac=0.15):
+    """Numerical + categorical + NaN-bearing features with a known signal."""
+    rng = np.random.RandomState(seed)
+    num = rng.randn(n, 3)
+    cat = rng.randint(0, 12, size=(n, 2)).astype(np.float64)
+    nanny = rng.randn(n, 2)
+    nanny[rng.rand(n, 2) < nan_frac] = np.nan
+    X = np.concatenate([num, cat, nanny], axis=1)
+    y = ((num[:, 0] + 0.8 * (cat[:, 0] % 3 == 1)
+          + 0.6 * np.nan_to_num(nanny[:, 0]) + 0.4 * rng.randn(n)) > 0.3)
+    return X, y.astype(np.float64)
+
+
+BASE = {"objective": "binary", "verbosity": -1, "num_leaves": 15,
+        "min_data_in_leaf": 5}
+
+
+class TestInteractionMatrix:
+    def test_missing_x_categorical_x_monotone(self):
+        X, y = _mixed_data()
+        params = dict(BASE, categorical_feature=[3, 4],
+                      monotone_constraints=[1, 0, 0, 0, 0, -1, 0])
+        bst = lgb.train(params, lgb.Dataset(
+            X, label=y, categorical_feature=[3, 4]), 15)
+        p = bst.predict(X)
+        assert roc_auc_score(y, p) > 0.75
+        # monotone direction actually holds on feature 0 (others at median)
+        grid = np.tile(np.nanmedian(X, axis=0), (20, 1))
+        grid[:, 0] = np.linspace(np.nanmin(X[:, 0]), np.nanmax(X[:, 0]), 20)
+        g = bst.predict(grid, raw_score=True)
+        assert (np.diff(g) >= -1e-6).all(), "monotone(+) violated"
+        # NaN rows route without error and predict finitely
+        assert np.isfinite(bst.predict(X[np.isnan(X[:, 5])])).all()
+
+    def test_missing_nan_vs_zero_as_missing(self):
+        X, y = _mixed_data(nan_frac=0.3)
+        b_nan = lgb.train(dict(BASE), lgb.Dataset(X, label=y), 8)
+        Xz = np.nan_to_num(X, nan=0.0)
+        ds = lgb.Dataset(Xz, label=y, params={"zero_as_missing": True})
+        b_zero = lgb.train(dict(BASE), ds, 8)
+        # both train to signal; zero-as-missing treats exact zeros as missing
+        assert roc_auc_score(y, b_nan.predict(X)) > 0.72
+        assert roc_auc_score(y, b_zero.predict(Xz)) > 0.7
+
+    def test_efb_x_continued_training(self):
+        rng = np.random.RandomState(2)
+        n, G, card = 3000, 40, 8
+        cats = rng.randint(0, card, size=(n, G))
+        X = np.zeros((n, G * card), np.float32)
+        for g in range(G):
+            X[np.arange(n), g * card + cats[:, g]] = 1.0
+        y = ((X @ (rng.randn(G * card) * .5)) > 0).astype(np.float64)
+        ds = lgb.Dataset(X, label=y)
+        b1 = lgb.train(dict(BASE), ds, 5)
+        assert ds._inner.bundle_info is not None
+        # continue training on a FRESH dataset (re-bundled independently)
+        b2 = lgb.train(dict(BASE), lgb.Dataset(X, label=y), 5, init_model=b1)
+        assert b2.num_trees() == 10
+        auc1 = roc_auc_score(y, b1.predict(X))
+        auc2 = roc_auc_score(y, b2.predict(X))
+        assert auc2 >= auc1 - 1e-9, (auc1, auc2)
+        # model text round-trips through the merge
+        b3 = lgb.Booster(model_str=b2.model_to_string())
+        np.testing.assert_allclose(b3.predict(X[:200]), b2.predict(X[:200]),
+                                   atol=1e-6)
+
+    def test_efb_x_missing_nan_features_stay_unbundled(self):
+        rng = np.random.RandomState(3)
+        n, G, card = 3000, 40, 8
+        cats = rng.randint(0, card, size=(n, G))
+        X = np.zeros((n, G * card + 1), np.float32)
+        for g in range(G):
+            X[np.arange(n), g * card + cats[:, g]] = 1.0
+        X[:, -1] = rng.randn(n)
+        X[rng.rand(n) < 0.2, -1] = np.nan        # NaN feature: not bundleable
+        y = ((np.nan_to_num(X[:, -1]) + X[:, 0]) > 0).astype(np.float64)
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train(dict(BASE), ds, 5)
+        info = ds._inner.bundle_info
+        assert info is not None
+        assert info.offset_of[-1] == -1          # NaN feature passthrough
+        assert roc_auc_score(y, bst.predict(X)) > 0.8
+
+    def test_categorical_x_continued_training_x_predict_leaf(self):
+        X, y = _mixed_data()
+        ds = lgb.Dataset(X, label=y, categorical_feature=[3, 4])
+        b1 = lgb.train(dict(BASE), ds, 4)
+        b2 = lgb.train(dict(BASE), lgb.Dataset(
+            X, label=y, categorical_feature=[3, 4]), 3, init_model=b1)
+        leaves = b2.predict(X[:50], pred_leaf=True)
+        assert leaves.shape == (50, 7)
+        assert (leaves >= 0).all()
+
+    def test_monotone_x_bagging_x_valid(self):
+        X, y = _mixed_data(seed=5)
+        params = dict(BASE, monotone_constraints=[1] + [0] * 6,
+                      bagging_fraction=0.7, bagging_freq=1, metric="auc")
+        ds = lgb.Dataset(X[:2400], label=y[:2400])
+        dv = ds.create_valid(X[2400:], label=y[2400:])
+        ev = {}
+        bst = lgb.train(params, ds, 12, valid_sets=[dv],
+                        callbacks=[lgb.record_evaluation(ev)])
+        assert len(ev["valid_0"]["auc"]) == 12
+        assert ev["valid_0"]["auc"][-1] > 0.7
+
+
+class TestParamValidation:
+    def test_label_length_mismatch(self):
+        X = np.random.randn(100, 4)
+        with pytest.raises((ValueError, Exception), match="[Ll]abel|length"):
+            lgb.train(dict(BASE), lgb.Dataset(X, label=np.zeros(50)), 2)
+
+    def test_predict_wrong_feature_count(self):
+        X, y = _mixed_data(n=500)
+        bst = lgb.train(dict(BASE), lgb.Dataset(X, label=y), 2)
+        with pytest.raises(ValueError, match="features"):
+            bst.predict(X[:, :3])
+
+    def test_unknown_objective(self):
+        from lightgbm_tpu.utils.log import LightGBMError
+        X, y = _mixed_data(n=300)
+        with pytest.raises(LightGBMError, match="objective"):
+            lgb.train({"objective": "no_such_objective", "verbosity": -1},
+                      lgb.Dataset(X, label=y), 2)
+
+    def test_garbage_model_string(self):
+        with pytest.raises(ValueError, match="model"):
+            lgb.Booster(model_str="definitely not a model")
+
+    def test_monotone_constraints_wrong_length(self):
+        X, y = _mixed_data(n=400)
+        with pytest.raises((ValueError, Exception)):
+            lgb.train(dict(BASE, monotone_constraints=[1, -1]),
+                      lgb.Dataset(X, label=y), 2)
+
+    def test_num_boost_round_zero(self):
+        X, y = _mixed_data(n=300)
+        bst = lgb.train(dict(BASE), lgb.Dataset(X, label=y), 0)
+        assert bst.num_trees() == 0
+        # constant prediction (init score only, converted)
+        p = bst.predict(X[:10])
+        assert np.allclose(p, p[0])
+
+    def test_group_sum_mismatch_for_ranking(self):
+        X = np.random.randn(200, 5)
+        y = np.random.randint(0, 3, 200).astype(np.float64)
+        with pytest.raises((ValueError, Exception)):
+            lgb.train({"objective": "lambdarank", "verbosity": -1},
+                      lgb.Dataset(X, label=y, group=[50, 50]), 2)
+
+    def test_max_bin_by_feature_wrong_length(self):
+        X, y = _mixed_data(n=300)
+        with pytest.raises(ValueError, match="max_bin_by_feature"):
+            ds = lgb.Dataset(X, label=y,
+                             params={"max_bin_by_feature": [15, 31]})
+            ds.construct()
+
+    def test_feature_names_length_mismatch(self):
+        X, y = _mixed_data(n=300)
+        with pytest.raises(ValueError, match="feature_names"):
+            lgb.Dataset(X, label=y, feature_name=["a", "b"]).construct()
