@@ -920,16 +920,18 @@ class GBDT:
             self.grower_params = gp
         if gp.fused_block and gp.efb_virtual and gp.fused_dual \
                 and not force_efb_fused:
-            # KNOWN ISSUE: the DUAL-RESIDENCY fused kernel faults the TPU
-            # worker on EFB-bundled datasets with deep trees (reproduced at
-            # F=532 bundle columns, bs=64, num_leaves=255; dense wide
-            # records and small trees are fine, and the kernel passes
-            # standalone stress at the same shape — the trigger needs the
-            # full grower context). Until root-caused, bundled datasets run
-            # the fused kernel in its copy-back variant (round-3 design,
-            # ~1/3 more DMA per split but no dual-residency machinery).
-            log.info("EFB-bundled dataset: using the copy-back fused kernel "
-                     "variant (dual residency has an open TPU fault there)")
+            # HISTORY: through round 4 the dual-residency kernel faulted
+            # the TPU worker on EFB-bundled deep trees (F=532 bundle
+            # columns, bs=64, 255 leaves). Round 5's in-kernel DMA-base
+            # clamps fixed the fault — the hardened dual path now trains
+            # the repro shape to completion with leaf counts exactly
+            # matching an independent re-routing (scripts/
+            # check_leaf_counts.py) — but bundled data stays on the
+            # copy-back variant (round-3 design, ~1/3 more DMA per split,
+            # measured within noise of dual at this shape) for one more
+            # round of soak. LGBM_TPU_FORCE_FUSED_EFB=1 opts into dual.
+            log.info("EFB-bundled dataset: using the copy-back fused "
+                     "kernel variant")
             gp = gp._replace(fused_dual=False)
             self.grower_params = gp
         if gp.fused_block:
