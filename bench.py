@@ -28,6 +28,50 @@ WARMUP = int(os.environ.get("BENCH_WARMUP", 2))
 BASELINE_ITERS_PER_SEC = 3.84  # Higgs-10.5M CPU, docs/Experiments.rst:113
 
 
+def _clear_backend_cache(jax_mod):
+    """Drop jax's (possibly partially-populated) backend cache.
+
+    When plugin discovery initializes CPU first and the TPU plugin then
+    fails, xla_bridge has already cached ``_backends={'cpu'}`` before
+    raising — a plain ``jax.devices()`` retry would silently return that
+    CPU backend and the bench would publish a CPU number as a TPU result.
+    Clearing forces a genuine re-init on the next attempt."""
+    if getattr(jax_mod, "__name__", None) != "jax":
+        return      # test doubles manage their own state
+    try:
+        from jax._src import xla_bridge
+        xla_bridge._clear_backends()
+    except Exception:  # pragma: no cover - private API may move
+        pass
+
+
+def _init_backend_with_retry(jax_mod, attempts=3, base_delay_s=5.0):
+    """Return the default device, retrying transient backend-init failures.
+
+    TPU runtimes are occasionally mid-restart when the bench launches;
+    "Unable to initialize backend" / UNAVAILABLE errors then clear within
+    seconds. Each retry clears the backend cache first (see
+    _clear_backend_cache) so the re-init is real. Non-transient errors
+    re-raise immediately; the last transient attempt re-raises too, so the
+    driver still sees rc!=0 when the backend never comes up."""
+    for attempt in range(attempts):
+        try:
+            return jax_mod.devices()[0]
+        except Exception as err:  # noqa: BLE001 - classified below
+            msg = str(err)
+            transient = ("Unable to initialize backend" in msg
+                         or "UNAVAILABLE" in msg or "Unavailable" in msg)
+            if not transient or attempt == attempts - 1:
+                raise
+            delay = base_delay_s * (2 ** attempt)
+            sys.stderr.write(
+                f"[bench] backend init failed (attempt {attempt + 1}/"
+                f"{attempts}): {msg.splitlines()[0][:200]}; retrying in "
+                f"{delay:.0f}s\n")
+            _clear_backend_cache(jax_mod)
+            time.sleep(delay)
+
+
 def make_higgs_like(n, f, seed=7):
     """Dense float features + nonlinear binary target (Higgs-shaped)."""
     rng = np.random.RandomState(seed)
@@ -97,6 +141,7 @@ def run_ranking_bench():
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      ".jax_bench_cache")))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    _init_backend_with_retry(jax)
     import lightgbm_tpu as lgb
 
     rows = int(float(os.environ.get("BENCH_ROWS", 2_270_000)))
@@ -156,7 +201,9 @@ def main():
 
     import lightgbm_tpu as lgb
 
-    dev = jax.devices()[0]
+    dev = _init_backend_with_retry(jax)
+    # announce up front so a silent CPU fallback is visible in the artifact
+    sys.stderr.write(f"[bench] backend platform: {dev.platform}\n")
     sparse = os.environ.get("BENCH_SPARSE", "") == "1"
     if sparse:
         X, y = make_allstate_like(ROWS, FEATURES)

@@ -1,0 +1,21 @@
+"""Static analysis + runtime guard rails for the TPU training stack.
+
+Two halves (see ISSUE/README "Static analysis & runtime guards"):
+
+  * :mod:`lightgbm_tpu.analysis.tpulint` — an AST pass with repo-specific
+    hazard rules (R001-R005), run by ``scripts/tpulint`` and by the tier-1
+    suite (tests/test_tpulint.py). Import is dependency-light: the static
+    half never imports jax.
+  * :mod:`lightgbm_tpu.analysis.guards` — runtime assertions (recompile
+    counter, host-transfer guard) for steady-state training regions;
+    imports jax, so it is imported lazily here.
+"""
+from .tpulint import lint_paths, load_allowlist, main  # noqa: F401
+
+
+def __getattr__(name):
+    if name in ("compile_counter", "no_host_transfers",
+                "steady_state_guard", "CompileCount", "HostTransferError"):
+        from . import guards
+        return getattr(guards, name)
+    raise AttributeError(name)

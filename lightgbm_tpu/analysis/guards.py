@@ -1,0 +1,149 @@
+"""Runtime guard rails: recompile and host-transfer assertions.
+
+The static pass (analysis/tpulint.py) catches hazard *patterns*; these
+guards catch the *behavior* — they wrap a steady-state region (e.g. 5
+post-warmup boosting iterations) and fail loudly if jax compiles anything
+or an array is materialized on the host inside it.
+
+``compile_counter``
+    Counts compilations via ``jax.monitoring`` duration events.
+    ``lowerings`` (jaxpr->MLIR) increments on every in-memory cache miss —
+    including ones served by the persistent compilation cache, which skips
+    only the backend compile — so it is the honest "did jit re-trace"
+    signal. ``backend_compiles`` counts actual XLA compiles.
+
+``no_host_transfers``
+    Patches the Python-level host-materialization funnels on
+    ``jax.Array`` (``_value``, ``__array__``, ``item``, ``tolist``,
+    ``__float__``/``__int__``/``__bool__``/``__index__``) to raise
+    ``HostTransferError`` at the offending call site, and additionally
+    arms ``jax.transfer_guard_device_to_host("disallow")``, which is
+    enforced natively on real device backends. CPU-backend caveat: numpy
+    can reach a CPU-resident buffer zero-copy through the C-level buffer
+    protocol (``np.asarray(arr)``) without touching any Python funnel —
+    that one idiom is only caught by the native transfer guard on TPU and
+    by the static pass (R001) everywhere.
+
+Both are plain context managers usable directly or as pytest fixtures
+(wired in tests/conftest.py).
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Iterator
+
+import jax
+from jax import monitoring
+
+_LOWER_EVENT = "/jax/core/compile/jaxpr_to_mlir_module_duration"
+_BACKEND_EVENT = "/jax/core/compile/backend_compile_duration"
+
+#: jax.Array methods/properties through which host materialization funnels
+_FUNNELS = ("_value", "__array__", "item", "tolist", "__float__",
+            "__int__", "__bool__", "__index__", "__complex__")
+
+
+class HostTransferError(AssertionError):
+    """An array was materialized on the host inside a guarded region."""
+
+
+@dataclasses.dataclass
+class CompileCount:
+    lowerings: int = 0
+    backend_compiles: int = 0
+
+    def assert_no_compiles(self, what: str = "guarded region") -> None:
+        if self.lowerings or self.backend_compiles:
+            raise AssertionError(
+                f"{what}: expected zero recompilations, saw "
+                f"{self.lowerings} lowering(s) and "
+                f"{self.backend_compiles} backend compile(s) — a shape, "
+                "dtype, or static-arg value changed after warmup")
+
+
+@contextlib.contextmanager
+def compile_counter() -> Iterator[CompileCount]:
+    """Count jit compilations inside the ``with`` block.
+
+    Usage::
+
+        with compile_counter() as cc:
+            for _ in range(5):
+                bst.update()
+        cc.assert_no_compiles("post-warmup boosting")
+    """
+    counts = CompileCount()
+    state = {"active": True}
+
+    def _listener(event: str, duration_secs: float = 0.0, **kw) -> None:
+        if not state["active"]:
+            return
+        if event == _LOWER_EVENT:
+            counts.lowerings += 1
+        elif event == _BACKEND_EVENT:
+            counts.backend_compiles += 1
+
+    monitoring.register_event_duration_secs_listener(_listener)
+    try:
+        yield counts
+    finally:
+        state["active"] = False
+        try:  # public unregister API landed after 0.4.37
+            from jax._src import monitoring as _mon
+            _mon._unregister_event_duration_listener_by_callback(_listener)
+        except Exception:
+            pass  # deactivated listener stays registered, harmless
+
+
+@contextlib.contextmanager
+def no_host_transfers() -> Iterator[None]:
+    """Raise ``HostTransferError`` on any device->host materialization.
+
+    See the module docstring for the CPU buffer-protocol caveat.
+    """
+    from jax._src import array as _array_mod
+
+    cls = _array_mod.ArrayImpl
+    saved = {}
+
+    def _wrap(name, orig):
+        if isinstance(orig, property):
+            @property
+            def guard_prop(self):
+                raise HostTransferError(
+                    f"jax.Array.{name} materialized an array on the host "
+                    "inside a no_host_transfers() region")
+            return guard_prop
+
+        def guard(self, *a, **k):
+            raise HostTransferError(
+                f"jax.Array.{name}() materialized an array on the host "
+                "inside a no_host_transfers() region")
+        return guard
+
+    for name in _FUNNELS:
+        orig = getattr(cls, name, None)
+        if orig is None:
+            continue
+        saved[name] = orig
+        setattr(cls, name, _wrap(name, orig))
+    try:
+        with jax.transfer_guard_device_to_host("disallow"):
+            yield
+    finally:
+        for name, orig in saved.items():
+            setattr(cls, name, orig)
+
+
+@contextlib.contextmanager
+def steady_state_guard(what: str = "guarded region"
+                       ) -> Iterator[CompileCount]:
+    """Combined guard: zero recompiles AND zero host transfers.
+
+    Asserts on clean exit; an exception from the body propagates as-is.
+    """
+    with compile_counter() as counts:
+        with no_host_transfers():
+            yield counts
+    counts.assert_no_compiles(what)

@@ -1,0 +1,15 @@
+"""tpulint rule registry. Each rule encodes one class of repo-specific
+hazard; see the individual modules for the rationale and seed cases."""
+from .base import Finding, ModuleInfo, PackageInfo, Rule
+from .r001_host_sync import HostSyncRule
+from .r002_recompile import RecompileRule
+from .r003_dtype import DtypeDriftRule
+from .r004_pallas import PallasContractRule
+from .r005_collectives import CollectiveAccountingRule
+
+ALL_RULES = (HostSyncRule, RecompileRule, DtypeDriftRule,
+             PallasContractRule, CollectiveAccountingRule)
+
+__all__ = ["Finding", "ModuleInfo", "PackageInfo", "Rule", "ALL_RULES",
+           "HostSyncRule", "RecompileRule", "DtypeDriftRule",
+           "PallasContractRule", "CollectiveAccountingRule"]

@@ -1,0 +1,477 @@
+"""Shared AST infrastructure for tpulint rules.
+
+The interesting part is *jit-reachability*: most hazards (host syncs, dtype
+drift, tracer branching) are only hazards inside code that runs under a
+``jax.jit`` trace. A function is considered jit-reachable when it is
+
+  * decorated with ``jax.jit`` / ``functools.partial(jax.jit, ...)``, or
+  * passed to a ``jax.jit(...)`` call anywhere in the package
+    (``jitted = jax.jit(step, ...)``), or
+  * referenced (by name) from the body of a reachable function — including
+    across modules through package-relative imports (``best_split`` in
+    ops/split.py is reachable because the jitted growers call it), or
+  * nested inside a reachable function (nested defs execute at trace time).
+
+Traced-value tracking is interprocedural: the positional parameters of a
+jit ROOT (minus its ``static_argnames``) are traced; for reachable helper
+functions a parameter is traced only if some observed call site passes an
+expression referencing a traced value (a helper only ever called with
+static config — ``_hist_packing(F, B)`` — stays static). Helpers that are
+reachable but never directly called (e.g. Pallas kernel bodies invoked
+through ``pallas_call``) conservatively default to traced positional
+params. Keyword-only parameters are treated as static configuration (this
+codebase consistently passes static config after ``*``), locals assigned
+from traced expressions become traced, and ``x.shape``/``x.dtype``-style
+accesses do NOT taint (static at trace time), nor do ``is``/``is not``
+identity tests. Deliberate exceptions carry an entry in the checked-in
+allowlist (analysis/tpulint.allow) with a one-line justification.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+#: names that (re)enter jit when called
+JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+PARTIAL_NAMES = {"functools.partial", "partial"}
+
+#: attribute accesses on a traced value that are static at trace time
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "weak_type", "sharding",
+                "aval", "at"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+    rule: str          # "R001".."R005"
+    path: str          # posix path as given to the driver
+    line: int
+    func: str          # enclosing function qualname, or "<module>"
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} [{self.func}] " \
+               f"{self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)
+
+
+def string_constants(node: ast.AST) -> List[str]:
+    return [n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+
+
+def static_argnames_of(call: ast.Call) -> Set[str]:
+    """String constants inside a ``static_argnames=...`` keyword."""
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            out.update(string_constants(kw.value))
+    return out
+
+
+def _names_in(node: ast.AST) -> Iterator[str]:
+    """Names referenced by an expression, skipping subtrees under static
+    attribute accesses (``x.shape[0]`` does not reference ``x`` as a
+    traced VALUE) and skipping ``is``/``is not`` identity tests."""
+    if isinstance(node, ast.Attribute) and node.attr in STATIC_ATTRS:
+        return
+    if isinstance(node, ast.Compare) and \
+            all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+        return
+    if isinstance(node, ast.Name):
+        yield node.id
+        return
+    for child in ast.iter_child_nodes(node):
+        yield from _names_in(child)
+
+
+def expr_references(node: ast.AST, names: Set[str]) -> bool:
+    return any(n in names for n in _names_in(node))
+
+
+def _is_jit_decorator(dec: ast.AST) -> Tuple[bool, Set[str]]:
+    """(is_jit, static_argnames) for one decorator node."""
+    name = dotted_name(dec)
+    if name in JIT_NAMES:
+        return True, set()
+    if isinstance(dec, ast.Call):
+        cname = call_name(dec)
+        if cname in JIT_NAMES:
+            return True, static_argnames_of(dec)
+        if cname in PARTIAL_NAMES and dec.args:
+            if dotted_name(dec.args[0]) in JIT_NAMES:
+                return True, static_argnames_of(dec)
+    return False, set()
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    node: ast.AST                      # FunctionDef / AsyncFunctionDef
+    qualname: str
+    module: "ModuleInfo"
+    parent: Optional["FunctionInfo"]
+    pos_params: Tuple[str, ...]        # posonly + args + vararg
+    kwonly_params: Tuple[str, ...]
+    jit_decorated: bool = False
+    static_argnames: Set[str] = dataclasses.field(default_factory=set)
+    # names referenced in the body: plain basenames and (alias, attr) pairs
+    refs: Set[str] = dataclasses.field(default_factory=set)
+    attr_refs: Set[Tuple[str, str]] = dataclasses.field(default_factory=set)
+    _own: Optional[List[ast.AST]] = None
+
+    @property
+    def basename(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+    def own_nodes(self) -> List[ast.AST]:
+        """This function's body nodes, NOT descending into nested defs."""
+        if self._own is None:
+            out: List[ast.AST] = []
+            stack: List[ast.AST] = list(ast.iter_child_nodes(self.node))
+            while stack:
+                n = stack.pop()
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                    continue
+                out.append(n)
+                stack.extend(ast.iter_child_nodes(n))
+            self._own = out
+        return self._own
+
+
+class ModuleInfo:
+    """Parsed module + its function table, imports, and jit roots."""
+
+    def __init__(self, path: str, source: str,
+                 dotted: Optional[str] = None):
+        self.path = path
+        self.dotted = dotted            # e.g. "lightgbm_tpu.ops.split"
+        self.tree = ast.parse(source, filename=path)
+        self.source_lines = source.splitlines()
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.by_basename: Dict[str, List[FunctionInfo]] = {}
+        # local alias -> (absolute module dotted name, symbol or None)
+        self.imports: Dict[str, Tuple[str, Optional[str]]] = {}
+        self._collect_imports()
+        self._collect_functions(self.tree, parent=None, prefix="")
+        self._collect_jit_callsites()
+
+    # -- construction --------------------------------------------------
+    def _resolve_relative(self, module: Optional[str], level: int) -> str:
+        if level == 0:
+            return module or ""
+        base = (self.dotted or "").split(".")
+        # drop the module's own name, then `level - 1` more packages
+        base = base[: max(0, len(base) - level)]
+        return ".".join(base + ([module] if module else []))
+
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports[a.asname or a.name.split(".")[0]] = \
+                        (a.name, None)
+            elif isinstance(node, ast.ImportFrom):
+                mod = self._resolve_relative(node.module, node.level)
+                for a in node.names:
+                    self.imports[a.asname or a.name] = (mod, a.name)
+
+    def _collect_functions(self, node: ast.AST,
+                           parent: Optional[FunctionInfo],
+                           prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                a = child.args
+                pos = tuple(p.arg for p in a.posonlyargs + a.args)
+                if a.vararg:
+                    pos += (a.vararg.arg,)
+                kwonly = tuple(p.arg for p in a.kwonlyargs)
+                jit, statics = False, set()
+                for dec in child.decorator_list:
+                    is_jit, s = _is_jit_decorator(dec)
+                    if is_jit:
+                        jit, statics = True, statics | s
+                fn = FunctionInfo(child, qual, self, parent, pos, kwonly,
+                                  jit, statics)
+                self._collect_refs(fn)
+                self.functions[qual] = fn
+                self.by_basename.setdefault(child.name, []).append(fn)
+                self._collect_functions(child, fn, prefix=f"{qual}.")
+            else:
+                self._collect_functions(child, parent, prefix)
+
+    def _collect_refs(self, fn: FunctionInfo) -> None:
+        for n in fn.own_nodes():
+            if isinstance(n, ast.Name):
+                fn.refs.add(n.id)
+            elif isinstance(n, ast.Attribute) and \
+                    isinstance(n.value, ast.Name):
+                fn.attr_refs.add((n.value.id, n.attr))
+
+    def _collect_jit_callsites(self) -> None:
+        """``jax.jit(step, static_argnames=...)`` marks ``step`` a root."""
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call)
+                    and call_name(node) in JIT_NAMES and node.args):
+                continue
+            statics = static_argnames_of(node)
+            for ref in ast.walk(node.args[0]):
+                if isinstance(ref, ast.Name):
+                    for fn in self.by_basename.get(ref.id, ()):
+                        fn.jit_decorated = True
+                        fn.static_argnames |= statics
+
+
+class PackageInfo:
+    """All linted modules + the cross-module jit-reachability closure and
+    the interprocedural traced-parameter fixpoint."""
+
+    def __init__(self, modules: List[ModuleInfo]):
+        self.modules = modules
+        self.by_dotted = {m.dotted: m for m in modules if m.dotted}
+        self.reachable: Set[int] = set()          # id(FunctionInfo)
+        self.param_traced: Dict[int, Set[str]] = {}
+        self._compute_reachability()
+        self._compute_param_tracedness()
+
+    def is_reachable(self, fn: FunctionInfo) -> bool:
+        return id(fn) in self.reachable
+
+    def reachable_functions(self, module: ModuleInfo) -> List[FunctionInfo]:
+        return [f for f in module.functions.values() if self.is_reachable(f)]
+
+    # -- name resolution ----------------------------------------------
+    def _resolve(self, module: ModuleInfo, name: str
+                 ) -> List[FunctionInfo]:
+        """Functions an imported name may refer to, package-internal only."""
+        if name not in module.imports:
+            return []
+        mod_name, symbol = module.imports[name]
+        target = self.by_dotted.get(mod_name)
+        if target is None or symbol is None:
+            return []
+        return [f for f in target.by_basename.get(symbol, ())
+                if f.parent is None]
+
+    def _resolve_attr(self, module: ModuleInfo, alias: str, attr: str
+                      ) -> List[FunctionInfo]:
+        if alias not in module.imports:
+            return []
+        mod_name, symbol = module.imports[alias]
+        if symbol is not None:       # `from x import y; y.attr` — not a call
+            return []
+        target = self.by_dotted.get(mod_name)
+        if target is None:
+            return []
+        return [f for f in target.by_basename.get(attr, ())
+                if f.parent is None]
+
+    def _callees(self, module: ModuleInfo, name: str
+                 ) -> List[FunctionInfo]:
+        return list(module.by_basename.get(name, ())) \
+            + self._resolve(module, name)
+
+    # -- reachability --------------------------------------------------
+    def _compute_reachability(self) -> None:
+        work: List[FunctionInfo] = []
+        for m in self.modules:
+            for f in m.functions.values():
+                if f.jit_decorated:
+                    work.append(f)
+        while work:
+            fn = work.pop()
+            if id(fn) in self.reachable:
+                continue
+            self.reachable.add(id(fn))
+            # nested defs run (and usually trace) with the parent
+            for g in fn.module.functions.values():
+                if g.parent is fn:
+                    work.append(g)
+            # same-module references by basename + package-internal imports
+            for name in fn.refs:
+                work.extend(self._callees(fn.module, name))
+            for alias, attr in fn.attr_refs:
+                work.extend(self._resolve_attr(fn.module, alias, attr))
+
+    # -- interprocedural traced params ---------------------------------
+    def _call_edges(self, fn: FunctionInfo
+                    ) -> List[Tuple[FunctionInfo, List[ast.AST],
+                                    List[Tuple[str, ast.AST]]]]:
+        """(callee, positional arg exprs, keyword arg exprs) per call."""
+        edges = []
+        # local `name = (a, b, c)` tuple literals, to expand `*name` args
+        tuples: Dict[str, ast.Tuple] = {}
+        for n in fn.own_nodes():
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name) and \
+                    isinstance(n.value, ast.Tuple):
+                tuples[n.targets[0].id] = n.value
+        for node in fn.own_nodes():
+            if not isinstance(node, ast.Call):
+                continue
+            cname = call_name(node)
+            args, keywords = [], node.keywords
+            for a in node.args:
+                if isinstance(a, ast.Starred):
+                    if isinstance(a.value, ast.Name) and \
+                            a.value.id in tuples:
+                        args.extend(tuples[a.value.id].elts)
+                        continue
+                    # unknown star-expansion: positional alignment is lost
+                    # past this point; stop mapping (under-taints, which the
+                    # no-callsite conservative default partially offsets)
+                    break
+                args.append(a)
+            if cname in PARTIAL_NAMES and args:
+                target = dotted_name(args[0])
+                if target is None:
+                    continue
+                cname, args = target, args[1:]
+            if cname is None:
+                continue
+            base = cname.rsplit(".", 1)[-1]
+            callees = self._callees(fn.module, base) if "." not in cname \
+                else []
+            if "." in cname:
+                head, _, attr = cname.partition(".")
+                if "." not in attr:
+                    callees = self._resolve_attr(fn.module, head, attr)
+            for callee in callees:
+                edges.append((callee, args,
+                              [(k.arg, k.value) for k in keywords
+                               if k.arg is not None]))
+        return edges
+
+    def _compute_param_tracedness(self) -> None:
+        reachable_fns = [f for m in self.modules
+                         for f in m.functions.values()
+                         if self.is_reachable(f)]
+        has_callsite: Set[int] = set()
+        for fn in reachable_fns:
+            if fn.jit_decorated:
+                self.param_traced[id(fn)] = \
+                    set(fn.pos_params) - fn.static_argnames
+            else:
+                self.param_traced[id(fn)] = set()
+
+        def run_fixpoint() -> None:
+            for _ in range(12):
+                changed = False
+                for fn in reachable_fns:
+                    traced = traced_names(fn, self)
+                    for callee, args, kwargs in self._call_edges(fn):
+                        if not self.is_reachable(callee):
+                            continue
+                        has_callsite.add(id(callee))
+                        if callee.jit_decorated:
+                            continue        # roots are pinned
+                        tgt = self.param_traced[id(callee)]
+                        for i, a in enumerate(args):
+                            if i < len(callee.pos_params) and \
+                                    expr_references(a, traced):
+                                if callee.pos_params[i] not in tgt:
+                                    tgt.add(callee.pos_params[i])
+                                    changed = True
+                        for kname, kval in kwargs:
+                            if kname in callee.pos_params and \
+                                    expr_references(kval, traced):
+                                if kname not in tgt:
+                                    tgt.add(kname)
+                                    changed = True
+                if not changed:
+                    break
+
+        run_fixpoint()
+        # reachable but never directly called (kernel bodies invoked via
+        # pallas_call, functions passed around by reference): conservative
+        # default — positional params are traced
+        grew = False
+        for fn in reachable_fns:
+            if not fn.jit_decorated and id(fn) not in has_callsite:
+                default = set(fn.pos_params) - fn.static_argnames
+                if default - self.param_traced[id(fn)]:
+                    self.param_traced[id(fn)] |= default
+                    grew = True
+        if grew:
+            run_fixpoint()
+
+
+def traced_names(fn: FunctionInfo, package: PackageInfo) -> Set[str]:
+    """Names likely bound to traced values inside ``fn``: its traced
+    params, traced params of reachable enclosing functions (closure), and
+    locals assigned from expressions referencing a traced name."""
+    names: Set[str] = set(package.param_traced.get(
+        id(fn), set(fn.pos_params) - fn.static_argnames))
+    p = fn.parent
+    while p is not None:
+        if package.is_reachable(p):
+            names |= package.param_traced.get(id(p), set())
+        p = p.parent
+    for _ in range(8):              # bounded fixpoint over local assigns
+        grew = False
+        for n in fn.own_nodes():
+            targets: List[ast.AST] = []
+            value: Optional[ast.AST] = None
+            if isinstance(n, ast.Assign):
+                targets, value = n.targets, n.value
+            elif isinstance(n, ast.AugAssign) and \
+                    isinstance(n.target, ast.Name):
+                targets, value = [n.target], n.value
+            elif isinstance(n, ast.AnnAssign) and n.value is not None:
+                targets, value = [n.target], n.value
+            if value is None or not expr_references(value, names):
+                continue
+            for t in targets:
+                for leaf in _plain_name_targets(t):
+                    if leaf not in names:
+                        names.add(leaf)
+                        grew = True
+        if not grew:
+            break
+    return names
+
+
+def _plain_name_targets(target: ast.AST) -> Iterator[str]:
+    """Plain-name assignment targets only: ``a = ...``, ``a, b = ...``.
+    Subscript/attribute stores (``x[i] = ...``) neither taint the base
+    nor the index names."""
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for el in target.elts:
+            yield from _plain_name_targets(el)
+    elif isinstance(target, ast.Starred):
+        yield from _plain_name_targets(target.value)
+
+
+class Rule:
+    """Base class; subclasses set ``code``/``title`` and implement check."""
+    code = "R000"
+    title = ""
+
+    def check(self, module: ModuleInfo, package: PackageInfo
+              ) -> List[Finding]:
+        raise NotImplementedError
+
+    def finding(self, module: ModuleInfo, node: ast.AST, func: str,
+                message: str) -> Finding:
+        return Finding(self.code, module.path,
+                       getattr(node, "lineno", 0), func, message)

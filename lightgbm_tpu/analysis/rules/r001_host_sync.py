@@ -1,0 +1,62 @@
+"""R001 — host synchronization inside jit-reachable code.
+
+``float()``, ``.item()``, ``.tolist()``, ``np.asarray``/``np.array`` and
+``jax.device_get`` on a traced value force a device->host round trip: under
+trace they either raise (``TracerArrayConversionError``) or, worse, silently
+bake a trace-time constant into the compiled program; called between jitted
+steps they serialize the dispatch pipeline (the tunneled-TPU RTT is ~130ms,
+see boosting/gbdt.py stop_check_freq). The gbdt train step and the ops/
+growers are the protected hot paths.
+
+Python casts (``float``/``int``/``bool``) are only flagged when an argument
+references a traced name — trace-time conversion of host config constants
+(e.g. ``float(obj.renew_alpha)`` on a closed-over host object) is fine.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .base import (Finding, ModuleInfo, PackageInfo, Rule, call_name,
+                   expr_references, traced_names)
+
+_ALWAYS = {"jax.device_get", "jax.block_until_ready"}
+_SYNC_METHODS = {"item", "tolist", "block_until_ready",
+                 "copy_to_host_async"}
+_TRACED_CASTS = {"float", "int", "bool", "complex",
+                 "np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+
+class HostSyncRule(Rule):
+    code = "R001"
+    title = "host sync in jit-reachable code"
+
+    def check(self, module: ModuleInfo, package: PackageInfo
+              ) -> List[Finding]:
+        out: List[Finding] = []
+        for fn in package.reachable_functions(module):
+            traced = traced_names(fn, package)
+            for node in fn.own_nodes():
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if name in _ALWAYS:
+                    out.append(self.finding(
+                        module, node, fn.qualname,
+                        f"{name}() in jit-reachable code forces a "
+                        "device->host sync (or bakes a trace-time "
+                        "constant)"))
+                elif name in _TRACED_CASTS and any(
+                        expr_references(a, traced) for a in node.args):
+                    out.append(self.finding(
+                        module, node, fn.qualname,
+                        f"{name}() on a traced value in jit-reachable "
+                        "code — host sync / TracerArrayConversionError"))
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr in _SYNC_METHODS
+                      and not (name or "").startswith(("np.", "numpy."))):
+                    out.append(self.finding(
+                        module, node, fn.qualname,
+                        f".{node.func.attr}() in jit-reachable code "
+                        "materializes the array on the host"))
+        return out

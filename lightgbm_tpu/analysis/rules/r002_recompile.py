@@ -1,0 +1,128 @@
+"""R002 — recompilation hazards.
+
+Three sub-checks:
+
+  (a) ``jax.jit(...)`` called inside a loop — a fresh jitted callable (and
+      a fresh compile-cache entry) per iteration; hoist the jit out of the
+      loop or cache the wrapper.
+  (b) an argument declared in ``static_argnames`` whose default value is a
+      mutable literal (list/dict/set) — unhashable statics raise at call
+      time, and per-call fresh objects defeat the compile cache even when
+      hashable.
+  (c) a Python ``if``/``while`` branching on a traced value inside
+      jit-reachable code — under trace this raises
+      ``TracerBoolConversionError``; outside it forces a host sync per
+      call. Branching on *declared static* arguments is deliberate jax
+      style and is not flagged (statics are excluded from the traced set).
+      ``is None`` / ``is not None`` tests are identity checks on the
+      Python level and are ignored.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .base import (Finding, ModuleInfo, PackageInfo, Rule, JIT_NAMES,
+                   call_name, expr_references, traced_names)
+
+
+def _bool_context_traced(test: ast.AST, traced) -> bool:
+    """Does evaluating ``test`` call __bool__ on a traced name?
+
+    Uses the STATIC_ATTRS-aware reference walk: ``x.shape[0] > 4`` is a
+    static trace-time branch even when ``x`` is traced."""
+    if isinstance(test, ast.Name):
+        return test.id in traced
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _bool_context_traced(test.operand, traced)
+    if isinstance(test, ast.BoolOp):
+        return any(_bool_context_traced(v, traced) for v in test.values)
+    if isinstance(test, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            return False
+        return any(expr_references(sub, traced)
+                   for sub in [test.left] + list(test.comparators))
+    return False
+
+
+class RecompileRule(Rule):
+    code = "R002"
+    title = "recompilation hazards"
+
+    def check(self, module: ModuleInfo, package: PackageInfo
+              ) -> List[Finding]:
+        out: List[Finding] = []
+        out.extend(self._jit_in_loop(module))
+        out.extend(self._unhashable_static_defaults(module))
+        out.extend(self._tracer_branches(module, package))
+        return out
+
+    # (a) ------------------------------------------------------------
+    def _jit_in_loop(self, module: ModuleInfo) -> List[Finding]:
+        out: List[Finding] = []
+
+        def walk(node: ast.AST, func: str, in_loop: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                child_func = func
+                child_loop = in_loop
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    child_func = f"{func}.{child.name}" \
+                        if func != "<module>" else child.name
+                    child_loop = False      # new call frame resets loop ctx
+                elif isinstance(child, (ast.For, ast.While)):
+                    child_loop = True
+                elif (isinstance(child, ast.Call)
+                      and call_name(child) in JIT_NAMES and in_loop):
+                    out.append(self.finding(
+                        module, child, func,
+                        "jax.jit called inside a loop — compiles a fresh "
+                        "callable per iteration; hoist or cache it"))
+                walk(child, child_func, child_loop)
+
+        walk(module.tree, "<module>", False)
+        return out
+
+    # (b) ------------------------------------------------------------
+    def _unhashable_static_defaults(self, module: ModuleInfo
+                                    ) -> List[Finding]:
+        out: List[Finding] = []
+        for fn in module.functions.values():
+            if not fn.static_argnames:
+                continue
+            args = fn.node.args
+            pos = args.posonlyargs + args.args
+            defaults = [None] * (len(pos) - len(args.defaults)) \
+                + list(args.defaults)
+            pairs = list(zip(pos, defaults)) \
+                + list(zip(args.kwonlyargs, args.kw_defaults))
+            for param, default in pairs:
+                if param.arg not in fn.static_argnames or default is None:
+                    continue
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
+                        isinstance(default, ast.Call)
+                        and call_name(default) in ("list", "dict", "set")):
+                    out.append(self.finding(
+                        module, default, fn.qualname,
+                        f"static arg '{param.arg}' has an unhashable "
+                        "mutable default — raises at call time and "
+                        "defeats the jit cache"))
+        return out
+
+    # (c) ------------------------------------------------------------
+    def _tracer_branches(self, module: ModuleInfo, package: PackageInfo
+                         ) -> List[Finding]:
+        out: List[Finding] = []
+        for fn in package.reachable_functions(module):
+            traced = traced_names(fn, package)
+            for node in fn.own_nodes():
+                if isinstance(node, (ast.If, ast.While)) and \
+                        _bool_context_traced(node.test, traced):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    out.append(self.finding(
+                        module, node, fn.qualname,
+                        f"Python `{kind}` on a traced value — "
+                        "TracerBoolConversionError under trace (use "
+                        "jnp.where/lax.cond), or a per-call host sync "
+                        "and recompile hazard outside it"))
+        return out

@@ -1,0 +1,77 @@
+"""R003 — dtype drift in device code.
+
+Two sub-checks, both scoped to jit-reachable functions:
+
+  * ``np.*`` math/array ops applied to traced values: numpy either raises
+    on tracers or silently materializes a trace-time constant, and the
+    result re-enters the trace as host data (an implicit f64 promotion on
+    many numpy paths). Device code must stay on ``jnp``/``lax``.
+    (``np.asarray``/``np.array`` are R001's host-sync territory; this rule
+    covers the computational ops.)
+  * explicit float64 requests (``jnp.float64``, ``np.float64``,
+    ``dtype="float64"``, ``.astype('float64')``): with x64 disabled (the
+    default, and the only supported mode on TPU here) jax silently lowers
+    these to f32 — the annotation lies; with x64 enabled they double
+    memory/VPU cost. Either way it is drift, not intent.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .base import (Finding, ModuleInfo, PackageInfo, Rule, call_name,
+                   dotted_name, expr_references, traced_names)
+
+_NP_EXEMPT = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_F64_NAMES = {"np.float64", "numpy.float64", "jnp.float64",
+              "jax.numpy.float64"}
+
+
+class DtypeDriftRule(Rule):
+    code = "R003"
+    title = "dtype drift in device code"
+
+    def check(self, module: ModuleInfo, package: PackageInfo
+              ) -> List[Finding]:
+        out: List[Finding] = []
+        for fn in package.reachable_functions(module):
+            traced = traced_names(fn, package)
+            for node in fn.own_nodes():
+                if isinstance(node, ast.Call):
+                    name = call_name(node) or ""
+                    if (name.startswith(("np.", "numpy."))
+                            and name not in _NP_EXEMPT
+                            and any(expr_references(a, traced)
+                                    for a in node.args)):
+                        out.append(self.finding(
+                            module, node, fn.qualname,
+                            f"{name}() on a traced value in device code "
+                            "— numpy ops escape the trace (use jnp)"))
+                    if name.endswith(".astype") and any(
+                            "float64" in c for a in node.args
+                            for c in _str_consts(a)):
+                        out.append(self.finding(
+                            module, node, fn.qualname,
+                            "astype('float64') in device code — f64 "
+                            "silently lowers to f32 with x64 disabled"))
+                    for kw in node.keywords:
+                        if kw.arg == "dtype" and (
+                                "float64" in _str_consts(kw.value)):
+                            out.append(self.finding(
+                                module, kw.value, fn.qualname,
+                                "dtype='float64' in device code — f64 "
+                                "silently lowers to f32 with x64 "
+                                "disabled"))
+                elif isinstance(node, ast.Attribute):
+                    if dotted_name(node) in _F64_NAMES:
+                        out.append(self.finding(
+                            module, node, fn.qualname,
+                            f"{dotted_name(node)} in device code — f64 "
+                            "silently lowers to f32 with x64 disabled "
+                            "(or doubles memory/VPU cost with it on)"))
+        return out
+
+
+def _str_consts(node: ast.AST) -> List[str]:
+    return [n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)]

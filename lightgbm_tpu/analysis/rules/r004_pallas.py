@@ -1,0 +1,145 @@
+"""R004 — Pallas/Mosaic kernel contract checks.
+
+The fused kernels carry hard contracts that the compiler cannot check for
+the caller (ops/fused_split.py module docstring):
+
+  * block sizes must be 32-multiples — Mosaic's DMA checker needs offsets
+    provably divisible by the sublane tiling; a literal that is not a
+    32-multiple fails at runtime on device only.
+  * environment overrides must not flow into a block size raw: the
+    automatic derivation rounds to 32 and re-checks the scoped-VMEM
+    estimate, and an unvalidated ``int(os.environ[...])`` bypasses both
+    (the seed case: LGBM_TPU_FUSED_BS, boosting/gbdt.py — ADVICE r5 #3).
+    An assignment whose target looks like a block size and whose value
+    reads ``os.environ`` must go through a validating helper (a call with
+    "valid" or "round" in its name) or inline ``// 32`` rounding.
+  * ``fused_split`` callers must pass ``num_rows`` so the kernel's
+    ``pad >= block_size`` contract is enforced statically instead of
+    silently clamping rows away (ADVICE r5 #2; the raise lives in
+    ops/fused_split.py).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .base import (Finding, ModuleInfo, PackageInfo, Rule, call_name,
+                   dotted_name)
+
+_BLOCK_KWARGS = {"block_size", "bs", "fused_block"}
+
+
+def _target_is_blocky(name: str) -> bool:
+    low = name.lower()
+    return "block" in low or low in ("bs", "bs_", "fused_bs") \
+        or low.endswith("_bs") or low.startswith("bs_")
+
+
+def _reads_environ(node: ast.AST) -> bool:
+    return any(dotted_name(n) == "os.environ"
+               for n in ast.walk(node) if isinstance(n, ast.Attribute))
+
+
+def _has_validation(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            name = (call_name(n) or "").rsplit(".", 1)[-1].lower()
+            if "valid" in name or "round" in name:
+                return True
+        if isinstance(n, ast.BinOp) and isinstance(n.op, ast.FloorDiv) \
+                and isinstance(n.right, ast.Constant) and n.right.value == 32:
+            return True
+    return False
+
+
+class PallasContractRule(Rule):
+    code = "R004"
+    title = "Pallas kernel contract checks"
+
+    def check(self, module: ModuleInfo, package: PackageInfo
+              ) -> List[Finding]:
+        out: List[Finding] = []
+        func_of = _FuncIndex(module)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                out.extend(self._check_call(module, node, func_of))
+            elif isinstance(node, ast.Assign):
+                out.extend(self._check_env_assign(module, node, func_of))
+        for fn in module.functions.values():
+            out.extend(self._check_defaults(module, fn))
+        return out
+
+    def _check_call(self, module, node: ast.Call, func_of) -> List[Finding]:
+        name = (call_name(node) or "").rsplit(".", 1)[-1]
+        out: List[Finding] = []
+        if name not in ("fused_split", "pallas_call", "pallas_histogram"):
+            return out
+        for kw in node.keywords:
+            if kw.arg in _BLOCK_KWARGS and \
+                    isinstance(kw.value, ast.Constant) and \
+                    isinstance(kw.value.value, int) and \
+                    kw.value.value % 32 != 0:
+                out.append(self.finding(
+                    module, kw.value, func_of(node),
+                    f"{name}({kw.arg}={kw.value.value}): block sizes "
+                    "must be 32-multiples (Mosaic DMA sublane "
+                    "alignment)"))
+        if name == "fused_split" and not any(
+                kw.arg == "num_rows" for kw in node.keywords):
+            out.append(self.finding(
+                module, node, func_of(node),
+                "fused_split call without num_rows= — the "
+                "pad >= block_size contract cannot be checked "
+                "statically and a short pad silently drops tail rows"))
+        return out
+
+    def _check_env_assign(self, module, node: ast.Assign, func_of
+                          ) -> List[Finding]:
+        targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if not any(_target_is_blocky(t) for t in targets):
+            return []
+        if not _reads_environ(node.value) or _has_validation(node.value):
+            return []
+        return [self.finding(
+            module, node, func_of(node),
+            f"block size '{targets[0]}' taken raw from os.environ — "
+            "round to a 32-multiple and re-check the scoped-VMEM "
+            "estimate before accepting an override")]
+
+    def _check_defaults(self, module, fn) -> List[Finding]:
+        out: List[Finding] = []
+        args = fn.node.args
+        pos = args.posonlyargs + args.args
+        defaults = [None] * (len(pos) - len(args.defaults)) \
+            + list(args.defaults)
+        pairs = list(zip(pos, defaults)) \
+            + list(zip(args.kwonlyargs, args.kw_defaults))
+        for param, default in pairs:
+            if param.arg in _BLOCK_KWARGS and \
+                    isinstance(default, ast.Constant) and \
+                    isinstance(default.value, int) and \
+                    default.value % 32 != 0:
+                out.append(self.finding(
+                    module, default, fn.qualname,
+                    f"default {param.arg}={default.value} is not a "
+                    "32-multiple (Mosaic DMA sublane alignment)"))
+        return out
+
+
+class _FuncIndex:
+    """Map an AST node to its enclosing function qualname (by line span)."""
+
+    def __init__(self, module: ModuleInfo):
+        self.spans = []
+        for fn in module.functions.values():
+            end = getattr(fn.node, "end_lineno", fn.node.lineno)
+            self.spans.append((fn.node.lineno, end, fn.qualname))
+        self.spans.sort(key=lambda s: (s[0], -s[1]))
+
+    def __call__(self, node: ast.AST) -> str:
+        line = getattr(node, "lineno", 0)
+        best = "<module>"
+        for lo, hi, qual in self.spans:
+            if lo <= line <= hi:
+                best = qual            # innermost wins (sorted outer-first)
+        return best

@@ -1,0 +1,80 @@
+"""R005 — async-collective byte accounting must use the result shape.
+
+Post-optimization TPU HLO emits collectives in async form: a ``*-start``
+op whose output is a tuple ``(operand, result, ...)``. For
+``all-reduce-start`` operand and result shapes match, but for
+``all-gather-start`` the result is ``num_devices`` times the operand (and
+``collective-permute-start`` also carries the payload in the result slot)
+— so accounting code that takes the FIRST tuple element under-reports the
+transferred bytes (the seed case: parallel/comm_accounting.py, ADVICE r5
+#1, where the voting/data ratio in COMM_ACCOUNTING.json would have been
+silently wrong the day async all-gathers appear).
+
+Detection: inside a branch guarded by a ``*-start`` test (a string
+constant ending in ``-start``), taking the first element of a shapes
+collection (``x[:1]`` / ``x[0]``) without any second-element selection
+(``x[1]`` / ``x[1:2]``) in the same guarded region means every async
+kind is counted by operand shape.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from .base import Finding, ModuleInfo, PackageInfo, Rule
+
+
+def _guards_start(test: ast.AST) -> bool:
+    return any(isinstance(n, ast.Constant) and isinstance(n.value, str)
+               and n.value.endswith("-start") for n in ast.walk(test))
+
+
+def _first_second_selects(node: ast.AST
+                          ) -> Tuple[Optional[ast.AST], bool]:
+    """(first first-element Subscript or None, any second-element select)."""
+    first = None
+    second = False
+    for n in ast.walk(node):
+        if not isinstance(n, ast.Subscript):
+            continue
+        sl = n.slice
+        if isinstance(sl, ast.Constant) and sl.value == 0:
+            first = first or n
+        elif isinstance(sl, ast.Constant) and sl.value == 1:
+            second = True
+        elif isinstance(sl, ast.Slice):
+            lo, hi = sl.lower, sl.upper
+            if lo is None and isinstance(hi, ast.Constant) \
+                    and hi.value == 1:
+                first = first or n
+            elif isinstance(lo, ast.Constant) and lo.value == 1:
+                second = True
+    return first, second
+
+
+class CollectiveAccountingRule(Rule):
+    code = "R005"
+    title = "async collective accounting shape rules"
+
+    def check(self, module: ModuleInfo, package: PackageInfo
+              ) -> List[Finding]:
+        out: List[Finding] = []
+        func_names = {}
+        for fn in module.functions.values():
+            for n in fn.own_nodes():
+                func_names[id(n)] = fn.qualname
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.If) and _guards_start(node.test)):
+                continue
+            first, second = _first_second_selects(
+                ast.Module(body=node.body, type_ignores=[]))
+            if first is not None and not second:
+                out.append(self.finding(
+                    module, first,
+                    func_names.get(id(node), "<module>"),
+                    "async '*-start' collective counted by its FIRST "
+                    "tuple element (the operand) — all-gather-start / "
+                    "collective-permute-start must count the result "
+                    "shape (second element) or gathered bytes are "
+                    "under-reported"))
+        return out

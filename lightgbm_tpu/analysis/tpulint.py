@@ -1,0 +1,203 @@
+"""tpulint — static JAX/TPU hazard analyzer for this repo.
+
+An AST pass over the package with repo-specific rules (the reference
+LightGBM ships sanitizer/CI wiring around its treelearner/network layers
+for the same reason — correctness tooling as a first-class layer):
+
+  R001  host sync in jit-reachable code (float()/.item()/np.asarray/
+        jax.device_get on traced values in the growers and train step)
+  R002  recompilation hazards (jit-in-loop, unhashable static defaults,
+        Python branching on traced values)
+  R003  dtype drift (numpy ops on traced values, f64 requests in device
+        code)
+  R004  Pallas contracts (32-multiple block sizes, validated env
+        overrides, fused_split pad contract via num_rows=)
+  R005  async collective accounting must count result shapes
+
+Deliberate exceptions live in the checked-in allowlist
+(analysis/tpulint.allow), one entry per line:
+
+    R002 lightgbm_tpu/ops/compact.py::partition_segment  # justification
+
+Every entry MUST carry a ``# justification`` — entries without one are a
+lint error themselves. The function part accepts a bare basename or
+``*`` for module-level findings. Unused entries print a warning so the
+file cannot rot silently.
+
+CLI: ``scripts/tpulint lightgbm_tpu/`` (exit 0 = clean tree); the tier-1
+suite runs the same pass via tests/test_tpulint.py.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .rules import ALL_RULES, Finding, ModuleInfo, PackageInfo
+
+DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(__file__), "tpulint.allow")
+
+
+class AllowEntry:
+    def __init__(self, rule: str, path: str, func: str, justification: str,
+                 lineno: int):
+        self.rule = rule
+        self.path = path.replace(os.sep, "/")
+        self.func = func
+        self.justification = justification
+        self.lineno = lineno
+        self.used = False
+
+    def matches(self, f: Finding) -> bool:
+        if self.rule != f.rule:
+            return False
+        fpath = f.path.replace(os.sep, "/")
+        if not (fpath == self.path or fpath.endswith("/" + self.path)):
+            return False
+        return self.func in ("*", f.func, f.func.rsplit(".", 1)[-1])
+
+    def render(self) -> str:
+        return f"{self.rule} {self.path}::{self.func}"
+
+
+def load_allowlist(path: str) -> Tuple[List[AllowEntry], List[str]]:
+    """Parse the allowlist; returns (entries, format errors)."""
+    entries: List[AllowEntry] = []
+    errors: List[str] = []
+    if not os.path.exists(path):
+        return entries, errors
+    with open(path) as fh:
+        for lineno, raw in enumerate(fh, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            body, _, justification = line.partition("#")
+            justification = justification.strip()
+            if not justification:
+                errors.append(
+                    f"{path}:{lineno}: allowlist entry without a "
+                    "justification — every exception needs a one-line "
+                    "'# why'")
+                continue
+            parts = body.split()
+            if len(parts) != 2 or "::" not in parts[1]:
+                errors.append(
+                    f"{path}:{lineno}: malformed entry (expected "
+                    "'RXXX path::func  # justification')")
+                continue
+            rule = parts[0]
+            fpath, _, func = parts[1].partition("::")
+            entries.append(AllowEntry(rule, fpath, func or "*",
+                                      justification, lineno))
+    return entries, errors
+
+
+def _iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def _dotted_of(path: str) -> Optional[str]:
+    """Dotted module name by walking up through __init__.py packages."""
+    parts = [os.path.splitext(os.path.basename(path))[0]]
+    d = os.path.dirname(os.path.abspath(path))
+    while os.path.exists(os.path.join(d, "__init__.py")):
+        parts.append(os.path.basename(d))
+        d = os.path.dirname(d)
+    if len(parts) == 1:
+        return None
+    if parts[0] == "__init__":
+        parts = parts[1:]
+    return ".".join(reversed(parts))
+
+
+def lint_paths(paths: Sequence[str], rules=None
+               ) -> Tuple[List[Finding], List[str]]:
+    """Run all rules over the python files under ``paths``.
+
+    Returns (findings, parse/read errors). Findings are sorted by
+    (path, line, rule) for stable output.
+    """
+    rules = [r() for r in (rules or ALL_RULES)]
+    modules: List[ModuleInfo] = []
+    errors: List[str] = []
+    for path in _iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+            modules.append(ModuleInfo(path, source, _dotted_of(path)))
+        except (SyntaxError, OSError, UnicodeDecodeError) as err:
+            errors.append(f"{path}: {err}")
+    package = PackageInfo(modules)
+    findings: List[Finding] = []
+    for module in modules:
+        for rule in rules:
+            findings.extend(rule.check(module, package))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, errors
+
+
+def apply_allowlist(findings: List[Finding], entries: List[AllowEntry]
+                    ) -> List[Finding]:
+    kept: List[Finding] = []
+    for f in findings:
+        hit = next((e for e in entries if e.matches(f)), None)
+        if hit is not None:
+            hit.used = True
+        else:
+            kept.append(f)
+    return kept
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpulint", description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+", help="files or directories to lint")
+    ap.add_argument("--allowlist", default=DEFAULT_ALLOWLIST,
+                    help="allowlist file (default: analysis/tpulint.allow)")
+    ap.add_argument("--no-allowlist", action="store_true",
+                    help="report allowlisted findings too")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as a JSON array")
+    args = ap.parse_args(argv)
+
+    findings, errors = lint_paths(args.paths)
+    allow_errors: List[str] = []
+    entries: List[AllowEntry] = []
+    if not args.no_allowlist:
+        entries, allow_errors = load_allowlist(args.allowlist)
+        findings = apply_allowlist(findings, entries)
+
+    for err in errors + allow_errors:
+        print(f"tpulint: error: {err}", file=sys.stderr)
+    for e in entries:
+        if not e.used:
+            print(f"tpulint: warning: unused allowlist entry "
+                  f"{e.render()} (line {e.lineno})", file=sys.stderr)
+
+    if args.as_json:
+        print(json.dumps([f.to_json() for f in findings], indent=1))
+    else:
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"tpulint: {len(findings)} finding(s)", file=sys.stderr)
+
+    if errors or allow_errors:
+        return 2
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -183,6 +183,31 @@ def _pick_fused_block(cfg) -> int:
     return 0
 
 
+def _validated_fused_block_env(value: str, num_cols: int,
+                               vmem_cap_bs: int) -> int:
+    """Round and re-guard an ``LGBM_TPU_FUSED_BS`` override.
+
+    The override exists for perf experiments, but it must not be able to
+    recreate the hazards the automatic derivation prevents: the kernel
+    requires a 32-multiple block size (Mosaic DMA alignment,
+    ops/fused_split.py), and its scoped-VMEM buffers scale with
+    ``block_size * num_cols`` — so the value is rounded down to a
+    32-multiple and clamped to the same scoped-VMEM-derived cap the
+    automatic path uses (``vmem_cap_bs``)."""
+    bs = max(32, (int(value) // 32) * 32)
+    if bs != int(value):
+        log.warning(f"LGBM_TPU_FUSED_BS={value} is not a 32-multiple; "
+                    f"rounded to {bs}")
+    if bs > vmem_cap_bs:
+        log.warning(
+            f"LGBM_TPU_FUSED_BS={value} exceeds the scoped-VMEM cap for "
+            f"{num_cols}-byte row records (max {vmem_cap_bs}); clamped — "
+            "an unchecked override would recreate the VMEM blowup the "
+            "guard prevents")
+        bs = vmem_cap_bs
+    return bs
+
+
 def _clamp_block(block: int, n: int, floor: int = 128) -> int:
     """Shrink a streaming block size toward the data size (power-of-two)."""
     while block // 2 >= max(n, floor) and block > floor:
@@ -940,9 +965,12 @@ class GBDT:
             # the block down for wide records and fall back to the XLA walk
             # when the histogram alone would blow the ~16MB scoped limit
             c_rec = layout.num_cols
-            bs = min(gp.fused_block, max(32, (49152 // c_rec) // 32 * 32))
+            vmem_cap_bs = max(32, (49152 // c_rec) // 32 * 32)
+            bs = min(gp.fused_block, vmem_cap_bs)
             if os.environ.get("LGBM_TPU_FUSED_BS", ""):
-                bs = int(os.environ["LGBM_TPU_FUSED_BS"])  # perf experiments
+                # perf experiments; rounded + re-guarded, never trusted raw
+                bs = _validated_fused_block_env(
+                    os.environ["LGBM_TPU_FUSED_BS"], c_rec, vmem_cap_bs)
             from ..ops.fused_split import _hist_packing
             stride, f_pad, _ = _hist_packing(
                 layout.num_features, int(self.grower_params.num_bins))

@@ -277,11 +277,14 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
         """One-hot contraction of a block's bins against its channel
         operand, accumulated into hist_ref.
 
-        The one-hot for a whole feature group is built with ONE
-        constant-index lane gather + ONE compare, not a per-feature
-        single-lane broadcast loop (measured on v5e: 28 per-feature
-        broadcasts cost ~5us/block regardless of B — lane relayouts
-        dominate, not one-hot element count)."""
+        The one-hot for a feature group is built as a per-feature compare
+        of that feature's bin column against a [BS, BS_] lane iota, with
+        the per-feature results concatenated group-wide so each group is
+        contracted in ONE MXU matmul (grouping bounds the one-hot operand
+        near 512 lanes, see _hist_packing). A jnp.repeat-based batched
+        lane spread was tried instead of the per-feature compare loop and
+        lowers to far slower relayouts on this Mosaic toolchain (0.54 vs
+        1.07 it/s on the 10.5M higgs bench)."""
         bins = rows_u8.astype(i32)[:, :F]
         # tightly packed: each feature spans B lanes (not 128-padded), so
         # B <= 64 fits 2+ features per lane tile; group widths and offsets
@@ -644,7 +647,7 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
 @functools.partial(
     jax.jit,
     static_argnames=("layout", "num_bins", "block_size", "bitset_words",
-                     "interpret", "dual", "hist_debug"))
+                     "interpret", "dual", "hist_debug", "num_rows"))
 def fused_split(
     work: jnp.ndarray,          # [N + pad, C] u8, C % 128 == 0
     scratch: jnp.ndarray,       # [N + pad, C] u8
@@ -667,8 +670,19 @@ def fused_split(
     side=None,                  # i32: 0 = parent lives in work, 1 = scratch
     dual: bool = True,
     hist_debug: str = "",       # timing bisect only (see GrowerParams)
+    num_rows: int = None,       # real (unpadded) row count, for pad checks
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One fused split. Returns (work', scratch', hist_smaller [F, B, 4]).
+
+    CONTRACT — pad >= block_size: the row arrays must be padded past the
+    real row count by at least ``block_size`` rows (internal callers pad by
+    ``fused_block + 32``, boosting/gbdt._setup_compact_state), because the
+    kernel's aligned block writes may overrun a segment end by up to one
+    block. The scalar sanitization below clamps ``count`` to
+    ``n_rows - block_size - start`` as defense-in-depth; with a smaller pad
+    that clamp would silently drop legitimate tail rows. Pass ``num_rows``
+    (the real row count, a static int) to turn a violated pad contract into
+    a static ValueError instead of silent row loss.
 
     In mode 1 the partition is skipped and the histogram covers the whole
     segment (hist channels: grad, hess, in-bag count, raw count).
@@ -698,10 +712,20 @@ def fused_split(
     BS_, F_pad, _ = _hist_packing(F, B)
     i32 = jnp.int32
 
+    n_rows = work.shape[0]
+    if num_rows is not None:
+        pad_rows = n_rows - int(num_rows)
+        if pad_rows < block_size:
+            raise ValueError(
+                f"fused_split pad contract violated: work has {n_rows} rows "
+                f"for num_rows={int(num_rows)} real rows (pad={pad_rows}), "
+                f"but block_size={block_size} requires pad >= block_size — "
+                "the defense-in-depth count clamp would silently drop tail "
+                "rows. Pad the row arrays by at least block_size (internal "
+                "callers use fused_block + 32).")
     # scalar sanitization (defense-in-depth, no effect on legit inputs):
     # bounds the kernel's block-loop trip counts and read windows even if a
     # caller hands a segment produced from corrupt histograms
-    n_rows = work.shape[0]
     start = jnp.clip(start.astype(i32), 0, n_rows - _A)
     count = jnp.clip(count.astype(i32), 0,
                      jnp.maximum(n_rows - block_size - start, 0))

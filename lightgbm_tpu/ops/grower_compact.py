@@ -191,7 +191,7 @@ def grow_tree_compact(
             zero, zero, zero, zero, zero, zero,
             jnp.zeros((W,), jnp.uint32), layout, B, params.fused_block, W,
             interpret=params.fused_interpret, dual=params.fused_dual,
-            hist_debug=params.fused_hist_debug)
+            hist_debug=params.fused_hist_debug, num_rows=n)
     else:
         root_loc = seg_hist(work, jnp.asarray(0, i32), jnp.asarray(n, i32))
     # data-parallel: histograms psum over the mesh axis (reference: the
@@ -460,7 +460,8 @@ def grow_tree_compact(
                 bits, layout, B, params.fused_block, W,
                 interpret=params.fused_interpret,
                 smaller_left=left_smaller.astype(i32), side=side_p,
-                dual=params.fused_dual, hist_debug=params.fused_hist_debug)
+                dual=params.fused_dual, hist_debug=params.fused_hist_debug,
+                num_rows=n)
         else:
             work, scratch = partition_segment(
                 st.work, st.scratch, s_, m_eff, n_left_eff, f_col, b_, dl,

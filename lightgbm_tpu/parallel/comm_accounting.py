@@ -27,6 +27,11 @@ _COLLECTIVES = ("all-reduce-start", "all-gather-start",
                 "collective-permute-start", "all-reduce", "all-gather",
                 "reduce-scatter", "collective-permute", "all-to-all")
 
+# async ops whose transferred payload is the RESULT shape (second element of
+# the (operand, result, ...) async tuple): all-gather's result is num_devices
+# times the operand, so counting the operand under-reports the gathered bytes
+_RESULT_SHAPE_STARTS = ("all-gather-start", "collective-permute-start")
+
 # one shaped tensor, e.g. f32[7,8,64]{2,1,0} — shapes can be scalar []
 _SHAPE_RE = re.compile(r"([a-z]+[0-9]*)\[([0-9,]*)\]")
 
@@ -62,7 +67,13 @@ def collective_bytes(hlo_text: str) -> Dict[str, int]:
         if kind.endswith("-start") and shapes:
             # async tuple output carries (operand, result, ...); count the
             # transferred payload once
-            shapes = shapes[:1]
+            if kind in _RESULT_SHAPE_STARTS:
+                # result shape (second tuple element); fall back to the
+                # operand if the tuple was flattened to a single shape
+                shapes = shapes[1:2] if len(shapes) > 1 else shapes[:1]
+            else:
+                # all-reduce-start: operand and result shapes are identical
+                shapes = shapes[:1]
         nbytes = sum(_tensor_bytes(d, dims) for d, dims in shapes)
         out[kind] += nbytes
         count += 1

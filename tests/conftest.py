@@ -34,3 +34,22 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.RandomState(42)
+
+
+@pytest.fixture
+def compile_guard():
+    """Count jit compilations inside a test; call
+    ``compile_guard.assert_no_compiles()`` (or read ``.lowerings``) after
+    the steady-state region (lightgbm_tpu.analysis.guards)."""
+    from lightgbm_tpu.analysis import guards
+    with guards.compile_counter() as counts:
+        yield counts
+
+
+@pytest.fixture
+def no_d2h_guard():
+    """Fail the test on any device->host materialization
+    (lightgbm_tpu.analysis.guards.no_host_transfers)."""
+    from lightgbm_tpu.analysis import guards
+    with guards.no_host_transfers():
+        yield

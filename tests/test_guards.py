@@ -1,0 +1,128 @@
+"""Runtime guard rails: compile counter + host-transfer guard, and the
+acceptance proof — the jitted compact step in boosting/gbdt.py runs 5
+post-warmup boosting iterations with zero recompilations and zero
+device-to-host transfers on the CPU backend."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.analysis import guards
+
+
+# ------------------------------------------------------- compile counter
+def test_compile_counter_zero_on_cache_hit():
+    @jax.jit
+    def f(x):
+        return x * 2 + 1
+
+    x = jnp.ones(3)
+    f(x)                                  # warm
+    with guards.compile_counter() as cc:
+        f(x)
+    assert cc.lowerings == 0
+    assert cc.backend_compiles == 0
+    cc.assert_no_compiles()               # does not raise
+
+
+def test_compile_counter_sees_recompile():
+    @jax.jit
+    def g(x):
+        return x - 1
+
+    x3, x5 = jnp.ones(3), jnp.ones(5)
+    g(x3)
+    with guards.compile_counter() as cc:
+        g(x5)                             # new shape -> retrace + lower
+    assert cc.lowerings >= 1
+    with pytest.raises(AssertionError, match="zero recompilations"):
+        cc.assert_no_compiles("shape change")
+
+
+def test_compile_counter_deactivates_after_exit():
+    @jax.jit
+    def h(x):
+        return x + 3
+
+    with guards.compile_counter() as cc:
+        pass
+    h(jnp.ones(7))                        # compiles AFTER the region
+    assert cc.lowerings == 0
+
+
+# --------------------------------------------------- host transfer guard
+def test_no_host_transfers_blocks_sync_idioms():
+    x = jnp.arange(4.0)
+    for sync in (lambda: float(x[0]),
+                 lambda: x.sum().item(),
+                 lambda: x.tolist(),
+                 lambda: jax.device_get(x)):
+        with pytest.raises(guards.HostTransferError):
+            with guards.no_host_transfers():
+                sync()
+
+
+def test_no_host_transfers_allows_device_work():
+    x = jnp.arange(8.0)
+    with guards.no_host_transfers():
+        y = (x * 2).sum()                 # pure device compute
+        z = jnp.asarray(np.ones(3))      # host->device is fine
+    assert float(y) == 56.0               # guard restored on exit
+    assert z.shape == (3,)
+
+
+def test_steady_state_guard_composes():
+    @jax.jit
+    def f(x):
+        return x * x
+
+    x = jnp.ones(6)
+    f(x)
+    with guards.steady_state_guard("steady f") as cc:
+        f(x)
+    assert cc.lowerings == 0
+
+
+# ----------------------------------------------- the acceptance criterion
+@pytest.fixture(scope="module")
+def warm_booster():
+    rng = np.random.RandomState(7)
+    n, f = 1500, 10
+    X = rng.randn(n, f).astype(np.float32)
+    y = (X[:, 0] + 0.4 * X[:, 1] + 0.5 * rng.randn(n) > 0).astype(
+        np.float64)
+    params = {
+        "objective": "binary",
+        "num_leaves": 15,
+        "max_bin": 63,
+        "learning_rate": 0.1,
+        "min_data_in_leaf": 20,
+        "verbosity": -1,
+        "tpu_grower": "compact",     # the physically-partitioned hot path
+        "stop_check_freq": 10_000,   # no mid-loop host flush
+    }
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params, ds)
+    for _ in range(2):               # warmup: compiles + first-iter paths
+        bst.update()
+    return bst
+
+
+def test_boosting_steady_state_no_recompiles_no_transfers(warm_booster):
+    """5 post-warmup iterations of the jitted compact step: zero
+    lowerings, zero backend compiles, zero device->host transfers."""
+    bst = warm_booster
+    with guards.steady_state_guard("5 post-warmup iterations") as cc:
+        for _ in range(5):
+            bst.update()
+    assert cc.lowerings == 0
+    assert cc.backend_compiles == 0
+    bst._gbdt._flush_trees()
+    assert bst._gbdt.num_total_trees >= 7
+
+
+def test_guard_pytest_fixtures(warm_booster, compile_guard, no_d2h_guard):
+    """The conftest fixtures wrap a whole test in both guards."""
+    warm_booster.update()
+    assert compile_guard.lowerings == 0

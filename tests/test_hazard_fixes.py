@@ -1,0 +1,146 @@
+"""Regression tests for the ADVICE r5 hazard fixes (the tpulint seed
+cases) + the bench backend-init retry."""
+import importlib.util
+import os
+import re
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightgbm_tpu.boosting.gbdt import _validated_fused_block_env
+from lightgbm_tpu.ops.compact import RowLayout
+from lightgbm_tpu.ops.fused_split import fused_split
+from lightgbm_tpu.parallel.comm_accounting import collective_bytes
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------- ADVICE #1: comm accounting
+HLO = """\
+ENTRY %main {
+  %p = f32[16]{0} parameter(0)
+  %ag = (f32[16]{0}, f32[128]{0}) all-gather-start(f32[16]{0} %p)
+  %agd = f32[128]{0} all-gather-done((f32[16]{0}, f32[128]{0}) %ag)
+  %ar = (f32[32]{0}, f32[32]{0}) all-reduce-start(f32[32]{0} %p2)
+  %ard = f32[32]{0} all-reduce-done((f32[32]{0}, f32[32]{0}) %ar)
+  %rs = f32[8]{0} reduce-scatter(f32[64]{0} %p3)
+}
+"""
+
+
+def test_all_gather_start_counts_result_shape():
+    """8-device all-gather: result is 8x the operand; bytes must reflect
+    the gathered (result) payload, not the pre-transfer operand."""
+    out = collective_bytes(HLO)
+    assert out["all-gather-start"] == 128 * 4        # NOT 16 * 4
+    assert out["all-reduce-start"] == 32 * 4         # operand == result
+    assert out["reduce-scatter"] == 8 * 4
+    assert out["count"] == 3                         # -done ops not counted
+    assert out["total"] == 128 * 4 + 32 * 4 + 8 * 4
+
+
+def test_collective_permute_start_counts_result_shape():
+    hlo = ("%cp = (f32[64]{0}, f32[64]{0}) "
+           "collective-permute-start(f32[64]{0} %x)")
+    out = collective_bytes(hlo)
+    assert out["collective-permute-start"] == 64 * 4
+
+
+# ------------------------------------------- ADVICE #2: fused pad contract
+def test_fused_split_raises_on_short_pad():
+    layout = RowLayout(num_features=10, num_extra=2)
+    C = layout.num_cols
+    work = jnp.zeros((96, C), jnp.uint8)
+    scratch = jnp.zeros((96, C), jnp.uint8)
+    z = jnp.asarray(0, jnp.int32)
+    with pytest.raises(ValueError, match="pad contract"):
+        fused_split(work, scratch, jnp.asarray(1, jnp.int32), z,
+                    jnp.asarray(64, jnp.int32), z, z, z, z, z, z,
+                    jnp.zeros((8,), jnp.uint32), layout, 64,
+                    block_size=64, num_rows=80)       # pad 16 < 64
+
+
+# ------------------------------------------- ADVICE #3: env override guard
+def test_env_override_rounded_to_32_multiple():
+    assert _validated_fused_block_env("100", 128, 384) == 96
+    assert _validated_fused_block_env("5", 128, 384) == 32
+    assert _validated_fused_block_env("256", 128, 384) == 256
+
+
+def test_env_override_clamped_to_vmem_cap():
+    """An oversize override must not recreate the VMEM blowup the scoped
+    guard prevents (pre-fix: accepted raw)."""
+    assert _validated_fused_block_env("8192", 128, 384) == 384
+    assert _validated_fused_block_env("512", 2048, 64) == 64
+
+
+# ------------------------------------------- ADVICE #4: docstring accuracy
+def test_hist_matmuls_docstring_matches_implementation():
+    src = open(os.path.join(
+        REPO, "lightgbm_tpu", "ops", "fused_split.py")).read()
+    doc = re.search(r"def hist_matmuls.*?\"\"\"(.*?)\"\"\"", src,
+                    re.DOTALL).group(1)
+    assert "constant-index lane gather" not in doc
+    assert "per-feature compare" in doc
+
+
+# --------------------------------------------- bench backend-init retry
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_retries_transient_backend_errors(monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+
+    class FlakyJax:
+        calls = 0
+
+        def devices(self):
+            FlakyJax.calls += 1
+            if FlakyJax.calls < 3:
+                raise RuntimeError("Unable to initialize backend 'tpu': "
+                                   "UNAVAILABLE: connection reset")
+            return ["tpu:0"]
+
+    assert bench._init_backend_with_retry(FlakyJax()) == "tpu:0"
+    assert FlakyJax.calls == 3
+
+
+def test_bench_reraises_non_transient_errors(monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+
+    class BrokenJax:
+        calls = 0
+
+        def devices(self):
+            BrokenJax.calls += 1
+            raise RuntimeError("no module named libtpu")
+
+    with pytest.raises(RuntimeError, match="libtpu"):
+        bench._init_backend_with_retry(BrokenJax())
+    assert BrokenJax.calls == 1               # no pointless retries
+
+
+def test_bench_gives_up_after_three_transient_attempts(monkeypatch):
+    bench = _load_bench()
+    sleeps = []
+    monkeypatch.setattr(bench.time, "sleep", sleeps.append)
+
+    class DownJax:
+        calls = 0
+
+        def devices(self):
+            DownJax.calls += 1
+            raise RuntimeError("Unable to initialize backend 'tpu'")
+
+    with pytest.raises(RuntimeError, match="Unable to initialize"):
+        bench._init_backend_with_retry(DownJax())
+    assert DownJax.calls == 3
+    assert sleeps == [5.0, 10.0]              # exponential backoff

@@ -1,0 +1,356 @@
+"""tpulint: tier-1 wiring + per-rule fixture tests + allowlist workflow.
+
+The whole-package test IS the tier-1 gate: any non-allowlisted finding in
+lightgbm_tpu/ fails the suite. The fixture snippets encode each rule's
+seed case (the pre-fix code from ADVICE r5) so a regression of the
+analyzer — or of the fixed code — fails loudly.
+"""
+import os
+import textwrap
+
+import lightgbm_tpu
+from lightgbm_tpu.analysis.tpulint import (DEFAULT_ALLOWLIST, apply_allowlist,
+                                           lint_paths, load_allowlist, main)
+
+PKG_DIR = os.path.dirname(lightgbm_tpu.__file__)
+
+
+def lint_snippet(tmp_path, source, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    findings, errors = lint_paths([str(p)])
+    assert not errors, errors
+    return findings
+
+
+def codes(findings):
+    return sorted(f.rule for f in findings)
+
+
+# ---------------------------------------------------------------- tier-1
+def test_package_is_clean():
+    """The shipped tree has zero non-allowlisted findings, and every
+    allowlist entry carries a justification and is actually used."""
+    findings, errors = lint_paths([PKG_DIR])
+    assert not errors, errors
+    entries, allow_errors = load_allowlist(DEFAULT_ALLOWLIST)
+    assert not allow_errors, allow_errors
+    remaining = apply_allowlist(findings, entries)
+    assert not remaining, "\n".join(f.render() for f in remaining)
+    unused = [e.render() for e in entries if not e.used]
+    assert not unused, f"unused allowlist entries: {unused}"
+
+
+def test_cli_exit_zero_on_package():
+    assert main([PKG_DIR]) == 0
+
+
+# ---------------------------------------------------------------- R001
+def test_r001_host_sync_flagged(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            v = float(x)
+            a = np.asarray(x)
+            jax.device_get(x)
+            i = x.sum().item()
+            return v, a, i
+    """)
+    assert codes(findings).count("R001") >= 4
+
+
+def test_r001_host_constants_not_flagged(tmp_path):
+    """float() on trace-time host config (closures, module constants) is
+    fine — only traced values sync."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+
+        ALPHA = "0.5"
+
+        def build(cfg):
+            @jax.jit
+            def step(x):
+                return x * float(ALPHA) + float(cfg.beta)
+            return step
+    """)
+    assert not findings
+
+
+def test_r001_host_code_not_flagged(tmp_path):
+    """Un-jitted host code may sync freely (treeshap-style host loops)."""
+    findings = lint_snippet(tmp_path, """
+        import numpy as np
+
+        def host_summary(arr):
+            return float(np.asarray(arr).sum())
+    """)
+    assert not findings
+
+
+# ---------------------------------------------------------------- R002
+def test_r002_jit_in_loop(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import jax
+
+        def build_all(fns):
+            out = []
+            for f in fns:
+                out.append(jax.jit(f))
+            return out
+    """)
+    assert "R002" in codes(findings)
+
+
+def test_r002_unhashable_static_default(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("opts",))
+        def run(x, opts=[]):
+            return x
+    """)
+    assert "R002" in codes(findings)
+
+
+def test_r002_tracer_branch(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import jax
+
+        @jax.jit
+        def step(x, flag):
+            if flag:
+                return x + 1
+            return x
+    """)
+    assert "R002" in codes(findings)
+
+
+def test_r002_static_shape_branch_not_flagged(tmp_path):
+    """x.shape is static at trace time — branching on it is fine even
+    when x itself is traced."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+
+        @jax.jit
+        def step(x):
+            if x.shape[0] > 4:
+                return x[:4]
+            return x
+    """)
+    assert not findings
+
+
+def test_r002_static_branch_not_flagged(tmp_path):
+    """Branching on declared static args is deliberate jax style."""
+    findings = lint_snippet(tmp_path, """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("mode",))
+        def step(x, mode):
+            if mode == "fast":
+                return x
+            return -x
+    """)
+    assert not findings
+
+
+def test_r002_interprocedural_static_helper_not_flagged(tmp_path):
+    """A helper only ever called with static values stays static — but the
+    same helper fed a traced value is flagged."""
+    clean = lint_snippet(tmp_path, """
+        import jax
+
+        def helper(n):
+            if n > 4:
+                return 1.0
+            return 2.0
+
+        @jax.jit
+        def step(x):
+            return x * helper(3)
+    """, name="clean.py")
+    assert not clean
+    dirty = lint_snippet(tmp_path, """
+        import jax
+
+        def helper(n):
+            if n > 4:
+                return 1.0
+            return 2.0
+
+        @jax.jit
+        def step(x):
+            return x * helper(x.sum())
+    """, name="dirty.py")
+    assert "R002" in codes(dirty)
+
+
+# ---------------------------------------------------------------- R003
+def test_r003_dtype_drift(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            y = np.sum(x)
+            z = x.astype("float64")
+            w = jnp.zeros(3, dtype="float64")
+            q = x * jnp.float64(2.0)
+            return y, z, w, q
+    """)
+    assert codes(findings).count("R003") >= 4
+
+
+def test_r003_host_numpy_not_flagged(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import numpy as np
+
+        def host_stats(values):
+            arr = np.asarray(values, np.float64)
+            return np.sum(arr)
+    """)
+    assert not findings
+
+
+# ---------------------------------------------------------------- R004
+def test_r004_env_override_unvalidated(tmp_path):
+    """The seed case: boosting/gbdt.py:945 pre-fix (ADVICE r5 #3)."""
+    findings = lint_snippet(tmp_path, """
+        import os
+
+        def pick_block(default_bs):
+            bs = default_bs
+            if os.environ.get("LGBM_TPU_FUSED_BS", ""):
+                bs = int(os.environ["LGBM_TPU_FUSED_BS"])
+            return bs
+    """)
+    assert "R004" in codes(findings)
+
+
+def test_r004_validated_env_override_ok(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import os
+
+        def _validated_block(value, cap):
+            v = max(32, (int(value) // 32) * 32)
+            return min(v, cap)
+
+        def pick_block(cap):
+            bs = _validated_block(os.environ["LGBM_TPU_FUSED_BS"], cap)
+            return bs
+    """)
+    assert not findings
+
+
+def test_r004_block_size_literal_and_num_rows(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        def caller(work, scratch, args):
+            return fused_split(work, scratch, *args, block_size=100)
+    """)
+    r4 = [f for f in findings if f.rule == "R004"]
+    assert len(r4) == 2           # non-32-multiple AND missing num_rows
+    clean = lint_snippet(tmp_path, """
+        def caller(work, scratch, args, n):
+            return fused_split(work, scratch, *args, block_size=128,
+                               num_rows=n)
+    """, name="clean_r4.py")
+    assert not clean
+
+
+# ---------------------------------------------------------------- R005
+def test_r005_operand_shape_counting(tmp_path):
+    """The seed case: parallel/comm_accounting.py:65 pre-fix (ADVICE r5
+    #1) — async starts counted by operand shape."""
+    findings = lint_snippet(tmp_path, """
+        def collective_bytes(entries):
+            total = 0
+            for kind, shapes in entries:
+                if kind.endswith("-start") and shapes:
+                    shapes = shapes[:1]
+                total += sum(shapes)
+            return total
+    """)
+    assert "R005" in codes(findings)
+
+
+def test_r005_result_shape_counting_ok(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        RESULT_KINDS = ("all-gather-start", "collective-permute-start")
+
+        def collective_bytes(entries):
+            total = 0
+            for kind, shapes in entries:
+                if kind.endswith("-start") and shapes:
+                    if kind in RESULT_KINDS:
+                        shapes = shapes[1:2] if len(shapes) > 1 \\
+                            else shapes[:1]
+                    else:
+                        shapes = shapes[:1]
+                total += sum(shapes)
+            return total
+    """)
+    assert not findings
+
+
+def test_r004_fixed_gbdt_clean():
+    """The LGBM_TPU_FUSED_BS override now routes through
+    _validated_fused_block_env (ADVICE r5 #3) — no R004 findings."""
+    path = os.path.join(PKG_DIR, "boosting", "gbdt.py")
+    findings, errors = lint_paths([path])
+    assert not errors
+    assert not [f for f in findings if f.rule == "R004"], \
+        [f.render() for f in findings]
+
+
+def test_r005_fixed_module_clean():
+    path = os.path.join(PKG_DIR, "parallel", "comm_accounting.py")
+    findings, errors = lint_paths([path])
+    assert not errors
+    assert not [f for f in findings if f.rule == "R005"], \
+        [f.render() for f in findings]
+
+
+# ------------------------------------------------------------ allowlist
+def test_allowlist_suppresses_and_tracks_usage(tmp_path):
+    snippet = tmp_path / "mod.py"
+    snippet.write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            return float(x)
+    """))
+    allow = tmp_path / "allow.txt"
+    allow.write_text(
+        "R001 mod.py::step  # deliberate: scalar debug readback\n"
+        "R003 other.py::nope  # never matches\n")
+    findings, _ = lint_paths([str(snippet)])
+    assert findings
+    entries, errors = load_allowlist(str(allow))
+    assert not errors
+    remaining = apply_allowlist(findings, entries)
+    assert not remaining
+    assert entries[0].used and not entries[1].used
+
+
+def test_allowlist_requires_justification(tmp_path):
+    allow = tmp_path / "allow.txt"
+    allow.write_text("R001 mod.py::step\n")
+    entries, errors = load_allowlist(str(allow))
+    assert not entries
+    assert errors and "justification" in errors[0]
+
+
+def test_allowlist_cli_errors_exit_2(tmp_path):
+    snippet = tmp_path / "ok.py"
+    snippet.write_text("x = 1\n")
+    allow = tmp_path / "allow.txt"
+    allow.write_text("R001 mod.py::step\n")
+    assert main([str(snippet), "--allowlist", str(allow)]) == 2
