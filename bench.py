@@ -133,6 +133,69 @@ def _record_shape(key, payload):
         json.dump(rec, fh, indent=1, sort_keys=True)
 
 
+def run_hist_microbench(print_json=True):
+    """BENCH_HIST_MICRO=1: the tentpole's speed claim, measured directly —
+    the quantized int8 one-hot contraction (int8 x int8 -> int32,
+    preferred_element_type=int32) vs the fp32-HIGHEST one-hot einsum it
+    replaces, on the SAME [N, F] x B histogram shape and channel count.
+    Records BENCH_SHAPES.json["hist_micro"] with both timings and the
+    speedup (acceptance: >= 2x on TPU)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    dev = _init_backend_with_retry(jax)
+    from lightgbm_tpu.ops.histogram import histogram_block
+
+    n = int(float(os.environ.get("BENCH_HIST_ROWS", 1 << 20)))
+    f = int(os.environ.get("BENCH_HIST_FEATURES", 28))
+    b = int(os.environ.get("BENCH_HIST_BINS", 256))
+    reps = int(os.environ.get("BENCH_HIST_REPS", 10))
+    rng = np.random.RandomState(0)
+    binned = jnp.asarray(rng.randint(0, b, (n, f)).astype(np.uint8))
+    ch_f32 = jnp.asarray(rng.randn(n, 4).astype(np.float32))
+    codes = rng.randint(-8, 9, (n, 4)).astype(np.int8)
+    codes[:, 2:] = 1                       # count channels
+    ch_int8 = jnp.asarray(codes)
+
+    # f32 baseline pinned to the chunked fp32-HIGHEST einsum (the exact
+    # path the int8 pipeline replaces); the int path uses the same auto
+    # dispatch the trainer uses (Mosaic int8 kernel on TPU, XLA on CPU)
+    f32_fn = jax.jit(lambda bn, ch: histogram_block(bn, ch, b, impl="xla"))
+    int_fn = jax.jit(lambda bn, ch: histogram_block(bn, ch, b, impl="auto"))
+
+    def bench_one(fn, ch):
+        fn(binned, ch).block_until_ready()         # compile + warm
+        fn(binned, ch).block_until_ready()
+        t0 = time.time()
+        for _ in range(reps):
+            out = fn(binned, ch)
+        out.block_until_ready()
+        return (time.time() - t0) / reps
+
+    t_f32 = bench_one(f32_fn, ch_f32)
+    t_int = bench_one(int_fn, ch_int8)
+    speedup = t_f32 / t_int
+    sys.stderr.write(
+        f"[bench-hist] platform={dev.platform} shape=[{n}, {f}] B={b} "
+        f"f32-HIGHEST={t_f32 * 1e3:.2f}ms int8={t_int * 1e3:.2f}ms "
+        f"speedup={speedup:.2f}x\n")
+    _record_shape("hist_micro", {
+        "platform": dev.platform, "rows": n, "features": f, "bins": b,
+        "f32_highest_ms": round(t_f32 * 1e3, 3),
+        "int8_ms": round(t_int * 1e3, 3),
+        "int8_speedup": round(speedup, 3),
+    })
+    if print_json:
+        print(json.dumps({
+            "metric": f"hist-micro [{n // 1024}k x {f}] B={b} int8 speedup",
+            "value": round(speedup, 3),
+            "unit": "x vs fp32-HIGHEST einsum",
+            "vs_baseline": round(speedup / 2.0, 3),  # acceptance target 2x
+        }))
+
+
 def run_ranking_bench():
     """Lambdarank at MS-LTR scale: pair-block chunking + NDCG under load."""
     import jax
@@ -187,6 +250,8 @@ def run_ranking_bench():
 
 
 def main():
+    if os.environ.get("BENCH_HIST_MICRO", "") == "1":
+        return run_hist_microbench()
     if os.environ.get("BENCH_RANKING", "") == "1":
         return run_ranking_bench()
     import jax
@@ -204,6 +269,14 @@ def main():
     dev = _init_backend_with_retry(jax)
     # announce up front so a silent CPU fallback is visible in the artifact
     sys.stderr.write(f"[bench] backend platform: {dev.platform}\n")
+    if dev.platform in ("tpu", "axon") \
+            and not os.environ.get("BENCH_SKIP_HIST_MICRO"):
+        # cheap (~seconds): every TPU bench run refreshes the int8-vs-f32
+        # histogram microbench record alongside the training throughput
+        try:
+            run_hist_microbench(print_json=False)
+        except Exception as err:  # noqa: BLE001 - never sink the main bench
+            sys.stderr.write(f"[bench-hist] microbench failed: {err}\n")
     sparse = os.environ.get("BENCH_SPARSE", "") == "1"
     if sparse:
         X, y = make_allstate_like(ROWS, FEATURES)

@@ -18,11 +18,17 @@ or an array is materialized on the host inside it.
     ``__float__``/``__int__``/``__bool__``/``__index__``) to raise
     ``HostTransferError`` at the offending call site, and additionally
     arms ``jax.transfer_guard_device_to_host("disallow")``, which is
-    enforced natively on real device backends. CPU-backend caveat: numpy
-    can reach a CPU-resident buffer zero-copy through the C-level buffer
-    protocol (``np.asarray(arr)``) without touching any Python funnel —
-    that one idiom is only caught by the native transfer guard on TPU and
-    by the static pass (R001) everywhere.
+    enforced natively on real device backends.
+
+    ``np.asarray(arr)`` on the CPU backend reaches the buffer zero-copy
+    through the C-level buffer protocol WITHOUT touching any ``jax.Array``
+    method — so the numpy entry points themselves
+    (``np.asarray``/``np.array``/``np.ascontiguousarray``/
+    ``np.asanyarray``) are wrapped too: a ``jax.Array`` as the top-level
+    argument raises inside the guard. Residual caveat: a direct C-level
+    consumer (``memoryview(arr)``, third-party C extensions taking the
+    buffer) still bypasses Python entirely — only the native transfer
+    guard on TPU and the static pass (R001) see those.
 
 Both are plain context managers usable directly or as pytest fixtures
 (wired in tests/conftest.py).
@@ -42,6 +48,10 @@ _BACKEND_EVENT = "/jax/core/compile/backend_compile_duration"
 #: jax.Array methods/properties through which host materialization funnels
 _FUNNELS = ("_value", "__array__", "item", "tolist", "__float__",
             "__int__", "__bool__", "__index__", "__complex__")
+
+#: numpy entry points that can materialize a CPU-backend jax.Array
+#: zero-copy via the C buffer protocol, bypassing every patched method
+_NP_FUNNELS = ("asarray", "array", "ascontiguousarray", "asanyarray")
 
 
 class HostTransferError(AssertionError):
@@ -128,12 +138,38 @@ def no_host_transfers() -> Iterator[None]:
             continue
         saved[name] = orig
         setattr(cls, name, _wrap(name, orig))
+
+    # the np.asarray buffer-protocol path materializes the array without
+    # calling ANY jax.Array method on CPU; guard the numpy entry points
+    # for direct jax.Array arguments (nested containers still route
+    # through the patched __array__ above)
+    import numpy as _np
+
+    def _np_wrap(name, orig):
+        def guard(a, *args, **kw):
+            if isinstance(a, cls):
+                raise HostTransferError(
+                    f"np.{name}() materialized a jax.Array on the host "
+                    "inside a no_host_transfers() region (C buffer-protocol "
+                    "path)")
+            return orig(a, *args, **kw)
+        return guard
+
+    np_saved = {}
+    for name in _NP_FUNNELS:
+        orig = getattr(_np, name, None)
+        if orig is None:  # pragma: no cover - numpy always has these
+            continue
+        np_saved[name] = orig
+        setattr(_np, name, _np_wrap(name, orig))
     try:
         with jax.transfer_guard_device_to_host("disallow"):
             yield
     finally:
         for name, orig in saved.items():
             setattr(cls, name, orig)
+        for name, orig in np_saved.items():
+            setattr(_np, name, orig)
 
 
 @contextlib.contextmanager

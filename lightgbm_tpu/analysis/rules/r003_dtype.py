@@ -1,6 +1,6 @@
 """R003 — dtype drift in device code.
 
-Two sub-checks, both scoped to jit-reachable functions:
+Four sub-checks, all scoped to jit-reachable functions:
 
   * ``np.*`` math/array ops applied to traced values: numpy either raises
     on tracers or silently materializes a trace-time constant, and the
@@ -13,18 +13,81 @@ Two sub-checks, both scoped to jit-reachable functions:
     default, and the only supported mode on TPU here) jax silently lowers
     these to f32 — the annotation lies; with x64 enabled they double
     memory/VPU cost. Either way it is drift, not intent.
+  * int-packing accumulation contract (quantized-gradient histograms): a
+    matmul-family call (``einsum``/``dot``/``matmul``/``dot_general``)
+    with an int8/int16-cast operand MUST carry
+    ``preferred_element_type=...`` — without it the contraction output
+    dtype follows the narrow operands and the int32 histogram sums
+    silently wrap at +-127 (ops/histogram.py int8 MXU path).
+  * dequantize contract: an ``.astype(jnp.float32)`` on a quantized
+    histogram (names matching ``qhist``/``quant_hist``/``hist_q``) must
+    sit inside a multiply by a ``*scale*`` name — a bare cast yields raw
+    code sums, silently off by the per-iteration leaf scale
+    (ops/histogram.py dequantize_hist is the sanctioned boundary).
 """
 from __future__ import annotations
 
 import ast
+import re
 from typing import List
 
 from .base import (Finding, ModuleInfo, PackageInfo, Rule, call_name,
-                   dotted_name, expr_references, traced_names)
+                   dotted_name, expr_references, string_constants,
+                   traced_names)
 
 _NP_EXEMPT = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
 _F64_NAMES = {"np.float64", "numpy.float64", "jnp.float64",
               "jax.numpy.float64"}
+
+_MATMUL_SUFFIXES = ("einsum", "dot", "matmul", "dot_general")
+_INT_NARROW = {"int8", "int16"}
+_F32_NAMES = {"float32"}
+_QHIST_RE = re.compile(r"(q|quant)_?hist|hist_?(q|quant)", re.I)
+
+
+def _is_int_narrow_cast(node: ast.Call) -> bool:
+    """``X.astype(jnp.int8)`` / ``X.astype('int16')`` style calls."""
+    name = call_name(node) or ""
+    if not name.endswith(".astype") and name != "astype":
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype"):
+            return False
+    for a in node.args:
+        if any(s in _INT_NARROW for s in string_constants(a)):
+            return True
+        for sub in ast.walk(a):
+            d = dotted_name(sub)
+            if d and d.split(".")[-1] in _INT_NARROW:
+                return True
+    return False
+
+
+def _has_int_narrow_cast(node: ast.AST) -> bool:
+    return any(isinstance(sub, ast.Call) and _is_int_narrow_cast(sub)
+               for sub in ast.walk(node))
+
+
+def _is_f32_astype(node: ast.Call) -> bool:
+    if not (isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype"):
+        return False
+    for a in node.args:
+        if any(s in _F32_NAMES for s in string_constants(a)):
+            return True
+        for sub in ast.walk(a):
+            d = dotted_name(sub)
+            if d and d.split(".")[-1] in _F32_NAMES:
+                return True
+    return False
+
+
+def _mentions(node: ast.AST, pattern) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and pattern(sub.id):
+            return True
+        if isinstance(sub, ast.Attribute) and pattern(sub.attr):
+            return True
+    return False
 
 
 class DtypeDriftRule(Rule):
@@ -36,6 +99,27 @@ class DtypeDriftRule(Rule):
         out: List[Finding] = []
         for fn in package.reachable_functions(module):
             traced = traced_names(fn, package)
+            # names locally assigned from int8/int16-cast expressions (the
+            # int-packing contract tracks them into matmul operands)
+            int_names = set()
+            for node in fn.own_nodes():
+                if isinstance(node, ast.Assign) \
+                        and _has_int_narrow_cast(node.value):
+                    for t in node.targets:
+                        for sub in ast.walk(t):
+                            if isinstance(sub, ast.Name):
+                                int_names.add(sub.id)
+            # astype(f32) nodes blessed by a sibling *scale* multiply
+            scale_ok = set()
+            for node in fn.own_nodes():
+                if isinstance(node, ast.BinOp) \
+                        and isinstance(node.op, ast.Mult):
+                    for side, other in ((node.left, node.right),
+                                        (node.right, node.left)):
+                        if _mentions(other, lambda s: "scale" in s.lower()):
+                            scale_ok.update(
+                                id(sub) for sub in ast.walk(side)
+                                if isinstance(sub, ast.Call))
             for node in fn.own_nodes():
                 if isinstance(node, ast.Call):
                     name = call_name(node) or ""
@@ -62,6 +146,35 @@ class DtypeDriftRule(Rule):
                                 "dtype='float64' in device code — f64 "
                                 "silently lowers to f32 with x64 "
                                 "disabled"))
+                    # int-packing contract: int8/int16 matmul operands need
+                    # preferred_element_type (else the contraction output
+                    # narrows to the operand dtype and histogram sums wrap)
+                    if name.split(".")[-1] in _MATMUL_SUFFIXES:
+                        int_op = any(
+                            _has_int_narrow_cast(a)
+                            or expr_references(a, int_names)
+                            for a in node.args)
+                        has_pref = any(kw.arg == "preferred_element_type"
+                                       for kw in node.keywords)
+                        if int_op and not has_pref:
+                            out.append(self.finding(
+                                module, node, fn.qualname,
+                                f"{name}() with int8/int16 operands and no "
+                                "preferred_element_type — the accumulator "
+                                "follows the narrow operand dtype and "
+                                "histogram sums overflow; pin it to int32 "
+                                "(ops/histogram.py int-packing contract)"))
+                    # dequantize contract: quantized-histogram casts to f32
+                    # must multiply by the leaf scale
+                    if (_is_f32_astype(node) and id(node) not in scale_ok
+                            and _mentions(node.func.value,
+                                          _QHIST_RE.search)):
+                        out.append(self.finding(
+                            module, node, fn.qualname,
+                            "quantized histogram cast to f32 without the "
+                            "leaf-scale multiply — raw code sums are off "
+                            "by the per-iteration scale; dequantize via "
+                            "ops.histogram.dequantize_hist"))
                 elif isinstance(node, ast.Attribute):
                     if dotted_name(node) in _F64_NAMES:
                         out.append(self.finding(

@@ -37,7 +37,7 @@ from ..ops.predict import StackedTrees, predict_raw, route_one_tree
 from ..parallel.multihost import to_host as _to_host
 from ..ops.renew import renew_leaf_quantile
 from ..utils import log
-from .sample_strategy import create_sample_strategy
+from .sample_strategy import GOSSStrategy, create_sample_strategy
 
 _EPS = 1e-35
 
@@ -91,17 +91,25 @@ def _parse_interactions(value, num_features: int) -> Optional[np.ndarray]:
     return sets
 
 
-def _quantize_gradients(grad, hess, key, num_bins: int, stochastic: bool,
-                        const_hess: bool):
-    """Quantized-gradient training (reference:
+def _discretize_gradients(grad, hess, key, num_bins: int, stochastic: bool,
+                          const_hess: bool, axis_name=None):
+    """Gradient discretization (reference:
     GradientDiscretizer::DiscretizeGradients, gradient_discretizer.cpp):
     gradients snap to num_grad_quant_bins levels of max|g|/(bins/2) with
-    stochastic rounding. Quantized values are kept DE-quantized in f32
-    (exact integer multiples of the scale), so the histogram pipeline is
-    unchanged while the training statistics match the reference's
-    coarse-gradient regularization."""
+    stochastic rounding. Returns ``(qg, qh, g_scale, h_scale)`` — the CODE
+    arrays (integer-valued f32: |qg| <= bins/2, 0 <= qh <= bins, so they
+    cast exactly to int8 for bins <= 127) plus the per-iteration scales.
+    The int-histogram pipeline consumes the codes directly; the masked
+    grower's shim multiplies them back (``_quantize_gradients``).
+
+    ``axis_name``: under shard_map the max-abs scale must be GLOBAL (pmax)
+    — per-shard scales would make the psum-ed int histograms sum codes on
+    different grids."""
     gmax = jnp.max(jnp.abs(grad))
     hmax = jnp.max(jnp.abs(hess))
+    if axis_name is not None:
+        gmax = jax.lax.pmax(gmax, axis_name)
+        hmax = jax.lax.pmax(hmax, axis_name)
     g_scale = jnp.maximum(gmax / (num_bins // 2), 1e-30)
     h_scale = jnp.maximum(
         hmax if const_hess else hmax / num_bins, 1e-30)
@@ -114,6 +122,19 @@ def _quantize_gradients(grad, hess, key, num_bins: int, stochastic: bool,
     else:
         qg = jnp.trunc(grad / g_scale + jnp.sign(grad) * 0.5)
         qh = jnp.trunc(hess / h_scale + 0.5)
+    return qg, qh, g_scale, h_scale
+
+
+def _quantize_gradients(grad, hess, key, num_bins: int, stochastic: bool,
+                        const_hess: bool):
+    """Dequantized-f32 shim over ``_discretize_gradients`` for the masked
+    grower: codes multiply straight back by their scales (exact integer
+    multiples), so that histogram pipeline is unchanged while the training
+    statistics match the reference's coarse-gradient regularization. The
+    compact grower skips this shim and feeds the codes to the int8 MXU
+    histogram path instead (ops/grower_compact.py quant_hist)."""
+    qg, qh, g_scale, h_scale = _discretize_gradients(
+        grad, hess, key, num_bins, stochastic, const_hess)
     return qg * g_scale, qh * h_scale
 
 
@@ -1097,6 +1118,28 @@ class GBDT:
         if mesh is not None:
             from ..parallel.mesh import DATA_AXIS
             gp = gp._replace(axis_name=DATA_AXIS)
+            # data-parallel histogram reduction: reduce-scatter over the
+            # feature axis + tiny best-split all-gather instead of
+            # all-reducing the full [F, B, 4] histogram (the reference's
+            # actual protocol — ReduceScatter + SyncUpGlobalBestSplit,
+            # data_parallel_tree_learner.cpp:223-300). EFB bundles and the
+            # intermediate monotone method scan across features a shard
+            # would not own, so they keep the all-reduce.
+            sc_cfg = os.environ.get(
+                "LGBM_TPU_HIST_SCATTER",
+                str(self.config.get("tpu_hist_scatter", "auto"))).lower()
+            n_sh = len(mesh.devices.ravel())
+            sc_able = (n_sh > 1 and gp.efb_virtual == 0
+                       and not gp.mono_intermediate)
+            if sc_cfg in ("on", "1", "true") and not sc_able:
+                why = ("a single-shard mesh has nothing to scatter"
+                       if n_sh <= 1 else
+                       "EFB bundles / monotone intermediate need "
+                       "cross-feature histogram access")
+                log.warning(f"tpu_hist_scatter=on: {why}; using the "
+                            "full histogram all-reduce")
+            if sc_cfg not in ("off", "0", "false") and sc_able:
+                gp = gp._replace(hist_scatter=n_sh)
         k_total = self.num_tree_per_iteration
         n = self._compact["nl"]          # per-shard rows (serial: all rows)
         n_real_g = self._n_real
@@ -1120,6 +1163,29 @@ class GBDT:
             quant_renew = False
         quant_bins = self._quant_bins
         quant_stoch = self._quant_stochastic
+        # quantized-gradient INT histogram path (the int8 MXU speed lever):
+        # grad/hess columns carry integer codes, histograms accumulate
+        # int8 x int8 -> int32 and dequantize at the split scan. Requires
+        # codes that survive the {0,1} bag multiply as integers — GOSS
+        # amplifies sampled rows' gradients by a non-integer factor, and
+        # multiclass carries per-class gradients whose shared scale would
+        # need cross-step plumbing; both keep the dequantized-f32 shim.
+        # Overflow bound: |hess code| <= quant_bins and the cross-shard
+        # psum sums over GLOBAL rows, so a near-constant feature's root
+        # bin holds up to num_data * quant_bins — that must stay inside
+        # int32 (the per-shard 2^24 row cap alone does not bound the
+        # reduced sums on many shards).
+        quant_int = (use_quant and k_total == 1 and quant_bins <= 127
+                     and self.num_data * quant_bins < (1 << 31)
+                     and not isinstance(self.sample_strategy, GOSSStrategy))
+        if use_quant and k_total == 1 and not quant_int \
+                and self.num_data * quant_bins >= (1 << 31):
+            log.warning(
+                f"use_quantized_grad: num_data*num_grad_quant_bins = "
+                f"{self.num_data}*{quant_bins} exceeds the int32 histogram "
+                "range; using the dequantized-f32 histogram path")
+        if quant_int:
+            gp = gp._replace(quant_hist=True)
         const_hess = bool(getattr(obj, "is_constant_hessian", False))
         feature_contri = self._feature_contri
         efb = self._efb
@@ -1155,13 +1221,30 @@ class GBDT:
             label = col(work, lbl_off)
             weight = col(work, w_off) if w_off is not None else None
             class_grads = []
+            quant_scales = None
             if ext_grads:
                 # gradients arrive pre-computed in the CURRENT row order
                 # (lambdarank couples rows of a query; _rank_grads_fn)
                 g_k, h_k = ext_g, ext_h
             elif k_total == 1:
                 g, h = _bound_gradients(obj, k_total, scores, label, weight)
-                if use_quant:
+                if quant_int:
+                    # integer-code path: the grad/hess columns carry the
+                    # discretizer CODES (exact small ints in f32 lanes) and
+                    # the per-iteration scales flow to the split scan as
+                    # traced scalars — the histogram pipeline runs
+                    # int8 x int8 -> int32 end to end
+                    qk = quant_key
+                    if gp.axis_name is not None:
+                        # shard-independent stochastic rounding draws
+                        qk = jax.random.fold_in(
+                            qk, lax.axis_index(gp.axis_name))
+                    qg, qh, g_s, h_s = _discretize_gradients(
+                        g, h, qk, quant_bins, quant_stoch, const_hess,
+                        axis_name=gp.axis_name)
+                    g, h = qg, qh
+                    quant_scales = (g_s, h_s)
+                elif use_quant:
                     g, h = _quantize_gradients(
                         g, h, quant_key, quant_bins, quant_stoch, const_hess)
                 g_k, h_k = g[0], h[0]
@@ -1199,7 +1282,7 @@ class GBDT:
                 work, scratch, num_bins_arr, nan_bin_arr, has_nan_arr,
                 is_cat_arr, feat_mask, layout, gp, n,
                 mono_types, inter_sets, bynode_key, cegb_coupled, cegb_used,
-                extra_key, feature_contri, efb)
+                extra_key, feature_contri, efb, quant_scales=quant_scales)
             if use_cegb:
                 cegb_used = _tree_used_features(tree, layout.num_features,
                                                 cegb_used)

@@ -44,6 +44,11 @@ def _load_dataset(params, data_path: str):
         group_column=params.get("group_column", ""),
         parser_config_file=str(params.get("parser_config_file", "") or ""),
         ignore_column=params.get("ignore_column", ""),
+        # memory-bounded two-pass loading (reference: two_round config,
+        # dataset_loader.cpp:266) — X comes back as a TextFileSequence and
+        # feeds the streaming construction path
+        two_round=str(params.get("two_round", "false")).lower()
+        in ("true", "1"),
     )
     if weight is None:
         weight = load_weight_file(data_path)

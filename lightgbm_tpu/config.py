@@ -176,6 +176,10 @@ PARAMS: Dict[str, Tuple[Any, type, Tuple[str, ...]]] = {
     "tpu_trace_dir": ("", str, ()),
     "tpu_part_block": (2048, int, ()),      # compact partition stream block
     "tpu_hist_block": (16384, int, ()),     # compact histogram stream block
+    # data-parallel histogram reduction: reduce-scatter over the feature
+    # axis + best-split all-gather vs full-histogram all-reduce
+    # (ops/grower_compact.py hist_scatter)
+    "tpu_hist_scatter": ("auto", str, ()),  # auto | on | off
     # fused per-split Mosaic kernel (partition + smaller-child histogram in
     # one streamed walk, ops/fused_split.py): auto = on with a TPU backend
     "tpu_fused": ("auto", str, ()),         # auto | on | off
@@ -274,7 +278,6 @@ METRIC_ALIASES: Dict[str, str] = {
 # rejects inconsistent configs outright, src/io/config.cpp:286). Entries are
 # removed from this set as the corresponding feature lands.
 UNIMPLEMENTED_PARAMS: Dict[str, str] = {
-    "two_round": "two-round file loading",
     "pre_partition": "pre-partitioned distributed data",
 }
 

@@ -5,6 +5,17 @@ TPU-native counterpart of the reference's DatasetLoader + Parser
 auto-detection src/io/parser.cpp — CSV/TSV/LibSVM with an optional header,
 label/weight/group columns by index or name). Parsing is host-side numpy;
 the result feeds the same BinnedDataset construction as array inputs.
+
+``two_round=True`` selects the reference's memory-bounded two-pass mode
+(reference: two_round config, DatasetLoader::LoadFromFile's
+SampleTextDataFromFile + second parse pass, dataset_loader.cpp:266-330):
+the first round scans the file once, recording per-row byte offsets and
+the tiny per-row metadata columns (label/weight/group); the second round
+is on-demand — a ``Sequence`` over the recorded offsets feeds the
+streaming ``BinnedDataset.construct_from_sequences`` path, so the dense
+``[N, F]`` float64 matrix is never materialized (peak memory = packed bin
+matrix + one parse batch + 8 bytes/row of offsets and 8 bytes/row per
+requested metadata column — compact ``array`` buffers, not Python lists).
 """
 from __future__ import annotations
 
@@ -12,6 +23,8 @@ import os
 from typing import Optional, Tuple
 
 import numpy as np
+
+from ..basic import Sequence
 
 
 def _detect_format(path: str, line: str) -> str:
@@ -26,6 +39,26 @@ def _detect_format(path: str, line: str) -> str:
     if any(":" in t for t in tokens[1:3]):
         return "libsvm"
     return "tsv" if "\t" in line else "csv"
+
+
+def _cell_float(v: str) -> float:
+    """One metadata cell -> float; empty/unparsable cells are NaN (the
+    one-round loader's ``np.genfromtxt`` semantics)."""
+    v = v.strip()
+    if not v:
+        return float("nan")
+    try:
+        return float(v)
+    except ValueError:
+        return float("nan")
+
+
+def _group_sizes_from_ids(gid: np.ndarray) -> np.ndarray:
+    """Consecutive identical group ids -> group sizes (reference query
+    files; shared by the one-round, two-round, and plugin loaders)."""
+    change = np.flatnonzero(np.diff(gid)) + 1
+    bounds = np.concatenate([[0], change, [len(gid)]])
+    return np.diff(bounds)
 
 
 def _parse_column_spec(spec: str, names) -> Optional[int]:
@@ -106,11 +139,9 @@ def _load_with_plugin(path: str, has_header: bool, parser_config_file: str,
         weight = X[:, wi]
         drop.append(wi)
     if gi is not None:
-        gid = X[:, gi].astype(np.int64)
         # contiguous query-id column -> group sizes
-        change = np.nonzero(np.diff(gid))[0]
-        bounds = np.concatenate([[0], change + 1, [len(gid)]])
-        group = np.diff(bounds).astype(np.int64)
+        group = _group_sizes_from_ids(
+            X[:, gi].astype(np.int64)).astype(np.int64)
         drop.append(gi)
     for spec in str(ignore_column).split(","):
         j = idx_of(spec)
@@ -122,6 +153,124 @@ def _load_with_plugin(path: str, has_header: bool, parser_config_file: str,
     return X, y, weight, group, None
 
 
+class TextFileSequence(Sequence):
+    """Random-access second-round view of a CSV/TSV file.
+
+    A ``lightgbm_tpu.Sequence``, so ``Dataset`` routes it through the
+    streaming construction path: ``__getitem__`` seeks to the recorded
+    byte offsets and parses only the requested rows, so batch reads
+    during streaming construction are one contiguous file read each.
+    """
+
+    batch_size = 4096
+
+    def __init__(self, path: str, offsets: np.ndarray, feat_cols,
+                 delim: str):
+        self.path = path
+        self._offsets = offsets          # [N + 1] byte offsets (int64)
+        self._feat_cols = list(feat_cols)
+        self._delim = delim
+
+    def __len__(self):
+        return len(self._offsets) - 1
+
+    def _parse_rows(self, start: int, stop: int) -> np.ndarray:
+        with open(self.path, "rb") as fh:
+            fh.seek(int(self._offsets[start]))
+            blob = fh.read(int(self._offsets[stop] - self._offsets[start]))
+        lines = blob.decode().splitlines()
+        out = np.empty((stop - start, len(self._feat_cols)), np.float64)
+        for r, line in enumerate(ln for ln in lines if ln.strip()):
+            vals = line.split(self._delim)
+            for c, j in enumerate(self._feat_cols):
+                # same tolerance as the one-round loader's genfromtxt:
+                # empty/junk cells are NaN, never a parse crash
+                out[r, c] = _cell_float(vals[j]) if j < len(vals) \
+                    else np.nan
+        return out
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            rng = range(*idx.indices(len(self)))
+            if not rng:
+                return np.empty((0, len(self._feat_cols)), np.float64)
+            if rng.step == 1:
+                return self._parse_rows(rng.start, rng.stop)
+            lo, hi = min(rng), max(rng) + 1
+            rows = self._parse_rows(lo, hi)
+            return rows[[i - lo for i in rng]]
+        i = int(idx)
+        if i < 0:
+            i += len(self)
+        if not 0 <= i < len(self):
+            raise IndexError(f"row {idx} out of range for {len(self)} rows")
+        return self._parse_rows(i, i + 1)[0]
+
+
+def _two_round_load(path, fmt, has_header, label_column, weight_column,
+                    group_column, ignore_column):
+    """First round: one streaming scan recording per-row byte offsets and
+    the scalar metadata columns. Returns the Sequence + metadata."""
+    from array import array
+    delim = "\t" if fmt == "tsv" else ","
+    offsets = array("q", [0])          # compact 8-byte/row buffers: the
+    label_v = array("d")               # first pass must stay memory-bounded
+    weight_v = array("d")              # at 100M-row files, not grow Python
+    group_v = array("d")               # object lists
+    names = None
+    with open(path, "rb") as fh:
+        pos = 0
+        first = True
+        label_idx = weight_idx = group_idx = None
+        ignore = set()
+        n_cols = None
+        for raw in fh:
+            pos += len(raw)
+            line = raw.decode().strip()
+            if first and has_header:
+                names = [c.strip() for c in line.split(delim)]
+                offsets[0] = pos
+                first = False
+                continue
+            first = False
+            if not line:
+                offsets[-1] = pos
+                continue
+            vals = line.split(delim)
+            if n_cols is None:
+                n_cols = len(vals)
+
+                def col_of(spec):
+                    return _parse_column_spec(spec, names or [])
+
+                label_idx = col_of(label_column)
+                weight_idx = col_of(weight_column)
+                group_idx = col_of(group_column)
+                if ignore_column:
+                    for part in str(ignore_column).split(","):
+                        j = col_of(part)
+                        if j is not None:
+                            ignore.add(j)
+            if label_idx is not None:
+                label_v.append(_cell_float(vals[label_idx]))
+            if weight_idx is not None:
+                weight_v.append(_cell_float(vals[weight_idx]))
+            if group_idx is not None:
+                group_v.append(_cell_float(vals[group_idx]))
+            offsets.append(pos)
+    special = {i for i in (label_idx, weight_idx, group_idx)
+               if i is not None} | ignore
+    feat_cols = [i for i in range(n_cols or 0) if i not in special]
+    seq = TextFileSequence(path, np.asarray(offsets, np.int64), feat_cols,
+                           delim)
+    label = np.asarray(label_v) if len(label_v) else None
+    weight = np.asarray(weight_v) if len(weight_v) else None
+    group_sizes = (_group_sizes_from_ids(np.asarray(group_v))
+                   if len(group_v) else None)
+    feat_names = ([names[i] for i in feat_cols] if names else None)
+    return seq, label, weight, group_sizes, feat_names
+
+
 def load_text_file(
     path: str,
     has_header: bool = False,
@@ -130,9 +279,12 @@ def load_text_file(
     group_column: str = "",
     ignore_column: str = "",
     parser_config_file: str = "",
+    two_round: bool = False,
 ) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray],
            Optional[np.ndarray], Optional[list]]:
-    """Returns (X, label, weight, group_sizes, feature_names)."""
+    """Returns (X, label, weight, group_sizes, feature_names); with
+    ``two_round=True`` X is a :class:`TextFileSequence` instead of a dense
+    matrix (see the module docstring)."""
     if not os.path.exists(path):
         raise FileNotFoundError(path)
     if parser_config_file:
@@ -141,6 +293,16 @@ def load_text_file(
     with open(path) as f:
         first = f.readline()
     fmt = _detect_format(path, first if not has_header else "")
+
+    if two_round:
+        if fmt == "libsvm":
+            import warnings
+            warnings.warn("two_round=true is implemented for CSV/TSV; "
+                          "LibSVM files load in one round")
+        else:
+            return _two_round_load(path, fmt, has_header, label_column,
+                                   weight_column, group_column,
+                                   ignore_column)
 
     if fmt == "libsvm":
         return _load_libsvm(path, has_header)
@@ -176,11 +338,7 @@ def load_text_file(
     weight = raw[:, weight_idx] if weight_idx is not None else None
     group_sizes = None
     if group_idx is not None:
-        gid = raw[:, group_idx]
-        # consecutive identical group ids -> sizes (reference query files)
-        change = np.flatnonzero(np.diff(gid)) + 1
-        bounds = np.concatenate([[0], change, [len(gid)]])
-        group_sizes = np.diff(bounds)
+        group_sizes = _group_sizes_from_ids(raw[:, group_idx])
     feat_names = ([names[i] for i in feat_cols] if names else None)
     return X, label, weight, group_sizes, feat_names
 

@@ -290,7 +290,8 @@ def segment_histogram(
     num_bins: int,
     block_size: int,
     impl: str = "auto",
-) -> jnp.ndarray:            # [F, B, 4] f32
+    quantized: bool = False,
+) -> jnp.ndarray:            # [F, B, 4] f32 (int32 when quantized)
     """Histogram of one contiguous leaf segment, streamed in fixed blocks.
 
     Channels: (grad, hess, in-bag count, raw count). The in-bag count is the
@@ -298,6 +299,13 @@ def segment_histogram(
     rows, not their weights). Counts accumulate in f32 and stay exact below
     2^24 rows — the raw-count channel drives the physical partition offsets,
     so exactness is required, not a nicety.
+
+    ``quantized``: the grad/hess columns hold integer discretizer codes
+    (|code| <= 127, stored as exact f32 — the row-record layout is
+    unchanged); they re-pack into an int8 channel matrix per block and the
+    contraction runs int8 x int8 -> int32 on the MXU (ops/histogram.py).
+    All four channels come back as exact int32 sums (the GBDT bounds
+    global num_data * quant_bins inside int32 before selecting this path).
     """
     from .histogram import histogram_block
 
@@ -311,14 +319,23 @@ def segment_histogram(
     def body(state):
         j, acc = state
         blk = lax.dynamic_slice(work, (start + j * bs, 0), (bs, c))
-        valid = (iota < (count - j * bs)).astype(jnp.float32)
         g, h, cw = block_grad_hess_cnt(blk, layout)
-        cw = (cw != 0.0).astype(jnp.float32)
-        chans = jnp.stack([g * valid, h * valid, cw * valid, valid], axis=1)
+        if quantized:
+            valid = iota < (count - j * bs)
+            v8 = valid.astype(jnp.int8)
+            inbag = (cw != 0.0).astype(jnp.int8) * v8
+            # f32 -> int8 casts are exact: the codes are integers <= 127
+            chans = jnp.stack([g.astype(jnp.int8) * v8,
+                               h.astype(jnp.int8) * v8, inbag, v8], axis=1)
+        else:
+            valid = (iota < (count - j * bs)).astype(jnp.float32)
+            cw = (cw != 0.0).astype(jnp.float32)
+            chans = jnp.stack([g * valid, h * valid, cw * valid, valid],
+                              axis=1)
         acc = acc + histogram_block(blk[:, :f], chans, b, impl=impl)
         return j + 1, acc
 
-    acc0 = jnp.zeros((f, b, 4), jnp.float32)
+    acc0 = jnp.zeros((f, b, 4), jnp.int32 if quantized else jnp.float32)
     _, acc = lax.while_loop(
         lambda s: s[0] < nblocks, body, (jnp.asarray(0, jnp.int32), acc0))
     return acc

@@ -68,6 +68,15 @@ try:  # pallas is TPU/Mosaic only; CPU tests use interpret mode
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     _HAS_PALLAS = True
+    # jax renamed TPUCompilerParams -> CompilerParams after 0.4.x, and the
+    # has_side_effects field only exists on the newer class; the kernel's
+    # outputs are always consumed, so on older jax the flag is safely absent
+    _cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams", None)
+    try:
+        _SIDE_EFFECT_PARAMS = _cls(has_side_effects=True)
+    except TypeError:
+        _SIDE_EFFECT_PARAMS = _cls()
 except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
@@ -127,7 +136,7 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
                   layout: RowLayout, num_bins: int, bs: int,
                   bitset_words: int, use_int8: bool,
                   interpret: bool, dual: bool,
-                  hist_debug: str = ""):
+                  hist_debug: str = "", quant: bool = False):
     # dual=True: dual residency — rights land LIVE in the other array at the
     #   same offsets (RMW blends protect neighbour segments; auxbuf=[bs,C]
     #   rmw buffer, sem_aux=single DMA sem). The grower merges once per tree.
@@ -246,14 +255,29 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
             work_out.at[pl.ds(0, bs), :], auxbuf, sem_aux).wait()
 
     def assemble_ch8(rows_u8, mask_f32):
-        """Masked rows of a [BS, C] u8 buffer -> the [BS, 8] bf16 channel
-        operand (grad-hi, hess-hi, in-bag, raw, grad-lo, hess-lo, 0, 0)."""
+        """Masked rows of a [BS, C] u8 buffer -> the [BS, 8] channel operand.
+
+        f32 mode (bf16 output): (grad-hi, hess-hi, in-bag, raw, grad-lo,
+        hess-lo, 0, 0) — the hi/lo split recovers ~f32 accuracy.
+        quant mode (int8 output): the PACKED integer channel layout
+        (qgrad, qhess, in-bag, raw, 0, 0, 0, 0) — the grad/hess columns
+        hold small integer discretizer codes (exact in f32), so the hi/lo
+        split collapses and the one-hot contraction runs
+        int8 x int8 -> int32 at 2x the bf16 MXU rate with exact sums."""
         rows = rows_u8.astype(i32)
         m = mask_f32[:, None]                              # [BS, 1]
         g = _assemble_f32(rows, layout.grad_off) * m
         h = _assemble_f32(rows, layout.hess_off) * m
         cw = _assemble_f32(rows, layout.cnt_off)
         inbag = jnp.where(cw != 0.0, m, 0.0)
+        lane8 = lax.broadcasted_iota(i32, (bs, 8), 1)
+        if quant:
+            chq = [g, h, inbag, m]
+            ch8 = jnp.zeros((bs, 8), jnp.float32)
+            for k, c in enumerate(chq):
+                ch8 = ch8 + jnp.where(lane8 == k, c, 0.0)
+            # f32 -> int8 is exact: codes are integers with |code| <= 127
+            return ch8.astype(i32).astype(jnp.int8)
         if interpret:
             # interpret mode traces through XLA, where
             # --xla_allow_excess_precision elides f32->bf16->f32 as identity
@@ -267,7 +291,6 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
             hhi = h.astype(jnp.bfloat16).astype(jnp.float32)
         chans = [ghi, hhi, inbag, m, g - ghi, h - hhi,
                  jnp.zeros_like(g), jnp.zeros_like(g)]
-        lane8 = lax.broadcasted_iota(i32, (bs, 8), 1)
         ch8 = jnp.zeros((bs, 8), jnp.float32)
         for k, c in enumerate(chans):
             ch8 = ch8 + jnp.where(lane8 == k, c, 0.0)
@@ -295,16 +318,20 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
         _, _, w = _hist_packing(F, B)   # group width (features)
         iota_b = lax.broadcasted_iota(i32, (bs, BS_), 1)
         zero_col = jnp.full((bs, 1), -1, i32)   # matches no bin lane
+        # quant: int8 one-hot x int8 packed channels -> int32 (exact, 2x
+        # MXU rate); f32: bf16 one-hot with f32 accumulation
+        oh_t = jnp.int8 if quant else jnp.bfloat16
+        acc_t = jnp.int32 if quant else jnp.float32
         fc = 0
         while fc < F_pad:
             wc = min(w, F_pad - fc)
             oh = jnp.concatenate(
                 [((bins[:, fc + j:fc + j + 1] if fc + j < F else zero_col)
-                  == iota_b).astype(jnp.bfloat16)
+                  == iota_b).astype(oh_t)
                  for j in range(wc)], axis=1)            # [BS, wc*BS_]
             part = lax.dot_general(
                 ch8, oh, dimension_numbers=(((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)      # [8, wc*BS_]
+                preferred_element_type=acc_t)            # [8, wc*BS_]
             hist_ref[:, fc * BS_:(fc + wc) * BS_] += part
             fc += wc
 
@@ -647,7 +674,7 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
 @functools.partial(
     jax.jit,
     static_argnames=("layout", "num_bins", "block_size", "bitset_words",
-                     "interpret", "dual", "hist_debug", "num_rows"))
+                     "interpret", "dual", "hist_debug", "num_rows", "quant"))
 def fused_split(
     work: jnp.ndarray,          # [N + pad, C] u8, C % 128 == 0
     scratch: jnp.ndarray,       # [N + pad, C] u8
@@ -671,8 +698,11 @@ def fused_split(
     dual: bool = True,
     hist_debug: str = "",       # timing bisect only (see GrowerParams)
     num_rows: int = None,       # real (unpadded) row count, for pad checks
+    quant: bool = False,        # packed int8 channel layout -> int32 hist
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """One fused split. Returns (work', scratch', hist_smaller [F, B, 4]).
+    """One fused split. Returns (work', scratch', hist_smaller [F, B, 4]);
+    the histogram is int32 when ``quant`` (quantized-gradient codes,
+    int8 x int8 -> int32 contraction — see assemble_ch8).
 
     CONTRACT — pad >= block_size: the row arrays must be padded past the
     real row count by at least ``block_size`` rows (internal callers pad by
@@ -755,13 +785,17 @@ def fused_split(
 
     bs = block_size
     W = bitset_words
+    if quant:
+        hist_debug = ""     # bisect probes assume the bf16 channel layout
     # int8 MXU path needs one free padding lane for the receive indicator
     use_int8 = layout.num_real_cols < C
     carry_t = jnp.int32 if use_int8 else jnp.float32
+    hist_t = jnp.int32 if quant else jnp.float32
+    ch_t = jnp.int8 if quant else jnp.bfloat16
     kernel = functools.partial(
         _fused_kernel, layout=layout, num_bins=B, bs=bs, bitset_words=W,
         use_int8=use_int8, interpret=interpret, dual=dual,
-        hist_debug=hist_debug)
+        hist_debug=hist_debug, quant=quant)
 
     work_o, scr_o, hist8 = pl.pallas_call(
         kernel,
@@ -789,17 +823,17 @@ def fused_split(
                 (pltpu.VMEM((bs, C), jnp.uint8) if dual
                  else pltpu.VMEM((2, bs, C), jnp.uint8)),   # auxbuf
                 pltpu.VMEM((2, bs, C), jnp.uint8),  # pendbuf (hist pipe)
-                pltpu.VMEM((2, bs, 8), jnp.bfloat16),  # pendch
+                pltpu.VMEM((2, bs, 8), ch_t),       # pendch
                 pltpu.SMEM((8,), jnp.int32),
             ],
         ),
         out_shape=[
             jax.ShapeDtypeStruct(work.shape, work.dtype),
             jax.ShapeDtypeStruct(scratch.shape, scratch.dtype),
-            jax.ShapeDtypeStruct((8, F_pad * BS_), jnp.float32),
+            jax.ShapeDtypeStruct((8, F_pad * BS_), hist_t),
         ],
         input_output_aliases={2: 0, 3: 1},
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=_SIDE_EFFECT_PARAMS,
         interpret=interpret,
     )(sp, cat_bitset, work, scratch)
 

@@ -108,6 +108,20 @@ class GrowerParams(NamedTuple):
     efb_virtual: int = 0
     efb_bmax: int = 0
 
+    # quantized-gradient integer histograms (compact grower): grad/hess
+    # columns carry int8 discretizer codes, histograms accumulate
+    # int8 x int8 -> int32 on the MXU and dequantize at the split scan
+    # (reference: gradient_discretizer.cpp + cuda_histogram_constructor
+    # .cu:249-524); the per-iteration scales ride as traced args
+    quant_hist: bool = False
+    # data-parallel histogram reduction: 0 = all-reduce (lax.psum) of the
+    # full [F, B, 4] histogram; S > 0 = reduce-scatter over the feature
+    # axis across S shards (lax.psum_scatter) + an all-gather of the tiny
+    # per-shard best-split candidate — the reference's actual protocol
+    # (ReduceScatter + SyncUpGlobalBestSplit,
+    # data_parallel_tree_learner.cpp:223-300)
+    hist_scatter: int = 0
+
     def split_params(self) -> SplitParams:
         return SplitParams(
             lambda_l1=self.lambda_l1,

@@ -130,11 +130,29 @@ def grow_tree_compact(
     feature_contri: jnp.ndarray = None,
     efb=None,   # (col_of_ext, route_cat_ext, off_ext, nb_ext, dbin_ext,
     #              orig_of_ext) — see io/efb.py / gbdt._setup_efb
+    quant_scales=None,   # (g_scale, h_scale) traced f32 (params.quant_hist)
 ):
     """Grow one tree; returns (TreeArrays, row_leaf [N], work', scratch',
     leaf_start [L], leaf_nrows [L]) — per-row outputs in the post-tree
     permuted row order. (Callers expand per-row leaf values themselves via
-    segments_to_leaf_vectors once shrinkage/renewal are applied.)"""
+    segments_to_leaf_vectors once shrinkage/renewal are applied.)
+
+    ``params.quant_hist``: the grad/hess row columns carry integer
+    discretizer codes; every histogram accumulates int8 x int8 -> int32 on
+    the MXU and stays int32 through caching/subtraction/reduction (exact
+    integer arithmetic while global num_data * quant_bins < 2^31; the
+    GBDT gates the path on that bound), dequantizing with
+    ``quant_scales`` only at the split scan and the scalar leaf sums.
+
+    ``params.hist_scatter`` = S > 1 (data-parallel): per-leaf histograms
+    reduce with ``lax.psum_scatter`` over the feature axis — each shard
+    owns the GLOBAL histogram of F/S features, scans its own slice, and
+    the tiny winning candidates sync with an all-gather (the reference's
+    ReduceScatter + SyncUpGlobalBestSplit protocol,
+    data_parallel_tree_learner.cpp:223-300) — instead of all-reducing the
+    full [F, B, 4] histogram to every shard. Requires efb_virtual == 0 and
+    mono_intermediate off (their scans need cross-feature histogram
+    access)."""
     n = n_real
     L = params.num_leaves
     B = params.num_bins
@@ -143,6 +161,22 @@ def grow_tree_compact(
     feat_info = (num_bins_arr, nan_bin_arr, has_nan_arr, is_cat_arr)
     sp_params = params.split_params()
     i32 = jnp.int32
+    quant = params.quant_hist
+    if quant and quant_scales is None:
+        raise ValueError("params.quant_hist needs quant_scales=(g_s, h_s)")
+    hdtype = jnp.float32
+    if quant:
+        hdtype = jnp.int32
+        g_scale, h_scale = quant_scales
+
+    def dq_g(x):    # dequantize scalar/array grad code sums
+        return x.astype(jnp.float32) * g_scale if quant else x
+
+    def dq_h(x):
+        return x.astype(jnp.float32) * h_scale if quant else x
+
+    def dq_c(x):    # count channels: exact integer -> f32 cast
+        return x.astype(jnp.float32) if quant else x
 
     if mono_types is None:
         mono_types = jnp.zeros((F_scan,), jnp.int8)
@@ -158,16 +192,84 @@ def grow_tree_compact(
         extra_key = jax.random.PRNGKey(6)
     big = jnp.float32(3.4e38)
 
+    W = params.bitset_words
+    zero = jnp.asarray(0, i32)
+    ax = params.axis_name
+
+    # ---- feature-scattered histogram reduction (data-parallel) ----
+    scatter = params.hist_scatter > 1
+    if scatter and ax is None:
+        raise ValueError("hist_scatter needs a data-parallel mesh axis")
+    if scatter and (params.efb_virtual or params.mono_intermediate):
+        raise ValueError("hist_scatter is incompatible with EFB bundles "
+                         "and monotone_constraints_method=intermediate")
+    if scatter:
+        S_sc = params.hist_scatter
+        F_loc = -(-F // S_sc)          # features owned per shard
+        f_pad_sc = F_loc * S_sc - F
+        shard_i = lax.axis_index(ax)
+
+        def _pad_f(a, fill):
+            return jnp.pad(a, (0, f_pad_sc), constant_values=fill) \
+                if f_pad_sc else a
+
+        # metadata slices for the shard's own features (pad features get
+        # num_bins=1 + mask False, so they can never win a split)
+        def _fslice(a):
+            return lax.dynamic_slice_in_dim(a, shard_i * F_loc, F_loc)
+
+        meta_sl = tuple(_fslice(_pad_f(a, fill)) for a, fill in (
+            (num_bins_arr, 1), (nan_bin_arr, 0), (has_nan_arr, False),
+            (is_cat_arr, False)))
+        mono_sl = _fslice(_pad_f(mono_types, 0))
+        contri_sl = (_fslice(_pad_f(feature_contri, 1.0))
+                     if feature_contri is not None else None)
+        F_h = F_loc                    # cached-histogram feature width
+    else:
+        F_h = F
+
+    def reduce_hist(local):
+        """[F, B, 4] shard-local -> globally-summed histogram (full copy,
+        or this shard's [F_loc, B, 4] feature slice under hist_scatter)."""
+        if scatter:
+            padded = jnp.pad(local, ((0, f_pad_sc), (0, 0), (0, 0))) \
+                if f_pad_sc else local
+            return lax.psum_scatter(padded, ax, scatter_dimension=0,
+                                    tiled=True)
+        return lax.psum(local, ax) if ax else local
+
+    def sync_split(sp):
+        """All-gather the per-shard best-split candidates and return the
+        global winner on every shard (reference: SyncUpGlobalBestSplit,
+        parallel_tree_learner.h) — a few dozen bytes instead of the full
+        histogram."""
+        gains = lax.all_gather(sp.gain, ax)                 # [S]
+        win = jnp.argmax(gains).astype(i32)
+        return type(sp)(*(lax.all_gather(v, ax)[win] for v in sp))
+
     def leaf_best(hist, pg, ph, pc, depth, fm, cmn, cmx, po, cegb_pen=None,
                   ek=None):
         if params.efb_virtual:
             # scan axis = stored columns + one virtual row per bundled
-            # original feature (io/efb.py)
+            # original feature (io/efb.py); exact in int32 when quantized
             hist = extend_hist_efb(hist, efb, params.efb_virtual,
                                    params.efb_bmax)
-        sp = best_split(hist, pg, ph, pc, *feat_info, fm, sp_params,
-                        mono_types, cmn, cmx, po, depth, cegb_pen, ek,
-                        feature_contri)
+        qs = quant_scales if quant else None
+        if scatter:
+            sp = best_split(hist, pg, ph, pc, *meta_sl,
+                            _fslice(_pad_f(fm, False)), sp_params,
+                            mono_sl, cmn, cmx, po, depth,
+                            (_fslice(_pad_f(cegb_pen, 0.0))
+                             if cegb_pen is not None else None),
+                            ek, contri_sl, quant_scales=qs)
+            # local winner -> global feature id, then the tiny cross-shard
+            # candidate exchange picks one winner bit-identically everywhere
+            sp = sp._replace(feature=shard_i * F_loc + sp.feature)
+            sp = sync_split(sp)
+        else:
+            sp = best_split(hist, pg, ph, pc, *feat_info, fm, sp_params,
+                            mono_types, cmn, cmx, po, depth, cegb_pen, ek,
+                            feature_contri, quant_scales=qs)
         if params.efb_virtual:
             # a bundled winner routes as a ready-made bitset on its column
             sp = apply_efb_bitset(sp, efb, F, B)
@@ -177,11 +279,8 @@ def grow_tree_compact(
 
     def seg_hist(work, start, count):
         return segment_histogram(work, start, count, layout, B,
-                                 params.hist_block, params.hist_impl)
-
-    W = params.bitset_words
-    zero = jnp.asarray(0, i32)
-    ax = params.axis_name
+                                 params.hist_block, params.hist_impl,
+                                 quantized=quant)
 
     # ---- root ----
     if params.fused_block:
@@ -191,18 +290,28 @@ def grow_tree_compact(
             zero, zero, zero, zero, zero, zero,
             jnp.zeros((W,), jnp.uint32), layout, B, params.fused_block, W,
             interpret=params.fused_interpret, dual=params.fused_dual,
-            hist_debug=params.fused_hist_debug, num_rows=n)
+            hist_debug=params.fused_hist_debug, num_rows=n, quant=quant)
     else:
         root_loc = seg_hist(work, jnp.asarray(0, i32), jnp.asarray(n, i32))
-    # data-parallel: histograms psum over the mesh axis (reference: the
+    # data-parallel: histograms reduce over the mesh axis (reference: the
     # ReduceScatter of per-feature histograms, data_parallel_tree_learner
     # .cpp:223-300); split decisions then replicate bit-identically
-    root_hist = lax.psum(root_loc, ax) if ax else root_loc
+    root_hist = reduce_hist(root_loc)
     # every feature's bins sum to the global totals (each row lands in
-    # exactly one bin per feature), so feature 0 gives the root sums
-    root_g = root_hist[0, :, 0].sum()
-    root_h = root_hist[0, :, 1].sum()
-    root_c = root_hist[0, :, 2].sum()
+    # exactly one bin per feature), so feature 0 gives the root sums;
+    # under hist_scatter the shard's slice may be all padding, so the
+    # totals come from the LOCAL histogram + a tiny scalar psum instead
+    if scatter:
+        sums = jnp.stack([root_loc[0, :, 0].sum(), root_loc[0, :, 1].sum(),
+                          root_loc[0, :, 2].sum()])
+        sums = lax.psum(sums, ax)
+        root_g = dq_g(sums[0])
+        root_h = dq_h(sums[1])
+        root_c = dq_c(sums[2])
+    else:
+        root_g = dq_g(root_hist[0, :, 0].sum())
+        root_h = dq_h(root_hist[0, :, 1].sum())
+        root_c = dq_c(root_hist[0, :, 2].sum())
     from .grower import node_feature_mask
     root_fm = node_feature_mask(
         feat_mask, jnp.zeros((F_scan,), bool), inter_sets,
@@ -222,11 +331,11 @@ def grow_tree_compact(
         num_nodes=jnp.asarray(0, i32),
         work=work,
         scratch=scratch,
-        leaf_hist=jnp.zeros((L, F, B * 4), jnp.float32).at[0]
-        .set(root_hist.reshape(F, B * 4)),
-        leaf_hist_loc=(jnp.zeros((L, F, B * 4), jnp.float32).at[0]
+        leaf_hist=jnp.zeros((L, F_h, B * 4), hdtype).at[0]
+        .set(root_hist.reshape(F_h, B * 4)),
+        leaf_hist_loc=(jnp.zeros((L, F, B * 4), hdtype).at[0]
                        .set(root_loc.reshape(F, B * 4)) if ax
-                       else jnp.zeros((1, 1, 1), jnp.float32)),
+                       else jnp.zeros((1, 1, 1), hdtype)),
         leaf_start=jnp.zeros((L,), i32),
         leaf_nrows=jnp.zeros((L,), i32).at[0].set(n),
         leaf_nrows_g=(jnp.zeros((L,), i32).at[0].set(n_g) if ax
@@ -461,7 +570,7 @@ def grow_tree_compact(
                 interpret=params.fused_interpret,
                 smaller_left=left_smaller.astype(i32), side=side_p,
                 dual=params.fused_dual, hist_debug=params.fused_hist_debug,
-                num_rows=n)
+                num_rows=n, quant=quant)
         else:
             work, scratch = partition_segment(
                 st.work, st.scratch, s_, m_eff, n_left_eff, f_col, b_, dl,
@@ -489,8 +598,8 @@ def grow_tree_compact(
 
         # one streamed pass over the SMALLER child only; the larger child
         # is parent - smaller (reference: SubtractHistogramForLeaf,
-        # cuda_histogram_constructor.cu:723)
-        parent_hist = st.leaf_hist[best_leaf].reshape(F, B, 4)
+        # cuda_histogram_constructor.cu:723); exact in int32 when quantized
+        parent_hist = st.leaf_hist[best_leaf].reshape(F_h, B, 4)
         if params.fused_block:
             hist_small_loc = hist_small_fused
         else:
@@ -498,14 +607,14 @@ def grow_tree_compact(
             m_small = jnp.where(left_smaller, n_left_eff,
                                 m_eff - n_left_eff)
             hist_small_loc = seg_hist(work, s_small, m_small)
-        hist_small = lax.psum(hist_small_loc, ax) if ax else hist_small_loc
+        hist_small = reduce_hist(hist_small_loc)
         hist_large = parent_hist - hist_small
         hist_left = jnp.where(left_smaller, hist_small, hist_large)
         hist_right = jnp.where(left_smaller, hist_large, hist_small)
         leaf_hist = st.leaf_hist.at[best_leaf].set(
-            jnp.where(applied, hist_left, parent_hist).reshape(F, B * 4))
+            jnp.where(applied, hist_left, parent_hist).reshape(F_h, B * 4))
         leaf_hist = leaf_hist.at[new_leaf].set(
-            jnp.where(applied, hist_right.reshape(F, B * 4),
+            jnp.where(applied, hist_right.reshape(F_h, B * 4),
                       leaf_hist[new_leaf]))
         if ax:
             large_loc = parent_loc - hist_small_loc
@@ -734,7 +843,7 @@ def grow_tree_compact(
 
                 def do(_):
                     sp = leaf_best(
-                        leaf_hist[i].reshape(F, B, 4), leaf_grad[i],
+                        leaf_hist[i].reshape(F_h, B, 4), leaf_grad[i],
                         leaf_hess[i], leaf_cnt[i], leaf_depth[i],
                         leaf_fmask[i], cmn_a[i], cmx_a[i], leaf_pout[i],
                         pen_cur,

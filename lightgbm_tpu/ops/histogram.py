@@ -51,14 +51,29 @@ def _xla_histogram(binned, channels, num_bins: int):
     chunk = _chunk_rows(n, f, b)
     iota = jnp.arange(b, dtype=jnp.int32)
 
-    # histogram sums need full f32 accuracy (hessian sums drive leaf outputs;
-    # SURVEY §7 "bf16 is out for hessian sums") — the TPU MXU's default bf16
-    # matmul precision is not enough, so force the fp32-accurate mode.
-    prec = lax.Precision.HIGHEST
+    quantized = jnp.issubdtype(channels.dtype, jnp.integer)
+    acc_dtype = jnp.int32 if quantized else channels.dtype
+
+    def contract(onehot, ch):
+        if quantized:
+            # quantized-gradient path (reference: gradient_discretizer.cpp
+            # + the int histogram kernels, cuda_histogram_constructor
+            # .cu:249-524): int8 one-hot x int8 codes accumulate
+            # int8*int8 -> int32 on the MXU. preferred_element_type=int32
+            # is load-bearing: without it XLA's dot output dtype follows
+            # the int8 operands and the sums wrap (tpulint R003).
+            return jnp.einsum("rfb,rk->fbk", onehot, ch,
+                              preferred_element_type=jnp.int32)
+        # histogram sums need full f32 accuracy (hessian sums drive leaf
+        # outputs; SURVEY §7 "bf16 is out for hessian sums") — the TPU
+        # MXU's default bf16 matmul precision is not enough, so force the
+        # fp32-accurate mode.
+        return jnp.einsum("rfb,rk->fbk", onehot, ch,
+                          precision=lax.Precision.HIGHEST)
 
     if n <= chunk:
         onehot = (binned.astype(jnp.int32)[:, :, None] == iota).astype(channels.dtype)
-        hist = jnp.einsum("rfb,rk->fbk", onehot, channels, precision=prec)
+        hist = contract(onehot, channels)
     else:
         n_chunks = -(-n // chunk)
         pad = n_chunks * chunk - n
@@ -71,12 +86,27 @@ def _xla_histogram(binned, channels, num_bins: int):
         def step(hist, inp):
             bc, cc = inp
             onehot = (bc.astype(jnp.int32)[:, :, None] == iota).astype(cc.dtype)
-            return hist + jnp.einsum("rfb,rk->fbk", onehot, cc,
-                                     precision=prec), None
+            return hist + contract(onehot, cc), None
 
-        hist0 = jnp.zeros((f, b, k), dtype=channels.dtype)
+        hist0 = jnp.zeros((f, b, k), dtype=acc_dtype)
         hist, _ = lax.scan(step, hist0, (binned_c, channels_c))
     return hist
+
+
+def dequantize_hist(hist: jax.Array, g_scale, h_scale) -> jax.Array:
+    """int32 quantized histogram ``[..., 4+]`` -> f32.
+
+    THE sanctioned int->f32 histogram boundary (tpulint R003 contract): the
+    grad/hess code sums multiply by the per-iteration scales; count channels
+    cast exactly (int32 counts are exact at any row count, unlike the f32
+    path's 2^24 ceiling). Split finding calls this on the LEAF's int32
+    per-bin sums right before gain computation (reference:
+    CUDABestSplitFinder unpacks the int histogram with grad_scale/hess_scale,
+    cuda_best_split_finder.cu)."""
+    g = hist[..., 0:1].astype(jnp.float32) * g_scale
+    h = hist[..., 1:2].astype(jnp.float32) * h_scale
+    rest = hist[..., 2:].astype(jnp.float32)
+    return jnp.concatenate([g, h, rest], axis=-1)
 
 
 def _resolve_impl(impl: str, num_bins: int, num_features: int = 0) -> str:
@@ -103,15 +133,21 @@ def _resolve_impl(impl: str, num_bins: int, num_features: int = 0) -> str:
 
 def histogram_block(
     binned: jax.Array,      # [BS, F] uint8
-    channels: jax.Array,    # [BS, K] f32
+    channels: jax.Array,    # [BS, K] f32, or int8 (quantized-gradient path)
     num_bins: int,
     impl: str = "auto",
-) -> jax.Array:             # [F, B, K] f32
+) -> jax.Array:             # [F, B, K] f32 (int32 for int8 channels)
     """Histogram of one already-sliced row block (no psum, no jit wrapper —
-    call sites are inside jitted loops)."""
+    call sites are inside jitted loops).
+
+    Integer ``channels`` select the quantized-gradient pipeline: int8
+    one-hot x int8 codes contracted with ``preferred_element_type=int32``
+    (native int8 MXU throughput, exact int32 sums)."""
     impl = _resolve_impl(impl, num_bins, binned.shape[1])
     if impl == "pallas":
         from .pallas_histogram import pallas_histogram
+        if jnp.issubdtype(channels.dtype, jnp.integer):
+            return pallas_histogram(binned, channels, num_bins, mode="int8")
         return pallas_histogram(binned, channels, num_bins)
     return _xla_histogram(binned, channels, num_bins)
 

@@ -28,6 +28,11 @@ Precision modes (the one-hot itself is exact in bf16 — values 0/1):
   * ``bf16`` — channels rounded to bf16; fastest, ~2^-9 relative error.
   * ``f32``  — fp32-accurate MXU mode (3-pass); ~5x slower, for bit-level
     comparisons against the XLA path.
+  * ``int8`` — quantized-gradient mode (reference:
+    cuda_histogram_constructor.cu:249-524): channels are int8 grad/hess
+    codes, the one-hot forms in int8, and the contraction runs
+    int8 x int8 -> int32 (``preferred_element_type=int32``) at 2x the bf16
+    MXU rate with EXACT integer sums — no hi/lo split needed.
 """
 from __future__ import annotations
 
@@ -70,18 +75,24 @@ def _hist_kernel(bins_ref, ch_ref, out_ref, *, num_bins: int, f_chunk: int,
 
     # uint8 -> int32 (Mosaic has no direct uint8 -> float cast)
     bins = bins_ref[:].astype(jnp.int32)          # [R, F]
-    ch = ch_ref[:]                                # [R, KP] f32
+    ch = ch_ref[:]                                # [R, KP] f32/int8
     r = bins.shape[0]
     f = bins.shape[1]
     b = num_bins
     w = f_chunk
     assert f % w == 0
 
-    oh_dtype = jnp.float32 if mode == "f32" else jnp.bfloat16
-    if mode != "f32":
-        ch = ch.astype(jnp.bfloat16)
-    precision = (lax.Precision.HIGHEST if mode == "f32"
-                 else lax.Precision.DEFAULT)
+    if mode == "int8":
+        oh_dtype = jnp.int8
+        acc_dtype = jnp.int32
+        precision = None
+    else:
+        oh_dtype = jnp.float32 if mode == "f32" else jnp.bfloat16
+        acc_dtype = jnp.float32
+        if mode != "f32":
+            ch = ch.astype(jnp.bfloat16)
+        precision = (lax.Precision.HIGHEST if mode == "f32"
+                     else lax.Precision.DEFAULT)
     iota_b = lax.broadcasted_iota(jnp.int32, (r, b), 1)
 
     for fc in range(0, f, w):
@@ -91,10 +102,12 @@ def _hist_kernel(bins_ref, ch_ref, out_ref, *, num_bins: int, f_chunk: int,
             [(bins[:, fc + j:fc + j + 1] == iota_b).astype(oh_dtype)
              for j in range(w)], axis=1)
         # MXU contraction over rows: [KP, R] x [R, W*B] -> [KP, W*B]
+        # (int8 mode: int8 x int8 -> int32, preferred_element_type pins the
+        # accumulator so the int8 operands cannot narrow the output)
         part = lax.dot_general(
             ch, oh,
             dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=acc_dtype,
             precision=precision,
         )
         out_ref[:, fc * b:(fc + w) * b] += part
@@ -105,13 +118,14 @@ def _hist_kernel(bins_ref, ch_ref, out_ref, *, num_bins: int, f_chunk: int,
     static_argnames=("num_bins", "row_block", "f_chunk", "mode", "interpret"))
 def pallas_histogram(
     binned: jax.Array,       # [N, F] uint8/int32
-    channels: jax.Array,     # [N, K] f32, K <= 8 (K <= 4 for mode='split')
+    channels: jax.Array,     # [N, K] f32 (int8 for mode='int8'), K <= 8
+    #                          (K <= 4 for mode='split')
     num_bins: int,
     row_block: int = 2048,   # v5e sweet spot (with f_chunk=2): 0.59 Telem/s
     f_chunk: int = 2,
-    mode: str = "split",     # split | bf16 | f32 (see module doc)
+    mode: str = "split",     # split | bf16 | f32 | int8 (see module doc)
     interpret: bool = False,
-) -> jax.Array:              # [F, B, K] f32
+) -> jax.Array:              # [F, B, K] f32 (int32 for mode='int8')
     n, f_in = binned.shape
     k = channels.shape[1]
     b = num_bins
@@ -121,6 +135,11 @@ def pallas_histogram(
     rb_cap = max(128, (121_000_000 // max(1, f_in * b)) // 128 * 128)
     row_block = min(row_block, rb_cap)
 
+    if mode == "int8" and not jnp.issubdtype(channels.dtype, jnp.integer):
+        raise ValueError("mode='int8' needs integer channels (grad/hess "
+                         "codes from the gradient discretizer)")
+    if mode == "int8":
+        channels = channels.astype(jnp.int8)
     if mode == "split":
         if 2 * k > _K_PAD:
             raise ValueError(f"mode='split' supports K<={_K_PAD // 2}, got {k}")
@@ -148,6 +167,7 @@ def pallas_histogram(
     kernel = functools.partial(
         _hist_kernel, num_bins=b, f_chunk=f_chunk, mode=mode)
 
+    acc_dtype = jnp.int32 if mode == "int8" else jnp.float32
     out = pl.pallas_call(
         kernel,
         grid=(n_tot // row_block,),
@@ -156,7 +176,7 @@ def pallas_histogram(
             pl.BlockSpec((row_block, _K_PAD), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((_K_PAD, f * b), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((_K_PAD, f * b), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((_K_PAD, f * b), acc_dtype),
         interpret=interpret,
     )(binned, channels)
     out = jnp.transpose(out.reshape(_K_PAD, f, b), (1, 2, 0))[:f_in]

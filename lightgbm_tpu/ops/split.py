@@ -281,8 +281,19 @@ def best_split(
     cegb_pen: Optional[jnp.ndarray] = None,     # [F] remaining coupled costs
     extra_key: Optional[jnp.ndarray] = None,    # PRNG key (extra_trees)
     feature_contri: Optional[jnp.ndarray] = None,  # [F] gain multipliers
+    quant_scales: Optional[tuple] = None,       # (g_scale, h_scale) f32
 ) -> SplitResult:
-    """Find the best (feature, threshold, direction) for one leaf."""
+    """Find the best (feature, threshold, direction) for one leaf.
+
+    ``quant_scales``: the histogram holds int32 quantized-gradient code sums
+    (ops/histogram.py int8 path); the per-bin sums dequantize HERE — leaf
+    scale multiply on the grad/hess channels — before any gain computation,
+    so the scan/gain machinery below is dtype-blind (reference: the int
+    histogram is unpacked with grad_scale/hess_scale inside the best-split
+    kernel, cuda_best_split_finder.cu)."""
+    if quant_scales is not None:
+        from .histogram import dequantize_hist
+        hist = dequantize_hist(hist, quant_scales[0], quant_scales[1])
     f, b, k = hist.shape
     g = hist[:, :, 0]
     h = hist[:, :, 1]

@@ -203,11 +203,9 @@ class TestConfigWarnings:
         from lightgbm_tpu.utils import log as _log
         _log.set_verbosity(1)  # earlier tests may have silenced warnings
         with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
-            Config({"two_round": True,
-                    "pre_partition": True})
+            Config({"pre_partition": True})
         text = caplog.text
-        for name in ("two_round",
-                     "pre_partition"):
+        for name in ("pre_partition",):
             assert f"{name}=" in text and "NOT implemented" in text, \
                 f"no warning for {name}: {text!r}"
 
@@ -226,7 +224,8 @@ class TestConfigWarnings:
         from lightgbm_tpu.config import UNIMPLEMENTED_PARAMS
         for implemented in ("num_leaves", "learning_rate", "bagging_fraction",
                             "feature_fraction", "lambda_l1", "max_bin",
-                            "is_unbalance", "tree_learner", "max_depth"):
+                            "is_unbalance", "tree_learner", "max_depth",
+                            "two_round"):
             assert implemented not in UNIMPLEMENTED_PARAMS
 
 

@@ -63,6 +63,29 @@ def test_no_host_transfers_blocks_sync_idioms():
                 sync()
 
 
+def test_no_host_transfers_blocks_np_buffer_protocol_path():
+    """np.asarray(jax_array) on CPU materializes zero-copy via the C buffer
+    protocol WITHOUT calling jax.Array.__array__ — the numpy entry points
+    themselves must funnel (the regression the airtight zero-d2h proof
+    needs)."""
+    x = jnp.arange(4.0)
+    for name in ("asarray", "array", "ascontiguousarray", "asanyarray"):
+        with pytest.raises(guards.HostTransferError, match=name):
+            with guards.no_host_transfers():
+                getattr(np, name)(x)
+    # numpy restored on exit: both for plain numpy data and jax arrays
+    assert np.asarray(x).shape == (4,)
+    assert np.asarray([1, 2]).sum() == 3
+
+
+def test_no_host_transfers_numpy_still_works_on_host_data():
+    with guards.no_host_transfers():
+        a = np.asarray([1.0, 2.0])          # host data: allowed
+        b = np.array(a) * 2
+        c = np.ascontiguousarray(b)
+    np.testing.assert_allclose(c, [2.0, 4.0])
+
+
 def test_no_host_transfers_allows_device_work():
     x = jnp.arange(8.0)
     with guards.no_host_transfers():

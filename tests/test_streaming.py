@@ -75,6 +75,75 @@ class TestSequenceConstruction:
         assert growth_kb < max(2 * binned_kb, 0.35 * raw_kb), \
             (growth_kb, binned_kb, raw_kb)
 
+    def test_two_round_text_loading(self, tmp_path):
+        """two_round text loading: pass 1 records byte offsets + metadata,
+        pass 2 streams batches through the Sequence construction path —
+        the dense [N, F] float64 matrix never materializes."""
+        from lightgbm_tpu.io.loader import TextFileSequence, load_text_file
+        rng = np.random.RandomState(5)
+        n, f = 3000, 6
+        X = rng.randn(n, f)
+        w = rng.rand(n) + 0.5
+        y = ((X @ rng.randn(f)) > 0).astype(np.float64)
+        path = tmp_path / "train.csv"
+        header = "label," + ",".join(f"f{j}" for j in range(f)) + ",wt"
+        rows = [header] + [
+            ",".join([f"{y[i]:.0f}"] + [f"{X[i, j]:.7g}" for j in range(f)]
+                     + [f"{w[i]:.7g}"])
+            for i in range(n)]
+        path.write_text("\n".join(rows) + "\n")
+
+        seq, label, weight, group, names = load_text_file(
+            str(path), has_header=True, label_column="name:label",
+            weight_column="name:wt", two_round=True)
+        assert isinstance(seq, TextFileSequence)
+        assert isinstance(seq, lgb.Sequence)
+        assert len(seq) == n
+        np.testing.assert_allclose(label, y)
+        np.testing.assert_allclose(weight, w, rtol=1e-6)
+        assert names == [f"f{j}" for j in range(f)]
+        # second round parses on demand, bit-equal to the one-round load
+        Xd, yd, wd, _, _ = load_text_file(
+            str(path), has_header=True, label_column="name:label",
+            weight_column="name:wt")
+        np.testing.assert_allclose(np.asarray(seq[0:n]), Xd, rtol=1e-6)
+        np.testing.assert_allclose(seq[17], Xd[17], rtol=1e-6)
+        # and the Sequence feeds streaming Dataset construction + training
+        params = {"objective": "binary", "verbosity": -1, "num_leaves": 15}
+        ds_s = lgb.Dataset(seq, label=label, weight=weight, params=params)
+        ds_d = lgb.Dataset(Xd, label=yd, weight=wd, params=params)
+        ds_s.construct(); ds_d.construct()
+        np.testing.assert_array_equal(ds_s._inner.binned, ds_d._inner.binned)
+        b = lgb.train(dict(params), ds_s, 5)
+        from sklearn.metrics import roc_auc_score
+        assert roc_auc_score(y, b.predict(Xd)) > 0.8
+
+    def test_two_round_metadata_and_slicing_edge_cases(self, tmp_path):
+        """Empty metadata cells parse as NaN (genfromtxt parity with the
+        one-round loader) and non-unit/negative slice steps work."""
+        from lightgbm_tpu.io.loader import load_text_file
+        path = tmp_path / "edge.csv"
+        path.write_text("1,0.5,2.0\n"
+                        ",1.5,3.0\n"      # empty label cell
+                        "0,2.5,4.0\n")
+        seq, label, _, _, _ = load_text_file(str(path), two_round=True)
+        assert np.isnan(label[1]) and label[0] == 1.0
+        dense = np.asarray(seq[0:3])
+        np.testing.assert_allclose(seq[::-1], dense[::-1])
+        np.testing.assert_allclose(seq[::2], dense[::2])
+        np.testing.assert_allclose(seq[2:0:-1], dense[2:0:-1])
+        assert np.asarray(seq[3:3]).shape == (0, 2)
+        np.testing.assert_allclose(seq[-1], dense[-1])
+        with pytest.raises(IndexError):
+            seq[3]
+        # junk feature cells are NaN, like np.genfromtxt in one-round mode
+        path2 = tmp_path / "junk.csv"
+        path2.write_text("1,0.5,NULL\n0,,4.0\n")
+        seq2, _, _, _, _ = load_text_file(str(path2), two_round=True)
+        row = np.asarray(seq2[0])
+        assert row[0] == 0.5 and np.isnan(row[1])
+        assert np.isnan(np.asarray(seq2[1])[0])
+
     def test_streaming_efb(self):
         rng = np.random.RandomState(3)
         n, G, card = 4000, 40, 8

@@ -219,6 +219,74 @@ def test_r003_host_numpy_not_flagged(tmp_path):
     assert not findings
 
 
+def test_r003_int_matmul_needs_preferred_element_type(tmp_path):
+    """The int-packing contract: int8 histogram contraction without
+    preferred_element_type=int32 wraps the sums at +-127."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def hist(binned, codes):
+            onehot = (binned[:, :, None] == jnp.arange(8)).astype(jnp.int8)
+            ch = codes.astype(jnp.int8)
+            return jnp.einsum("rfb,rk->fbk", onehot, ch)
+    """)
+    assert "R003" in codes(findings)
+
+
+def test_r003_int_matmul_with_preferred_ok(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        @jax.jit
+        def hist(binned, codes):
+            onehot = (binned[:, :, None] == jnp.arange(8)).astype(jnp.int8)
+            return jnp.einsum("rfb,rk->fbk", onehot,
+                              codes.astype(jnp.int8),
+                              preferred_element_type=jnp.int32)
+
+        @jax.jit
+        def perm(lt, sel):
+            return lax.dot_general(
+                lt, sel.astype("int8"),
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+    """)
+    assert not findings
+
+
+def test_r003_dequantize_without_scale_flagged(tmp_path):
+    """The dequantize contract: a bare f32 cast of a quantized histogram
+    yields raw code sums, silently off by the per-iteration scale."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def gains(qhist):
+            g = qhist[:, :, 0].astype(jnp.float32)
+            return g.sum()
+    """)
+    assert "R003" in codes(findings)
+
+
+def test_r003_dequantize_with_scale_ok(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def gains(qhist, g_scale):
+            g = qhist[:, :, 0].astype(jnp.float32) * g_scale
+            h = g_scale * qhist[:, :, 1].astype(jnp.float32)
+            return g.sum() + h.sum()
+    """)
+    assert not findings
+
+
 # ---------------------------------------------------------------- R004
 def test_r004_env_override_unvalidated(tmp_path):
     """The seed case: boosting/gbdt.py:945 pre-fix (ADVICE r5 #3)."""
