@@ -1,21 +1,29 @@
 """Static analysis + runtime guard rails for the TPU training stack.
 
-Two halves (see ISSUE/README "Static analysis & runtime guards"):
+Three layers (see README "Static analysis & runtime guards" and "HLO
+contracts & concurrency sanitizer"):
 
   * :mod:`lightgbm_tpu.analysis.tpulint` — an AST pass with repo-specific
-    hazard rules (R001-R005), run by ``scripts/tpulint`` and by the tier-1
+    hazard rules (R001-R007), run by ``scripts/tpulint`` and by the tier-1
     suite (tests/test_tpulint.py). Import is dependency-light: the static
     half never imports jax.
+  * :mod:`lightgbm_tpu.analysis.hlo_check` — post-lowering verification of
+    the compiled step programs against the checked-in learner-mode
+    contracts (``analysis/contracts/*.json``): collective inventory and
+    byte budgets, zero host ops, int32-accumulating integer dots, stable
+    program fingerprints. The text parser it shares with
+    ``parallel/comm_accounting.py`` is :mod:`lightgbm_tpu.analysis.hlo`.
   * :mod:`lightgbm_tpu.analysis.guards` — runtime assertions (recompile
-    counter, host-transfer guard) for steady-state training regions;
-    imports jax, so it is imported lazily here.
+    counter, host-transfer guard, API race sanitizer) for steady-state
+    training regions; imports jax, so it is imported lazily here.
 """
 from .tpulint import lint_paths, load_allowlist, main  # noqa: F401
 
 
 def __getattr__(name):
     if name in ("compile_counter", "no_host_transfers",
-                "steady_state_guard", "CompileCount", "HostTransferError"):
+                "steady_state_guard", "CompileCount", "HostTransferError",
+                "api_race_sanitizer", "ApiRaceSanitizer", "ApiRaceError"):
         from . import guards
         return getattr(guards, name)
     raise AttributeError(name)

@@ -30,17 +30,29 @@ or an array is materialized on the host inside it.
     buffer) still bypasses Python entirely — only the native transfer
     guard on TPU and the static pass (R001) see those.
 
-Both are plain context managers usable directly or as pytest fixtures
+``api_race_sanitizer``
+    The runtime half of tpulint R007: while armed, every
+    ``@read_locked``/``@write_locked`` public ``Booster``/``Dataset``
+    method reports entry/exit (from *inside* the lock, utils/rwlock.py),
+    and any overlap — a writer concurrent with anything, on the same
+    object, from another thread — is recorded as a race. A correctly
+    locked program records nothing; a bypassed or missing lock (the
+    seeded mutation in tests/test_concurrency.py) lights it up.
+
+All are plain context managers usable directly or as pytest fixtures
 (wired in tests/conftest.py).
 """
 from __future__ import annotations
 
 import contextlib
 import dataclasses
-from typing import Iterator
+import threading
+from typing import Iterator, List
 
 import jax
 from jax import monitoring
+
+from ..utils import rwlock as _rwlock
 
 _LOWER_EVENT = "/jax/core/compile/jaxpr_to_mlir_module_duration"
 _BACKEND_EVENT = "/jax/core/compile/backend_compile_duration"
@@ -170,6 +182,91 @@ def no_host_transfers() -> Iterator[None]:
             setattr(cls, name, orig)
         for name, orig in np_saved.items():
             setattr(_np, name, orig)
+
+
+class ApiRaceError(AssertionError):
+    """Unsynchronized concurrent access to a shared API object."""
+
+
+class ApiRaceSanitizer:
+    """Detector for concurrent unsynchronized ``Booster``/``Dataset`` use.
+
+    Holds a table of (object, thread) -> current access kind, fed by the
+    rwlock decorators. Because the hooks run while the API lock is held,
+    a working lock admits no overlap; overlaps therefore mean the lock
+    was bypassed, replaced, or a method skipped its decorator. Detector
+    mode records races in ``.races`` without blocking the offending
+    thread; ``raise_on_race=True`` turns the first overlap into an
+    immediate ``ApiRaceError`` at the second accessor's call site.
+    """
+
+    def __init__(self, raise_on_race: bool = False):
+        self.races: List[str] = []
+        self.raise_on_race = raise_on_race
+        self._mu = threading.Lock()
+        # id(obj) -> {thread_id: [kind, depth, method]}
+        self._held = {}
+
+    def enter(self, obj, kind: str, method: str):
+        me = threading.get_ident()
+        key = id(obj)
+        with self._mu:
+            holds = self._held.setdefault(key, {})
+            mine = holds.get(me)
+            if mine is not None:
+                mine[1] += 1            # same-thread nesting is not a race
+                return (key, me)
+            clash = next(
+                (f"{type(obj).__name__}.{method} [{kind}] in thread {me} "
+                 f"overlaps {type(obj).__name__}.{m} [{k}] in thread {t}"
+                 for t, (k, _, m) in holds.items()
+                 if kind == "write" or k == "write"), None)
+            if clash is not None:
+                self.races.append(clash)
+                if self.raise_on_race:
+                    # the access does not proceed (the wrapper's exit_ is
+                    # never reached), so do NOT register the hold — a
+                    # phantom entry would indict every later accessor
+                    raise ApiRaceError(clash)
+            holds[me] = [kind, 1, method]
+            return (key, me)
+
+    def exit_(self, token) -> None:
+        key, me = token
+        with self._mu:
+            holds = self._held.get(key, {})
+            mine = holds.get(me)
+            if mine is None:
+                return
+            mine[1] -= 1
+            if mine[1] <= 0:
+                del holds[me]
+
+    def assert_no_races(self, what: str = "guarded region") -> None:
+        if self.races:
+            raise ApiRaceError(
+                f"{what}: {len(self.races)} unsynchronized concurrent "
+                "API access(es):\n  " + "\n  ".join(self.races[:10]))
+
+
+@contextlib.contextmanager
+def api_race_sanitizer(raise_on_race: bool = False
+                       ) -> Iterator[ApiRaceSanitizer]:
+    """Arm the API race detector for the ``with`` block.
+
+    Usage::
+
+        with api_race_sanitizer() as san:
+            ... threads hammering booster.predict()/update() ...
+        san.assert_no_races("concurrent predict")
+    """
+    san = ApiRaceSanitizer(raise_on_race=raise_on_race)
+    prev = _rwlock.get_sanitizer()
+    _rwlock.set_sanitizer(san)
+    try:
+        yield san
+    finally:
+        _rwlock.set_sanitizer(prev)
 
 
 @contextlib.contextmanager

@@ -1,0 +1,186 @@
+"""Compiled-HLO text parsing shared by comm accounting and hlo_check.
+
+The reference budgets its distributed learners by hand-written message
+sizes (ReduceScatter of per-feature histograms,
+src/treelearner/data_parallel_tree_learner.cpp:223-300; voting-parallel
+reduces only the elected top-2k features' histograms,
+voting_parallel_tree_learner.cpp). Under GSPMD/shard_map the collectives
+are inserted by XLA, so the honest measurement is to read them back out
+of the compiled HLO. This module is the one parser for that text:
+``parallel/comm_accounting.py`` sums collective bytes through it and
+``analysis/hlo_check.py`` verifies whole-program contracts with it.
+
+Deliberately dependency-light: plain string/regex work, no jax import, so
+``scripts/tpulint`` can load it on hosts without a working backend.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import re
+from typing import Dict, List, Optional, Tuple
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8": 1,
+    "s64": 8, "s32": 4, "s16": 2, "s8": 1,
+    "u64": 8, "u32": 4, "u16": 2, "u8": 1, "pred": 1,
+}
+
+# async forms (-start) are what post-optimization TPU HLO emits; each
+# start/done pair counts once (the -done carries no shape of its own here)
+_COLLECTIVES = ("all-reduce-start", "all-gather-start",
+                "reduce-scatter-start", "collective-permute-start",
+                "all-to-all-start", "all-reduce", "all-gather",
+                "reduce-scatter", "collective-permute", "all-to-all")
+
+# async ops whose transferred payload is the RESULT shape (second element of
+# the (operand, result, ...) async tuple): all-gather's result is num_devices
+# times the operand, so counting the operand under-reports the gathered
+# bytes; reduce-scatter/all-to-all/collective-permute likewise carry the
+# payload in the result slot (accounting convention: output bytes).
+_RESULT_SHAPE_STARTS = ("all-gather-start", "reduce-scatter-start",
+                        "collective-permute-start", "all-to-all-start")
+
+#: ops that move data between host and device inside a program — a
+#: steady-state jitted step must contain none of these
+HOST_OPS = ("infeed", "outfeed", "send", "send-done", "recv", "recv-done")
+
+#: custom-call targets that funnel back into host Python (jax callbacks)
+HOST_CUSTOM_CALL_MARKERS = ("callback", "python", "host")
+
+INT_NARROW = ("s8", "s16", "u8", "u16")
+
+COLLECTIVE_KINDS = _COLLECTIVES
+
+# one shaped tensor, e.g. f32[7,8,64]{2,1,0} — shapes can be scalar []
+_SHAPE_RE = re.compile(r"([a-z]+[0-9]*)\[([0-9,]*)\]")
+
+# `%name = <result shape(s)> opcode(operands...), attrs` with optional ROOT
+_INSTR_RE = re.compile(
+    r"^(?:ROOT\s+)?%?([\w.\-]+)\s+=\s+(.*?)\s*([a-z][a-z0-9\-]*)\((.*)$")
+
+
+def tensor_bytes(dtype: str, dims: str) -> int:
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+@dataclasses.dataclass
+class Instruction:
+    """One parsed HLO instruction line (post-optimization text form)."""
+    name: str
+    opcode: str
+    result_shapes: List[Tuple[str, str]]   # [(dtype, "dims"), ...]
+    operand_shapes: List[Tuple[str, str]]
+    line: int                              # 1-based within the module text
+    raw: str
+
+    @property
+    def result_bytes(self) -> int:
+        return sum(tensor_bytes(d, dims) for d, dims in self.result_shapes)
+
+
+def _split_operands(rest: str) -> str:
+    """The operand text of ``opcode(<operands>), attrs...`` (balanced)."""
+    depth = 1
+    for i, ch in enumerate(rest):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return rest[:i]
+    return rest
+
+
+def parse_instructions(hlo_text: str) -> List[Instruction]:
+    """Parse every instruction line of compiled HLO text.
+
+    Tolerant by construction: lines that are not instructions (module
+    headers, computation braces, comments) are skipped, and shapes are
+    extracted by pattern so layout annotations (``{2,1,0}``) and sharding
+    attrs don't need a real grammar.
+    """
+    out: List[Instruction] = []
+    for lineno, line in enumerate(hlo_text.splitlines(), 1):
+        s = line.strip()
+        if " = " not in s:
+            continue
+        m = _INSTR_RE.match(s)
+        if m is None:
+            continue
+        name, head, opcode, rest = m.groups()
+        operands = _split_operands(rest)
+        out.append(Instruction(
+            name=name, opcode=opcode,
+            result_shapes=_SHAPE_RE.findall(head),
+            operand_shapes=_SHAPE_RE.findall(operands),
+            line=lineno, raw=s))
+    return out
+
+
+def collective_kind(opcode: str) -> Optional[str]:
+    return opcode if opcode in _COLLECTIVES else None
+
+
+def collective_payload_shapes(instr: Instruction) -> List[Tuple[str, str]]:
+    """The shapes whose bytes a collective instruction transfers."""
+    shapes = instr.result_shapes
+    if instr.opcode.endswith("-start") and shapes:
+        # async tuple output carries (operand, result, ...); count the
+        # transferred payload once
+        if instr.opcode in _RESULT_SHAPE_STARTS:
+            # result shape (second tuple element); fall back to the
+            # operand if the tuple was flattened to a single shape
+            return shapes[1:2] if len(shapes) > 1 else shapes[:1]
+        # all-reduce-start: operand and result shapes are identical
+        return shapes[:1]
+    return shapes
+
+
+def collective_bytes(hlo_text: str) -> Dict[str, int]:
+    """Sum output bytes of every collective instruction in compiled HLO.
+
+    Returns {kind: bytes, ..., "total": bytes, "count": n_instructions}.
+    """
+    out: Dict[str, int] = {k: 0 for k in _COLLECTIVES}
+    count = 0
+    for instr in parse_instructions(hlo_text):
+        kind = collective_kind(instr.opcode)
+        if kind is None:
+            continue
+        out[kind] += sum(tensor_bytes(d, dims)
+                         for d, dims in collective_payload_shapes(instr))
+        count += 1
+    out["total"] = sum(out[k] for k in _COLLECTIVES)
+    out["count"] = count
+    return out
+
+
+# name suffixes XLA appends freely (%fusion.3, %dot.12) plus metadata and
+# buffer-assignment noise that changes run to run without changing the
+# program — stripped before fingerprinting
+_ID_RE = re.compile(r"%([\w\-]+?)\.[0-9]+\b")
+_METADATA_RE = re.compile(r",?\s*metadata=\{[^}]*\}")
+_MODULE_RE = re.compile(r"^HloModule\s+\S+", re.MULTILINE)
+_IDS_ATTR_RE = re.compile(r"\bid=\d+")
+
+
+def canonicalize(hlo_text: str) -> str:
+    """Compiled HLO text with unstable naming noise removed, so two
+    lowerings of the SAME program fingerprint identically while any real
+    change — a new collective, a dtype flip, a different loop body —
+    changes the fingerprint."""
+    text = _MODULE_RE.sub("HloModule _", hlo_text)
+    text = _METADATA_RE.sub("", text)
+    text = _ID_RE.sub(r"%\1", text)
+    text = _IDS_ATTR_RE.sub("id=_", text)
+    return "\n".join(ln.strip() for ln in text.splitlines() if ln.strip())
+
+
+def fingerprint(hlo_text: str) -> str:
+    """Stable short hash of a compiled program (see ``canonicalize``)."""
+    return hashlib.sha256(canonicalize(hlo_text).encode()).hexdigest()[:16]

@@ -1,0 +1,461 @@
+"""hlo_check — post-lowering contract verification of the step programs.
+
+PR 1's tpulint checks hazard *patterns* in Python source; the runtime
+guards check *behavior* counters. This pass closes the remaining gap: the
+claims the repo makes about its COMPILED programs — which collectives a
+learner mode is allowed to emit (reduce-scatter, not a full-histogram
+all-reduce, when ``tpu_hist_scatter`` is on), that the jitted step moves
+zero bytes between host and device, that every integer histogram
+contraction carries ``preferred_element_type=int32`` (an s8 dot that
+keeps an s8 accumulator silently wraps at ±127), and that the program
+stays byte-for-byte stable across iterations (recompile detection at the
+HLO level, not just the event counter) — were previously asserted by
+hand-read HLO. Here they are **contract files**
+(``analysis/contracts/*.json``), one per learner mode, verified
+mechanically against the lowered text on any backend (the tier-1 gate
+runs on CPU; the same programs are what dryrun_multichip records into
+COMM_ACCOUNTING.json).
+
+Contract schema (one JSON object per mode)::
+
+    {
+      "mode": "data_scatter",
+      "description": "...",
+      "params":  {...},          # Booster params reproducing the program
+      "num_devices": 8,          # mesh size the program was lowered for
+      "program": "compact_step_k0",   # key in GBDT._comm_hlo
+      "collectives": {
+        "allow":   ["reduce-scatter", "all-gather", "all-reduce"],
+        "require": ["reduce-scatter"],
+        "max_bytes": {"all-reduce": 16, ...}   # per-kind byte budgets
+      },
+      "forbid_host_ops": true,   # no infeed/outfeed/send/recv/callbacks
+      "int_dot_s32": true,       # narrow-int dots must accumulate in s32
+      "require_integer_dot": false,  # quant mode: the int path must be live
+      "stable_fingerprint": true,
+      "measured": {...}          # collective_bytes() at generation time —
+    }                            #   scripts/verify_contracts.py diffs this
+
+The harness half (``capture_mode``) trains a tiny Booster with
+``LGBM_TPU_COMM_ACCOUNTING=1`` so ``boosting/gbdt.py`` records the
+compiled step text (and re-lowers on any argument-signature change —
+``_comm_hlo_history``); it imports jax lazily so the checking half stays
+importable from ``scripts/tpulint``'s backend-free stub.
+
+CLI: ``scripts/tpulint hlo [--update] [mode ...]``; tier-1 runs the same
+gate in tests/test_hlo_check.py.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, List, Optional, Sequence
+
+from .hlo import (HOST_CUSTOM_CALL_MARKERS, HOST_OPS, INT_NARROW,
+                  collective_bytes, fingerprint, parse_instructions)
+
+_CUSTOM_CALL_TARGET_RE = re.compile(r'custom_call_target="([^"]*)"')
+
+CONTRACTS_DIR = os.path.join(os.path.dirname(__file__), "contracts")
+
+#: integer element types an MXU-friendly accumulator may use
+_INT_ACCUM = ("s32", "s64", "u32", "u64")
+_INT_ALL = INT_NARROW + _INT_ACCUM
+
+
+@dataclasses.dataclass(frozen=True)
+class ContractFinding:
+    contract: str
+    check: str        # collectives | host-ops | int-dot | fingerprint | ...
+    message: str
+
+    def render(self) -> str:
+        return f"[{self.contract}] {self.check}: {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# mode templates: the static half of each contract. `params` must rebuild the
+# exact steady-state step program; measured budgets are filled by --update.
+# ---------------------------------------------------------------------------
+_BASE = {"objective": "binary", "num_leaves": 7, "max_bin": 15,
+         "min_data_in_leaf": 2, "verbosity": -1}
+
+MODE_TEMPLATES: Dict[str, dict] = {
+    "serial_compact": {
+        "description": "single-chip compact grower: a pure on-device step "
+                       "— no collectives, no host traffic",
+        "params": dict(_BASE, tpu_grower="compact"),
+        "num_devices": 1,
+        "program": "compact_step_k0",
+        "require": [],
+        "require_integer_dot": False,
+        "problem": {"n": 509, "f": 8, "seed": 0},
+    },
+    "data_scatter": {
+        "description": "data-parallel compact grower with the feature-axis "
+                       "reduce-scatter histogram reduction "
+                       "(tpu_hist_scatter): the full-histogram all-reduce "
+                       "is budgeted down to the best-split sync bytes",
+        "params": dict(_BASE, tpu_grower="compact", tree_learner="data",
+                       tpu_hist_scatter="on"),
+        "num_devices": 8,
+        "program": "compact_step_k0",
+        "require": ["reduce-scatter"],
+        "require_integer_dot": False,
+        "problem": {"n": 509, "f": 8, "seed": 0},
+    },
+    "voting": {
+        "description": "voting-parallel learner (PV-Tree): top-k elected "
+                       "histograms reduce, so collective bytes stay far "
+                       "below the full-F data-parallel exchange",
+        "params": dict(_BASE, tree_learner="voting", top_k=2),
+        "num_devices": 8,
+        "program": "step",
+        "require": ["all-reduce"],
+        "require_integer_dot": False,
+        "problem": {"n": 509, "f": 64, "seed": 1},
+    },
+    "quant_int8": {
+        "description": "quantized-gradient int8 histogram pipeline: every "
+                       "narrow-int contraction must accumulate in int32 "
+                       "(preferred_element_type) and the integer dot path "
+                       "must actually be live",
+        "params": dict(_BASE, tpu_grower="compact", use_quantized_grad=True,
+                       num_grad_quant_bins=16, quant_train_renew_leaf=True),
+        "num_devices": 1,
+        "program": "compact_step_k0",
+        "require": [],
+        "require_integer_dot": True,
+        "problem": {"n": 509, "f": 8, "seed": 0},
+    },
+}
+
+MODES = tuple(MODE_TEMPLATES)
+
+
+def contract_path(mode: str) -> str:
+    return os.path.join(CONTRACTS_DIR, f"{mode}.json")
+
+
+def load_contract(mode: str) -> dict:
+    with open(contract_path(mode)) as fh:
+        return json.load(fh)
+
+
+# ---------------------------------------------------------------------------
+# checking half (pure text; no jax)
+# ---------------------------------------------------------------------------
+def check_collectives(hlo_text: str, contract: dict) -> List[ContractFinding]:
+    name = contract["mode"]
+    spec = contract.get("collectives", {})
+    allow = set(spec.get("allow", []))
+    require = set(spec.get("require", []))
+    budgets = spec.get("max_bytes", {})
+    acct = collective_bytes(hlo_text)
+    out: List[ContractFinding] = []
+    observed = {k: v for k, v in acct.items()
+                if k not in ("total", "count") and v > 0}
+    for kind, nbytes in sorted(observed.items()):
+        if kind not in allow:
+            out.append(ContractFinding(
+                name, "collectives",
+                f"forbidden collective '{kind}' ({nbytes} B) in the step "
+                f"program — allowed inventory: {sorted(allow) or 'none'}. "
+                "If the learner's comm protocol deliberately changed, "
+                "regenerate contracts (scripts/verify_contracts.py "
+                "--update) and justify in the PR"))
+        elif kind in budgets and nbytes > budgets[kind]:
+            out.append(ContractFinding(
+                name, "collectives",
+                f"'{kind}' moves {nbytes} B > budget {budgets[kind]} B — "
+                "e.g. a histogram all-reduce reappearing next to the "
+                "reduce-scatter path doubles cross-chip traffic silently"))
+    for kind in sorted(require - set(observed)):
+        out.append(ContractFinding(
+            name, "collectives",
+            f"required collective '{kind}' is missing — the mode's "
+            "comm-reduction claim (README/COMM_ACCOUNTING.json) no longer "
+            "holds for this program"))
+    return out
+
+
+def check_host_ops(hlo_text: str, contract: dict) -> List[ContractFinding]:
+    if not contract.get("forbid_host_ops", True):
+        return []
+    name = contract["mode"]
+    out: List[ContractFinding] = []
+    for instr in parse_instructions(hlo_text):
+        if instr.opcode in HOST_OPS:
+            out.append(ContractFinding(
+                name, "host-ops",
+                f"'{instr.opcode}' at HLO line {instr.line}: the jitted "
+                "step must keep a 0-d2h steady state — host traffic here "
+                "serializes every iteration on the transfer"))
+        elif instr.opcode == "custom-call":
+            # match the TARGET only — the raw line also carries metadata
+            # like source_file=".../site-packages/jax/..." whose 'python'
+            # substring would false-positive on every benign custom-call
+            m = _CUSTOM_CALL_TARGET_RE.search(instr.raw)
+            target = (m.group(1) if m else "").lower()
+            if any(marker in target for marker in HOST_CUSTOM_CALL_MARKERS):
+                out.append(ContractFinding(
+                    name, "host-ops",
+                    f"host-callback custom-call '{target}' at HLO line "
+                    f"{instr.line}: a Python callback inside the step "
+                    "program round-trips to the host every iteration"))
+    return out
+
+
+def check_int_dots(hlo_text: str, contract: dict) -> List[ContractFinding]:
+    name = contract["mode"]
+    out: List[ContractFinding] = []
+    saw_integer_dot = False
+    for instr in parse_instructions(hlo_text):
+        if instr.opcode != "dot":
+            continue
+        op_dtypes = [d for d, _ in instr.operand_shapes]
+        res_dtypes = [d for d, _ in instr.result_shapes]
+        if op_dtypes and all(d in _INT_ALL for d in op_dtypes) \
+                and all(d in _INT_ACCUM for d in res_dtypes):
+            saw_integer_dot = True
+        if contract.get("int_dot_s32", True):
+            narrow = [d for d in op_dtypes + res_dtypes if d in INT_NARROW]
+            if narrow and not all(d in _INT_ACCUM for d in res_dtypes):
+                out.append(ContractFinding(
+                    name, "int-dot",
+                    f"dot at HLO line {instr.line} contracts "
+                    f"{'/'.join(op_dtypes)} into {'/'.join(res_dtypes)} — "
+                    "an int8/int16 matmul without "
+                    "preferred_element_type=int32 wraps its sums at the "
+                    "narrow-type bound (ops/histogram.py contract)"))
+    if contract.get("require_integer_dot") and not saw_integer_dot:
+        out.append(ContractFinding(
+            name, "int-dot",
+            "no integer-accumulating dot found — the quantized int8 "
+            "histogram path is not live in this program (fell back to the "
+            "dequantized f32 shim?)"))
+    return out
+
+
+def check_fingerprint(history: Sequence[str],
+                      contract: dict) -> List[ContractFinding]:
+    name = contract["mode"]
+    if not contract.get("stable_fingerprint", True) or len(history) <= 1:
+        return []
+    prints = [fingerprint(t) for t in history]
+    detail = ("identical program re-lowered (argument signature changed)"
+              if len(set(prints)) == 1 else
+              f"program CHANGED across lowerings: {prints}")
+    return [ContractFinding(
+        name, "fingerprint",
+        f"step program was lowered {len(history)} times during the "
+        f"steady-state run — {detail}. A stable step must compile once; "
+        "a shape/dtype/static-arg flip after warmup recompiles every "
+        "change (guards.compile_counter sees the event, this names the "
+        "program)")]
+
+
+def check_hlo(hlo_text: str, contract: dict) -> List[ContractFinding]:
+    """All single-program checks against one contract."""
+    return (check_collectives(hlo_text, contract)
+            + check_host_ops(hlo_text, contract)
+            + check_int_dots(hlo_text, contract))
+
+
+# ---------------------------------------------------------------------------
+# harness half (imports jax + the package lazily)
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class CapturedMode:
+    mode: str
+    program: str
+    hlo_text: str
+    history: List[str]
+    all_programs: Dict[str, str]
+
+
+def _tiny_problem(n: int, f: int, seed: int):
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = ((X[:, 0] + 0.5 * X[:, 1] + 0.2 * rng.randn(n)) > 0)
+    return X, y.astype(np.float64)
+
+
+def capture_mode(mode: str, template: Optional[dict] = None,
+                 iterations: int = 4) -> CapturedMode:
+    """Train a tiny Booster in ``mode`` and return its step-program HLO.
+
+    Requires an initialized jax backend with >= the mode's device count
+    (the tier-1 conftest provisions 8 virtual CPU devices; the CLI path
+    sets XLA_FLAGS before first import).
+    """
+    import jax
+
+    import lightgbm_tpu as lgb
+
+    t = template or MODE_TEMPLATES[mode]
+    platform = jax.devices()[0].platform
+    if platform != "cpu":
+        # the checked-in contracts are CPU lowerings; diffing a TPU/GPU
+        # program against them would report meaningless drift
+        raise RuntimeError(
+            f"hlo_check contracts are CPU-backend lowerings, but this "
+            f"process's jax backend is '{platform}' — run via "
+            "scripts/tpulint hlo (which forces the CPU platform before "
+            "jax initializes)")
+    need = t.get("num_devices", 1)
+    if len(jax.devices()) < need:
+        raise RuntimeError(
+            f"mode '{mode}' needs {need} devices, have "
+            f"{len(jax.devices())} (set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count)")
+    X, y = _tiny_problem(**t["problem"])
+    prev = os.environ.get("LGBM_TPU_COMM_ACCOUNTING")
+    os.environ["LGBM_TPU_COMM_ACCOUNTING"] = "1"
+    try:
+        bst = lgb.Booster(dict(t["params"]), lgb.Dataset(X, label=y))
+        for _ in range(iterations):
+            bst.update()
+    finally:
+        if prev is None:
+            os.environ.pop("LGBM_TPU_COMM_ACCOUNTING", None)
+        else:
+            os.environ["LGBM_TPU_COMM_ACCOUNTING"] = prev
+    g = bst._gbdt
+    key = t["program"]
+    if key not in g._comm_hlo:
+        raise RuntimeError(
+            f"mode '{mode}': step program '{key}' was not captured "
+            f"(have {sorted(g._comm_hlo)}) — the learner dispatched a "
+            "different step path than the contract expects")
+    return CapturedMode(mode, key, g._comm_hlo[key],
+                        list(g._comm_hlo_history.get(key, [])),
+                        dict(g._comm_hlo))
+
+
+def verify_mode(mode: str, contract: Optional[dict] = None,
+                captured: Optional[CapturedMode] = None
+                ) -> List[ContractFinding]:
+    """Lower the mode's program and verify it against its contract."""
+    contract = contract or load_contract(mode)
+    captured = captured or capture_mode(mode)
+    findings = check_hlo(captured.hlo_text, contract)
+    findings += check_fingerprint(captured.history, contract)
+    return findings
+
+
+def build_contract(mode: str, captured: Optional[CapturedMode] = None
+                   ) -> dict:
+    """Measure the mode's program and emit its contract dict (--update)."""
+    t = MODE_TEMPLATES[mode]
+    captured = captured or capture_mode(mode)
+    acct = collective_bytes(captured.hlo_text)
+    observed = sorted(k for k, v in acct.items()
+                      if k not in ("total", "count") and v > 0)
+    return {
+        "mode": mode,
+        "description": t["description"],
+        "params": t["params"],
+        "num_devices": t["num_devices"],
+        "program": t["program"],
+        "collectives": {
+            "allow": observed,
+            "require": list(t["require"]),
+            "max_bytes": {k: acct[k] for k in observed},
+        },
+        "forbid_host_ops": True,
+        "int_dot_s32": True,
+        "require_integer_dot": bool(t["require_integer_dot"]),
+        "stable_fingerprint": True,
+        "measured": {k: v for k, v in sorted(acct.items())},
+    }
+
+
+def verify_contracts(modes: Sequence[str] = MODES, update: bool = False,
+                     check_drift: bool = True) -> List[ContractFinding]:
+    """The full gate: every mode verified, and the regenerated measurement
+    diffed against the checked-in contract (silent comm-shape drift fails
+    tier-1; ``update=True`` rewrites the files instead)."""
+    findings: List[ContractFinding] = []
+    for mode in modes:
+        captured = capture_mode(mode)
+        fresh = build_contract(mode, captured)
+        if update:
+            os.makedirs(CONTRACTS_DIR, exist_ok=True)
+            with open(contract_path(mode), "w") as fh:
+                json.dump(fresh, fh, indent=1, sort_keys=True)
+                fh.write("\n")
+        if not os.path.exists(contract_path(mode)):
+            findings.append(ContractFinding(
+                mode, "missing",
+                f"no checked-in contract at {contract_path(mode)} — run "
+                "scripts/verify_contracts.py --update"))
+            continue
+        contract = load_contract(mode)
+        findings += verify_mode(mode, contract, captured)
+        if check_drift and not update and fresh != contract:
+            drift = sorted(k for k in set(fresh) | set(contract)
+                           if fresh.get(k) != contract.get(k))
+            findings.append(ContractFinding(
+                mode, "drift",
+                f"regenerated contract differs from the checked-in file "
+                f"in {drift} — comm/program shape drifted; if intended, "
+                "rerun scripts/verify_contracts.py --update and review "
+                "the diff"))
+    return findings
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI body for ``scripts/tpulint hlo`` / scripts/verify_contracts.py.
+
+    Must run before jax initializes a backend elsewhere in the process:
+    it forces the CPU platform with enough virtual devices for every
+    requested mode.
+    """
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="tpulint hlo",
+        description="verify the learner-mode HLO contracts on the CPU "
+                    "backend (no TPU required)")
+    ap.add_argument("modes", nargs="*", default=list(MODES),
+                    help=f"modes to verify (default: all of {list(MODES)})")
+    ap.add_argument("--update", action="store_true",
+                    help="regenerate analysis/contracts/*.json from the "
+                         "current lowering instead of failing on drift")
+    args = ap.parse_args(argv)
+    modes = args.modes or list(MODES)
+    unknown = [m for m in modes if m not in MODE_TEMPLATES]
+    if unknown:
+        print(f"hlo_check: unknown mode(s) {unknown}; "
+              f"known: {list(MODES)}")
+        return 2
+
+    # jax reads JAX_PLATFORMS/XLA_FLAGS at IMPORT time, and importing this
+    # module already pulled the package (and jax) in — so the pre-import
+    # env lives in ONE place, scripts/tpulint's hlo branch (which
+    # scripts/verify_contracts.py execs). Here only the post-import
+    # platform override remains (the same move as tests/conftest.py); the
+    # virtual device count cannot be raised after backend init, so
+    # capture_mode raises an actionable error if too few devices exist.
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass   # backend already initialized elsewhere; device check below
+
+    findings = verify_contracts(modes, update=args.update)
+    for f in findings:
+        print(f.render())
+    if args.update and not findings:
+        print(f"hlo_check: contracts regenerated for {list(modes)}")
+    if not findings:
+        print(f"hlo_check: {len(modes)} contract(s) verified clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -6,10 +6,14 @@ from .r002_recompile import RecompileRule
 from .r003_dtype import DtypeDriftRule
 from .r004_pallas import PallasContractRule
 from .r005_collectives import CollectiveAccountingRule
+from .r006_axis import AxisNameRule
+from .r007_api_race import ApiRaceRule
 
 ALL_RULES = (HostSyncRule, RecompileRule, DtypeDriftRule,
-             PallasContractRule, CollectiveAccountingRule)
+             PallasContractRule, CollectiveAccountingRule,
+             AxisNameRule, ApiRaceRule)
 
 __all__ = ["Finding", "ModuleInfo", "PackageInfo", "Rule", "ALL_RULES",
            "HostSyncRule", "RecompileRule", "DtypeDriftRule",
-           "PallasContractRule", "CollectiveAccountingRule"]
+           "PallasContractRule", "CollectiveAccountingRule",
+           "AxisNameRule", "ApiRaceRule"]

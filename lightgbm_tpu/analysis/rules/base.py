@@ -169,9 +169,22 @@ class ModuleInfo:
         self.by_basename: Dict[str, List[FunctionInfo]] = {}
         # local alias -> (absolute module dotted name, symbol or None)
         self.imports: Dict[str, Tuple[str, Optional[str]]] = {}
+        self._func_of: Optional[Dict[int, str]] = None
         self._collect_imports()
         self._collect_functions(self.tree, parent=None, prefix="")
         self._collect_jit_callsites()
+
+    def func_of(self, node: ast.AST) -> str:
+        """Qualname of the function whose body contains ``node`` (for
+        Finding attribution), or ``"<module>"`` — shared by the rules so
+        each does not rebuild the id->qualname map itself."""
+        if self._func_of is None:
+            table: Dict[int, str] = {}
+            for fn in self.functions.values():
+                for n in fn.own_nodes():
+                    table[id(n)] = fn.qualname
+            self._func_of = table
+        return self._func_of.get(id(node), "<module>")
 
     # -- construction --------------------------------------------------
     def _resolve_relative(self, module: Optional[str], level: int) -> str:

@@ -15,6 +15,20 @@ constant ending in ``-start``), taking the first element of a shapes
 collection (``x[:1]`` / ``x[0]``) without any second-element selection
 (``x[1]`` / ``x[1:2]``) in the same guarded region means every async
 kind is counted by operand shape.
+
+PR 2 widened the collective surface — ``lax.psum_scatter`` lowers to
+``reduce-scatter`` (async twin ``reduce-scatter-start``), and async
+``-start``/``-done`` pairs appear throughout post-optimization TPU HLO —
+so two more accounting hazards are checked:
+
+* **stale inventory**: a collective-kind literal that carries ``-start``
+  twins for some kinds but lists a base kind (e.g. ``reduce-scatter``)
+  without its ``-start`` twin silently drops that kind's bytes the day
+  XLA goes async on it (exactly how psum_scatter traffic would have
+  vanished from COMM_ACCOUNTING.json);
+* **double counting**: accumulating bytes inside a branch guarded by a
+  ``*-done`` test — the ``-done`` op carries no payload of its own, so
+  counting both halves of the pair reports every async collective twice.
 """
 from __future__ import annotations
 
@@ -22,6 +36,18 @@ import ast
 from typing import List, Optional, Tuple
 
 from .base import Finding, ModuleInfo, PackageInfo, Rule
+
+#: base collective opcodes as post-optimization HLO spells them
+_BASE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+               "collective-permute", "all-to-all")
+
+
+def _collective_token(value: str) -> Optional[str]:
+    """The base kind a string denotes, or None if not a collective name."""
+    for base in _BASE_KINDS:
+        if value in (base, base + "-start", base + "-done"):
+            return base
+    return None
 
 
 def _guards_start(test: ast.AST) -> bool:
@@ -52,6 +78,27 @@ def _first_second_selects(node: ast.AST
     return first, second
 
 
+def _guards_done(test: ast.AST) -> bool:
+    return any(isinstance(n, ast.Constant) and isinstance(n.value, str)
+               and n.value.endswith("-done") for n in ast.walk(test))
+
+
+def _accumulates(body: List[ast.AST]) -> Optional[ast.AST]:
+    """First byte-accumulation statement in a region (``+=``, ``.append``,
+    ``sum(...)``), or None."""
+    region = ast.Module(body=body, type_ignores=[])
+    for n in ast.walk(region):
+        if isinstance(n, ast.AugAssign) and isinstance(n.op, ast.Add):
+            return n
+        if isinstance(n, ast.Call):
+            if isinstance(n.func, ast.Attribute) and \
+                    n.func.attr in ("append", "add"):
+                return n
+            if isinstance(n.func, ast.Name) and n.func.id == "sum":
+                return n
+    return None
+
+
 class CollectiveAccountingRule(Rule):
     code = "R005"
     title = "async collective accounting shape rules"
@@ -59,22 +106,55 @@ class CollectiveAccountingRule(Rule):
     def check(self, module: ModuleInfo, package: PackageInfo
               ) -> List[Finding]:
         out: List[Finding] = []
-        func_names = {}
-        for fn in module.functions.values():
-            for n in fn.own_nodes():
-                func_names[id(n)] = fn.qualname
+        func_of = module.func_of
+
+        has_start_handling = any(
+            isinstance(n, ast.Constant) and isinstance(n.value, str)
+            and n.value.endswith("-start") for n in ast.walk(module.tree))
+
         for node in ast.walk(module.tree):
-            if not (isinstance(node, ast.If) and _guards_start(node.test)):
-                continue
-            first, second = _first_second_selects(
-                ast.Module(body=node.body, type_ignores=[]))
-            if first is not None and not second:
-                out.append(self.finding(
-                    module, first,
-                    func_names.get(id(node), "<module>"),
-                    "async '*-start' collective counted by its FIRST "
-                    "tuple element (the operand) — all-gather-start / "
-                    "collective-permute-start must count the result "
-                    "shape (second element) or gathered bytes are "
-                    "under-reported"))
+            if isinstance(node, ast.If) and _guards_start(node.test):
+                first, second = _first_second_selects(
+                    ast.Module(body=node.body, type_ignores=[]))
+                if first is not None and not second:
+                    out.append(self.finding(
+                        module, first, func_of(node),
+                        "async '*-start' collective counted by its FIRST "
+                        "tuple element (the operand) — all-gather-start / "
+                        "reduce-scatter-start / collective-permute-start "
+                        "must count the result shape (second element) or "
+                        "transferred bytes are mis-reported"))
+            if isinstance(node, ast.If) and _guards_done(node.test) \
+                    and has_start_handling:
+                acc = _accumulates(node.body)
+                if acc is not None:
+                    out.append(self.finding(
+                        module, acc, func_of(node),
+                        "bytes accumulated under a '*-done' guard — the "
+                        "-done half of an async pair carries no payload "
+                        "of its own; counting both halves reports every "
+                        "async collective twice"))
+            if isinstance(node, (ast.Tuple, ast.List)) and \
+                    len(node.elts) >= 3:
+                values = [e.value for e in node.elts
+                          if isinstance(e, ast.Constant)
+                          and isinstance(e.value, str)]
+                if len(values) != len(node.elts):
+                    continue
+                tokens = [_collective_token(v) for v in values]
+                if any(t is None for t in tokens):
+                    continue           # not a collective inventory
+                if not any(v.endswith("-start") for v in values):
+                    continue           # sync-only inventory: out of scope
+                missing = sorted(
+                    v for v in values if _collective_token(v) == v
+                    and v + "-start" not in values)
+                for base in missing:
+                    out.append(self.finding(
+                        module, node, func_of(node),
+                        f"collective inventory lists '{base}' without its "
+                        f"async twin '{base}-start' — post-optimization "
+                        "HLO emits the async form (lax.psum_scatter => "
+                        "reduce-scatter-start), so its bytes silently "
+                        "drop out of the accounting"))
         return out

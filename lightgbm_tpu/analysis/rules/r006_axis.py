@@ -1,0 +1,225 @@
+"""R006 — shard_map/collective axis-name consistency.
+
+Two hazards around the mesh boundary:
+
+* ``lax.psum(x, "axis")`` (and psum_scatter / all_gather / ppermute /
+  axis_index / pmean / pmax / pmin) with an axis name that no mesh in the
+  package declares: under ``shard_map`` this is a NameError at trace time
+  on the multi-chip path only — the serial CPU tests never execute it, so
+  a typo ships. The rule resolves names through module constants and
+  package-relative imports (``DATA_AXIS`` in parallel/mesh.py), and skips
+  dynamic expressions (``gp.axis_name``).
+
+* host readback of a sharded value without a gather:
+  ``np.asarray(x)`` / ``float(x)`` on an array that was explicitly
+  ``jax.device_put`` with a non-replicated sharding reads back only via
+  an implicit cross-device gather — on multi-host meshes the array is
+  not fully addressable and this RAISES; on single-host it hides the
+  gather cost inside numpy. The gather must be explicit
+  (``jax.device_get`` / ``multihost.to_host`` / ``process_allgather``)
+  so it is visible and portable.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .base import (Finding, ModuleInfo, PackageInfo, Rule, call_name,
+                   dotted_name, string_constants)
+
+#: collective/axis primitives whose axis argument must name a mesh axis
+_AXIS_CALLS = {"psum", "psum_scatter", "pmean", "pmax", "pmin",
+               "all_gather", "ppermute", "pshuffle", "axis_index",
+               "axis_size", "pbroadcast"}
+#: the axis argument position (after the value operand(s))
+_AXIS_ARG_POS = {"axis_index": 0, "axis_size": 0}
+
+#: calls whose string arguments declare mesh axis names
+_DECL_CALLS = {"Mesh", "make_mesh", "PartitionSpec", "P", "NamedSharding",
+               "AxisType"}
+
+#: readback funnels for the sharded-value sub-check
+_READBACK = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+             "np.ascontiguousarray", "np.asanyarray", "float", "int",
+             "memoryview"}
+#: an explicit gather: reassigning through these clears the taint
+_GATHERS = {"jax.device_get", "device_get", "to_host", "process_allgather"}
+
+
+def _module_str_constants(tree: ast.AST) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` bindings."""
+    out: Dict[str, str] = {}
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+class AxisNameRule(Rule):
+    code = "R006"
+    title = "shard_map/collective axis-name consistency"
+
+    def __init__(self):
+        # the vocabulary depends only on the package; check() runs once
+        # per module, so cache it or the pass walks every AST per module
+        self._vocab_for: Optional[int] = None
+        self._vocab: Set[str] = set()
+
+    # -- axis vocabulary ----------------------------------------------------
+    def _vocabulary(self, package: PackageInfo) -> Set[str]:
+        if self._vocab_for == id(package):
+            return self._vocab
+        vocab: Set[str] = set()
+        for m in package.modules:
+            consts = _module_str_constants(m.tree)
+            for name, value in consts.items():
+                if "AXIS" in name.upper():
+                    vocab.add(value)
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = (call_name(node) or "").rsplit(".", 1)[-1]
+                if cname in _DECL_CALLS:
+                    vocab.update(string_constants(node))
+                    for ref in ast.walk(node):
+                        if isinstance(ref, ast.Name) and ref.id in consts:
+                            vocab.add(consts[ref.id])
+        self._vocab_for = id(package)
+        self._vocab = vocab
+        return vocab
+
+    def _resolve_axis(self, expr: ast.AST, module: ModuleInfo,
+                      package: PackageInfo) -> List[Optional[str]]:
+        """Axis-name strings an expression denotes; [None] = dynamic."""
+        if isinstance(expr, ast.Constant):
+            return [expr.value] if isinstance(expr.value, str) else [None]
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out: List[Optional[str]] = []
+            for el in expr.elts:
+                out.extend(self._resolve_axis(el, module, package))
+            return out
+        if isinstance(expr, ast.Name):
+            local = _module_str_constants(module.tree)
+            if expr.id in local:
+                return [local[expr.id]]
+            if expr.id in module.imports:
+                mod_name, symbol = module.imports[expr.id]
+                target = package.by_dotted.get(mod_name)
+                if target is not None and symbol is not None:
+                    remote = _module_str_constants(target.tree)
+                    if symbol in remote:
+                        return [remote[symbol]]
+        return [None]   # attribute access / call result: dynamic, skip
+
+    def _check_axis_names(self, module: ModuleInfo, package: PackageInfo,
+                          vocab: Set[str], func_of) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = call_name(node) or ""
+            base = cname.rsplit(".", 1)[-1]
+            if base not in _AXIS_CALLS:
+                continue
+            pos = _AXIS_ARG_POS.get(base, 1)
+            axis_expr = None
+            for kw in node.keywords:
+                # only the axis NAME keyword — `axis=`/`scatter_dimension=`
+                # on all_gather/psum_scatter is an integer dimension, and
+                # matching it would mask a typo'd positional axis name
+                if kw.arg == "axis_name":
+                    axis_expr = kw.value
+            if axis_expr is None and len(node.args) > pos:
+                axis_expr = node.args[pos]
+            if axis_expr is None:
+                continue
+            for axis in self._resolve_axis(axis_expr, module, package):
+                if axis is not None and axis not in vocab:
+                    out.append(self.finding(
+                        module, node, func_of(node),
+                        f"{base}() over axis '{axis}', but no mesh in the "
+                        f"package declares it (known axes: "
+                        f"{sorted(vocab) or 'none'}) — trace-time NameError "
+                        "on the multi-chip path only"))
+        return out
+
+    # -- sharded readback ---------------------------------------------------
+    def _check_sharded_readback(self, module: ModuleInfo,
+                                func_of) -> List[Finding]:
+        out: List[Finding] = []
+        for fn in module.functions.values():
+            # replay the function in source order: a sharded device_put
+            # taints its target name, any other reassignment (e.g. through
+            # jax.device_get) clears it, a readback call on a tainted name
+            # is the finding
+            events = []                    # (lineno, kind, payload)
+            for n in fn.own_nodes():
+                if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                        and isinstance(n.targets[0], ast.Name):
+                    events.append((n.lineno, "assign", n))
+                elif isinstance(n, ast.Call) and call_name(n) in _READBACK \
+                        and n.args and isinstance(n.args[0], ast.Name):
+                    events.append((n.lineno, "read", n))
+            sharded: Dict[str, int] = {}
+            # at equal lines, reads run before the assignment they feed
+            # (`v = np.asarray(v)` reads the sharded v)
+            for _, kind, n in sorted(events,
+                                     key=lambda e: (e[0], e[1] != "read")):
+                if kind == "assign":
+                    tgt = n.targets[0].id
+                    if self._is_sharded_put(n.value):
+                        sharded[tgt] = n.lineno
+                    else:
+                        sharded.pop(tgt, None)
+                elif n.args[0].id in sharded:
+                    out.append(self.finding(
+                        module, n, fn.qualname,
+                        f"{call_name(n)}() reads back '{n.args[0].id}', "
+                        "which was device_put with a non-replicated "
+                        "sharding — on a multi-host mesh the array is not "
+                        "fully addressable and this raises; gather "
+                        "explicitly (jax.device_get / multihost.to_host / "
+                        "process_allgather) first"))
+        return out
+
+    @staticmethod
+    def _is_sharded_put(value: ast.AST) -> bool:
+        if not (isinstance(value, ast.Call)
+                and (call_name(value) or "").endswith("device_put")
+                and len(value.args) >= 2):
+            return False
+        spec = value.args[1]
+        for n in ast.walk(spec):
+            if not isinstance(n, ast.Call):
+                continue
+            base = (call_name(n) or "").rsplit(".", 1)[-1].lower()
+            if "sharding" not in base or "replicat" in base:
+                continue
+            if base == "namedsharding":
+                # NamedSharding(mesh, P()) with an axis-free spec is fully
+                # replicated — the documented-safe readback case
+                pspec = next(
+                    (c for c in ast.walk(n) if c is not n
+                     and isinstance(c, ast.Call)
+                     and (call_name(c) or "").rsplit(".", 1)[-1]
+                     in ("P", "PartitionSpec")), None)
+                if pspec is not None and not pspec.keywords and all(
+                        isinstance(a, ast.Constant) and a.value is None
+                        for a in pspec.args):
+                    continue
+            return True
+        return False
+
+    def check(self, module: ModuleInfo, package: PackageInfo
+              ) -> List[Finding]:
+        func_of = module.func_of
+        vocab = self._vocabulary(package)
+        # no mesh declared anywhere in the linted set (e.g. a single-file
+        # lint of a helper module): axis names can't be validated — only
+        # the readback sub-check applies
+        axis = (self._check_axis_names(module, package, vocab, func_of)
+                if vocab else [])
+        return axis + self._check_sharded_readback(module, func_of)

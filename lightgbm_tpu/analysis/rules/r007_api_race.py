@@ -1,0 +1,146 @@
+"""R007 — unsynchronized mutation of shared ``Booster``/``Dataset`` state.
+
+The reference shared-locks every C API call (yamc shared mutex,
+src/c_api.cpp:163): concurrent predicts are readers, training/mutation is
+exclusive. This repo's equivalent is the ``_api_lock`` reader-writer lock
+(utils/rwlock.py) with ``@read_locked``/``@write_locked`` on public
+methods — and THIS rule is what keeps that discipline from rotting: a new
+public method added without a decorator, or a cache fill slipped into a
+read-locked method (the ``_device_trees_cache`` race this PR fixes), is a
+finding, not a code-review hope.
+
+Scope: classes named ``Booster``/``Dataset`` and any class whose
+``__init__`` installs a ``self._api_lock``. Checks, per public method
+(no leading underscore, not a dunder, not a property/classmethod/
+staticmethod):
+
+  * it carries a ``read_locked`` or ``write_locked`` decorator;
+  * if its body assigns/deletes ``self.<attr>`` (including subscript
+    stores like ``self.x[i] = v``), the decorator is ``write_locked``.
+
+The runtime half — detecting concurrent unsynchronized access when the
+lock is bypassed — is ``analysis/guards.api_race_sanitizer``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .base import Finding, ModuleInfo, PackageInfo, Rule, dotted_name
+
+_SHARED_CLASS_NAMES = {"Booster", "Dataset"}
+_LOCK_DECORATORS = {"read_locked", "write_locked"}
+_SKIP_DECORATORS = {"property", "staticmethod", "classmethod",
+                    "cached_property"}
+
+
+def _decorator_basenames(fn: ast.AST) -> List[str]:
+    out = []
+    for dec in fn.decorator_list:
+        node = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted_name(node)
+        if name:
+            out.append(name.rsplit(".", 1)[-1])
+    return out
+
+
+def _declares_api_lock(cls: ast.ClassDef) -> bool:
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and t.attr == "_api_lock" \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    return True
+    return False
+
+
+def _self_mutations(fn: ast.FunctionDef) -> List[str]:
+    """Attributes of ``self`` this method assigns/deletes directly (not
+    descending into nested defs)."""
+    out: List[str] = []
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        targets: List[ast.AST] = []
+        if isinstance(n, ast.Assign):
+            targets = n.targets
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            targets = [n.target]
+        elif isinstance(n, ast.Delete):
+            targets = n.targets
+        for t in targets:
+            attr = _self_attr_of(t)
+            if attr is not None:
+                out.append(attr)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _self_attr_of(target: ast.AST) -> Optional[str]:
+    # unwrap tuple targets and subscript stores: self.x[i] = v mutates x
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for el in target.elts:
+            a = _self_attr_of(el)
+            if a is not None:
+                return a
+        return None
+    while isinstance(target, ast.Subscript):
+        target = target.value
+    if isinstance(target, ast.Attribute) and \
+            isinstance(target.value, ast.Name) and target.value.id == "self":
+        return target.attr
+    return None
+
+
+class ApiRaceRule(Rule):
+    code = "R007"
+    title = "unsynchronized mutation of shared Booster/Dataset state"
+
+    def check(self, module: ModuleInfo, package: PackageInfo
+              ) -> List[Finding]:
+        out: List[Finding] = []
+        for cls in ast.walk(module.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            declares = _declares_api_lock(cls)
+            if cls.name in _SHARED_CLASS_NAMES and not declares:
+                out.append(self.finding(
+                    module, cls, cls.name,
+                    f"shared API class {cls.name} declares no _api_lock — "
+                    "concurrent predict/update race on its state "
+                    "(utils/rwlock.RWLock; reference: the C API's shared "
+                    "mutex, src/c_api.cpp:163)"))
+                continue
+            if not (declares or cls.name in _SHARED_CLASS_NAMES):
+                continue
+            for fn in cls.body:
+                if not isinstance(fn, ast.FunctionDef):
+                    continue
+                if fn.name.startswith("_"):
+                    continue
+                decos = _decorator_basenames(fn)
+                if any(d in _SKIP_DECORATORS for d in decos):
+                    continue
+                lock = next((d for d in decos if d in _LOCK_DECORATORS),
+                            None)
+                qual = f"{cls.name}.{fn.name}"
+                muts = _self_mutations(fn)
+                if lock is None:
+                    out.append(self.finding(
+                        module, fn, qual,
+                        f"public API method '{fn.name}' is not "
+                        "read_locked/write_locked — every entry point of a "
+                        "shared class holds the rwlock"
+                        + (f" (it mutates self.{muts[0]})" if muts else "")))
+                elif lock == "read_locked" and muts:
+                    out.append(self.finding(
+                        module, fn, qual,
+                        f"'{fn.name}' mutates self.{muts[0]} under the "
+                        "READ lock — concurrent readers interleave the "
+                        "write (the _device_trees_cache race); take "
+                        "@write_locked"))
+        return out

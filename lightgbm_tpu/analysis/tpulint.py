@@ -12,7 +12,13 @@ for the same reason — correctness tooling as a first-class layer):
         code)
   R004  Pallas contracts (32-multiple block sizes, validated env
         overrides, fused_split pad contract via num_rows=)
-  R005  async collective accounting must count result shapes
+  R005  async collective accounting must count result shapes; inventories
+        need the -start twins (psum_scatter => reduce-scatter-start) and
+        -done ops carry no bytes
+  R006  shard_map/collective axis names must exist in a declared mesh;
+        sharded values gather explicitly before host readback
+  R007  public Booster/Dataset methods hold the _api_lock rwlock;
+        mutating methods take the write side
 
 Deliberate exceptions live in the checked-in allowlist
 (analysis/tpulint.allow), one entry per line:
@@ -94,6 +100,74 @@ def load_allowlist(path: str) -> Tuple[List[AllowEntry], List[str]]:
     return entries, errors
 
 
+def _allowlist_root(allowlist_path: str) -> str:
+    """The package root the allowlist's anchors are judged against: walk
+    up from the allowlist file through ``__init__.py`` packages, so a
+    subset lint (``tpulint lightgbm_tpu/ops --check-allow``) still
+    validates entries anchored elsewhere in the package instead of
+    reporting them stale."""
+    d = os.path.dirname(os.path.abspath(allowlist_path))
+    while os.path.exists(os.path.join(os.path.dirname(d), "__init__.py")):
+        d = os.path.dirname(d)
+    return d
+
+
+def check_allowlist_staleness(entries: Sequence[AllowEntry],
+                              paths: Sequence[str],
+                              allowlist_path: Optional[str] = None
+                              ) -> List[str]:
+    """Flag allowlist entries whose file::func anchor no longer matches
+    the source — the staleness pass that keeps the file from accumulating
+    exceptions for code that moved or died.
+
+    Anchors are resolved against the union of ``paths`` and (when given)
+    the allowlist's own package root, so linting a subtree does not
+    false-flag entries anchored outside it. An entry is stale when no
+    file matches its path suffix, or (for a non-``*`` func) the anchored
+    file no longer defines a function with that basename. Returned
+    strings are error messages; the tier-1 gate and ``--check-allow``
+    treat any as a failure.
+    """
+    import ast as _ast
+    roots = list(paths)
+    if allowlist_path is not None:
+        roots.append(_allowlist_root(allowlist_path))
+    files = sorted({p.replace(os.sep, "/") for p in _iter_py_files(roots)})
+    defined_cache: Dict[str, set] = {}
+
+    def defined_in(f: str) -> set:
+        if f not in defined_cache:
+            names: set = set()
+            try:
+                with open(f, encoding="utf-8") as fh:
+                    tree = _ast.parse(fh.read(), filename=f)
+                names = {n.name for n in _ast.walk(tree)
+                         if isinstance(n, (_ast.FunctionDef,
+                                           _ast.AsyncFunctionDef))}
+            except (SyntaxError, OSError, UnicodeDecodeError):
+                pass
+            defined_cache[f] = names
+        return defined_cache[f]
+
+    stale: List[str] = []
+    for e in entries:
+        hits = [f for f in files
+                if f == e.path or f.endswith("/" + e.path)]
+        if not hits:
+            stale.append(
+                f"allowlist line {e.lineno}: stale entry {e.render()} — "
+                f"no file matches '{e.path}'")
+            continue
+        if e.func == "*":
+            continue
+        want = e.func.rsplit(".", 1)[-1]
+        if not any(want in defined_in(f) for f in hits):
+            stale.append(
+                f"allowlist line {e.lineno}: stale entry {e.render()} — "
+                f"'{e.path}' no longer defines a function '{want}'")
+    return stale
+
+
 def _iter_py_files(paths: Sequence[str]) -> List[str]:
     out: List[str] = []
     for p in paths:
@@ -168,6 +242,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="allowlist file (default: analysis/tpulint.allow)")
     ap.add_argument("--no-allowlist", action="store_true",
                     help="report allowlisted findings too")
+    ap.add_argument("--check-allow", action="store_true",
+                    help="fail on allowlist entries whose file::func "
+                         "anchor no longer matches the source")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit findings as a JSON array")
     args = ap.parse_args(argv)
@@ -175,16 +252,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     findings, errors = lint_paths(args.paths)
     allow_errors: List[str] = []
     entries: List[AllowEntry] = []
-    if not args.no_allowlist:
+    if not args.no_allowlist or args.check_allow:
+        # --check-allow validates anchors even under --no-allowlist (an
+        # audit run must not silently skip the staleness pass)
         entries, allow_errors = load_allowlist(args.allowlist)
+    if not args.no_allowlist:
         findings = apply_allowlist(findings, entries)
+    if args.check_allow:
+        allow_errors += check_allowlist_staleness(entries, args.paths,
+                                                  args.allowlist)
 
     for err in errors + allow_errors:
         print(f"tpulint: error: {err}", file=sys.stderr)
-    for e in entries:
-        if not e.used:
-            print(f"tpulint: warning: unused allowlist entry "
-                  f"{e.render()} (line {e.lineno})", file=sys.stderr)
+    if not args.no_allowlist:
+        for e in entries:
+            if not e.used:
+                print(f"tpulint: warning: unused allowlist entry "
+                      f"{e.render()} (line {e.lineno})", file=sys.stderr)
 
     if args.as_json:
         print(json.dumps([f.to_json() for f in findings], indent=1))

@@ -24,6 +24,7 @@ from .io.dataset import BinnedDataset, Metadata
 from .metrics import create_metrics
 from .objectives import create_objective
 from .utils import log
+from .utils.rwlock import RWLock, read_locked, write_locked
 
 _ArrayLike = Any
 
@@ -78,6 +79,10 @@ class Dataset:
         free_raw_data: bool = True,
         position: Optional[_ArrayLike] = None,
     ):
+        # shared-state discipline (reference: the C API's yamc shared mutex,
+        # src/c_api.cpp:163): public methods below are @read_locked /
+        # @write_locked against this lock; tpulint R007 enforces coverage
+        self._api_lock = RWLock()
         self.data = data
         self.label = label
         self.reference = reference
@@ -135,6 +140,7 @@ class Dataset:
         return self
 
     # -- construction --------------------------------------------------------
+    @write_locked
     def construct(self) -> "Dataset":
         """(reference: Dataset.construct, basic.py:2517)"""
         if self._inner is not None:
@@ -225,6 +231,7 @@ class Dataset:
         md.set_init_score(self.init_score)
         md.set_position(self.position)
 
+    @write_locked
     def subset(self, used_indices, params=None) -> "Dataset":
         """Row-subset Dataset sharing this dataset's bin mappers
         (reference: Dataset.subset, python-package basic.py ->
@@ -237,7 +244,8 @@ class Dataset:
         # must agree on order (the reference sorts used_indices the same way)
         idx = np.unique(np.asarray(used_indices, np.int64).reshape(-1))
         inner = self._inner
-        sub = Dataset.__new__(Dataset)
+        sub = Dataset.__new__(Dataset)   # bypasses __init__: lock it here
+        sub._api_lock = RWLock()
         sub.data = None
         sub.label = None
         sub.reference = self
@@ -295,6 +303,7 @@ class Dataset:
         sub._inner = si
         return sub
 
+    @read_locked
     def create_valid(self, data, label=None, weight=None, group=None,
                      init_score=None, params=None, position=None) -> "Dataset":
         """(reference: Dataset.create_valid, basic.py:2454)"""
@@ -304,36 +313,42 @@ class Dataset:
             free_raw_data=self.free_raw_data, position=position)
 
     # -- setters (reference: set_field family) -------------------------------
+    @write_locked
     def set_label(self, label) -> "Dataset":
         self.label = label
         if self._inner is not None:
             self._inner.metadata.set_label(_maybe_series(label))
         return self
 
+    @write_locked
     def set_weight(self, weight) -> "Dataset":
         self.weight = weight
         if self._inner is not None:
             self._inner.metadata.set_weight(_maybe_series(weight))
         return self
 
+    @write_locked
     def set_group(self, group) -> "Dataset":
         self.group = group
         if self._inner is not None:
             self._inner.metadata.set_group(group)
         return self
 
+    @write_locked
     def set_init_score(self, init_score) -> "Dataset":
         self.init_score = init_score
         if self._inner is not None:
             self._inner.metadata.set_init_score(init_score)
         return self
 
+    @write_locked
     def set_position(self, position) -> "Dataset":
         self.position = position
         if self._inner is not None:
             self._inner.metadata.set_position(position)
         return self
 
+    @write_locked
     def save_binary(self, filename: str) -> "Dataset":
         """Persist the constructed dataset (reference: Dataset.save_binary ->
         LGBM_DatasetSaveBinary; reload by passing the file path as data)."""
@@ -341,26 +356,31 @@ class Dataset:
         self._inner.save_binary(filename)
         return self
 
+    @read_locked
     def get_label(self):
         if self._inner is not None and self._inner.metadata.label is not None:
             return self._inner.metadata.label
         return self.label
 
+    @read_locked
     def get_weight(self):
         if self._inner is not None:
             return self._inner.metadata.weight
         return self.weight
 
+    @read_locked
     def get_group(self):
         if self._inner is not None:
             return self._inner.metadata.group
         return self.group
 
+    @read_locked
     def get_init_score(self):
         if self._inner is not None:
             return self._inner.metadata.init_score
         return self.init_score
 
+    @read_locked
     def get_field(self, name):
         getter = {"label": self.get_label, "weight": self.get_weight,
                   "group": self.get_group, "init_score": self.get_init_score}
@@ -368,6 +388,7 @@ class Dataset:
             raise KeyError(name)
         return getter[name]()
 
+    @write_locked
     def set_field(self, name, value):
         setter = {"label": self.set_label, "weight": self.set_weight,
                   "group": self.set_group, "init_score": self.set_init_score,
@@ -376,6 +397,7 @@ class Dataset:
             raise KeyError(name)
         return setter[name](value)
 
+    @read_locked
     def num_data(self) -> int:
         if self._inner is not None:
             return self._inner.num_data
@@ -386,6 +408,7 @@ class Dataset:
                          else self.data.values)
         return arr.shape[0]
 
+    @read_locked
     def num_feature(self) -> int:
         if self._inner is not None:
             return self._inner.num_total_features
@@ -398,6 +421,7 @@ class Dataset:
                          else self.data.values)
         return arr.shape[1] if arr.ndim == 2 else 1
 
+    @write_locked
     def get_feature_name(self) -> List[str]:
         self.construct()
         return list(self._inner.feature_names)
@@ -423,6 +447,10 @@ class Booster:
         model_file: Optional[str] = None,
         model_str: Optional[str] = None,
     ):
+        # every public method below holds this as reader or writer — the
+        # reference's per-handle shared mutex (src/c_api.cpp:163); fixes
+        # the predict/update race on the device-tree cache
+        self._api_lock = RWLock()
         params = copy.deepcopy(params) if params else {}
         self.params = params
         self.best_iteration = -1
@@ -487,6 +515,7 @@ class Booster:
         vs = self._gbdt.valid_sets[which]
         vs.score = vs.score.at[:, : pre_raw.shape[1]].add(jnp.asarray(pre_raw))
 
+    @read_locked
     def refit(self, data, label, decay_rate: Optional[float] = None,
               weight=None, **kwargs) -> "Booster":
         """Re-fit all leaf values on new data, keeping tree structures
@@ -536,6 +565,7 @@ class Booster:
         return Booster(model_str=loaded_to_string(loaded))
 
     # -- training ------------------------------------------------------------
+    @write_locked
     def add_valid(self, data: Dataset, name: str) -> "Booster":
         """(reference: Booster.add_valid, basic.py:3963)"""
         if not isinstance(data, Dataset):
@@ -562,6 +592,7 @@ class Booster:
         self._valid_names.append(name)
         return self
 
+    @write_locked
     def update(self, train_set: Optional[Dataset] = None,
                fobj: Optional[Callable] = None) -> bool:
         """One boosting iteration; True if no further splits were possible
@@ -579,10 +610,12 @@ class Booster:
         pending, self._pending_finish = self._pending_finish, False
         return finished or pending
 
+    @write_locked
     def rollback_one_iter(self) -> "Booster":
         self._gbdt.rollback_one_iter()
         return self
 
+    @write_locked
     def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
         """(reference: Booster.reset_parameter → GBDT::ResetConfig gbdt.cpp:795)"""
         self.params.update(params)
@@ -619,6 +652,7 @@ class Booster:
         return self
 
     # -- evaluation ----------------------------------------------------------
+    @write_locked
     def eval_train(self, feval=None):
         out = self._gbdt.eval_train()
         out = [(self._train_data_name, m, v, hb) for (_, m, v, hb) in out]
@@ -626,6 +660,7 @@ class Booster:
             out.extend(self._eval_custom(feval, self._train_data_name, "train"))
         return out
 
+    @write_locked
     def eval_valid(self, feval=None):
         out = self._gbdt.eval_valid()
         if feval is not None:
@@ -665,6 +700,7 @@ class Booster:
         return out
 
     # -- prediction ----------------------------------------------------------
+    @read_locked
     def predict(
         self,
         data: _ArrayLike,
@@ -801,6 +837,7 @@ class Booster:
         return out
 
     # -- model IO ------------------------------------------------------------
+    @read_locked
     def model_to_string(self, num_iteration: Optional[int] = None,
                         start_iteration: int = 0,
                         importance_type: str = "split") -> str:
@@ -827,6 +864,7 @@ class Booster:
         return (min(num_iteration, pre.current_iteration),
                 max(num_iteration - pre.current_iteration, 0))
 
+    @read_locked
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0,
                    importance_type: str = "split") -> "Booster":
@@ -834,6 +872,7 @@ class Booster:
             f.write(self.model_to_string(num_iteration))
         return self
 
+    @read_locked
     def dump_model(self, num_iteration: Optional[int] = None,
                    start_iteration: int = 0,
                    importance_type: str = "split") -> Dict:
@@ -846,6 +885,7 @@ class Booster:
         return booster_to_dict(self, num_iteration)
 
     # -- introspection -------------------------------------------------------
+    @read_locked
     def num_trees(self) -> int:
         g = self._gbdt
         own = g.num_total_trees if hasattr(g, "num_total_trees") \
@@ -853,26 +893,31 @@ class Booster:
         pre = getattr(self, "_pre_model", None)
         return own + (len(pre.models) if pre is not None else 0)
 
+    @read_locked
     def current_iteration(self) -> int:
         pre = getattr(self, "_pre_model", None)
         return self._gbdt.current_iteration + \
             (pre.current_iteration if pre is not None else 0)
 
+    @read_locked
     def num_model_per_iteration(self) -> int:
         return self._gbdt.num_tree_per_iteration
 
+    @read_locked
     def num_feature(self) -> int:
         ts = getattr(self._gbdt, "train_set", None)
         if ts is not None:
             return ts.num_total_features
         return self._gbdt.max_feature_idx + 1  # loaded model
 
+    @read_locked
     def feature_name(self) -> List[str]:
         ts = getattr(self._gbdt, "train_set", None)
         if ts is not None:
             return list(ts.feature_names)
         return list(self._gbdt.feature_names)  # loaded model
 
+    @read_locked
     def feature_importance(self, importance_type: str = "split",
                            iteration: Optional[int] = None) -> np.ndarray:
         imp = self._gbdt.feature_importance(importance_type, iteration)
@@ -892,10 +937,12 @@ class Booster:
             (list(pre.models) if pre is not None else [])
         return models
 
+    @read_locked
     def lower_bound(self):
         return min((m.leaf_value.min() for m in self._all_leaf_values()),
                    default=0.0)
 
+    @read_locked
     def upper_bound(self):
         return max((m.leaf_value.max() for m in self._all_leaf_values()),
                    default=0.0)

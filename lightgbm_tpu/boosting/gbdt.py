@@ -37,6 +37,7 @@ from ..ops.predict import StackedTrees, predict_raw, route_one_tree
 from ..parallel.multihost import to_host as _to_host
 from ..ops.renew import renew_leaf_quantile
 from ..utils import log
+from ..utils.rwlock import Mutex
 from .sample_strategy import GOSSStrategy, create_sample_strategy
 
 _EPS = 1e-35
@@ -433,6 +434,15 @@ class GBDT:
         self.train_metrics: List[Metric] = []
         self.best_iteration = -1
         self._device_trees_cache: Optional[StackedTrees] = None
+        # serializes the pending-tree flush and the device-tree cache fill,
+        # so concurrent Booster.predict readers (basic.py read lock) never
+        # interleave _flush_trees' models/_dev_trees mutation; re-entrant
+        # because predict_raw_binned -> device_trees -> _flush_trees nests,
+        # and deepcopy-safe so users can still snapshot trained models
+        self._trees_mu = Mutex()
+        self._comm_hlo: Dict[str, str] = {}
+        self._comm_hlo_history: Dict[str, List[str]] = {}
+        self._comm_hlo_sigs: Dict[str, List[tuple]] = {}
         self._use_compact = False
         self._compact = None
         self.tree_learner = "serial"
@@ -821,7 +831,9 @@ class GBDT:
             if md.weight is not None else None)
         self._grad_fn = None
         self._step_fn = None
-        self._comm_hlo: Dict[str, str] = {}
+        self._comm_hlo = {}
+        self._comm_hlo_history = {}
+        self._comm_hlo_sigs = {}
 
     def _build_step_fn(self):
         """One fused, jitted train step per tree: mask gradients, grow, renew,
@@ -909,20 +921,41 @@ class GBDT:
         use_lazy = self._cegb_lazy is not None
         jitted = jax.jit(step)
         if os.environ.get("LGBM_TPU_COMM_ACCOUNTING", "") == "1":
-            outer = jitted
-
-            def capture(*args):
-                if "step" not in self._comm_hlo:
-                    self._comm_hlo["step"] = \
-                        outer.lower(*args).compile().as_text()
-                return outer(*args)
-            return capture
+            return self._comm_capture(jitted, "step")
         return jitted
 
-    # comm-volume accounting (dryrun_multichip): compiled-HLO text of the
-    # train-step programs, captured when LGBM_TPU_COMM_ACCOUNTING=1 so the
-    # dryrun can parse the collectives XLA actually inserted
+    # comm-volume accounting (dryrun_multichip) and the hlo_check contract
+    # gate: compiled-HLO text of the train-step programs, captured when
+    # LGBM_TPU_COMM_ACCOUNTING=1 so the collectives XLA actually inserted
+    # can be parsed back out (analysis/hlo.py)
     _comm_hlo: Dict[str, str]
+
+    def _comm_capture(self, jitted, key):
+        """Wrap a jitted step for LGBM_TPU_COMM_ACCOUNTING=1 runs.
+
+        Records the compiled HLO text under ``key`` on the first call and
+        re-lowers whenever the abstract argument signature changes, so
+        ``analysis/hlo_check.py`` can both verify the steady-state program
+        against its contract and prove it stable across iterations — a
+        recompile detector at the HLO level, not just the event counter
+        (``_comm_hlo_history[key]`` holds one text per distinct signature;
+        length 1 == the step never re-lowered)."""
+        key_of = key if callable(key) else (lambda kwargs: key)
+
+        def capture(*args, **kwargs):
+            k = key_of(kwargs)
+            sig = tuple(
+                (tuple(x.shape), str(x.dtype))
+                for x in jax.tree_util.tree_leaves((args, kwargs))
+                if hasattr(x, "shape"))
+            seen = self._comm_hlo_sigs.setdefault(k, [])
+            if sig not in seen:
+                seen.append(sig)
+                text = jitted.lower(*args, **kwargs).compile().as_text()
+                self._comm_hlo.setdefault(k, text)
+                self._comm_hlo_history.setdefault(k, []).append(text)
+            return jitted(*args, **kwargs)
+        return capture
 
     # -- compact (physically partitioned) serial path ------------------------
     def _setup_compact_state(self) -> None:
@@ -1333,8 +1366,14 @@ class GBDT:
             return tree, work, scratch, sc, cegb_used
 
         if mesh is None:
-            return jax.jit(step, donate_argnums=(0, 1),
-                           static_argnames=("k",))
+            jitted = jax.jit(step, donate_argnums=(0, 1),
+                             static_argnames=("k",))
+            if os.environ.get("LGBM_TPU_COMM_ACCOUNTING", "") == "1":
+                # same key scheme as the mesh dispatch below so hlo_check
+                # addresses the serial/compact step uniformly
+                return self._comm_capture(
+                    jitted, lambda kw: f"compact_step_k{kw.get('k', 0)}")
+            return jitted
 
         # data-parallel: the whole per-tree step runs per shard under
         # shard_map — shard-local partitions, psum-ed histograms inside
@@ -1370,12 +1409,12 @@ class GBDT:
 
         def dispatch(*args, k):
             if k not in fns:
-                fns[k] = jax.jit(
+                jitted = jax.jit(
                     smap(functools.partial(step, k=k), in_specs, out_specs),
                     donate_argnums=(0, 1))
                 if os.environ.get("LGBM_TPU_COMM_ACCOUNTING", "") == "1":
-                    self._comm_hlo[f"compact_step_k{k}"] = \
-                        fns[k].lower(*args).compile().as_text()
+                    jitted = self._comm_capture(jitted, f"compact_step_k{k}")
+                fns[k] = jitted
             return fns[k](*args)
 
         return dispatch
@@ -1892,12 +1931,20 @@ class GBDT:
 
     @property
     def num_total_trees(self) -> int:
-        return len(self.models) + len(self._dev_trees)
+        # under the trees mutex so a read-locked num_trees()/
+        # current_iteration() never observes a mid-flush torn count
+        # (a concurrent read-locked predict may be flushing)
+        with self._trees_mu:
+            return len(self.models) + len(self._dev_trees)
 
     def _flush_trees(self) -> bool:
         """Materialize pending device trees to host in one batched transfer;
         returns True if training should stop (an iteration produced no
         splittable leaf — reference: gbdt.cpp:440-450)."""
+        with self._trees_mu:
+            return self._flush_trees_locked()
+
+    def _flush_trees_locked(self) -> bool:
         if not self._dev_trees:
             return False
         k = self.num_tree_per_iteration
@@ -1912,24 +1959,31 @@ class GBDT:
             host_trees = jax.tree.map(_to_host, trees)
         else:
             host_trees = jax.device_get(trees)
-        self._dev_trees = []
+        # copy-on-write: mutate a private list and rebind once, so code
+        # reading self.models WITHOUT the trees mutex (model text dumps,
+        # leaf-value bounds) always sees a self-consistent list — either
+        # fully pre-flush or fully post-flush, never mid-append
+        models = list(self.models)
         for i, one in enumerate(host_trees):
             ht = HostTree(one, shrinkage=shrinks[i])
             if ht.num_nodes == 0:
                 ht.num_leaves = 1
-            self.models.append(ht)
+            models.append(ht)
         # stop if the last flushed iteration had no splits at all
         # (reference: gbdt.cpp:440-450 — the failed iteration's trees are
         # popped unless they are the very first, which stay as constant trees)
-        tail = self.models[-k:]
+        stop = False
+        tail = models[-k:]
         if len(tail) == k and all(m.num_nodes == 0 for m in tail):
-            if len(self.models) > k:
-                del self.models[-k:]
+            if len(models) > k:
+                models = models[:-k]
             self.iter_ -= 1
             log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")
-            return True
-        return False
+            stop = True
+        self.models = models
+        self._dev_trees = []
+        return stop
 
     def _renew_tree_output(self, tree: TreeArrays, row_leaf, mask,
                            cur_tree_id: int) -> TreeArrays:
@@ -2076,24 +2130,30 @@ class GBDT:
     # -- prediction ----------------------------------------------------------
     def device_trees(self, num_iteration: Optional[int] = None,
                      start_iteration: int = 0) -> StackedTrees:
-        self._flush_trees()
-        models = self.models
-        k = self.num_tree_per_iteration
-        if start_iteration > 0:
-            # (reference: start_iteration in GBDT::Predict* and Predictor)
-            models = models[start_iteration * k:]
-        if num_iteration is not None and num_iteration > 0:
-            models = models[: num_iteration * k]
-        if num_iteration is None and start_iteration == 0 \
-                and self._device_trees_cache is not None:
-            return self._device_trees_cache
-        # width from the models themselves: num_leaves may have been changed
-        # mid-training via reset_parameter
-        max_lv = max((len(m.leaf_value) for m in models), default=self.max_leaves)
-        st = stack_trees(models, max_lv - 1, max_lv)
-        if num_iteration is None and start_iteration == 0:
-            self._device_trees_cache = st
-        return st
+        # cache fill and model-list read run under the trees mutex so
+        # concurrent read-locked predicts (basic.py) see a consistent
+        # (models, cache) pair — the reference serializes the same window
+        # behind its shared C API lock (src/c_api.cpp:163)
+        with self._trees_mu:
+            self._flush_trees()
+            models = self.models
+            k = self.num_tree_per_iteration
+            if start_iteration > 0:
+                # (reference: start_iteration in GBDT::Predict* / Predictor)
+                models = models[start_iteration * k:]
+            if num_iteration is not None and num_iteration > 0:
+                models = models[: num_iteration * k]
+            if num_iteration is None and start_iteration == 0 \
+                    and self._device_trees_cache is not None:
+                return self._device_trees_cache
+            # width from the models themselves: num_leaves may have been
+            # changed mid-training via reset_parameter
+            max_lv = max((len(m.leaf_value) for m in models),
+                         default=self.max_leaves)
+            st = stack_trees(models, max_lv - 1, max_lv)
+            if num_iteration is None and start_iteration == 0:
+                self._device_trees_cache = st
+            return st
 
     def predict_raw_binned(self, binned: jax.Array,
                            num_iteration: Optional[int] = None,

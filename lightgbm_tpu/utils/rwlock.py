@@ -1,0 +1,225 @@
+"""Reader-writer lock for the public ``Booster``/``Dataset`` API.
+
+The reference guards every C API entry point with a yamc shared mutex
+(``API_BEGIN``/``UNIQUE_LOCK``, src/c_api.cpp:163): many concurrent
+predictions, exclusive training/mutation. This repo has no C boundary —
+the Python ``Booster`` drives the JAX GBDT directly — so the same
+discipline lives here: public methods are decorated ``@read_locked`` or
+``@write_locked`` against the instance's ``_api_lock`` (tpulint R007
+statically enforces that no public method of a lock-declaring class
+skips the decorator, and that mutating methods take the write side).
+
+Semantics:
+  * many concurrent readers, one exclusive writer, writer preference
+    (a waiting writer blocks new readers, so a predict storm cannot
+    starve training);
+  * re-entrant per thread: read-inside-read, anything-inside-write, and
+    write-inside-write all nest freely (``save_model`` calls
+    ``model_to_string``; ``update`` may flush through other write
+    methods);
+  * read→write upgrade raises ``RuntimeError`` instead of deadlocking —
+    a public read method must not call a public write method.
+
+The decorators report every entry/exit to an optional *sanitizer*
+(:func:`set_sanitizer`, armed by
+``lightgbm_tpu.analysis.guards.api_race_sanitizer``) AFTER acquiring the
+lock, so a correctly locked program shows zero overlap while a bypassed
+or missing lock shows up as a detected race — the runtime half of R007.
+No jax import here: the lock is plain threading and loads anywhere.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Optional
+
+#: armed by guards.api_race_sanitizer(); must expose enter()/exit_()
+_sanitizer = None
+
+
+def set_sanitizer(san) -> None:
+    global _sanitizer
+    _sanitizer = san
+
+
+def get_sanitizer():
+    return _sanitizer
+
+
+class RWLock:
+    """Re-entrant reader-writer lock with writer preference.
+
+    Copies and pickles as a FRESH lock: hold state is meaningless in a
+    copy, and a raw ``threading.Condition`` in ``Booster``/``Dataset``
+    would otherwise break ``copy.deepcopy`` of trained models.
+    """
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0                     # active read holds (threads)
+        self._writer: Optional[int] = None    # thread id holding write
+        self._writer_depth = 0
+        self._waiting_writers = 0
+        self._local = threading.local()       # per-thread read depth
+
+    def __deepcopy__(self, memo):
+        return type(self)()
+
+    def __reduce__(self):
+        return (type(self), ())
+
+    # -- per-thread state ---------------------------------------------------
+    def _read_depth(self) -> int:
+        return getattr(self._local, "depth", 0)
+
+    def _set_read_depth(self, n: int) -> None:
+        self._local.depth = n
+
+    # -- read side ----------------------------------------------------------
+    def acquire_read(self) -> None:
+        me = threading.get_ident()
+        with self._cond:
+            if self._writer == me or self._read_depth() > 0:
+                # nested read under our own write or read: free (already
+                # counted in _readers when the outer read registered)
+                self._set_read_depth(self._read_depth() + 1)
+                return
+            while self._writer is not None or self._waiting_writers:
+                self._cond.wait()
+            self._readers += 1
+            self._set_read_depth(1)
+
+    def release_read(self) -> None:
+        me = threading.get_ident()
+        with self._cond:
+            depth = self._read_depth()
+            if depth <= 0:
+                raise RuntimeError("release_read without acquire_read")
+            self._set_read_depth(depth - 1)
+            if self._writer == me:
+                return                        # read nested under our write
+            if depth == 1:
+                self._readers -= 1
+                if self._readers == 0:
+                    self._cond.notify_all()
+
+    # -- write side ---------------------------------------------------------
+    def acquire_write(self) -> None:
+        me = threading.get_ident()
+        with self._cond:
+            if self._writer == me:
+                self._writer_depth += 1
+                return
+            if self._read_depth() > 0:
+                raise RuntimeError(
+                    "read->write lock upgrade: a public read-locked method "
+                    "called a write-locked one; make the caller write_locked")
+            self._waiting_writers += 1
+            try:
+                while self._writer is not None or self._readers:
+                    self._cond.wait()
+            finally:
+                self._waiting_writers -= 1
+            self._writer = me
+            self._writer_depth = 1
+
+    def release_write(self) -> None:
+        with self._cond:
+            if self._writer != threading.get_ident():
+                raise RuntimeError("release_write by a non-holder")
+            if self._writer_depth == 1 and self._read_depth() > 0:
+                # reads nested under this write never bumped _readers;
+                # dropping the write first would make the later
+                # release_read underflow the count and wedge every
+                # future writer — fail loudly instead
+                raise RuntimeError(
+                    "release_write while reads acquired under the write "
+                    "are still held — release order must be LIFO")
+            self._writer_depth -= 1
+            if self._writer_depth == 0:
+                self._writer = None
+                self._cond.notify_all()
+
+    # -- context-manager views ---------------------------------------------
+    def read(self) -> "_Side":
+        return _Side(self.acquire_read, self.release_read)
+
+    def write(self) -> "_Side":
+        return _Side(self.acquire_write, self.release_write)
+
+
+class _Side:
+    def __init__(self, acquire, release):
+        self._acquire = acquire
+        self._release = release
+
+    def __enter__(self):
+        self._acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._release()
+        return False
+
+
+class NullLock:
+    """Lock-shaped no-op — the seeded R007 bypass mutation for the
+    sanitizer tests (swap a Booster's ``_api_lock`` for this and the
+    detector must light up). Never used in shipped code paths."""
+
+    def read(self):
+        return _Side(lambda: None, lambda: None)
+
+    def write(self):
+        return _Side(lambda: None, lambda: None)
+
+
+class Mutex:
+    """Re-entrant mutex (``with mutex:``) that deep-copies/pickles as a
+    fresh lock — for internal serialization members (``GBDT._trees_mu``)
+    living on objects users may ``copy.deepcopy``."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def __enter__(self):
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._lock.release()
+        return False
+
+    def __deepcopy__(self, memo):
+        return type(self)()
+
+    def __reduce__(self):
+        return (type(self), ())
+
+
+def _locked(kind: str, method):
+    @functools.wraps(method)
+    def wrapper(self, *args, **kwargs):
+        lock = self._api_lock
+        side = lock.read() if kind == "read" else lock.write()
+        with side:
+            san = _sanitizer
+            if san is None:
+                return method(self, *args, **kwargs)
+            token = san.enter(self, kind, method.__name__)
+            try:
+                return method(self, *args, **kwargs)
+            finally:
+                san.exit_(token)
+    wrapper.__lock_kind__ = kind
+    return wrapper
+
+
+def read_locked(method):
+    """Shared-lock a public API method (concurrent with other readers)."""
+    return _locked("read", method)
+
+
+def write_locked(method):
+    """Exclusively lock a public API method that mutates shared state."""
+    return _locked("write", method)

@@ -1,0 +1,27 @@
+#!/usr/bin/env python3
+"""Regenerate/verify the learner-mode HLO contracts from a CPU lowering.
+
+    scripts/verify_contracts.py            # lower all modes, diff against
+                                           #   analysis/contracts/*.json
+                                           #   (exit 1 on drift or violation)
+    scripts/verify_contracts.py --update   # rewrite the contract files
+
+Update workflow: when a comm-protocol or dtype change is INTENDED, rerun
+with ``--update``, review the JSON diff (it is the machine-checked form
+of the README's comm/dtype/residency claims), and commit it with the
+change. Tier-1 (tests/test_hlo_check.py) runs the no-update path, so a
+silent comm-shape drift — a new collective, a budget blowout, a dropped
+``preferred_element_type`` — fails the suite with an actionable finding.
+
+Exec-delegates to ``scripts/tpulint hlo`` (the single place that sets the
+CPU-backend env BEFORE jax imports); kept as its own script so CI and
+humans have an obvious name for the contract-regeneration step.
+"""
+import os
+import sys
+
+if __name__ == "__main__":
+    tpulint = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "tpulint")
+    os.execv(sys.executable,
+             [sys.executable, tpulint, "hlo"] + sys.argv[1:])

@@ -1,0 +1,257 @@
+"""Concurrent Booster API: the rwlock keeps 16 predict threads and
+interleaved updates consistent, and the R007 runtime sanitizer catches a
+seeded lock-bypass mutation in detector mode.
+
+The reference serializes the same surface behind its C API shared mutex
+(src/c_api.cpp:163, yamc shared lock: concurrent predicts, exclusive
+update); utils/rwlock.py + the @read_locked/@write_locked decorators in
+basic.py are this repo's equivalent, and analysis/guards.api_race_sanitizer
+is the detector that proves the lock is actually doing the work.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.analysis import guards
+from lightgbm_tpu.utils.rwlock import NullLock, RWLock
+
+from utils import FAST_PARAMS, binary_data
+
+N_THREADS = 16
+
+
+def _train(num_boost_round=10, **kw):
+    X, y = binary_data()
+    params = dict(FAST_PARAMS, objective="binary", **kw)
+    return lgb.train(params, lgb.Dataset(X, label=y), num_boost_round), X
+
+
+# --------------------------------------------------------------- rwlock
+class TestRWLock:
+    def test_concurrent_readers_exclusive_writer(self):
+        lock = RWLock()
+        state = {"readers": 0, "max_readers": 0, "writer_saw_readers": False}
+        mu = threading.Lock()
+        stop = threading.Event()
+
+        def reader():
+            while not stop.is_set():
+                with lock.read():
+                    with mu:
+                        state["readers"] += 1
+                        state["max_readers"] = max(state["max_readers"],
+                                                   state["readers"])
+                    # dwell inside the read section so reader overlap is
+                    # actually observable (a bare inc/dec window loses to
+                    # the GIL switch interval and flakes)
+                    time.sleep(0.001)
+                    with mu:
+                        state["readers"] -= 1
+
+        rs = [threading.Thread(target=reader) for _ in range(4)]
+        for t in rs:
+            t.start()
+        # phase 1: readers only — they must genuinely overlap
+        deadline = time.monotonic() + 5.0
+        while state["max_readers"] < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+
+        # phase 2: a writer must never observe an active reader
+        def writer():
+            for _ in range(50):
+                with lock.write():
+                    if state["readers"]:
+                        state["writer_saw_readers"] = True
+
+        w = threading.Thread(target=writer)
+        w.start()
+        w.join()
+        stop.set()
+        for t in rs:
+            t.join()
+        assert not state["writer_saw_readers"]
+        assert state["max_readers"] >= 2   # readers really were concurrent
+
+    def test_reentrant_nesting(self):
+        lock = RWLock()
+        with lock.read(), lock.read():
+            pass
+        with lock.write(), lock.write(), lock.read():
+            pass
+
+    def test_read_to_write_upgrade_raises(self):
+        lock = RWLock()
+        with lock.read():
+            with pytest.raises(RuntimeError, match="upgrade"):
+                lock.acquire_write()
+
+    def test_non_lifo_release_raises(self):
+        """Dropping the write while a nested read is still held would
+        underflow the reader count and wedge all future writers — it must
+        fail loudly instead."""
+        lock = RWLock()
+        lock.acquire_write()
+        lock.acquire_read()
+        with pytest.raises(RuntimeError, match="LIFO"):
+            lock.release_write()
+        lock.release_read()
+        lock.release_write()        # LIFO order releases cleanly
+        with lock.write():          # and the lock is still serviceable
+            pass
+
+
+# ----------------------------------------------------- predict vs update
+def test_concurrent_predict_with_interleaved_update():
+    """16 threads hammer predict while the main thread keeps boosting.
+    Every concurrent prediction must exactly match the serial prediction
+    of SOME tree-count snapshot — a torn read (cache from one model
+    state, trees from another) matches none of them."""
+    bst, X = _train(10)
+    extra = 6
+    # serial reference predictions for every reachable snapshot
+    snapshots = [bst.predict(X)]
+
+    results, errors = [], []
+    started = threading.Barrier(N_THREADS + 1)
+
+    def hammer():
+        try:
+            started.wait()
+            for _ in range(4):
+                results.append(bst.predict(X))
+        except Exception as err:  # pragma: no cover - the failure path
+            errors.append(err)
+
+    threads = [threading.Thread(target=hammer) for _ in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    started.wait()
+    for _ in range(extra):
+        bst.update()
+        snapshots.append(bst.predict(X))
+    for t in threads:
+        t.join()
+
+    assert not errors, errors
+    assert len(results) == N_THREADS * 4
+    for p in results:
+        assert p.shape == snapshots[0].shape
+        assert np.isfinite(p).all()
+        assert any(np.allclose(p, s, atol=1e-6) for s in snapshots), \
+            "a concurrent prediction matches no consistent model snapshot"
+    assert bst.num_trees() == 16
+
+
+def test_concurrent_predict_matches_serial_exactly():
+    bst, X = _train(8)
+    want = bst.predict(X)
+    got, errors = [], []
+
+    def hammer():
+        try:
+            for _ in range(3):
+                got.append(bst.predict(X))
+        except Exception as err:  # pragma: no cover
+            errors.append(err)
+
+    threads = [threading.Thread(target=hammer) for _ in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for p in got:
+        np.testing.assert_allclose(p, want, rtol=0, atol=0)
+
+
+def test_deepcopy_of_trained_booster_still_works():
+    """The locks must not break model snapshotting: RWLock/Mutex
+    deep-copy as fresh locks (hold state is meaningless in a copy)."""
+    import copy
+    bst, X = _train(5)
+    snap = copy.deepcopy(bst)
+    np.testing.assert_allclose(snap.predict(X), bst.predict(X))
+    bst.update()
+    assert bst.num_trees() == 6
+    assert snap.num_trees() == 5        # the snapshot is independent
+    ds = lgb.Dataset(X, label=np.zeros(len(X)))
+    assert copy.deepcopy(ds) is not ds
+
+
+# ------------------------------------------------------------- sanitizer
+def test_sanitizer_quiet_under_real_lock():
+    bst, X = _train(5)
+    with guards.api_race_sanitizer() as san:
+        threads = [threading.Thread(
+            target=lambda: [bst.predict(X) for _ in range(3)])
+            for _ in range(6)]
+        up = threading.Thread(target=lambda: [bst.update()
+                                              for _ in range(3)])
+        for t in threads:
+            t.start()
+        up.start()
+        for t in threads:
+            t.join()
+        up.join()
+    san.assert_no_races("locked concurrent predict/update")
+    assert san.races == []
+
+
+def test_sanitizer_catches_seeded_lock_bypass():
+    """The seeded R007 mutation: swap the Booster's rwlock for a no-op
+    and the detector must observe writer/reader overlap."""
+    bst, X = _train(5)
+    bst._api_lock = NullLock()          # the seeded bypass
+    detected = False
+    for _ in range(3):                  # overlap is stochastic; retry
+        with guards.api_race_sanitizer() as san:
+            threads = [threading.Thread(
+                target=lambda: [bst.predict(X) for _ in range(6)])
+                for _ in range(8)]
+            up = threading.Thread(
+                target=lambda: [bst.update() for _ in range(6)])
+            for t in threads:
+                t.start()
+            up.start()
+            for t in threads:
+                t.join()
+            up.join()
+        if san.races:
+            detected = True
+            break
+    assert detected, "sanitizer missed the unlocked predict/update overlap"
+    with pytest.raises(guards.ApiRaceError, match="unsynchronized"):
+        san.assert_no_races()
+
+
+def test_sanitizer_raise_on_race_leaves_no_phantom_hold():
+    """A raising enter() must not register a hold — otherwise every later
+    (correctly serialized) access is indicted against a dead entry."""
+    san = guards.ApiRaceSanitizer(raise_on_race=True)
+    obj = object()
+    tok = {}
+    t = threading.Thread(
+        target=lambda: tok.setdefault("w", san.enter(obj, "write", "update")))
+    t.start()
+    t.join()
+    with pytest.raises(guards.ApiRaceError):
+        san.enter(obj, "read", "predict")   # overlaps the writer's hold
+    san.exit_(tok["w"])
+    token = san.enter(obj, "write", "update")   # must be clean now
+    san.exit_(token)
+    assert len(san.races) == 1
+
+
+def test_sanitizer_ignores_same_thread_nesting():
+    """save_model -> model_to_string nests read-in-read on one thread;
+    not a race."""
+    bst, X = _train(3)
+    with guards.api_race_sanitizer() as san:
+        bst.predict(X)
+        s = bst.model_to_string()
+        bst.update()
+        assert len(s) > 0
+    assert san.races == []

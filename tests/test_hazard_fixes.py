@@ -47,6 +47,22 @@ def test_collective_permute_start_counts_result_shape():
     assert out["collective-permute-start"] == 64 * 4
 
 
+def test_reduce_scatter_start_counts_result_shape():
+    """The psum_scatter path gone async (R005 extension seed): the
+    reduce-scatter result is operand/num_devices — counting the operand
+    would over-report 8x, and missing the kind entirely (the pre-fix
+    inventory) reports 0."""
+    hlo = ("%rs = (f32[64,8]{1,0}, f32[8,8]{1,0}) "
+           "reduce-scatter-start(f32[64,8]{1,0} %x)\n"
+           "%rsd = f32[8,8]{1,0} reduce-scatter-done("
+           "(f32[64,8]{1,0}, f32[8,8]{1,0}) %rs)\n"
+           "%aa = (f32[16]{0}, f32[16]{0}) all-to-all-start(f32[16]{0} %y)")
+    out = collective_bytes(hlo)
+    assert out["reduce-scatter-start"] == 8 * 8 * 4   # result, not operand
+    assert out["all-to-all-start"] == 16 * 4
+    assert out["count"] == 2                          # -done carries nothing
+
+
 # ------------------------------------------- ADVICE #2: fused pad contract
 def test_fused_split_raises_on_short_pad():
     layout = RowLayout(num_features=10, num_extra=2)

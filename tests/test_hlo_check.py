@@ -1,0 +1,158 @@
+"""HLO contract gate: the four learner-mode step programs verify against
+their checked-in contracts (analysis/contracts/*.json), deliberately
+broken contracts produce failing actionable findings, and the regenerated
+measurement matches the checked-in files (no silent comm-shape drift).
+
+This IS the tier-1 wiring of the hlo_check tentpole: it runs on the CPU
+backend (lowered-HLO text, no TPU required) against the same 8-device
+virtual mesh the distributed tests use.
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.analysis import hlo, hlo_check
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual CPU mesh")
+
+
+@pytest.fixture(scope="module")
+def captured():
+    """Lower every mode's steady-state step program once for the module."""
+    return {mode: hlo_check.capture_mode(mode) for mode in hlo_check.MODES}
+
+
+# ------------------------------------------------------------------ gate
+def test_all_contracts_verify_clean(captured):
+    for mode in hlo_check.MODES:
+        contract = hlo_check.load_contract(mode)
+        findings = hlo_check.verify_mode(mode, contract, captured[mode])
+        assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_no_contract_drift(captured):
+    """Regenerating from the live lowering must match the checked-in
+    files byte for byte — comm-shape changes are a reviewed --update,
+    never an accident."""
+    for mode in hlo_check.MODES:
+        fresh = hlo_check.build_contract(mode, captured[mode])
+        assert fresh == hlo_check.load_contract(mode), (
+            f"contract drift in '{mode}': rerun "
+            "scripts/verify_contracts.py --update and review the diff")
+
+
+def test_fingerprints_stable_across_iterations(captured):
+    """The steady-state step lowered exactly once over 4 boosting
+    iterations (recompile detection at the HLO level)."""
+    for mode, cap in captured.items():
+        assert len(cap.history) == 1, (
+            f"{mode}: step re-lowered {len(cap.history)}x; fingerprints "
+            f"{[hlo.fingerprint(t) for t in cap.history]}")
+
+
+def test_data_scatter_program_contains_reduce_scatter(captured):
+    acct = hlo.collective_bytes(captured["data_scatter"].hlo_text)
+    assert acct["reduce-scatter"] > 0
+    # the best-split sync is tiny next to the histogram exchange
+    assert acct["all-reduce"] < acct["reduce-scatter"]
+
+
+# -------------------------------------------------- broken contracts fail
+def test_forcing_allreduce_with_scatter_contract_fails():
+    """The acceptance case: lower the data-parallel step with the
+    reduce-scatter reduction disabled and check it against the
+    data_scatter contract — must fail with actionable findings."""
+    t = dict(hlo_check.MODE_TEMPLATES["data_scatter"])
+    t["params"] = dict(t["params"], tpu_hist_scatter="off")
+    cap = hlo_check.capture_mode("data_scatter", template=t)
+    contract = hlo_check.load_contract("data_scatter")
+    findings = hlo_check.check_hlo(cap.hlo_text, contract)
+    msgs = "\n".join(f.render() for f in findings)
+    assert any(f.check == "collectives" and "reduce-scatter" in f.message
+               and "missing" in f.message for f in findings), msgs
+    assert any(f.check == "collectives" and "budget" in f.message
+               for f in findings), msgs
+
+
+def test_dropped_preferred_element_type_fails():
+    """An int8 histogram contraction without preferred_element_type=int32
+    keeps a narrow accumulator in the compiled text — the int-dot check
+    must produce a failing finding; the correct form stays clean."""
+    a = jnp.ones((8, 16), jnp.int8)
+    b = jnp.ones((16, 8), jnp.int8)
+
+    def bad(x, y):
+        return jnp.einsum("ij,jk->ik", x, y)
+
+    def good(x, y):
+        return jnp.einsum("ij,jk->ik", x, y,
+                          preferred_element_type=jnp.int32)
+
+    contract = hlo_check.load_contract("quant_int8")
+    bad_txt = jax.jit(bad).lower(a, b).compile().as_text()
+    findings = hlo_check.check_int_dots(bad_txt, contract)
+    assert findings and "preferred_element_type" in findings[0].message
+    good_txt = jax.jit(good).lower(a, b).compile().as_text()
+    assert not [f for f in hlo_check.check_int_dots(good_txt, contract)
+                if "wraps" in f.message]
+
+
+def test_quant_contract_requires_live_integer_dot():
+    """A quant program that silently fell back to f32 histograms fails
+    require_integer_dot."""
+    contract = hlo_check.load_contract("quant_int8")
+    f32_txt = jax.jit(
+        lambda x, y: jnp.einsum("ij,jk->ik", x, y)).lower(
+            jnp.ones((8, 16), jnp.float32),
+            jnp.ones((16, 8), jnp.float32)).compile().as_text()
+    findings = hlo_check.check_int_dots(f32_txt, contract)
+    assert any("not live" in f.message for f in findings)
+
+
+def test_host_op_in_step_fails():
+    """infeed/outfeed/callback custom-calls violate the 0-d2h contract."""
+    contract = {"mode": "synthetic", "forbid_host_ops": True}
+    hlo_text = """
+ENTRY %main {
+  %p = f32[8]{0} parameter(0)
+  %o = token[] outfeed(f32[8]{0} %p, token[] %tok)
+  ROOT %cc = f32[8]{0} custom-call(f32[8]{0} %p), custom_call_target="xla_ffi_python_cpu_callback"
+}
+"""
+    findings = hlo_check.check_host_ops(hlo_text, contract)
+    assert len(findings) == 2, findings
+    assert any("outfeed" in f.message for f in findings)
+    assert any("callback" in f.message for f in findings)
+
+
+def test_fingerprint_check_flags_relowering():
+    contract = {"mode": "synthetic", "stable_fingerprint": True}
+    t1 = "ENTRY %main { ROOT %a = f32[8]{0} parameter(0) }"
+    t2 = "ENTRY %main { ROOT %a = f32[16]{0} parameter(0) }"
+    assert not hlo_check.check_fingerprint([t1], contract)
+    findings = hlo_check.check_fingerprint([t1, t2], contract)
+    assert findings and "CHANGED" in findings[0].message
+    same = hlo_check.check_fingerprint([t1, t1], contract)
+    assert same and "re-lowered" in same[0].message
+
+
+# ------------------------------------------------------------ parser unit
+def test_parser_reads_async_tuple_result_shapes():
+    txt = """
+ENTRY %e {
+  %ag = (f32[8,64]{1,0}, f32[64,64]{1,0}) all-gather-start(f32[8,64]{1,0} %p), dimensions={0}
+  %rs = (f32[64,64]{1,0}, f32[8,64]{1,0}) reduce-scatter-start(f32[64,64]{1,0} %x), dimensions={0}
+}
+"""
+    acct = hlo.collective_bytes(txt)
+    assert acct["all-gather-start"] == 64 * 64 * 4      # result, not operand
+    assert acct["reduce-scatter-start"] == 8 * 64 * 4   # result, not operand
+
+
+def test_canonicalize_strips_naming_noise():
+    a = "%dot.3 = s32[8]{0} dot(s32[8]{0} %x.1), metadata={op_name=\"m\"}"
+    b = "%dot.9 = s32[8]{0} dot(s32[8]{0} %x.2)"
+    assert hlo.fingerprint(a) == hlo.fingerprint(b)
+    c = "%dot.9 = s8[8]{0} dot(s8[8]{0} %x.2)"
+    assert hlo.fingerprint(a) != hlo.fingerprint(c)

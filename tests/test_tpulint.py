@@ -10,6 +10,7 @@ import textwrap
 
 import lightgbm_tpu
 from lightgbm_tpu.analysis.tpulint import (DEFAULT_ALLOWLIST, apply_allowlist,
+                                           check_allowlist_staleness,
                                            lint_paths, load_allowlist, main)
 
 PKG_DIR = os.path.dirname(lightgbm_tpu.__file__)
@@ -385,6 +386,216 @@ def test_r005_fixed_module_clean():
         [f.render() for f in findings]
 
 
+# ------------------------------------------------------- R005 extensions
+def test_r005_inventory_missing_async_twin(tmp_path):
+    """PR 2's psum_scatter lowers to reduce-scatter; an inventory with
+    -start twins for other kinds but not reduce-scatter drops its bytes
+    the day the HLO goes async."""
+    findings = lint_snippet(tmp_path, """
+        KINDS = ("all-reduce-start", "all-gather-start", "reduce-scatter",
+                 "all-reduce", "all-gather")
+    """)
+    r5 = [f for f in findings if f.rule == "R005"]
+    assert len(r5) == 1 and "reduce-scatter-start" in r5[0].message
+
+
+def test_r005_inventory_with_twins_clean(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        KINDS = ("all-reduce-start", "all-gather-start",
+                 "reduce-scatter-start", "all-reduce", "all-gather",
+                 "reduce-scatter")
+    """)
+    assert not findings
+
+
+def test_r005_done_counting_flagged(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        def count(entries):
+            total = 0
+            for kind, nbytes in entries:
+                if kind.endswith("-start"):
+                    total += nbytes
+                if kind.endswith("-done"):
+                    total += nbytes
+            return total
+    """)
+    assert any(f.rule == "R005" and "-done" in f.message for f in findings)
+
+
+def test_r005_fixed_parser_module_clean():
+    """analysis/hlo.py (the extracted parser) carries every async twin and
+    counts result shapes — no R005 findings."""
+    path = os.path.join(PKG_DIR, "analysis", "hlo.py")
+    findings, errors = lint_paths([path])
+    assert not errors
+    assert not [f for f in findings if f.rule == "R005"], \
+        [f.render() for f in findings]
+
+
+# ---------------------------------------------------------------- R006
+def test_r006_unknown_axis_name(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        from jax import lax
+        from jax.sharding import Mesh
+
+        def make(devs):
+            return Mesh(devs, axis_names=("data",))
+
+        def step(x):
+            return lax.psum_scatter(x, "dta")
+    """)
+    r6 = [f for f in findings if f.rule == "R006"]
+    assert len(r6) == 1 and "'dta'" in r6[0].message
+
+
+def test_r006_dimension_kwarg_does_not_mask_axis_name(tmp_path):
+    """all_gather's `axis=` kwarg is an integer DIMENSION — it must not
+    swallow a typo'd positional axis name."""
+    findings = lint_snippet(tmp_path, """
+        from jax import lax
+        from jax.sharding import Mesh
+
+        def make(devs):
+            return Mesh(devs, axis_names=("data",))
+
+        def step(x):
+            return lax.all_gather(x, "dta", axis=0, tiled=True)
+    """)
+    r6 = [f for f in findings if f.rule == "R006"]
+    assert len(r6) == 1 and "'dta'" in r6[0].message
+
+
+def test_r006_declared_axis_and_dynamic_clean(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        from jax import lax
+        from jax.sharding import Mesh
+
+        DATA_AXIS = "data"
+
+        def make(devs):
+            return Mesh(devs, axis_names=(DATA_AXIS,))
+
+        def step(x, gp):
+            a = lax.psum(x, DATA_AXIS)
+            b = lax.psum(x, gp.axis_name)      # dynamic: skipped
+            return a + b + lax.axis_index(DATA_AXIS)
+    """)
+    assert not [f for f in findings if f.rule == "R006"]
+
+
+def test_r006_sharded_readback_without_gather(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import jax
+        import numpy as np
+
+        def bad(x, mesh, row_sharding):
+            v = jax.device_put(x, row_sharding(mesh))
+            return np.asarray(v)
+
+        def gathered(x, mesh, row_sharding):
+            v = jax.device_put(x, row_sharding(mesh))
+            v = jax.device_get(v)
+            return np.asarray(v)
+
+        def replicated_ok(x, mesh, replicated):
+            v = jax.device_put(x, replicated(mesh))
+            return np.asarray(v)
+
+        def named_replicated_ok(x, mesh):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            v = jax.device_put(x, NamedSharding(mesh, P()))
+            return np.asarray(v)
+
+        def named_sharded_bad(x, mesh):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            v = jax.device_put(x, NamedSharding(mesh, P("data")))
+            return np.asarray(v)
+    """)
+    r6 = [f for f in findings if f.rule == "R006"]
+    assert sorted(f.func for f in r6) == ["bad", "named_sharded_bad"]
+
+
+# ---------------------------------------------------------------- R007
+def test_r007_unlocked_public_method(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        class Booster:
+            def __init__(self):
+                self._api_lock = RWLock()
+                self.cache = None
+
+            def predict(self, x):
+                return x
+    """)
+    r7 = [f for f in findings if f.rule == "R007"]
+    assert len(r7) == 1 and "predict" in r7[0].message
+
+
+def test_r007_mutation_under_read_lock(tmp_path):
+    """The _device_trees_cache pattern: a cache fill in a read-locked
+    method interleaves with concurrent readers."""
+    findings = lint_snippet(tmp_path, """
+        class Booster:
+            def __init__(self):
+                self._api_lock = RWLock()
+                self.cache = None
+
+            @read_locked
+            def predict(self, x):
+                self.cache = x
+                return x
+
+            @write_locked
+            def update(self):
+                self.cache = None
+    """)
+    r7 = [f for f in findings if f.rule == "R007"]
+    assert len(r7) == 1 and "READ lock" in r7[0].message
+
+
+def test_r007_lockless_shared_class_flagged(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        class Dataset:
+            def __init__(self):
+                self.data = None
+
+            def construct(self):
+                self.data = 1
+    """)
+    r7 = [f for f in findings if f.rule == "R007"]
+    assert len(r7) == 1 and "_api_lock" in r7[0].message
+
+
+def test_r007_properly_locked_clean(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        class Dataset:
+            def __init__(self):
+                self._api_lock = RWLock()
+                self._inner = None
+
+            @write_locked
+            def construct(self):
+                self._inner = 1
+                return self
+
+            @read_locked
+            def num_data(self):
+                return 0
+
+            def _internal(self):
+                self._inner = None     # private: caller holds the lock
+    """)
+    assert not [f for f in findings if f.rule == "R007"]
+
+
+def test_r007_shipped_api_is_locked():
+    """basic.py itself: every public Booster/Dataset method decorated."""
+    path = os.path.join(PKG_DIR, "basic.py")
+    findings, errors = lint_paths([path])
+    assert not errors
+    assert not [f for f in findings if f.rule == "R007"], \
+        [f.render() for f in findings]
+
+
 # ------------------------------------------------------------ allowlist
 def test_allowlist_suppresses_and_tracks_usage(tmp_path):
     snippet = tmp_path / "mod.py"
@@ -422,3 +633,71 @@ def test_allowlist_cli_errors_exit_2(tmp_path):
     allow = tmp_path / "allow.txt"
     allow.write_text("R001 mod.py::step\n")
     assert main([str(snippet), "--allowlist", str(allow)]) == 2
+
+
+# ------------------------------------------------- allowlist staleness
+def test_check_allow_flags_dead_anchor(tmp_path):
+    """Entries whose file::func anchor no longer matches the source are
+    staleness errors — the allowlist cannot rot as code moves."""
+    mod = tmp_path / "mod.py"
+    mod.write_text("def live():\n    return 1\n")
+    entries, errors = load_allowlist_text(
+        tmp_path,
+        "R001 mod.py::live  # still anchored\n"
+        "R001 mod.py::dead_func  # function was deleted\n"
+        "R002 gone.py::anything  # file was deleted\n")
+    assert not errors
+    stale = check_allowlist_staleness(entries, [str(tmp_path)])
+    assert len(stale) == 2
+    assert any("dead_func" in s for s in stale)
+    assert any("gone.py" in s for s in stale)
+    # wildcard funcs only need the file to exist
+    entries2, _ = load_allowlist_text(tmp_path, "R003 mod.py::*  # module\n")
+    assert not check_allowlist_staleness(entries2, [str(tmp_path)])
+
+
+def load_allowlist_text(tmp_path, text):
+    allow = tmp_path / "allow_stale.txt"
+    allow.write_text(text)
+    return load_allowlist(str(allow))
+
+
+def test_check_allow_subset_lint_does_not_false_flag(tmp_path):
+    """Linting a subtree must not report entries anchored elsewhere in
+    the allowlist's package as stale — anchors resolve against the
+    allowlist's own root too."""
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    (tmp_path / "a" / "mod.py").write_text("def live():\n    return 1\n")
+    (tmp_path / "b" / "other.py").write_text("x = 1\n")
+    allow = tmp_path / "allow.txt"
+    allow.write_text("R001 a/mod.py::live  # anchored outside the subset\n")
+    entries, _ = load_allowlist(str(allow))
+    assert not check_allowlist_staleness(
+        entries, [str(tmp_path / "b")], str(allow))
+    # a genuinely dead anchor is still stale in the subset run
+    allow.write_text("R001 a/mod.py::dead  # function deleted\n")
+    entries, _ = load_allowlist(str(allow))
+    assert check_allowlist_staleness(
+        entries, [str(tmp_path / "b")], str(allow))
+
+
+def test_check_allow_cli_exit_2(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text("x = 1\n")
+    allow = tmp_path / "allow.txt"
+    allow.write_text("R001 mod.py::deleted_fn  # anchor died\n")
+    assert main([str(tmp_path), "--allowlist", str(allow),
+                 "--check-allow"]) == 2
+    # without the flag the entry is only an unused-entry warning
+    assert main([str(tmp_path), "--allowlist", str(allow)]) == 0
+    # an audit run (--no-allowlist) must still validate the anchors
+    assert main([str(tmp_path), "--allowlist", str(allow),
+                 "--no-allowlist", "--check-allow"]) == 2
+
+
+def test_package_allowlist_staleness_clean():
+    """Tier-1 wiring: the shipped allowlist has no stale anchors."""
+    entries, errors = load_allowlist(DEFAULT_ALLOWLIST)
+    assert not errors
+    assert not check_allowlist_staleness(entries, [PKG_DIR])
