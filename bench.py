@@ -19,7 +19,29 @@ import time
 
 import numpy as np
 
-ROWS = int(float(os.environ.get("BENCH_ROWS", 10_500_000)))
+def _cli_override(flag, default):
+    """``--rows 5e5``-style CLI overrides (the env knobs predate them).
+    The row override exists so scaled-down runs are explicit in the
+    command line AND normalized: every recorded shape now carries a
+    rows/s column, so a 500k-row Allstate number is never quoted next to
+    the reference's full 13.2M-row wall without a per-row figure.
+
+    Runs at import time (bench.py is also imported for its dataset
+    makers), so a missing or unparseable value must not crash the host
+    process — it warns and keeps the default."""
+    if flag not in sys.argv:
+        return default
+    idx = sys.argv.index(flag)
+    try:
+        return int(float(sys.argv[idx + 1]))
+    except (IndexError, ValueError):
+        sys.stderr.write(f"[bench] ignoring {flag}: expected a numeric "
+                         "value after the flag\n")
+        return default
+
+
+ROWS = _cli_override("--rows", int(float(os.environ.get("BENCH_ROWS",
+                                                        10_500_000))))
 FEATURES = int(os.environ.get("BENCH_FEATURES", 28))
 NUM_LEAVES = int(os.environ.get("BENCH_NUM_LEAVES", 255))
 MAX_BIN = int(os.environ.get("BENCH_MAX_BIN", 255))
@@ -181,11 +203,30 @@ def run_hist_microbench(print_json=True):
         f"[bench-hist] platform={dev.platform} shape=[{n}, {f}] B={b} "
         f"f32-HIGHEST={t_f32 * 1e3:.2f}ms int8={t_int * 1e3:.2f}ms "
         f"speedup={speedup:.2f}x\n")
+
+    # batched-M sweep (tpu_hist_mbatch): K row blocks per one-hot
+    # contraction -> M = 8K MXU rows (ops/fused_split.py hist_flush);
+    # per-K timings of both channel layouts land in BENCH_SHAPES.json
+    mb_sweep = {}
+    for kb in (1, 8, 16):
+        fn_k = jax.jit(functools.partial(
+            histogram_block, num_bins=b, impl="auto", mbatch=kb))
+        t_kf = bench_one(fn_k, ch_f32)
+        t_ki = bench_one(fn_k, ch_int8)
+        mb_sweep[str(kb)] = {
+            "f32_ms": round(t_kf * 1e3, 3),
+            "int8_ms": round(t_ki * 1e3, 3),
+            "int8_rows_per_sec": round(n / t_ki),
+        }
+        sys.stderr.write(
+            f"[bench-hist] mbatch={kb}: f32={t_kf * 1e3:.2f}ms "
+            f"int8={t_ki * 1e3:.2f}ms ({n / t_ki / 1e6:.1f} Mrows/s)\n")
     _record_shape("hist_micro", {
         "platform": dev.platform, "rows": n, "features": f, "bins": b,
         "f32_highest_ms": round(t_f32 * 1e3, 3),
         "int8_ms": round(t_int * 1e3, 3),
         "int8_speedup": round(speedup, 3),
+        "mbatch_sweep": mb_sweep,
     })
     if print_json:
         print(json.dumps({
@@ -236,7 +277,9 @@ def run_ranking_bench():
                      f"{name}={ndcg:.5f}\n")
     _record_shape("ranking", {
         "rows": rows, "features": feats, "leaves": params["num_leaves"],
-        "iters_per_sec": round(iters / dt, 3), "ndcg": round(float(ndcg), 5),
+        "iters_per_sec": round(iters / dt, 3),
+        "rows_per_sec": round(rows * iters / dt),
+        "ndcg": round(float(ndcg), 5),
     })
     # MS-LTR CPU baseline: ref Experiments.rst:117 xgb_hist/LightGBM table
     # does not publish iters/sec for MS-LTR; report absolute throughput
@@ -371,6 +414,9 @@ def main():
     _record_shape(shape, {
         "rows": ROWS, "features": FEATURES, "leaves": NUM_LEAVES,
         "bins": MAX_BIN, "iters_per_sec": round(iters_per_sec, 3),
+        # normalized per-row throughput: rows scanned per second of
+        # boosting (iterations x rows) — comparable across row counts
+        "rows_per_sec": round(ROWS * iters_per_sec),
         "construct_s": round(construct_s, 1),
         "compile_s": round(compile_s, 1), "auc": auc,
         "wall_to_auc_s": wall_to_auc,

@@ -17,6 +17,19 @@ the caller (ops/fused_split.py module docstring):
     ``pad >= block_size`` contract is enforced statically instead of
     silently clamping rows away (ADVICE r5 #2; the raise lives in
     ops/fused_split.py).
+  * batched-M pending rings (round 6, ops/fused_split.py hist_flush):
+    a constant ``mbatch`` must keep 8*mbatch within the 128 MXU rows,
+    and ``mbatch x block_size`` VMEM residency (bin slots, transposed
+    channel slots, and the flush's one-hot/block-diagonal transients,
+    evaluated for both the bf16 and int8 channel layouts) must stay
+    under the scoped-VMEM ring budget — the arithmetic lives in
+    ops/fused_split.py fused_ring_bytes and is evaluated here at the
+    minimum 128-byte record width.
+  * a kernel that stages histogram blocks into a pending ring (writes
+    to a ``pend*`` buffer keyed off ``mbatch``) must drain the
+    ``pushes % mbatch`` remainder: without a drain function carrying
+    that modulo, the last partial batch is silently dropped and every
+    histogram whose block count is not a multiple of K is wrong.
 """
 from __future__ import annotations
 
@@ -27,6 +40,8 @@ from .base import (Finding, ModuleInfo, PackageInfo, Rule, call_name,
                    dotted_name)
 
 _BLOCK_KWARGS = {"block_size", "bs", "fused_block"}
+_MBATCH_KWARGS = {"mbatch", "hist_mbatch"}
+_MBATCH_MAX = 16          # 8K <= 128 MXU rows
 
 
 def _target_is_blocky(name: str) -> bool:
@@ -67,6 +82,7 @@ class PallasContractRule(Rule):
                 out.extend(self._check_env_assign(module, node, func_of))
         for fn in module.functions.values():
             out.extend(self._check_defaults(module, fn))
+        out.extend(self._check_ring_drain(module))
         return out
 
     def _check_call(self, module, node: ast.Call, func_of) -> List[Finding]:
@@ -91,7 +107,93 @@ class PallasContractRule(Rule):
                 "fused_split call without num_rows= — the "
                 "pad >= block_size contract cannot be checked "
                 "statically and a short pad silently drops tail rows"))
+        out.extend(self._check_mbatch(module, node, func_of, name))
         return out
+
+    def _check_mbatch(self, module, node: ast.Call, func_of,
+                      name: str) -> List[Finding]:
+        """Constant-foldable batched-M contracts: MXU-row bound + the
+        pending ring's scoped-VMEM budget (both channel layouts)."""
+        mb = bs = None
+        for kw in node.keywords:
+            if isinstance(kw.value, ast.Constant) and \
+                    isinstance(kw.value.value, int):
+                if kw.arg in _MBATCH_KWARGS:
+                    mb = kw.value.value
+                elif kw.arg in _BLOCK_KWARGS:
+                    bs = kw.value.value
+        if mb is None:
+            return []
+        out: List[Finding] = []
+        if not 1 <= mb <= _MBATCH_MAX:
+            out.append(self.finding(
+                module, node, func_of(node),
+                f"{name}(mbatch={mb}): the batched-M depth must stay in "
+                f"[1, {_MBATCH_MAX}] — 8*mbatch output rows must fit the "
+                "128 MXU rows (ops/fused_split.py hist_flush)"))
+            return out
+        if name == "fused_split" and bs is not None:
+            from ...ops.fused_split import (_VMEM_RING_BUDGET,
+                                            fused_ring_bytes)
+            # minimum 128-byte record width; bf16 >= int8 so checking
+            # both layouts reduces to the bf16 (quant=False) evaluation
+            worst = max(fused_ring_bytes(bs, 128, mb, quant=False),
+                        fused_ring_bytes(bs, 128, mb, quant=True))
+            if worst > _VMEM_RING_BUDGET:
+                out.append(self.finding(
+                    module, node, func_of(node),
+                    f"{name}(block_size={bs}, mbatch={mb}): the pending "
+                    f"ring needs >= {worst >> 20}MB of scoped VMEM "
+                    f"(budget {_VMEM_RING_BUDGET >> 20}MB) even at the "
+                    "minimum record width — derive the block size via "
+                    "fused_block_cap(num_cols, mbatch)"))
+        return out
+
+    def _check_ring_drain(self, module) -> List[Finding]:
+        """A kernel that stages histogram blocks into a pending ring
+        (writes a ``pend*`` buffer keyed off ``mbatch``) must drain the
+        ``pushes % mbatch`` remainder somewhere in the module: a drain
+        function carrying ``lax.rem(_, mbatch)`` / ``_ % mbatch``."""
+        stagers = []
+        has_drain = False
+        for fname, fn in module.functions.items():
+            writes_pend = any(
+                isinstance(n, ast.Assign) and any(
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id.startswith("pend")
+                    for t in n.targets)
+                for n in ast.walk(fn.node))
+            uses_mbatch = any(
+                isinstance(n, ast.Name) and n.id in _MBATCH_KWARGS
+                for n in ast.walk(fn.node))
+            if writes_pend and uses_mbatch:
+                stagers.append(fn)
+            if "drain" in fname.lower() and self._has_mbatch_rem(fn.node):
+                has_drain = True
+        if not stagers or has_drain:
+            return []
+        fn = stagers[0]
+        return [self.finding(
+            module, fn.node, fn.qualname,
+            "pending-ring staging without a remainder drain: no 'drain' "
+            "function computes pushes % mbatch, so the last partial "
+            "batch of staged histogram blocks is silently dropped "
+            "whenever the block count is not a multiple of mbatch")]
+
+    @staticmethod
+    def _has_mbatch_rem(fn_node: ast.AST) -> bool:
+        for n in ast.walk(fn_node):
+            if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Mod) \
+                    and isinstance(n.right, ast.Name) \
+                    and n.right.id in _MBATCH_KWARGS:
+                return True
+            if isinstance(n, ast.Call) and \
+                    (call_name(n) or "").endswith("rem") and \
+                    len(n.args) == 2 and isinstance(n.args[1], ast.Name) \
+                    and n.args[1].id in _MBATCH_KWARGS:
+                return True
+        return False
 
     def _check_env_assign(self, module, node: ast.Assign, func_of
                           ) -> List[Finding]:

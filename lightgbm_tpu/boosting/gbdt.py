@@ -205,6 +205,30 @@ def _pick_fused_block(cfg) -> int:
     return 0
 
 
+def _pick_hist_mbatch(cfg) -> int:
+    """Resolve the batched-M histogram depth (``tpu_hist_mbatch``): K row
+    blocks per one-hot contraction, M = 8K MXU rows (ops/fused_split.py
+    hist_flush). The LGBM_TPU_HIST_MBATCH env override exists for perf
+    experiments and is validated the same way the block-size override is
+    (R004): clamped to [1, 16] so 8K never exceeds the 128 MXU rows and
+    the pending ring's VMEM multiplier stays bounded."""
+    k = int(cfg.get("tpu_hist_mbatch", 8))
+    if os.environ.get("LGBM_TPU_HIST_MBATCH", ""):
+        k = _validated_mbatch_env(os.environ["LGBM_TPU_HIST_MBATCH"])
+    return max(1, min(k, 16))
+
+
+def _validated_mbatch_env(value: str) -> int:
+    """Round and re-guard an ``LGBM_TPU_HIST_MBATCH`` override (1-16)."""
+    k = int(value)
+    if not 1 <= k <= 16:
+        clamped = max(1, min(k, 16))
+        log.warning(f"LGBM_TPU_HIST_MBATCH={value} outside [1, 16] "
+                    f"(8K must fit the 128 MXU rows); clamped to {clamped}")
+        k = clamped
+    return k
+
+
 def _validated_fused_block_env(value: str, num_cols: int,
                                vmem_cap_bs: int) -> int:
     """Round and re-guard an ``LGBM_TPU_FUSED_BS`` override.
@@ -716,6 +740,7 @@ class GBDT:
                 int(cfg.get("tpu_hist_block", 16384)), self._n_real),
             fused_block=_pick_fused_block(cfg),
             fused_interpret=bool(cfg.get("tpu_fused_interpret", False)),
+            hist_mbatch=_pick_hist_mbatch(cfg),
         )
 
         # serial-learner row storage: the compact grower physically
@@ -1014,12 +1039,16 @@ class GBDT:
             gp = gp._replace(fused_dual=False)
             self.grower_params = gp
         if gp.fused_block:
-            # kernel scoped-VMEM buffers scale with block_size * num_cols
-            # and the histogram accumulator with num_cols * num_bins; scale
-            # the block down for wide records and fall back to the XLA walk
-            # when the histogram alone would blow the ~16MB scoped limit
+            # kernel scoped-VMEM buffers scale with block_size * num_cols,
+            # the batched-M pending ring with hist_mbatch * block_size
+            # (bins + transposed channels + the flush's one-hot and
+            # block-diagonal transients), and the histogram accumulator
+            # with num_cols * num_bins; scale the block down for wide
+            # records / deep rings and fall back to the XLA walk when the
+            # histogram alone would blow the ~16MB scoped limit
+            from ..ops.fused_split import fused_block_cap
             c_rec = layout.num_cols
-            vmem_cap_bs = max(32, (49152 // c_rec) // 32 * 32)
+            vmem_cap_bs = fused_block_cap(c_rec, gp.hist_mbatch)
             bs = min(gp.fused_block, vmem_cap_bs)
             if os.environ.get("LGBM_TPU_FUSED_BS", ""):
                 # perf experiments; rounded + re-guarded, never trusted raw
@@ -2187,21 +2216,18 @@ class GBDT:
 
     def bin_matrix(self, arr: np.ndarray) -> np.ndarray:
         """Bin raw feature rows with the training BinMappers (host side)."""
+        from ..io.binning import bin_columns
         ds = self.train_set
-        arr = np.asarray(arr, dtype=np.float64)
+        arr = np.asarray(arr)
+        if arr.dtype != np.float32:     # float32 upcasts exactly per-compare
+            arr = arr.astype(np.float64, copy=False)
         if arr.ndim == 1:
             arr = arr.reshape(1, -1)
         if arr.shape[1] != ds.num_total_features:
             raise ValueError(
                 f"input has {arr.shape[1]} features, model expects "
                 f"{ds.num_total_features}")
-        dtype = ds.binned.dtype
-        out = np.zeros(arr.shape, dtype=dtype)
-        for j, m in enumerate(ds.mappers):
-            if m.is_trivial:
-                continue
-            out[:, j] = m.value_to_bin(arr[:, j]).astype(dtype)
-        return out
+        return bin_columns(ds.mappers, arr, ds.binned.dtype)
 
     def predict_raw_matrix(self, arr: np.ndarray,
                            num_iteration: Optional[int] = None,

@@ -176,6 +176,11 @@ PARAMS: Dict[str, Tuple[Any, type, Tuple[str, ...]]] = {
     "tpu_trace_dir": ("", str, ()),
     "tpu_part_block": (2048, int, ()),      # compact partition stream block
     "tpu_hist_block": (16384, int, ()),     # compact histogram stream block
+    # batched-M histogram depth: K row blocks per one-hot contraction fill
+    # M = 8K of the 128 MXU rows (ops/fused_split.py hist_flush; 1 = the
+    # sync reference path). The pending ring multiplies histogram-side
+    # VMEM residency by K, so tpu_fused_block is re-clamped against it
+    "tpu_hist_mbatch": (8, int, ("hist_mbatch",)),
     # data-parallel histogram reduction: reduce-scatter over the feature
     # axis + best-split all-gather vs full-histogram all-reduce
     # (ops/grower_compact.py hist_scatter)

@@ -51,6 +51,12 @@ def _to_2d_float(data: Any) -> np.ndarray:
         arr = arr.reshape(-1, 1)
     if arr.ndim != 2:
         raise ValueError(f"features must be 2-D, got shape {arr.shape}")
+    if arr.dtype == np.float32:
+        # keep float32: promoting a 10M x 4228 matrix to float64 doubles
+        # peak host memory for nothing — every bound comparison in the
+        # binning path upcasts exactly, so bins are bit-identical
+        # (io/binning.py bin_columns)
+        return arr
     return arr.astype(np.float64, copy=False)
 
 
@@ -317,13 +323,11 @@ class BinnedDataset:
                          use_missing, zero_as_missing, forcedbins_filename,
                          max_bin_by_feature)
 
-        # bin all columns
+        # bin all columns — batched over row chunks and column groups
+        # (io/binning.py bin_columns, the construct hot path)
         dtype = np.uint8 if ds.max_num_bins <= 256 else np.uint16
-        binned = np.zeros((n, f), dtype=dtype)
-        for j, m in enumerate(ds.mappers):
-            if m.is_trivial:
-                continue
-            binned[:, j] = m.value_to_bin(arr[:, j]).astype(dtype)
+        from .binning import bin_columns
+        binned = bin_columns(ds.mappers, arr, dtype)
         # Exclusive Feature Bundling: pack mutually-exclusive sparse features
         # into shared columns (reference: FeatureGroup / Dataset::Construct
         # FindGroups, include/LightGBM/feature_group.h). The growers then see
@@ -344,7 +348,9 @@ class BinnedDataset:
         ds.binned = binned
         ds.metadata = Metadata(n)
         if keep_raw:
-            ds.raw_data = arr
+            # linear-tree least squares runs on raw values; keep those in
+            # float64 regardless of the float32 binning fast path
+            ds.raw_data = arr.astype(np.float64, copy=False)
         return ds
 
     @staticmethod
@@ -536,12 +542,8 @@ def _plan_efb(ds, sample_binned, max_bin, max_conflict_rate):
 
 def _bin_chunk(mappers, arr: np.ndarray, dtype) -> np.ndarray:
     """Bin a raw [K, F] float chunk with fitted mappers."""
-    out = np.zeros(arr.shape, dtype=dtype)
-    for j, m in enumerate(mappers):
-        if m.is_trivial:
-            continue
-        out[:, j] = m.value_to_bin(arr[:, j]).astype(dtype)
-    return out
+    from .binning import bin_columns
+    return bin_columns(mappers, arr, dtype)
 
 
 def _fit_mappers(ds, sample, f, cat_idx, max_bin, min_data_in_bin,

@@ -291,6 +291,7 @@ def segment_histogram(
     block_size: int,
     impl: str = "auto",
     quantized: bool = False,
+    mbatch: int = 1,
 ) -> jnp.ndarray:            # [F, B, 4] f32 (int32 when quantized)
     """Histogram of one contiguous leaf segment, streamed in fixed blocks.
 
@@ -332,7 +333,8 @@ def segment_histogram(
             cw = (cw != 0.0).astype(jnp.float32)
             chans = jnp.stack([g * valid, h * valid, cw * valid, valid],
                               axis=1)
-        acc = acc + histogram_block(blk[:, :f], chans, b, impl=impl)
+        acc = acc + histogram_block(blk[:, :f], chans, b, impl=impl,
+                                    mbatch=mbatch)
         return j + 1, acc
 
     acc0 = jnp.zeros((f, b, 4), jnp.int32 if quantized else jnp.float32)

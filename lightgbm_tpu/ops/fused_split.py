@@ -53,6 +53,21 @@ a spare padding lane carries the per-slot receive indicator so the offset is
 undone exactly at flush). With no spare lane the kernel falls back to bf16
 (0..255 exact, f32 accumulate). Histogram channels use the same hi/lo-bf16
 split as ops/pallas_histogram.py: counts exact, grad/hess ~2^-17 relative.
+
+Batched-M histogram pipeline (round 6): the histogram contraction's output
+has only 8 rows (the channel count), so a per-block issue runs at M=8 of the
+MXU's 128 rows — the round-5 decomposition's dominant waste. The kernel now
+stages K = ``mbatch`` row blocks (bins + TRANSPOSED [8, bs] channel
+operands) in a pending ring and issues ONE contraction per feature group
+with a block-diagonal [8K, K*bs] channel LHS against the K blocks'
+row-concatenated one-hots — M = 8K = 64-128 MXU rows per issue, the TPU
+analogue of the reference CUDA constructor accumulating many row-blocks per
+launch (cuda_histogram_constructor.cu:17-68). The drain flushes the
+``pushes % K`` remainder exactly (stale slots zero out on the channel side).
+If Mosaic relayouts dominate at B <= 64 despite the batching, the next
+fallback is the bins-on-sublanes layout (VERDICT r5 attack (c)): transpose
+the ONE-HOT operand instead so bins provide the M rows — not implemented
+while the block-diagonal path holds.
 """
 from __future__ import annotations
 
@@ -83,6 +98,45 @@ except Exception:  # pragma: no cover
 from .compact import RowLayout
 
 _A = 32  # row alignment every DMA offset is provably divisible by
+
+# ---- scoped-VMEM accounting (shared with boosting/gbdt.py and tpulint) ----
+# The kernel's fixed streaming buffers (inbuf/carries/stages/aux) scale with
+# block_size * num_cols; 49152 is the empirical bs*C product the round-3
+# kernel tolerated on v5e. The batched-M pending ring (hist_flush) ADDS
+# mbatch-proportional residency: the staged bin blocks, the transposed
+# channel slots, and the per-feature-group one-hot + block-diagonal
+# transients of the ONE big contraction — so the block size must shrink as
+# the ring deepens, bounded by _VMEM_RING_BUDGET.
+_VMEM_STREAM_CAP = 49152
+_VMEM_RING_BUDGET = 4 << 20
+
+
+def fused_ring_bytes(block_size: int, num_cols: int, mbatch: int,
+                     quant: bool = False) -> int:
+    """Scoped-VMEM bytes of the pending ring + its flush transients.
+
+    Counted per slot: the [bs, C] u8 bin block, the [8, bs] transposed
+    channel operand (bf16 padded to 16 sublanes / int8 to 32), the
+    row-concatenated one-hot of one feature group (<= 512 lanes bf16,
+    which covers the int8 layout too), and the [8K, K*bs] block-diagonal
+    channel operand of the batched contraction."""
+    elt = 1 if quant else 2
+    bins = mbatch * block_size * num_cols
+    cht = mbatch * (32 if quant else 16) * block_size * elt
+    oh = mbatch * block_size * 512 * elt
+    diag = 8 * mbatch * mbatch * block_size * elt
+    return bins + cht + oh + diag
+
+
+def fused_block_cap(num_cols: int, mbatch: int, quant: bool = False) -> int:
+    """Largest 32-multiple block size whose streaming buffers AND pending
+    ring fit the scoped-VMEM caps (the automatic derivation and the
+    LGBM_TPU_FUSED_BS clamp both go through here)."""
+    bs = max(32, (_VMEM_STREAM_CAP // max(num_cols, 1)) // 32 * 32)
+    while bs > 32 and fused_ring_bytes(bs, num_cols, mbatch,
+                                       quant) > _VMEM_RING_BUDGET:
+        bs -= 32
+    return bs
 
 # sp scalar-prefetch vector layout (i32[16])
 _MODE, _BASE_T, _PHI, _COUNT, _NLEFT, _FEAT, _BIN, _DLEFT, _NANBIN, _ISCAT, \
@@ -136,7 +190,8 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
                   layout: RowLayout, num_bins: int, bs: int,
                   bitset_words: int, use_int8: bool,
                   interpret: bool, dual: bool,
-                  hist_debug: str = "", quant: bool = False):
+                  hist_debug: str = "", quant: bool = False,
+                  mbatch: int = 1):
     # dual=True: dual residency — rights land LIVE in the other array at the
     #   same offsets (RMW blends protect neighbour segments; auxbuf=[bs,C]
     #   rmw buffer, sem_aux=single DMA sem). The grower merges once per tree.
@@ -335,12 +390,84 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
             hist_ref[:, fc * BS_:(fc + wc) * BS_] += part
             fc += wc
 
+    cht = jnp.int8 if quant else jnp.bfloat16
+    eye_bs = (io2 == jo2).astype(cht)   # transpose-by-matmul identity
+
+    def transpose_ch(ch8):
+        """[bs, 8] channel operand -> [8, bs] via an identity contraction.
+
+        Mosaic relayout transposes are catastrophically slow on this
+        toolchain (see hist_matmuls), so the transpose rides the MXU:
+        ch8^T = ch8^T @ I. Exact: one nonzero per output element, i32
+        accumulation for int8 codes / f32 for bf16 channels (whose values
+        are already bf16-representable, so the round-trip cast is exact)."""
+        if quant:
+            return lax.dot_general(
+                ch8, eye_bs, dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=i32).astype(jnp.int8)
+        return lax.dot_general(
+            ch8, eye_bs, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(jnp.bfloat16)
+
+    def hist_flush(n_valid):
+        """ONE batched one-hot contraction per feature group over the first
+        ``n_valid`` staged blocks of the pending ring (the batched-M
+        tentpole): the staged transposed channel operands form a
+        block-diagonal [8K, K*bs] LHS and the staged blocks' one-hots
+        concatenate row-wise into a [K*bs, group] RHS, so each MXU issue
+        carries M = 8*mbatch output rows (64-128 at K=8-16) instead of 8.
+        The K per-block partial sums come back stacked on the sublane axis
+        and reduce with K-1 vector adds. Slots past ``n_valid`` (a partial
+        drain, or stale data from a previous ring wrap) are zeroed on the
+        channel side, so whatever their bins one-hot into contributes
+        exactly zero — counts stay bit-identical to the K=1 sync path and
+        int32 quantized sums stay exact."""
+        blocks = []
+        for t in range(mbatch):
+            chT = pendch[t]                               # [8, bs]
+            chT = jnp.where(n_valid > t, chT, jnp.zeros_like(chT))
+            parts = []
+            if t:
+                parts.append(jnp.zeros((8, t * bs), cht))
+            parts.append(chT)
+            if mbatch - 1 - t:
+                parts.append(jnp.zeros((8, (mbatch - 1 - t) * bs), cht))
+            blocks.append(parts[0] if len(parts) == 1
+                          else jnp.concatenate(parts, axis=1))
+        ch_diag = (blocks[0] if mbatch == 1
+                   else jnp.concatenate(blocks, axis=0))  # [8K, K*bs]
+        bins_k = [pendbuf[t].astype(i32)[:, :F] for t in range(mbatch)]
+        _, _, w = _hist_packing(F, B)
+        iota_b = lax.broadcasted_iota(i32, (bs, BS_), 1)
+        zero_col = jnp.full((bs, 1), -1, i32)
+        oh_t = jnp.int8 if quant else jnp.bfloat16
+        acc_t = jnp.int32 if quant else jnp.float32
+        fc = 0
+        while fc < F_pad:
+            wc = min(w, F_pad - fc)
+            ohs = [jnp.concatenate(
+                [((bins[:, fc + j:fc + j + 1] if fc + j < F else zero_col)
+                  == iota_b).astype(oh_t)
+                 for j in range(wc)], axis=1)             # [bs, wc*BS_]
+                for bins in bins_k]
+            oh = ohs[0] if mbatch == 1 else jnp.concatenate(ohs, axis=0)
+            part = lax.dot_general(
+                ch_diag, oh, dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=acc_t)             # [8K, wc*BS_]
+            red = part[0:8]
+            for t in range(1, mbatch):
+                red = red + part[8 * t:8 * (t + 1)]
+            hist_ref[:, fc * BS_:(fc + wc) * BS_] += red
+            fc += wc
+
     def hist_accum(rows_u8, mask_f32):
-        """Software-pipelined histogram push: the block's channel operand
-        is assembled NOW (a long serial VPU chain), but its matmuls run on
-        the NEXT push — so the MXU never stalls waiting on a freshly
-        computed ch8 (measured on v5e at 10.5M rows: the synchronous
-        VPU->MXU edge costs ~0.6 s/tree, ~60%% of tree time)."""
+        """Batched-M histogram push: the block's channel operand is
+        assembled and transposed NOW (VPU chain + one tiny M=8 matmul),
+        staged into the K-deep pending ring, and the one-hot contractions
+        issue once per K pushes as ONE M=8K matmul per feature group
+        (hist_flush) — both deferring the MXU work off the assembly's
+        critical path (the round-5 double buffer's job, measured ~0.6
+        s/tree on v5e) and filling the MXU rows the M=8 issue wasted."""
         if hist_debug == "off":
             return  # timing bisect: histograms disabled (results invalid)
         if hist_debug == "assembly":
@@ -360,32 +487,30 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
             hist_matmuls(rows_u8, cheap)
             return
         if hist_debug == "sync":
-            # the pre-pipelining behavior (timing comparison)
+            # the pre-pipelining, pre-batching behavior (timing comparison)
             hist_matmuls(rows_u8, assemble_ch8(rows_u8, mask_f32))
             return
 
-        # double-buffered pending slots: the matmuls read slot p while the
-        # assembly writes slot 1-p, so there is no write-after-read hazard
-        # forcing the two engine streams to serialize
         pushes = smem[_PEND]
-        cur = lax.rem(pushes, 2)
-
-        @pl.when(pushes >= 1)
-        def _():
-            hist_matmuls(pendbuf[1 - cur], pendch[1 - cur])
-        pendch[cur] = assemble_ch8(rows_u8, mask_f32)
+        cur = lax.rem(pushes, mbatch)
         pendbuf[cur] = rows_u8
+        pendch[cur] = transpose_ch(assemble_ch8(rows_u8, mask_f32))
         smem[_PEND] = pushes + 1
 
-    def hist_drain():
-        """Flush the deferred histogram block (end of kernel)."""
-        pushes = smem[_PEND]
-
-        @pl.when(pushes >= 1)
+        @pl.when(cur == mbatch - 1)
         def _():
-            last = lax.rem(pushes - 1, 2)
-            hist_matmuls(pendbuf[last], pendch[last])
-            smem[_PEND] = 0
+            hist_flush(jnp.asarray(mbatch, i32))
+
+    def hist_drain():
+        """Flush the partial pending batch (end of kernel): exactly the
+        ``pushes % mbatch`` blocks staged since the last full-ring flush."""
+        pushes = smem[_PEND]
+        pending = lax.rem(pushes, mbatch)
+
+        @pl.when(pending > 0)
+        def _():
+            hist_flush(pending)
+            smem[_PEND] = pushes - pending
 
     def stage_flush(stream, data_u8, hbm_base, do_hist, hist_mask):
         """Write one full block via the stream's staging ring; maybe hist."""
@@ -674,7 +799,8 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
 @functools.partial(
     jax.jit,
     static_argnames=("layout", "num_bins", "block_size", "bitset_words",
-                     "interpret", "dual", "hist_debug", "num_rows", "quant"))
+                     "interpret", "dual", "hist_debug", "num_rows", "quant",
+                     "mbatch"))
 def fused_split(
     work: jnp.ndarray,          # [N + pad, C] u8, C % 128 == 0
     scratch: jnp.ndarray,       # [N + pad, C] u8
@@ -699,10 +825,20 @@ def fused_split(
     hist_debug: str = "",       # timing bisect only (see GrowerParams)
     num_rows: int = None,       # real (unpadded) row count, for pad checks
     quant: bool = False,        # packed int8 channel layout -> int32 hist
+    mbatch: int = 8,            # batched-M pending-ring depth (1-16)
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One fused split. Returns (work', scratch', hist_smaller [F, B, 4]);
     the histogram is int32 when ``quant`` (quantized-gradient codes,
     int8 x int8 -> int32 contraction — see assemble_ch8).
+
+    ``mbatch`` (env/param ``tpu_hist_mbatch``) is the depth of the
+    histogram pending ring: K staged row blocks issue ONE one-hot
+    contraction per feature group with M = 8K MXU rows (hist_flush)
+    instead of K matmuls at M = 8. K = 1 is the sync reference path
+    (counts and int32 histograms bit-identical at any K; bf16 grad/hess
+    within ~2^-17 relative — the f32 accumulation regroups). The ring
+    multiplies histogram-side VMEM residency by K, so callers must size
+    ``block_size`` through :func:`fused_block_cap`.
 
     CONTRACT — pad >= block_size: the row arrays must be padded past the
     real row count by at least ``block_size`` rows (internal callers pad by
@@ -787,6 +923,7 @@ def fused_split(
     W = bitset_words
     if quant:
         hist_debug = ""     # bisect probes assume the bf16 channel layout
+    mbatch = max(1, min(int(mbatch), 16))   # 8*mbatch <= 128 MXU rows
     # int8 MXU path needs one free padding lane for the receive indicator
     use_int8 = layout.num_real_cols < C
     carry_t = jnp.int32 if use_int8 else jnp.float32
@@ -795,7 +932,7 @@ def fused_split(
     kernel = functools.partial(
         _fused_kernel, layout=layout, num_bins=B, bs=bs, bitset_words=W,
         use_int8=use_int8, interpret=interpret, dual=dual,
-        hist_debug=hist_debug, quant=quant)
+        hist_debug=hist_debug, quant=quant, mbatch=mbatch)
 
     work_o, scr_o, hist8 = pl.pallas_call(
         kernel,
@@ -822,8 +959,10 @@ def fused_split(
                 pltpu.VMEM((2, bs, C), jnp.uint8),  # rstage
                 (pltpu.VMEM((bs, C), jnp.uint8) if dual
                  else pltpu.VMEM((2, bs, C), jnp.uint8)),   # auxbuf
-                pltpu.VMEM((2, bs, C), jnp.uint8),  # pendbuf (hist pipe)
-                pltpu.VMEM((2, bs, 8), ch_t),       # pendch
+                # batched-M pending ring: K staged bin blocks + their
+                # TRANSPOSED [8, bs] channel operands (hist_flush)
+                pltpu.VMEM((mbatch, bs, C), jnp.uint8),   # pendbuf
+                pltpu.VMEM((mbatch, 8, bs), ch_t),        # pendch
                 pltpu.SMEM((8,), jnp.int32),
             ],
         ),

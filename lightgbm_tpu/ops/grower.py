@@ -114,6 +114,14 @@ class GrowerParams(NamedTuple):
     # (reference: gradient_discretizer.cpp + cuda_histogram_constructor
     # .cu:249-524); the per-iteration scales ride as traced args
     quant_hist: bool = False
+    # batched-M histogram depth (env/param tpu_hist_mbatch): K staged row
+    # blocks per one-hot contraction fill M = 8K of the 128 MXU rows —
+    # the fused kernel's pending ring, the Mosaic kernel's window
+    # partition, and the XLA engine's chunk widening all key off this
+    # (ops/fused_split.py hist_flush is the reference design). K = 1 is
+    # the sync reference path; the ring multiplies histogram-side VMEM
+    # residency by K (ops/fused_split.py fused_block_cap)
+    hist_mbatch: int = 8
     # data-parallel histogram reduction: 0 = all-reduce (lax.psum) of the
     # full [F, B, 4] histogram; S > 0 = reduce-scatter over the feature
     # axis across S shards (lax.psum_scatter) + an all-gather of the tiny
@@ -310,8 +318,10 @@ def grow_tree(
             from ..parallel.voting import voting_histogram
             return voting_histogram(binned, chans, B, params.voting_shards,
                                     params.voting_k, params.split_params(),
-                                    impl=params.hist_impl)
-        return histogram(binned, chans, B, ax, impl=params.hist_impl)
+                                    impl=params.hist_impl,
+                                    mbatch=params.hist_mbatch)
+        return histogram(binned, chans, B, ax, impl=params.hist_impl,
+                         mbatch=params.hist_mbatch)
 
     if mono_types is None:
         mono_types = jnp.zeros((f,), jnp.int8)

@@ -280,7 +280,8 @@ def grow_tree_compact(
     def seg_hist(work, start, count):
         return segment_histogram(work, start, count, layout, B,
                                  params.hist_block, params.hist_impl,
-                                 quantized=quant)
+                                 quantized=quant,
+                                 mbatch=params.hist_mbatch)
 
     # ---- root ----
     if params.fused_block:
@@ -290,7 +291,8 @@ def grow_tree_compact(
             zero, zero, zero, zero, zero, zero,
             jnp.zeros((W,), jnp.uint32), layout, B, params.fused_block, W,
             interpret=params.fused_interpret, dual=params.fused_dual,
-            hist_debug=params.fused_hist_debug, num_rows=n, quant=quant)
+            hist_debug=params.fused_hist_debug, num_rows=n, quant=quant,
+            mbatch=params.hist_mbatch)
     else:
         root_loc = seg_hist(work, jnp.asarray(0, i32), jnp.asarray(n, i32))
     # data-parallel: histograms reduce over the mesh axis (reference: the
@@ -570,7 +572,7 @@ def grow_tree_compact(
                 interpret=params.fused_interpret,
                 smaller_left=left_smaller.astype(i32), side=side_p,
                 dual=params.fused_dual, hist_debug=params.fused_hist_debug,
-                num_rows=n, quant=quant)
+                num_rows=n, quant=quant, mbatch=params.hist_mbatch)
         else:
             work, scratch = partition_segment(
                 st.work, st.scratch, s_, m_eff, n_left_eff, f_col, b_, dl,

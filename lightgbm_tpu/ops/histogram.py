@@ -44,11 +44,17 @@ def _chunk_rows(n: int, f: int, b: int) -> int:
     return max(128, min(c, max(128, n)))
 
 
-def _xla_histogram(binned, channels, num_bins: int):
+def _xla_histogram(binned, channels, num_bins: int, mbatch: int = 1):
     n, f = binned.shape
     k = channels.shape[1]
     b = num_bins
-    chunk = _chunk_rows(n, f, b)
+    # batched-M port (ops/fused_split.py hist_flush is the reference
+    # design): the XLA engine's analogue of staging K row blocks per MXU
+    # issue is contracting K chunks of rows in ONE einsum — the scan trip
+    # count drops K-fold and XLA sees a K-times-deeper contraction to
+    # tile, instead of K back-to-back launches over small one-hots
+    chunk = _chunk_rows(n, f, b) * max(1, int(mbatch))
+    chunk = max(128, min(chunk, -(-max(n, 1) // 128) * 128))
     iota = jnp.arange(b, dtype=jnp.int32)
 
     quantized = jnp.issubdtype(channels.dtype, jnp.integer)
@@ -136,30 +142,39 @@ def histogram_block(
     channels: jax.Array,    # [BS, K] f32, or int8 (quantized-gradient path)
     num_bins: int,
     impl: str = "auto",
+    mbatch: int = 1,
 ) -> jax.Array:             # [F, B, K] f32 (int32 for int8 channels)
     """Histogram of one already-sliced row block (no psum, no jit wrapper —
     call sites are inside jitted loops).
 
     Integer ``channels`` select the quantized-gradient pipeline: int8
     one-hot x int8 codes contracted with ``preferred_element_type=int32``
-    (native int8 MXU throughput, exact int32 sums)."""
+    (native int8 MXU throughput, exact int32 sums).
+
+    ``mbatch`` (env/param ``tpu_hist_mbatch``) is the batched-M depth:
+    the Mosaic kernel issues M = 8*mbatch MXU rows per contraction, the
+    XLA engine contracts mbatch row chunks per einsum. Counts and int32
+    sums are bit-identical across mbatch values."""
     impl = _resolve_impl(impl, num_bins, binned.shape[1])
     if impl == "pallas":
         from .pallas_histogram import pallas_histogram
         if jnp.issubdtype(channels.dtype, jnp.integer):
-            return pallas_histogram(binned, channels, num_bins, mode="int8")
-        return pallas_histogram(binned, channels, num_bins)
-    return _xla_histogram(binned, channels, num_bins)
+            return pallas_histogram(binned, channels, num_bins, mode="int8",
+                                    mbatch=mbatch)
+        return pallas_histogram(binned, channels, num_bins, mbatch=mbatch)
+    return _xla_histogram(binned, channels, num_bins, mbatch=mbatch)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("num_bins", "axis_name", "impl"))
+                   static_argnames=("num_bins", "axis_name", "impl",
+                                    "mbatch"))
 def histogram(
     binned: jax.Array,      # [N, F] uint8/uint16/int32
     channels: jax.Array,    # [N, K] f32
     num_bins: int,          # B (static)
     axis_name: Optional[str] = None,
     impl: str = "auto",
+    mbatch: int = 1,
 ) -> jax.Array:             # [F, B, K] f32
     """Accumulate per-(feature, bin) sums of ``channels`` columns."""
     if impl == "pallas":
@@ -167,7 +182,8 @@ def histogram(
         if not pallas_available():
             raise RuntimeError(
                 "tpu_hist_impl=pallas requires a TPU backend; use 'xla'")
-    hist = histogram_block(binned, channels, num_bins, impl=impl)
+    hist = histogram_block(binned, channels, num_bins, impl=impl,
+                           mbatch=mbatch)
 
     if axis_name is not None:
         # distributed data-parallel: the reference reduce-scatters histograms over

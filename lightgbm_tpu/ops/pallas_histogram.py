@@ -17,6 +17,17 @@ Where the CUDA kernel resolves collisions with atomicAdd into shared memory,
 the one-hot contraction has no collisions by construction: each row contributes
 to exactly one bin column per feature, and the MXU reduces over rows.
 
+Batched-M issue (round 6, shared design with ops/fused_split.py hist_flush):
+the contraction's natural output has only 8 rows (the padded channel count),
+so each MXU issue ran at M=8 of 128 rows. Channels now arrive CHANNEL-MAJOR
+([KP, N], transposed once on the XLA side — no in-kernel relayout), each
+grid step's row block subdivides into ``mbatch`` windows, and the kernel
+builds a block-diagonal [8K, R] channel LHS (tile the [KP, R] slab K times
+along sublanes, mask each 8-row band to its own lane window) contracted in
+ONE matmul per feature chunk with M = 8*mbatch rows; the K per-window
+partial sums reduce with K-1 vector adds. Counts and int32 sums are
+bit-identical to mbatch=1; f32/split sums regroup within ~1 ulp.
+
 Precision modes (the one-hot itself is exact in bf16 — values 0/1):
 
   * ``split`` (default) — channels decompose as hi+lo bf16 pairs occupying the
@@ -54,13 +65,22 @@ _K_PAD = 8
 
 
 def _hist_kernel(bins_ref, ch_ref, out_ref, *, num_bins: int, f_chunk: int,
-                 mode: str):
+                 mode: str, mbatch: int):
     """One grid step: accumulate a row-block into the [KP, F*B] histogram.
 
     The output is CHANNEL-major: [KP, F*B] keeps the lane dimension wide
     (F*B) instead of padding an 8-lane channel dimension to 128, so the
     VMEM-resident accumulator costs 8 x F*B x 4B (1.1MB at F=137, B=256)
     rather than 32x that.
+
+    ``ch_ref`` is the CHANNEL-MAJOR [KP, R] slab of this row block; with
+    ``mbatch`` > 1 the block subdivides into K row windows of R/K rows and
+    the channel LHS becomes block-diagonal [8K, R] so every matmul issues
+    M = 8K MXU rows (see module docstring). The drain of a ragged tail
+    needs no special casing here: padding rows carry zero channels, so
+    whatever they one-hot into sums to zero. pushes % mbatch == 0 always
+    holds because the window partition is exact (R % mbatch == 0,
+    enforced by the wrapper).
 
     The unrolled chunk loop makes the register allocator spill the one-hot
     temporaries to the VMEM stack when F*B is large (measured on v5e at
@@ -75,12 +95,14 @@ def _hist_kernel(bins_ref, ch_ref, out_ref, *, num_bins: int, f_chunk: int,
 
     # uint8 -> int32 (Mosaic has no direct uint8 -> float cast)
     bins = bins_ref[:].astype(jnp.int32)          # [R, F]
-    ch = ch_ref[:]                                # [R, KP] f32/int8
+    ch = ch_ref[:]                                # [KP, R] f32/int8
     r = bins.shape[0]
     f = bins.shape[1]
     b = num_bins
     w = f_chunk
     assert f % w == 0
+    assert r % mbatch == 0
+    sub = r // mbatch
 
     if mode == "int8":
         oh_dtype = jnp.int8
@@ -93,6 +115,15 @@ def _hist_kernel(bins_ref, ch_ref, out_ref, *, num_bins: int, f_chunk: int,
             ch = ch.astype(jnp.bfloat16)
         precision = (lax.Precision.HIGHEST if mode == "f32"
                      else lax.Precision.DEFAULT)
+    if mbatch > 1:
+        # block-diagonal [8K, R] channel LHS: K sublane-tiled copies of the
+        # [KP, R] slab, each 8-row band masked to its own lane window
+        tiled = jnp.concatenate([ch] * mbatch, axis=0)        # [8K, R]
+        band = lax.broadcasted_iota(jnp.int32, tiled.shape, 0) // _K_PAD
+        win = lax.broadcasted_iota(jnp.int32, tiled.shape, 1) // sub
+        ch_lhs = jnp.where(band == win, tiled, jnp.zeros_like(tiled))
+    else:
+        ch_lhs = ch
     iota_b = lax.broadcasted_iota(jnp.int32, (r, b), 1)
 
     for fc in range(0, f, w):
@@ -101,21 +132,34 @@ def _hist_kernel(bins_ref, ch_ref, out_ref, *, num_bins: int, f_chunk: int,
         oh = jnp.concatenate(
             [(bins[:, fc + j:fc + j + 1] == iota_b).astype(oh_dtype)
              for j in range(w)], axis=1)
-        # MXU contraction over rows: [KP, R] x [R, W*B] -> [KP, W*B]
+        # MXU contraction over rows: [8K, R] x [R, W*B] -> [8K, W*B]
         # (int8 mode: int8 x int8 -> int32, preferred_element_type pins the
         # accumulator so the int8 operands cannot narrow the output)
         part = lax.dot_general(
-            ch, oh,
-            dimension_numbers=(((0,), (0,)), ((), ())),
+            ch_lhs, oh,
+            dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=acc_dtype,
             precision=precision,
         )
-        out_ref[:, fc * b:(fc + w) * b] += part
+        red = part[0:_K_PAD]
+        for t in range(1, mbatch):
+            red = red + part[_K_PAD * t:_K_PAD * (t + 1)]
+        out_ref[:, fc * b:(fc + w) * b] += red
+
+
+def _resolve_mbatch(mbatch: int, row_block: int) -> int:
+    """Clamp the batched-M depth to a divisor of the row block (exact
+    window partition) with 8*K <= 128 MXU rows and windows >= 128 lanes."""
+    mb = max(1, min(int(mbatch), 16, row_block // 128))
+    while mb > 1 and row_block % mb:
+        mb -= 1
+    return mb
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("num_bins", "row_block", "f_chunk", "mode", "interpret"))
+    static_argnames=("num_bins", "row_block", "f_chunk", "mode", "interpret",
+                     "mbatch"))
 def pallas_histogram(
     binned: jax.Array,       # [N, F] uint8/int32
     channels: jax.Array,     # [N, K] f32 (int8 for mode='int8'), K <= 8
@@ -125,6 +169,7 @@ def pallas_histogram(
     f_chunk: int = 2,
     mode: str = "split",     # split | bf16 | f32 | int8 (see module doc)
     interpret: bool = False,
+    mbatch: int = 1,         # batched-M windows per row block (1-16)
 ) -> jax.Array:              # [F, B, K] f32 (int32 for mode='int8')
     n, f_in = binned.shape
     k = channels.shape[1]
@@ -134,6 +179,7 @@ def pallas_histogram(
     # row block so wide-F configs compile instead of OOMing vmem
     rb_cap = max(128, (121_000_000 // max(1, f_in * b)) // 128 * 128)
     row_block = min(row_block, rb_cap)
+    mbatch = _resolve_mbatch(mbatch, row_block)
 
     if mode == "int8" and not jnp.issubdtype(channels.dtype, jnp.integer):
         raise ValueError("mode='int8' needs integer channels (grad/hess "
@@ -163,9 +209,12 @@ def pallas_histogram(
         channels = jnp.pad(channels, ((0, 0), (0, _K_PAD - kc)))
     n_tot = n + n_pad
     f = f_in + f_pad
+    # channel-major slab: ONE XLA-side transpose instead of an in-kernel
+    # Mosaic relayout per block (relayouts dominate on this toolchain)
+    channels_t = channels.T                       # [KP, N]
 
     kernel = functools.partial(
-        _hist_kernel, num_bins=b, f_chunk=f_chunk, mode=mode)
+        _hist_kernel, num_bins=b, f_chunk=f_chunk, mode=mode, mbatch=mbatch)
 
     acc_dtype = jnp.int32 if mode == "int8" else jnp.float32
     out = pl.pallas_call(
@@ -173,12 +222,12 @@ def pallas_histogram(
         grid=(n_tot // row_block,),
         in_specs=[
             pl.BlockSpec((row_block, f), lambda i: (i, 0)),
-            pl.BlockSpec((row_block, _K_PAD), lambda i: (i, 0)),
+            pl.BlockSpec((_K_PAD, row_block), lambda i: (0, i)),
         ],
         out_specs=pl.BlockSpec((_K_PAD, f * b), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((_K_PAD, f * b), acc_dtype),
         interpret=interpret,
-    )(binned, channels)
+    )(binned, channels_t)
     out = jnp.transpose(out.reshape(_K_PAD, f, b), (1, 2, 0))[:f_in]
     if mode == "split":
         return out[:, :, :k] + out[:, :, k:2 * k]

@@ -44,6 +44,7 @@ def voting_histogram(
     top_k: int,                # static: per-shard vote size (config top_k)
     split_params,
     impl: str = "auto",
+    mbatch: int = 1,
 ) -> jnp.ndarray:              # [F, B, K] f32 (replicated)
     """Histogram with voting-capped communication: only the globally voted
     2k features carry reduced histograms; every other feature's histogram is
@@ -61,7 +62,7 @@ def voting_histogram(
     # so this is communication-free under GSPMD
     bs = binned.reshape(s, n_local, f)
     cs = chans.reshape(s, n_local, k)
-    local = _vmap_hist(bs, cs, b, impl)                # [S, F, B, K]
+    local = _vmap_hist(bs, cs, b, impl, mbatch)        # [S, F, B, K]
 
     # local votes (top-k features by local gain) and the global election
     gains = _vmap_gains(local, split_params)           # [S, F]
@@ -76,9 +77,10 @@ def voting_histogram(
     return full.at[sel].set(hist_sel)
 
 
-def _vmap_hist(bs, cs, b, impl):
+def _vmap_hist(bs, cs, b, impl, mbatch=1):
     import jax
-    return jax.vmap(lambda x, c: histogram_block(x, c, b, impl=impl))(bs, cs)
+    return jax.vmap(lambda x, c: histogram_block(x, c, b, impl=impl,
+                                                 mbatch=mbatch))(bs, cs)
 
 
 def _vmap_gains(local, p):

@@ -333,6 +333,73 @@ def test_r004_block_size_literal_and_num_rows(tmp_path):
     assert not clean
 
 
+def test_r004_mbatch_exceeds_mxu_rows(tmp_path):
+    """8*mbatch must fit the 128 MXU rows (batched-M contract)."""
+    findings = lint_snippet(tmp_path, """
+        def caller(work, scratch, args, n):
+            return fused_split(work, scratch, *args, block_size=128,
+                               num_rows=n, mbatch=32)
+    """)
+    r4 = [f for f in findings if f.rule == "R004"]
+    assert len(r4) == 1 and "MXU rows" in r4[0].message
+
+
+def test_r004_mbatch_ring_over_vmem_budget(tmp_path):
+    """pending_depth x block_size residency (ring slots + flush
+    transients) must stay under the scoped-VMEM ring budget."""
+    findings = lint_snippet(tmp_path, """
+        def caller(work, scratch, args, n):
+            return fused_split(work, scratch, *args, block_size=1024,
+                               num_rows=n, mbatch=16)
+    """)
+    r4 = [f for f in findings if f.rule == "R004"]
+    assert len(r4) == 1 and "scoped VMEM" in r4[0].message
+    clean = lint_snippet(tmp_path, """
+        def caller(work, scratch, args, n):
+            return fused_split(work, scratch, *args, block_size=256,
+                               num_rows=n, mbatch=8)
+    """, name="clean_ring.py")
+    assert not clean
+
+
+def test_r004_pending_ring_missing_drain(tmp_path):
+    """The missing-drain seed: a kernel staging histogram blocks into a
+    pending ring keyed off mbatch, with no pushes % mbatch drain — the
+    last partial batch would be silently dropped."""
+    findings = lint_snippet(tmp_path, """
+        from jax import lax
+
+        def kernel(pendbuf, pendch, smem, mbatch):
+            def hist_accum(rows, ch):
+                pushes = smem[0]
+                cur = lax.rem(pushes, mbatch)
+                pendbuf[cur] = rows
+                pendch[cur] = ch
+                smem[0] = pushes + 1
+            return hist_accum
+    """)
+    r4 = [f for f in findings if f.rule == "R004"]
+    assert len(r4) == 1 and "drain" in r4[0].message
+    clean = lint_snippet(tmp_path, """
+        from jax import lax
+
+        def kernel(pendbuf, pendch, smem, mbatch, flush):
+            def hist_accum(rows, ch):
+                pushes = smem[0]
+                cur = lax.rem(pushes, mbatch)
+                pendbuf[cur] = rows
+                pendch[cur] = ch
+                smem[0] = pushes + 1
+
+            def hist_drain():
+                pushes = smem[0]
+                pending = lax.rem(pushes, mbatch)
+                flush(pending)
+            return hist_accum, hist_drain
+    """, name="clean_drain.py")
+    assert not clean
+
+
 # ---------------------------------------------------------------- R005
 def test_r005_operand_shape_counting(tmp_path):
     """The seed case: parallel/comm_accounting.py:65 pre-fix (ADVICE r5
