@@ -237,6 +237,135 @@ def run_hist_microbench(print_json=True):
         }))
 
 
+def run_predict_microbench(print_json=True):
+    """BENCH_PREDICT=1: serving throughput of the depth-batched inference
+    engine vs the pre-change serial tree scan (ops/predict.py), measured
+    end to end at the gbdt serving entry on already-binned requests.
+
+    Sweeps batch sizes {1k, 10k, 100k, 1M} x tree counts {100, 500}
+    (255-leaf trees) and records, per cell, rows/s for both paths plus
+    the compile events each path spent across its whole sweep — the old
+    path compiles one program per (T, N) shape, the bucketed engine one
+    per (row rung, tree bucket). Acceptance (ISSUE 5): >= 5x rows/s at
+    T=500, N=100k on the CPU backend. Results land in
+    BENCH_SHAPES.json["predict_micro"].
+
+    Trees are real (trained on a Higgs-like shape); larger tree counts
+    tile the trained base model — traversal cost per tree is
+    structure-dependent, not value-dependent, so tiling preserves the
+    measured work while keeping the bench's training phase short.
+    """
+    import jax
+
+    dev = _init_backend_with_retry(jax)
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.analysis import guards
+
+    train_rows = int(float(os.environ.get("BENCH_PREDICT_TRAIN_ROWS",
+                                          30_000)))
+    feats = int(os.environ.get("BENCH_FEATURES", 28))
+    leaves = int(os.environ.get("BENCH_NUM_LEAVES", 255))
+    base_trees = int(os.environ.get("BENCH_PREDICT_BASE_TREES", 50))
+    tree_sweep = [int(t) for t in os.environ.get(
+        "BENCH_PREDICT_TREES", "100,500").split(",")]
+    rows_sweep = [int(float(t)) for t in os.environ.get(
+        "BENCH_PREDICT_ROWS", "1000,10000,100000,1000000").split(",")]
+    budget_s = float(os.environ.get("BENCH_PREDICT_BUDGET_S", 120.0))
+    if any(t % base_trees for t in tree_sweep):
+        raise SystemExit("BENCH_PREDICT_TREES entries must be multiples of "
+                         f"BENCH_PREDICT_BASE_TREES ({base_trees})")
+
+    X, y = make_higgs_like(train_rows, feats)
+    params = {
+        "objective": "binary", "num_leaves": leaves, "max_bin": 255,
+        "learning_rate": 0.1, "min_data_in_leaf": 20, "verbosity": -1,
+        "stop_check_freq": 10_000,
+    }
+    t0 = time.time()
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                    base_trees)
+    g = bst._gbdt
+    g._flush_trees()
+    sys.stderr.write(f"[bench-predict] trained {len(g.models)} x "
+                     f"{leaves}-leaf trees in {time.time() - t0:.1f}s "
+                     f"(depth {g._models_max_depth(g.models)})\n")
+    base_models = list(g.models)
+
+    rng = np.random.RandomState(3)
+    n_max = max(rows_sweep)
+    Xq = rng.randn(min(n_max, 1 << 20), feats).astype(np.float32)
+    binned_all = g.bin_matrix(np.resize(Xq, (n_max, feats)))
+
+    def timed(fn, n_rows):
+        t1 = time.time()
+        fn()
+        once = time.time() - t1
+        reps = max(1, min(5, int(2.0 / max(once, 1e-9))))
+        t1 = time.time()
+        for _ in range(reps):
+            fn()
+        dt = (time.time() - t1) / reps
+        return dt, n_rows / dt
+
+    cells = {}
+    compile_events = {"scan": 0, "batched": 0}
+    for engine in ("batched", "scan"):
+        g.config.set({"tpu_predict_engine": engine})
+        with guards.compile_counter() as cc:
+            for t_count in tree_sweep:
+                g.models = base_models * (t_count // base_trees)
+                g._device_trees_cache = None
+                skip_rest = False
+                for n in sorted(rows_sweep):
+                    key = f"t{t_count}_n{n}"
+                    cell = cells.setdefault(key, {"trees": t_count,
+                                                  "rows": n})
+                    if skip_rest:
+                        cell[engine + "_s"] = None
+                        continue
+                    req = binned_all[:n]
+                    fn = (lambda: np.asarray(
+                        g.predict_raw_device(req)).sum())
+                    dt, rps = timed(fn, n)
+                    cell[engine + "_s"] = round(dt, 4)
+                    cell[engine + "_rows_per_sec"] = round(rps)
+                    sys.stderr.write(
+                        f"[bench-predict] {engine} T={t_count} N={n}: "
+                        f"{dt * 1e3:.1f}ms ({rps / 1e6:.2f} Mrows/s)\n")
+                    # the serial scan is O(T*L*N); stop a sweep leg that
+                    # would blow the budget and record the gap honestly
+                    if dt * 10 > budget_s:
+                        skip_rest = True
+        compile_events[engine] = cc.lowerings
+    g.config.set({"tpu_predict_engine": "batched"})
+    g.models = base_models
+    g._device_trees_cache = None
+
+    for cell in cells.values():
+        if cell.get("scan_s") and cell.get("batched_s"):
+            cell["speedup"] = round(cell["scan_s"] / cell["batched_s"], 2)
+    t_top = max(tree_sweep)
+    accept = cells.get(f"t{t_top}_n100000", {}).get("speedup")
+    sys.stderr.write(
+        f"[bench-predict] compile events: scan={compile_events['scan']} "
+        f"batched={compile_events['batched']}; T={t_top} N=100k "
+        f"speedup={accept}x\n")
+    _record_shape("predict_micro", {
+        "platform": dev.platform, "leaves": leaves,
+        "train_rows": train_rows, "features": feats,
+        "cells": cells, "compile_events": compile_events,
+        "t500_n100k_speedup": accept,
+    })
+    if print_json:
+        print(json.dumps({
+            "metric": f"predict-micro {t_top}x{leaves}-leaf trees "
+                      "N=100k engine speedup",
+            "value": accept,
+            "unit": "x vs serial tree scan",
+            "vs_baseline": round((accept or 0) / 5.0, 3),  # acceptance 5x
+        }))
+
+
 def run_ranking_bench():
     """Lambdarank at MS-LTR scale: pair-block chunking + NDCG under load."""
     import jax
@@ -295,6 +424,8 @@ def run_ranking_bench():
 def main():
     if os.environ.get("BENCH_HIST_MICRO", "") == "1":
         return run_hist_microbench()
+    if os.environ.get("BENCH_PREDICT", "") == "1":
+        return run_predict_microbench()
     if os.environ.get("BENCH_RANKING", "") == "1":
         return run_ranking_bench()
     import jax

@@ -1,6 +1,6 @@
 """R002 — recompilation hazards.
 
-Three sub-checks:
+Four sub-checks:
 
   (a) ``jax.jit(...)`` called inside a loop — a fresh jitted callable (and
       a fresh compile-cache entry) per iteration; hoist the jit out of the
@@ -16,11 +16,21 @@ Three sub-checks:
       style and is not flagged (statics are excluded from the traced set).
       ``is None`` / ``is not None`` tests are identity checks on the
       Python level and are ignored.
+  (d) a serving entry point (function whose name mentions
+      predict/infer/serve) passing request-derived data into a jitted
+      callable WITHOUT bucket padding: the jit key then carries the raw
+      request shape and every distinct batch size compiles a fresh
+      program (the 26-97s serving stalls BENCH_SHAPES.json recorded
+      before the bucketed engine). Values are cleared by flowing through
+      a call whose name mentions bucket/pad/tile/shard (e.g.
+      ``_pad_request_to_bucket``, ``np.pad``); deliberately unbucketed
+      reference paths carry an allowlist anchor.
 """
 from __future__ import annotations
 
 import ast
-from typing import List
+import re
+from typing import List, Set
 
 from .base import (Finding, ModuleInfo, PackageInfo, Rule, JIT_NAMES,
                    call_name, expr_references, traced_names)
@@ -55,6 +65,7 @@ class RecompileRule(Rule):
         out.extend(self._jit_in_loop(module))
         out.extend(self._unhashable_static_defaults(module))
         out.extend(self._tracer_branches(module, package))
+        out.extend(self._unbucketed_entry_shapes(module, package))
         return out
 
     # (a) ------------------------------------------------------------
@@ -125,4 +136,68 @@ class RecompileRule(Rule):
                         "TracerBoolConversionError under trace (use "
                         "jnp.where/lax.cond), or a per-call host sync "
                         "and recompile hazard outside it"))
+        return out
+
+    # (d) ------------------------------------------------------------
+    _ENTRY_RE = re.compile(r"predict|infer|serve", re.I)
+    _BUCKET_RE = re.compile(r"bucket|pad|tile|shard", re.I)
+
+    def _jit_callee(self, module: ModuleInfo, package: PackageInfo,
+                    node: ast.Call) -> bool:
+        """Does this call invoke a jit-compiled package function?"""
+        name = call_name(node)
+        if name is None:
+            return False
+        base = name.rsplit(".", 1)[-1]
+        return any(f.jit_decorated
+                   for f in package._callees(module, base))
+
+    def _unbucketed_entry_shapes(self, module: ModuleInfo,
+                                 package: PackageInfo) -> List[Finding]:
+        out: List[Finding] = []
+        for fn in module.functions.values():
+            if fn.jit_decorated or not self._ENTRY_RE.search(fn.basename):
+                continue
+            # taint = values carrying the raw request size: the entry's
+            # own parameters, plus locals derived from them — cleared by
+            # assignment from a bucket/pad-named call
+            tainted: Set[str] = {p for p in fn.pos_params + fn.kwonly_params
+                                 if p not in ("self", "cls")}
+
+            def clears(expr: ast.AST) -> bool:
+                return any(isinstance(c, ast.Call)
+                           and (call_name(c) or "")
+                           and self._BUCKET_RE.search(call_name(c))
+                           for c in ast.walk(expr))
+
+            # own_nodes is DFS order; the taint walk needs SOURCE order so
+            # a clearing assignment upstream of the call actually clears
+            ordered = sorted(fn.own_nodes(),
+                             key=lambda n: (getattr(n, "lineno", 0),
+                                            getattr(n, "col_offset", 0)))
+            for node in ordered:
+                if isinstance(node, ast.Assign) and \
+                        all(isinstance(t, ast.Name) for t in node.targets):
+                    names = [t.id for t in node.targets]
+                    if clears(node.value):
+                        tainted.difference_update(names)
+                    elif expr_references(node.value, tainted):
+                        tainted.update(names)
+                    else:
+                        tainted.difference_update(names)
+                elif isinstance(node, ast.Call) and \
+                        self._jit_callee(module, package, node):
+                    for arg in list(node.args) + \
+                            [kw.value for kw in node.keywords]:
+                        if expr_references(arg, tainted) and \
+                                not clears(arg):
+                            out.append(self.finding(
+                                module, node, fn.qualname,
+                                "jit entry fed request-derived data "
+                                "without bucket padding — the compiled "
+                                "program is keyed on the raw request "
+                                "shape and every distinct batch size "
+                                "recompiles; pad to a bucket ladder "
+                                "first (ops/predict.py bucket_rows)"))
+                            break
         return out

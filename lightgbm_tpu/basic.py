@@ -784,6 +784,65 @@ class Booster:
             raw.T if k > 1 else raw[0]))
         return conv
 
+    @read_locked
+    def predict_device(self, data: _ArrayLike,
+                       start_iteration: int = 0,
+                       num_iteration: Optional[int] = None):
+        """Serve raw scores WITHOUT materializing them on the host.
+
+        Bins the request, routes it through the bucketed inference engine
+        (ops/predict.py) and returns a device-resident ``jax.Array`` —
+        ``[N]`` raw scores for binary/regression, ``[N, K]`` for
+        multiclass — for downstream device pipelines to consume in HBM.
+        Steady-state calls (warm bucket rung) compile nothing; the only
+        transfers are the request upload and the final [K, rung] -> [K, N]
+        device-side slice. Loaded-from-file models predict on the host
+        path and are not supported here."""
+        inner = self._gbdt
+        if not hasattr(inner, "predict_raw_device"):
+            raise NotImplementedError(
+                "predict_device needs a trained booster (models loaded "
+                "from file predict on the host path; use predict())")
+        if getattr(self, "_pre_model", None) is not None:
+            # the loaded base model routes on the host (raw-value
+            # thresholds); silently serving only the new trees would be
+            # wrong — predict() merges both windows correctly
+            raise NotImplementedError(
+                "predict_device does not support continue-trained "
+                "boosters (the loaded base model predicts on the host "
+                "path); use predict()")
+        if num_iteration is None:
+            num_iteration = (self.best_iteration
+                             if self.best_iteration > 0 else None)
+        arr = np.asarray(_maybe_series(data), dtype=np.float64)
+        if arr.ndim == 1:
+            arr = arr.reshape(1, -1)
+        n = arr.shape[0]
+        import jax.numpy as jnp
+        binned = inner.bin_matrix(arr)
+        _, ladder, engine = inner._predict_cfg()
+        from .ops.predict import bucket_rows
+        if (engine != "scan" and bucket_rows(n, ladder) is None
+                and not inner._can_shard_predict(n, ladder)):
+            # above the ladder with no mesh: device-side concat of
+            # max-rung slices, each through the warm max-rung program
+            top = ladder[-1]
+            parts = [inner.predict_raw_device(
+                binned[a:a + top], num_iteration,
+                start_iteration)[:, :min(top, n - a)]
+                for a in range(0, n, top)]
+            raw = jnp.concatenate(parts, axis=1)
+        else:
+            raw = inner.predict_raw_device(binned, num_iteration,
+                                           start_iteration)[:, :n]
+        if inner.average_output:
+            with inner._trees_mu:
+                t_real = len(inner._model_window(num_iteration,
+                                                 start_iteration))
+            raw = raw / max(t_real // max(inner.num_tree_per_iteration, 1),
+                            1)
+        return raw[0] if raw.shape[0] == 1 else raw.T
+
     def _predict_contrib(self, arr, num_iteration):
         """Exact TreeSHAP contributions [N, K*(F+1)] (reference:
         PredictContrib -> Tree::TreeSHAP, src/io/tree.cpp).

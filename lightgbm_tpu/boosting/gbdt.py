@@ -33,7 +33,10 @@ from ..objectives import Objective
 from ..ops.compact import RowLayout, pack_rows, segments_to_leaf_vectors
 from ..ops.grower import GrowerParams, TreeArrays, grow_tree
 from ..ops.grower_compact import grow_tree_compact
-from ..ops.predict import StackedTrees, predict_raw, route_one_tree
+from ..ops.predict import (StackedTrees, bucket_rows, depth_bucket,
+                           early_stop_tbatch, parse_bucket_ladder,
+                           predict_leaf_batched, predict_raw_batched,
+                           predict_raw_scan, route_one_tree, tree_bucket)
 from ..parallel.multihost import to_host as _to_host
 from ..ops.renew import renew_leaf_quantile
 from ..utils import log
@@ -304,23 +307,36 @@ class HostTree:
         self.leaf_value = self.leaf_value + bias
 
 
-def stack_trees(models: Sequence[HostTree], max_nodes: int, max_leaves: int
+def stack_trees(models: Sequence[HostTree], max_nodes: int, max_leaves: int,
+                cat_w: Optional[int] = None, pad_to: Optional[int] = None
                 ) -> StackedTrees:
-    """Stack host trees into device arrays for scan-based prediction."""
+    """Stack host trees into device arrays for batch prediction.
+
+    ``pad_to`` pads the leading T axis (on host, before the transfer) up
+    to a tree-count bucket: padding entries are all-constant trees
+    (num_nodes == 0, leaf_value 0) that contribute exactly nothing, so
+    the padded stack predicts identically while the jit key stays on the
+    bucket. ``cat_w`` forces the categorical-bitset width (the bucketed
+    cache appends new trees into existing padded arrays, so widths must
+    match across fills)."""
     t = len(models)
+    t_pad = max(t, pad_to or t)
 
     def pad2(getter, fill, dtype, width):
-        out = np.full((t, width), fill, dtype=dtype)
+        out = np.full((t_pad, width), fill, dtype=dtype)
         for i, m in enumerate(models):
             a = getter(m)
             out[i, : len(a)] = a
         return jnp.asarray(out)
 
-    cat_w = max((m.cat_bitset.shape[1] for m in models), default=1)
-    cat = np.zeros((t, max_nodes, cat_w), np.uint32)
+    cat_w = max(cat_w or 1,
+                max((m.cat_bitset.shape[1] for m in models), default=1))
+    cat = np.zeros((t_pad, max_nodes, cat_w), np.uint32)
     for i, m in enumerate(models):
         cb = m.cat_bitset
         cat[i, : cb.shape[0], : cb.shape[1]] = cb
+    nn = np.zeros(t_pad, np.int32)
+    nn[:t] = [m.num_nodes for m in models]
     return StackedTrees(
         split_feature=pad2(lambda m: m.split_feature, -1, np.int32, max_nodes),
         split_bin=pad2(lambda m: m.split_bin, 0, np.int32, max_nodes),
@@ -329,7 +345,7 @@ def stack_trees(models: Sequence[HostTree], max_nodes: int, max_leaves: int
         left_child=pad2(lambda m: m.left_child, -1, np.int32, max_nodes),
         right_child=pad2(lambda m: m.right_child, -1, np.int32, max_nodes),
         leaf_value=pad2(lambda m: m.leaf_value, 0.0, np.float32, max_leaves),
-        num_nodes=jnp.asarray([m.num_nodes for m in models], jnp.int32),
+        num_nodes=jnp.asarray(nn),
     )
 
 
@@ -457,7 +473,12 @@ class GBDT:
         self.valid_sets: List[_ValidSet] = []
         self.train_metrics: List[Metric] = []
         self.best_iteration = -1
-        self._device_trees_cache: Optional[StackedTrees] = None
+        # bucketed device-tree cache (see _device_trees_batched): per
+        # tbatch, stacked trees padded to the tree-count bucket plus fill
+        # metadata. APPENDED trees extend a slot in place; the cache is
+        # set to None only where existing models are mutated or removed
+        # (rollback, DART drops/normalization, RF vote scaling, reload)
+        self._device_trees_cache: Optional[Dict[int, Dict[str, Any]]] = None
         # serializes the pending-tree flush and the device-tree cache fill,
         # so concurrent Booster.predict readers (basic.py read lock) never
         # interleave _flush_trees' models/_dev_trees mutation; re-entrant
@@ -581,6 +602,16 @@ class GBDT:
             train_set.feature_is_categorical(), False))
         self.base_feat_mask = fpad(np.array(
             [not m.is_trivial for m in train_set.mappers], dtype=bool), False)
+        # inference-engine flags: prediction inputs arrive in ORIGINAL
+        # feature space, so categorical presence and 4-bit-pack
+        # eligibility come from the raw mappers (ops/predict.py engine)
+        self._pred_any_cat = bool(np.any(train_set.feature_is_categorical()))
+        from ..io.dataset import pack4_eligible
+        want_pack4 = bool(cfg.get("tpu_bin_pack4", False))
+        self._pred_pack4 = want_pack4 and pack4_eligible(train_set.mappers)
+        if want_pack4 and not self._pred_pack4:
+            log.warning("tpu_bin_pack4=true needs every feature to have "
+                        "<= 16 bins (max_bin <= 15); serving the u8 matrix")
 
         nf = train_set.num_total_features
         mono_np = _parse_monotone(cfg.get("monotone_constraints"), nf,
@@ -1550,7 +1581,9 @@ class GBDT:
                 tree = tree._replace(
                     leaf_value=tree.leaf_value + self._init_scores[k])
             self._dev_trees.append((tree, self.shrinkage_rate))
-            self._device_trees_cache = None
+            # NOTE: appends do NOT invalidate the device-tree cache — the
+            # bucketed cache append-pads new trees in (mid-train predict
+            # used to re-stack the whole model every iteration)
 
         self.iter_ += 1
         if len(self._dev_trees) >= k_total * self.stop_check_freq:
@@ -1898,7 +1931,6 @@ class GBDT:
                 tree = tree._replace(
                     leaf_value=tree.leaf_value + self._init_scores[cur_tree_id])
             self._dev_trees.append((tree, self.shrinkage_rate))
-            self._device_trees_cache = None
 
         self.iter_ += 1
         if self._linear:
@@ -1908,6 +1940,9 @@ class GBDT:
                 # pop the failed iteration unless it is the very first
                 if len(self.models) > k:
                     del self.models[-k:]
+                    # removal, not append: a cached stack may hold the
+                    # popped trees (append-pad cannot repair deletions)
+                    self._device_trees_cache = None
                 self.iter_ -= 1
                 log.warning("Stopped training because there are no more "
                             "leaves that meet the split requirements")
@@ -1955,7 +1990,6 @@ class GBDT:
             host.leaf_value = host.leaf_value + init
             add_bias_linear(host, init)
         self.models.append(host)
-        self._device_trees_cache = None
         return host.num_nodes > 0
 
     @property
@@ -2006,6 +2040,8 @@ class GBDT:
         if len(tail) == k and all(m.num_nodes == 0 for m in tail):
             if len(models) > k:
                 models = models[:-k]
+                # removal: drop any cached stack holding the popped tail
+                self._device_trees_cache = None
             self.iter_ -= 1
             log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")
@@ -2157,34 +2193,223 @@ class GBDT:
         return out
 
     # -- prediction ----------------------------------------------------------
-    def device_trees(self, num_iteration: Optional[int] = None,
-                     start_iteration: int = 0) -> StackedTrees:
-        # cache fill and model-list read run under the trees mutex so
-        # concurrent read-locked predicts (basic.py) see a consistent
-        # (models, cache) pair — the reference serializes the same window
-        # behind its shared C API lock (src/c_api.cpp:163)
+    def _predict_cfg(self):
+        """(tbatch, row-bucket ladder, engine) resolved from config per
+        call — cheap, and reset_parameter may change them mid-session."""
+        cfg = self.config
+        tb = max(1, min(int(cfg.get("tpu_predict_tbatch", 16) or 16), 128))
+        ladder = parse_bucket_ladder(cfg.get("tpu_predict_buckets", "auto"))
+        engine = str(cfg.get("tpu_predict_engine", "batched")).lower()
+        return tb, ladder, engine
+
+    def _pred_route_args(self):
+        """(nan_bin, is_cat) in ORIGINAL feature space — prediction inputs
+        are binned per original feature (no bundling)."""
+        if self._efb is not None:
+            return self._orig_nan_arr, self._orig_cat_arr
+        return self.nan_bin_arr, self.is_cat_arr
+
+    def _model_window(self, num_iteration: Optional[int],
+                      start_iteration: int) -> List[HostTree]:
+        """Model slice for a prediction window (reference: start_iteration
+        in GBDT::Predict* / Predictor; num_iteration_for_pred_)."""
+        models = self.models
+        k = self.num_tree_per_iteration
+        if start_iteration > 0:
+            models = models[start_iteration * k:]
+        if num_iteration is not None and num_iteration > 0:
+            models = models[: num_iteration * k]
+        return models
+
+    @staticmethod
+    def _models_max_depth(models: Sequence[HostTree]) -> int:
+        """Deepest root-to-leaf path in the window — the walk-step count
+        the engine needs (recorded per HostTree by the grower)."""
+        return max((int(np.max(m.leaf_depth[:m.num_leaves], initial=0))
+                    for m in models), default=0)
+
+    def _device_trees_plain(self, num_iteration: Optional[int] = None,
+                            start_iteration: int = 0):
+        """(unpadded StackedTrees, t_real): the pre-engine layout, kept for
+        tpu_predict_engine=scan (parity/bench reference path)."""
         with self._trees_mu:
             self._flush_trees()
-            models = self.models
-            k = self.num_tree_per_iteration
-            if start_iteration > 0:
-                # (reference: start_iteration in GBDT::Predict* / Predictor)
-                models = models[start_iteration * k:]
-            if num_iteration is not None and num_iteration > 0:
-                models = models[: num_iteration * k]
-            if num_iteration is None and start_iteration == 0 \
-                    and self._device_trees_cache is not None:
-                return self._device_trees_cache
+            models = self._model_window(num_iteration, start_iteration)
+            max_lv = max((len(m.leaf_value) for m in models),
+                         default=self.max_leaves)
+            return stack_trees(models, max_lv - 1, max_lv), len(models)
+
+    #: device-tree cache slots kept before evicting the oldest (each slot
+    #: holds one padded model copy on device; serving uses 1-2 slots)
+    _DTC_SLOTS = 8
+
+    def _device_trees_batched(self, num_iteration: Optional[int] = None,
+                              start_iteration: int = 0, tbatch: int = 16):
+        """(StackedTrees padded to the tree-count bucket, t_real, depth).
+
+        Cached per (tbatch, start_iteration, num_iteration) and
+        APPEND-PADDED: trees grown since the last fill are stacked alone
+        (a transfer the size of the delta, not the model) and written
+        into the padded device arrays, so mid-train predict stops
+        re-stacking the whole model every iteration. Windows are
+        first-class keys because they ARE the common serving shape —
+        Booster.predict defaults num_iteration to best_iteration after
+        early-stopped training — and the models list is append-only, so
+        a window's contents are stable under appends. Distinct chunk
+        sizes (plain vs early-stop predicts) get their own slots; the
+        oldest slot is evicted past _DTC_SLOTS. Cache fill and
+        model-list read run under the trees mutex so concurrent
+        read-locked predicts (basic.py) see a consistent (models, cache)
+        pair — the reference serializes the same window behind its
+        shared C API lock (src/c_api.cpp:163).
+        """
+        with self._trees_mu:
+            self._flush_trees()
+            models = self._model_window(num_iteration, start_iteration)
+            t = len(models)
             # width from the models themselves: num_leaves may have been
             # changed mid-training via reset_parameter
             max_lv = max((len(m.leaf_value) for m in models),
                          default=self.max_leaves)
-            st = stack_trees(models, max_lv - 1, max_lv)
-            if num_iteration is None and start_iteration == 0:
-                self._device_trees_cache = st
-            return st
+            cat_w = max((m.cat_bitset.shape[1] for m in models), default=1)
+            t_bkt = tree_bucket(t, tbatch)
+            if self._device_trees_cache is None:
+                self._device_trees_cache = {}
+            cache = self._device_trees_cache
+            key = (tbatch, start_iteration,
+                   num_iteration if num_iteration is not None
+                   and num_iteration > 0 else None)
+            c = cache.get(key)
+            if (c is not None and c["max_lv"] == max_lv
+                    and c["cat_w"] == cat_w and t >= c["t_real"]):
+                if t > c["t_real"]:
+                    t0 = c["t_real"]
+                    fresh = stack_trees(models[t0:], max_lv - 1, max_lv,
+                                        cat_w=cat_w)
+                    st = c["st"]
+                    if t_bkt != c["t_bucket"]:
+                        # bucket grew: extend the padded arrays on device
+                        # (the old trees never re-cross PCIe)
+                        grow = t_bkt - c["t_bucket"]
+                        st = jax.tree.map(
+                            lambda a: jnp.concatenate(
+                                [a, jnp.zeros((grow,) + a.shape[1:],
+                                              a.dtype)]), st)
+                    st = jax.tree.map(lambda a, new: a.at[t0:t].set(new),
+                                      st, fresh)
+                    c.update(st=st, t_real=t, t_bucket=t_bkt,
+                             depth=max(c["depth"],
+                                       self._models_max_depth(models[t0:])))
+                return c["st"], c["t_real"], c["depth"]
+            depth = self._models_max_depth(models)
+            st = stack_trees(models, max_lv - 1, max_lv, cat_w=cat_w,
+                             pad_to=t_bkt)
+            cache[key] = {
+                "st": st, "t_real": t, "t_bucket": t_bkt, "depth": depth,
+                "max_lv": max_lv, "cat_w": cat_w}
+            while len(cache) > self._DTC_SLOTS:
+                cache.pop(next(k for k in cache if k != key))
+            return st, t, depth
 
-    def predict_raw_binned(self, binned: jax.Array,
+    def _pad_request_to_bucket(self, mat: np.ndarray, rung: int,
+                               packed: bool) -> jax.Array:
+        """Host-pad a request matrix to its bucket rung and device_put.
+
+        Pure numpy + one transfer: no compilation, no device->host — the
+        zero-recompile serving contract depends on the padding happening
+        BEFORE the array reaches a jitted program (tpulint R002)."""
+        if mat.shape[0] != rung:
+            mat = np.pad(mat, ((0, rung - mat.shape[0]), (0, 0)))
+        if packed:
+            from ..io.dataset import pack4_matrix
+            mat = pack4_matrix(mat)
+        return jnp.asarray(mat)
+
+    def predict_raw_device(self, binned,
+                           num_iteration: Optional[int] = None,
+                           start_iteration: int = 0,
+                           early_stop=None) -> jax.Array:
+        """Raw UNAVERAGED score sums, left on device: [K, n_padded] with
+        the first ``binned.shape[0]`` columns valid.
+
+        The serving hot path: numpy requests pad on host up to a bucket
+        rung, trees come from the bucketed append-pad cache, and the
+        jitted engine program is keyed on (row rung, tree bucket, depth
+        bucket, num_class) — after one warmup per rung, mixed batch
+        sizes run with zero compiles and zero device->host transfers.
+        Requests larger than the ladder run as one GSPMD row-sharded
+        program over the training mesh when one exists (each shard padded
+        to its own rung), else they are the caller's to slice
+        (predict_raw_binned does). ``early_stop`` is an optional
+        (margin, freq) pair (reference: prediction_early_stop.cpp)."""
+        k = self.num_tree_per_iteration
+        n = binned.shape[0]
+        tb_cfg, ladder, engine = self._predict_cfg()
+        margin, freq = early_stop if early_stop else (0.0, 0)
+        use_stop = freq > 0 and margin > 0.0
+        nan_a, cat_a = self._pred_route_args()
+        if engine == "scan":
+            # pre-engine reference path: serial tree scan, jitted on the
+            # concrete batch shape (recompiles per size by design)
+            st, _ = self._device_trees_plain(num_iteration, start_iteration)
+            return predict_raw_scan(
+                jnp.asarray(binned), st, nan_a, cat_a, np.int32(k), k,
+                early_stop_margin=float(margin) if use_stop else 0.0,
+                early_stop_freq=int(freq) if use_stop else 0)
+        # with early stopping the tree chunk must land on the reference's
+        # exact iteration-multiple-of-freq checkpoints
+        tbatch = early_stop_tbatch(k, freq, tb_cfg) if use_stop else tb_cfg
+        st, t_real, depth = self._device_trees_batched(
+            num_iteration, start_iteration, tbatch)
+        if t_real == 0:
+            return jnp.zeros((k, n), jnp.float32)
+        kwargs = dict(
+            num_class=k, depth=depth_bucket(depth), tbatch=tbatch,
+            early_stop_margin=float(margin) if use_stop else 0.0,
+            early_stop_freq=int(freq) if use_stop else 0,
+            any_cat=self._pred_any_cat)
+        kk = np.int32(k)
+        if not isinstance(binned, np.ndarray):
+            # device-array input (internal/test path): pad eagerly when a
+            # rung fits; nibble packing applies to host requests only
+            rung = bucket_rows(n, ladder)
+            if rung is not None and rung != n:
+                binned = jnp.pad(binned, ((0, rung - n), (0, 0)))
+            return predict_raw_batched(binned, st, nan_a, cat_a, kk,
+                                       packed=False, **kwargs)
+        packed = self._pred_pack4
+        rung = bucket_rows(n, ladder)
+        if rung is not None:
+            dev = self._pad_request_to_bucket(binned, rung, packed)
+            return predict_raw_batched(dev, st, nan_a, cat_a, kk,
+                                       packed=packed, **kwargs)
+        if self._can_shard_predict(n, ladder):
+            from ..parallel.mesh import predict_shard_pad, row_sharding_2d
+            num_shards = len(self.mesh.devices.ravel())
+            n_pad = predict_shard_pad(n, num_shards, ladder)
+            mat = np.pad(binned, ((0, n_pad - n), (0, 0)))
+            if packed:
+                from ..io.dataset import pack4_matrix
+                mat = pack4_matrix(mat)
+            dev = jax.device_put(mat, row_sharding_2d(self.mesh))
+            return predict_raw_batched(dev, st, nan_a, cat_a, kk,
+                                       packed=packed, **kwargs)
+        raise ValueError(
+            f"request of {n} rows overflows the serving ladder "
+            f"(max {ladder[-1]}) and cannot be row-sharded here; slice it "
+            "(predict_raw_binned does) or raise tpu_predict_buckets")
+
+    def _can_shard_predict(self, n: int, ladder) -> bool:
+        """True when an oversize request can run as ONE GSPMD row-sharded
+        program over the training mesh (per-shard share fits the ladder);
+        otherwise callers slice through the largest rung."""
+        if self.mesh is None or getattr(self, "_multiproc", False):
+            return False
+        from ..parallel.mesh import predict_shard_pad
+        num_shards = len(self.mesh.devices.ravel())
+        return predict_shard_pad(n, num_shards, ladder) is not None
+
+    def predict_raw_binned(self, binned,
                            num_iteration: Optional[int] = None,
                            start_iteration: int = 0,
                            early_stop=None) -> np.ndarray:
@@ -2195,22 +2420,33 @@ class GBDT:
         if not self.models:
             n = binned.shape[0]
             return np.zeros((self.num_tree_per_iteration, n), np.float32)
-        trees = self.device_trees(num_iteration, start_iteration)
-        # prediction inputs are binned per ORIGINAL feature (no bundling)
-        nan_a, cat_a = ((self._orig_nan_arr, self._orig_cat_arr)
-                        if self._efb is not None
-                        else (self.nan_bin_arr, self.is_cat_arr))
-        raw = predict_raw(
-            jnp.asarray(binned), trees, nan_a, cat_a,
-            jnp.asarray(self.num_tree_per_iteration, jnp.int32),
-            self.num_tree_per_iteration,
-            early_stop_margin=(early_stop[0] if early_stop else 0.0),
-            early_stop_freq=(early_stop[1] if early_stop else 0))
-        raw = np.asarray(raw)
+        n = binned.shape[0]
+        _, ladder, engine = self._predict_cfg()
+        oversize = (engine != "scan" and isinstance(binned, np.ndarray)
+                    and bucket_rows(n, ladder) is None
+                    and not self._can_shard_predict(n, ladder))
+        if oversize:
+            # above the ladder with no mesh: slices of the largest rung,
+            # each hitting the warm max-rung program (early stopping is
+            # per row, so slicing preserves its semantics exactly)
+            top = ladder[-1]
+            parts = []
+            for a in range(0, n, top):
+                raw = self.predict_raw_device(
+                    binned[a:a + top], num_iteration, start_iteration,
+                    early_stop)
+                parts.append(np.asarray(raw)[:, :min(top, n - a)])
+            raw = np.concatenate(parts, axis=1)
+        else:
+            raw = np.asarray(self.predict_raw_device(
+                binned, num_iteration, start_iteration, early_stop))[:, :n]
         if self.average_output:
             # divide by the iteration count actually accumulated (after the
             # start/num slicing), reference: num_iteration_for_pred_
-            n_iters = trees.num_trees // max(self.num_tree_per_iteration, 1)
+            with self._trees_mu:
+                t_real = len(self._model_window(num_iteration,
+                                                start_iteration))
+            n_iters = t_real // max(self.num_tree_per_iteration, 1)
             raw = raw / max(n_iters, 1)
         return raw
 
@@ -2259,15 +2495,34 @@ class GBDT:
     def predict_leaf_matrix(self, arr: np.ndarray,
                             num_iteration: Optional[int] = None,
                             start_iteration: int = 0) -> np.ndarray:
-        from ..ops.predict import predict_leaf_index
+        """Per-row, per-tree leaf indices [N, T] via the walk engine
+        (reference: PredictLeafIndex), bucketed like predict_raw_device."""
         binned = self.bin_matrix(arr)
-        trees = self.device_trees(num_iteration, start_iteration)
-        nan_a, cat_a = ((self._orig_nan_arr, self._orig_cat_arr)
-                        if self._efb is not None
-                        else (self.nan_bin_arr, self.is_cat_arr))
-        leaves = predict_leaf_index(
-            jnp.asarray(binned), trees, nan_a, cat_a)
-        return np.asarray(leaves).T
+        n = binned.shape[0]
+        nan_a, cat_a = self._pred_route_args()
+        tb, ladder, engine = self._predict_cfg()
+        if engine == "scan":
+            from ..ops.predict import predict_leaf_index
+            trees, _ = self._device_trees_plain(num_iteration,
+                                                start_iteration)
+            return np.asarray(predict_leaf_index(
+                jnp.asarray(binned), trees, nan_a, cat_a)).T
+        st, t_real, depth = self._device_trees_batched(
+            num_iteration, start_iteration, tb)
+        if t_real == 0 or n == 0:
+            return np.zeros((n, t_real), np.int32)
+        packed = self._pred_pack4
+        top = ladder[-1]
+        parts = []
+        for a in range(0, n, top):
+            sl = binned[a:a + top]
+            rung = bucket_rows(sl.shape[0], ladder)
+            dev = self._pad_request_to_bucket(sl, rung, packed)
+            lv = predict_leaf_batched(
+                dev, st, nan_a, cat_a, depth=depth_bucket(depth),
+                tbatch=tb, any_cat=self._pred_any_cat, packed=packed)
+            parts.append(np.asarray(lv)[:t_real, :sl.shape[0]])
+        return np.concatenate(parts, axis=1).T
 
     @property
     def current_iteration(self) -> int:

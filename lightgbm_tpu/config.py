@@ -191,6 +191,19 @@ PARAMS: Dict[str, Tuple[Any, type, Tuple[str, ...]]] = {
     "tpu_fused_block": (512, int, ()),      # fused kernel block size (x32)
     "tpu_fused_interpret": (False, bool, ()),  # CI: Pallas interpret on CPU
     "num_shards": (0, int, ()),             # 0 = use all local devices when tree_learner != serial
+    # inference engine (ops/predict.py): trees walked tbatch at a time so
+    # each depth step is one [Tb, N] gather dispatch
+    "tpu_predict_tbatch": (16, int, ("predict_tbatch",)),
+    # row-bucket ladder for zero-recompile serving: requests pad up to a
+    # geometric rung ("auto" = x2 from 1k to 1M) and the jitted predict
+    # program is keyed on (row rung, tree bucket, depth bucket, num_class)
+    "tpu_predict_buckets": ("auto", str, ("predict_buckets",)),
+    # escape hatch: "scan" restores the pre-engine serial tree scan
+    # (recompiles per batch shape; parity/bench reference)
+    "tpu_predict_engine": ("batched", str, ()),
+    # 4-bit nibble packing of served request matrices when every feature
+    # has <= 16 bins (io/dataset.py pack4_matrix; halves request HBM)
+    "tpu_bin_pack4": (False, bool, ("bin_pack4",)),
     # snapshot / continue
     "snapshot_freq": (-1, int, ("save_period",)),
     "input_model": ("", str, ("model_input", "model_in")),

@@ -605,6 +605,41 @@ def _apply_bundles(binned, info, ds, max_conflict_rate=1e-4):
     return out
 
 
+# -- 4-bit dense bin packing (reference: the 4-bit mode of the dense bin
+# store, src/io/dense_bin.hpp DenseBin<true>: two bins per byte) -----------
+def pack4_eligible(mappers) -> bool:
+    """True when every feature's realized bin count fits a nibble, so the
+    bin matrix can store two columns per byte (``tpu_bin_pack4``). The
+    check is per-ORIGINAL-feature: prediction inputs are binned in
+    original feature space, so EFB bundling of the training matrix does
+    not affect eligibility."""
+    return bool(mappers) and all(m.num_bins <= 16 for m in mappers)
+
+
+def pack4_matrix(binned: np.ndarray) -> np.ndarray:
+    """[N, F] u8 (all values < 16) -> [N, ceil(F/2)] u8 nibble-packed.
+
+    Column ``2j`` lands in the low nibble of packed column ``j``,
+    ``2j+1`` in the high nibble — the layout ops/packed.py unpack4 and
+    the predict walk's nibble gather invert. Halves the HBM footprint of
+    a served request matrix."""
+    if binned.dtype != np.uint8:
+        raise ValueError("pack4_matrix needs a uint8 bin matrix")
+    if binned.shape[1] % 2:
+        binned = np.pad(binned, ((0, 0), (0, 1)))
+    return (binned[:, 0::2] | (binned[:, 1::2] << 4)).astype(np.uint8)
+
+
+def unpack4_matrix(packed: np.ndarray, num_features: int) -> np.ndarray:
+    """Host inverse of ``pack4_matrix`` (round-trip tested)."""
+    lo = packed & 0x0F
+    hi = (packed >> 4) & 0x0F
+    out = np.empty((packed.shape[0], packed.shape[1] * 2), np.uint8)
+    out[:, 0::2] = lo
+    out[:, 1::2] = hi
+    return out[:, :num_features]
+
+
 def _resolve_categorical(
     categorical_feature: Optional[Sequence[Union[int, str]]],
     feature_names: List[str],

@@ -143,6 +143,7 @@ def histogram_block(
     num_bins: int,
     impl: str = "auto",
     mbatch: int = 1,
+    packed4_features: int = 0,
 ) -> jax.Array:             # [F, B, K] f32 (int32 for int8 channels)
     """Histogram of one already-sliced row block (no psum, no jit wrapper —
     call sites are inside jitted loops).
@@ -154,7 +155,19 @@ def histogram_block(
     ``mbatch`` (env/param ``tpu_hist_mbatch``) is the batched-M depth:
     the Mosaic kernel issues M = 8*mbatch MXU rows per contraction, the
     XLA engine contracts mbatch row chunks per einsum. Counts and int32
-    sums are bit-identical across mbatch values."""
+    sums are bit-identical across mbatch values.
+
+    ``packed4_features``: the block arrives nibble-packed
+    ([BS, ceil(F/2)] u8, ``tpu_bin_pack4`` — io/dataset.py pack4_matrix)
+    and is unpacked here, inside the jitted block loop, so only one
+    block's full width ever materializes while the HBM-resident matrix
+    stays at half size. This is the engine-level hook for packed bin
+    matrices (parity-tested in tests/test_predict_engine.py);
+    ``tpu_bin_pack4`` currently packs SERVED request matrices only — no
+    trainer path feeds packed blocks yet (training matrices stay u8)."""
+    if packed4_features:
+        from .packed import unpack4
+        binned = unpack4(binned, packed4_features)
     impl = _resolve_impl(impl, num_bins, binned.shape[1])
     if impl == "pallas":
         from .pallas_histogram import pallas_histogram

@@ -1,0 +1,42 @@
+"""Device-side helpers for the 4-bit packed bin matrix.
+
+Features whose realized bin count is <= 16 fit two bins per byte; the host
+packer (io/dataset.py pack4_matrix) stores column ``2j`` in the low nibble
+and ``2j+1`` in the high nibble of packed column ``j`` (reference: the
+4-bit mode of the dense bin store, src/io/dense_bin.hpp DenseBin<true> —
+same nibble order). Packing halves the HBM footprint of a served request
+matrix; consumers unpack *inside* their gathers so the full-width [N, F]
+matrix never materializes on device.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def unpack4(packed: jax.Array, num_features: int) -> jax.Array:
+    """[..., ceil(F/2)] u8 nibble-packed -> [..., F] u8.
+
+    The histogram engines call this on one streamed row block at a time
+    (ops/histogram.py), so the unpacked width is a transient the size of
+    one block, not the dataset.
+    """
+    lo = packed & jnp.uint8(0x0F)
+    hi = (packed >> 4) & jnp.uint8(0x0F)
+    full = jnp.stack([lo, hi], axis=-1).reshape(*packed.shape[:-1], -1)
+    return full[..., :num_features]
+
+
+def gather_bin(binned: jax.Array, rows: jax.Array, col: jax.Array,
+               packed: bool) -> jax.Array:
+    """Per-row dynamic column gather ``binned[rows, col]`` -> i32.
+
+    With ``packed`` the byte at column ``col >> 1`` is gathered and the
+    nibble selected by ``col & 1`` is extracted — one gather either way,
+    which is what keeps the packed predict walk the same number of
+    dispatches as the u8 one.
+    """
+    if packed:
+        byte = binned[rows, col >> 1].astype(jnp.int32)
+        return (byte >> ((col & 1) * 4)) & 0xF
+    return binned[rows, col].astype(jnp.int32)

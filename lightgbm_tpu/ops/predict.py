@@ -1,33 +1,53 @@
-"""On-device prediction over struct-of-arrays trees.
+"""On-device inference engine over struct-of-arrays trees.
 
 TPU-native re-design of the reference's prediction path
 (reference: Tree::Predict pointer-chasing threshold walk include/LightGBM/tree.h:134,
 GBDT::PredictRaw src/boosting/gbdt_prediction.cpp, OMP-over-rows Predictor
 src/application/predictor.hpp:244).
 
-Pointer-chasing is hostile to TPUs; instead rows are routed *level-synchronously*:
-internal nodes are created in monotonically increasing index order (children
-always have a larger node id than their parent — grower.py invariant), so a
-single in-order sweep ``k = 0..L-2`` over nodes routes every row with one
-feature-column gather per step. All rows move in lockstep; there is no
-data-dependent control flow, so the whole multi-tree prediction compiles to one
-XLA program (scan over trees) with zero host syncs.
+Three stacked designs live here:
+
+  * ``route_one_tree`` / ``predict_raw_scan`` — the level-synchronous node
+    sweep: every node ``k = 0..L-2`` is visited in creation order and rows
+    sitting on it move to a child. O(T*L*N), one column slice per node.
+    Kept as the bit-exact reference path (parity tests, bench baseline,
+    and per-tree routing during training where L is the natural bound).
+  * ``predict_raw_batched`` / ``predict_leaf_batched`` — the serving
+    engine. Each row carries its current node id and takes D steps
+    (D = the stacked model's max depth, recorded at tree-stacking time);
+    every step is ONE gather of the packed per-node record
+    (col/bin/children/defaults) by node id plus one binned-column gather,
+    and leaves self-loop through the negative child encoding. Trees run
+    ``tbatch`` at a time so the per-step gathers are ``[Tb, N]``-shaped
+    single dispatches instead of T sequential scan steps — O(T*D*N) with
+    leaf indices bit-identical to the sweep.
+  * bucket ladders (``parse_bucket_ladder`` & friends) — callers pad the
+    row count, tree count, and walk depth up to geometric rungs so the
+    jitted program is keyed on (row bucket, tree bucket, depth bucket,
+    num_class) and steady-state serving hits a warm jit cache: mixed
+    request sizes compile once per rung, then never again.
+
+All rows move in lockstep; there is no data-dependent control flow, so
+prediction compiles to one XLA program with zero host syncs.
 """
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional
+from typing import NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .packed import gather_bin
+
 
 class StackedTrees(NamedTuple):
     """All trees of a model stacked along a leading T axis (pytree-of-arrays).
 
-    The reference keeps ``std::vector<std::unique_ptr<Tree>>`` (gbdt.h) and loops
-    trees serially per row; here the T axis is a ``lax.scan`` axis.
+    The reference keeps ``std::vector<std::unique_ptr<Tree>>`` (gbdt.h) and
+    loops trees serially per row; here the T axis is either a ``lax.scan``
+    axis (reference path) or chunked ``tbatch`` trees at a time (engine).
     """
     split_feature: jax.Array   # [T, L-1] i32
     split_bin: jax.Array       # [T, L-1] i32
@@ -47,6 +67,100 @@ class StackedTrees(NamedTuple):
         return self.split_feature.shape[1]
 
 
+# ---------------------------------------------------------------------------
+# bucket ladders: the zero-recompile serving contract
+# ---------------------------------------------------------------------------
+
+#: default row-bucket ladder: x2 from 1k up to 1M (tpu_predict_buckets)
+DEFAULT_MIN_BUCKET = 1024
+DEFAULT_MAX_BUCKET = 1 << 20
+
+
+def parse_bucket_ladder(spec) -> Tuple[int, ...]:
+    """Resolve ``tpu_predict_buckets`` into a sorted rung tuple.
+
+    ``"auto"`` (default) is the geometric x2 ladder 1k..1M; a comma string
+    or int sequence gives an explicit ladder. Rows pad up to the smallest
+    rung that fits, so every rung is one jit cache entry — the recompile
+    contract is "at most len(ladder) compiles per (tree bucket, depth
+    bucket, num_class), ever".
+    """
+    if spec is None or (isinstance(spec, str)
+                        and spec.strip().lower() in ("", "auto")):
+        out, b = [], DEFAULT_MIN_BUCKET
+        while b <= DEFAULT_MAX_BUCKET:
+            out.append(b)
+            b *= 2
+        return tuple(out)
+    if isinstance(spec, str):
+        rungs = [int(float(t)) for t in spec.split(",") if t.strip()]
+    else:
+        rungs = [int(t) for t in spec]
+    rungs = sorted({r for r in rungs if r > 0})
+    if not rungs:
+        raise ValueError(f"tpu_predict_buckets={spec!r} has no positive rungs")
+    return tuple(rungs)
+
+
+def bucket_rows(n: int, ladder: Sequence[int]) -> Optional[int]:
+    """Smallest rung >= n, or None when n overflows the ladder (callers
+    then slice the request into max-rung pieces or row-shard it)."""
+    for rung in ladder:
+        if n <= rung:
+            return rung
+    return None
+
+
+def tree_bucket(t: int, tbatch: int) -> int:
+    """Tree-count bucket: the smallest ``tbatch * 2**j`` >= t.
+
+    Mid-training predict crosses a rung only O(log T) times, so the
+    in-training predict program recompiles logarithmically instead of
+    once per iteration; the padded tail is all-constant trees
+    (num_nodes == 0) that contribute exactly 0.
+    """
+    b = max(tbatch, 1)
+    while b < t:
+        b *= 2
+    return b
+
+
+def depth_bucket(d: int) -> int:
+    """Walk-depth bucket: the smallest ``4 * 2**j`` >= d. Extra steps are
+    free of semantics (leaves self-loop), so padding the depth keeps the
+    jit key stable while trees deepen during training."""
+    b = 4
+    while b < d:
+        b *= 2
+    return b
+
+
+def early_stop_tbatch(k: int, freq: int, tbatch: int) -> int:
+    """Largest tree-chunk size that lands a chunk boundary on EVERY
+    iteration multiple of ``freq`` (reference: the per-round counter in
+    gbdt_prediction.cpp checks at exact iteration boundaries).
+
+    Chunks are ``k * f`` trees with ``f`` a divisor of ``freq`` no larger
+    than the configured batch, so the margin check runs at precisely the
+    reference's boundaries and tree batching never skips or adds one.
+    """
+    k = max(k, 1)
+    freq = max(freq, 1)
+    best = 1
+    f = 1
+    while f * f <= freq:
+        if freq % f == 0:
+            for d in (f, freq // f):
+                if k * d <= max(tbatch, k) and d > best:
+                    best = d
+        f += 1
+    return k * best
+
+
+# ---------------------------------------------------------------------------
+# reference path: level-synchronous node sweep
+# ---------------------------------------------------------------------------
+
 @jax.jit
 def route_one_tree(
     binned: jax.Array,        # [N, F] uint8/16
@@ -63,9 +177,18 @@ def route_one_tree(
 ) -> jax.Array:
     """Return the leaf index [N] each row lands in for one tree.
 
-    ``col_of`` translates original feature ids to stored-column ids when the
-    binned matrix is EFB-bundled (io/efb.py); bundled features must then have
-    is_cat_arr True (they route by the bitset the grower recorded)."""
+    Node-sweep routing: internal nodes are created in monotonically
+    increasing index order (children always have a larger node id than
+    their parent — grower.py invariant), so a single in-order sweep
+    ``k = 0..L-2`` routes every row with one feature-column gather per
+    step. This is the bit-exactness reference for the depth walk below
+    and the natural per-tree router during training (valid-set score
+    updates, rollback), where one tree is routed at a time.
+
+    ``col_of`` translates original feature ids to stored-column ids when
+    the binned matrix is EFB-bundled (io/efb.py); bundled features must
+    then have is_cat_arr True (they route by the bitset the grower
+    recorded)."""
     from .split import go_left_pred
 
     n = binned.shape[0]
@@ -94,7 +217,7 @@ def route_one_tree(
 
 @functools.partial(jax.jit, static_argnames=(
     "num_class", "early_stop_margin", "early_stop_freq"))
-def predict_raw(
+def predict_raw_scan(
     binned: jax.Array,         # [N, F]
     trees: StackedTrees,
     nan_bin_arr: jax.Array,    # [F] i32
@@ -104,7 +227,12 @@ def predict_raw(
     early_stop_margin: float = 0.0,
     early_stop_freq: int = 0,
 ) -> jax.Array:
-    """Accumulate raw scores over all trees; returns [num_class, N].
+    """Accumulate raw scores over all trees serially; returns [num_class, N].
+
+    The pre-engine path: ``lax.scan`` over trees, each routed with the
+    O(L) node sweep, jitted on the CONCRETE batch shape. Kept as the
+    semantic reference for parity tests and as the bench baseline; the
+    serving path is ``predict_raw_batched``.
 
     Trees are stored iteration-major (reference: GBDT::models_ ordering — tree
     ``t`` belongs to class ``t % num_class``), matching gbdt_prediction.cpp.
@@ -116,16 +244,7 @@ def predict_raw(
     per-row tree-loop break (all rows ride the same scan on TPU).
     """
     n = binned.shape[0]
-    t_total = trees.num_trees
     use_stop = early_stop_freq > 0 and early_stop_margin > 0.0
-
-    def margin_of(scores):
-        if num_class == 1:
-            # reference binary margin: 2*|score|
-            # (prediction_early_stop.cpp CreatePredictionEarlyStopInstance)
-            return 2.0 * jnp.abs(scores[0])
-        top2 = jnp.sort(scores, axis=0)[-2:]
-        return top2[1] - top2[0]
 
     def step(carry, tree_slice):
         scores, done, t_idx = carry
@@ -143,9 +262,11 @@ def predict_raw(
             at_boundary = (t_idx + 1) % k_it == 0
             it_done = (t_idx + 1) // k_it
             check = at_boundary & (it_done % early_stop_freq == 0)
-            done = done | (check & (margin_of(scores) > early_stop_margin))
+            done = done | (check & (_margin_of(scores, num_class)
+                                    > early_stop_margin))
         return (scores, done, t_idx + 1), None
 
+    t_total = trees.num_trees
     class_ids = (jnp.arange(t_total, dtype=jnp.int32)
                  % jnp.maximum(num_model_per_iteration, 1))
     scores0 = jnp.zeros((num_class, n), jnp.float32)
@@ -166,7 +287,8 @@ def predict_leaf_index(
     nan_bin_arr: jax.Array,
     is_cat_arr: jax.Array,
 ) -> jax.Array:
-    """Per-tree leaf index for every row: [T, N] (reference: PredictLeafIndex)."""
+    """Per-tree leaf index for every row via the node sweep: [T, N]
+    (reference: PredictLeafIndex). Parity baseline for the walk engine."""
 
     def step(_, tree_slice):
         (sf, sb, cb, dl, lc, rc, nn) = tree_slice
@@ -181,3 +303,188 @@ def predict_leaf_index(
          trees.num_nodes),
     )
     return leaves
+
+
+# ---------------------------------------------------------------------------
+# serving engine: depth-iteration pointer walk over tree chunks
+# ---------------------------------------------------------------------------
+
+def _margin_of(scores, num_class: int):
+    """Decided prediction margin (reference:
+    prediction_early_stop.cpp CreatePredictionEarlyStopInstance)."""
+    if num_class == 1:
+        # reference binary margin: 2*|score|
+        return 2.0 * jnp.abs(scores[0])
+    top2 = jnp.sort(scores, axis=0)[-2:]
+    return top2[1] - top2[0]
+
+
+#: per-node record lanes packed for the walk's single node gather
+_REC_COL, _REC_BIN, _REC_DL, _REC_LC, _REC_RC, _REC_NAN, _REC_CAT = range(7)
+
+
+def _pack_node_records(trees: StackedTrees, nan_bin_arr, is_cat_arr,
+                       col_of) -> jax.Array:
+    """[T, L-1, 7] i32: (stored column, threshold bin, default_left,
+    left child, right child, nan bin, is_categorical) per node.
+
+    The per-feature lookups (nan bin, cat flag, EFB column translation)
+    are resolved HERE, once per node over the tiny [T, L-1] tree arrays,
+    so each walk step gathers one record instead of chasing three [F]
+    tables per row. XLA CSEs this across the chunk scan.
+    """
+    sf = trees.split_feature
+    safe_f = jnp.maximum(sf, 0)
+    col = safe_f if col_of is None else col_of[safe_f]
+    return jnp.stack([
+        col.astype(jnp.int32),
+        trees.split_bin.astype(jnp.int32),
+        trees.default_left.astype(jnp.int32),
+        trees.left_child.astype(jnp.int32),
+        trees.right_child.astype(jnp.int32),
+        nan_bin_arr[safe_f].astype(jnp.int32),
+        is_cat_arr[safe_f].astype(jnp.int32),
+    ], axis=-1)
+
+
+def _walk_chunk(binned, rec, cat_bitset, num_nodes, depth: int,
+                any_cat: bool, packed: bool) -> jax.Array:
+    """Leaf index [Tb, N] for one chunk of Tb trees.
+
+    Each row holds its current node id; a step gathers the node record
+    ([Tb, N, 7], ONE gather), gathers the row's bin for the node's
+    column, evaluates the shared routing predicate and moves to a child.
+    Leaves (negative ids) self-loop, so running the loop to the padded
+    depth bucket is semantics-free. The predicate mirrors
+    ops/split.py go_left_pred bit-for-bit (the parity tests in
+    tests/test_predict_engine.py hold both to the same leaves).
+    """
+    tb, n = rec.shape[0], binned.shape[0]
+    start = jnp.where(num_nodes > 0, 0, -1).astype(jnp.int32)     # [Tb]
+    cur = jnp.broadcast_to(start[:, None], (tb, n))
+    rows = jnp.arange(n, dtype=jnp.int32)[None, :]
+
+    def step(_, cur):
+        node = jnp.maximum(cur, 0)
+        r = jnp.take_along_axis(rec, node[..., None], axis=1)     # [Tb, N, 7]
+        fcol = gather_bin(binned, rows, r[..., _REC_COL], packed)
+        bin_ = r[..., _REC_BIN]
+        go_left = (fcol <= bin_) | ((r[..., _REC_DL] != 0)
+                                    & (fcol == r[..., _REC_NAN]))
+        if any_cat:
+            w = cat_bitset.shape[-1]
+            idx = jnp.broadcast_to(node[..., None], (tb, n, w))
+            words = jnp.take_along_axis(cat_bitset, idx, axis=1)  # [Tb, N, W]
+            word_id = (fcol // 32).astype(jnp.uint32)
+            sel = jnp.zeros_like(fcol, dtype=jnp.uint32)
+            for j in range(w):
+                sel = jnp.where(word_id == j, words[..., j], sel)
+            in_set = ((sel >> (fcol.astype(jnp.uint32) % 32)) & 1) != 0
+            go_left = jnp.where(r[..., _REC_CAT] != 0, in_set, go_left)
+        nxt = jnp.where(go_left, r[..., _REC_LC], r[..., _REC_RC])
+        return jnp.where(cur >= 0, nxt, cur)
+
+    cur = lax.fori_loop(0, depth, step, cur)
+    return -(cur + 1)
+
+
+def _chunked(arr: jax.Array, chunks: int) -> jax.Array:
+    return arr.reshape(chunks, arr.shape[0] // chunks, *arr.shape[1:])
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "num_class", "depth", "tbatch", "early_stop_margin", "early_stop_freq",
+    "any_cat", "packed"))
+def predict_raw_batched(
+    binned: jax.Array,         # [N, F] u8/u16, or [N, ceil(F/2)] u8 packed
+    trees: StackedTrees,       # T padded to a multiple of tbatch
+    nan_bin_arr: jax.Array,    # [F] i32
+    is_cat_arr: jax.Array,     # [F] bool
+    num_model_per_iteration: jax.Array,  # scalar i32
+    num_class: int = 1,
+    depth: int = 8,            # depth bucket >= the stacked max depth
+    tbatch: int = 16,
+    early_stop_margin: float = 0.0,
+    early_stop_freq: int = 0,
+    any_cat: bool = False,
+    packed: bool = False,
+    col_of: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Raw scores [num_class, N] via the tree-batched depth walk.
+
+    Callers pad N to a row bucket, T to a tree bucket (all-constant
+    padding trees add exactly 0) and pass the model's depth bucket, so
+    the compiled program is keyed purely on rungs — the serving cache
+    contract (see module docstring). With early stopping, ``tbatch``
+    must come from ``early_stop_tbatch`` so chunk boundaries land on the
+    reference's exact iteration-multiple-of-freq checkpoints.
+    """
+    n = binned.shape[0]
+    t_total = trees.num_trees
+    chunks = t_total // tbatch
+    use_stop = early_stop_freq > 0 and early_stop_margin > 0.0
+    k_it = jnp.maximum(num_model_per_iteration, 1)
+
+    rec = _pack_node_records(trees, nan_bin_arr, is_cat_arr, col_of)
+    class_ids = (jnp.arange(t_total, dtype=jnp.int32) % k_it)
+    xs = (_chunked(rec, chunks), _chunked(trees.cat_bitset, chunks),
+          _chunked(trees.num_nodes, chunks),
+          _chunked(trees.leaf_value, chunks), _chunked(class_ids, chunks))
+
+    def chunk_step(carry, x):
+        scores, done, t_idx = carry
+        rec_b, cat_b, nn_b, lv_b, cid_b = x
+        leaf = _walk_chunk(binned, rec_b, cat_b, nn_b, depth, any_cat,
+                           packed)
+        add = jnp.take_along_axis(lv_b, leaf, axis=1)             # [Tb, N]
+        if use_stop:
+            add = jnp.where(done[None, :], 0.0, add)
+        if num_class == 1:
+            scores = scores + add.sum(axis=0)[None, :]
+        else:
+            # per-chunk class scatter-add (trees are iteration-major)
+            scores = scores.at[cid_b].add(add)
+        t_idx = t_idx + tbatch
+        if use_stop:
+            at_boundary = t_idx % k_it == 0
+            it_done = t_idx // k_it
+            check = at_boundary & (it_done % early_stop_freq == 0)
+            done = done | (check & (_margin_of(scores, num_class)
+                                    > early_stop_margin))
+        return (scores, done, t_idx), None
+
+    scores0 = jnp.zeros((num_class, n), jnp.float32)
+    done0 = jnp.zeros((n,), bool)
+    (scores, _, _), _ = lax.scan(
+        chunk_step, (scores0, done0, jnp.asarray(0, jnp.int32)), xs)
+    return scores
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "depth", "tbatch", "any_cat", "packed"))
+def predict_leaf_batched(
+    binned: jax.Array,
+    trees: StackedTrees,
+    nan_bin_arr: jax.Array,
+    is_cat_arr: jax.Array,
+    depth: int = 8,
+    tbatch: int = 16,
+    any_cat: bool = False,
+    packed: bool = False,
+    col_of: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Per-tree leaf index [T, N] via the depth walk (engine twin of
+    ``predict_leaf_index``; bit-identical leaves, O(D) instead of O(L))."""
+    t_total = trees.num_trees
+    chunks = t_total // tbatch
+    rec = _pack_node_records(trees, nan_bin_arr, is_cat_arr, col_of)
+    xs = (_chunked(rec, chunks), _chunked(trees.cat_bitset, chunks),
+          _chunked(trees.num_nodes, chunks))
+
+    def chunk_step(_, x):
+        rec_b, cat_b, nn_b = x
+        return _, _walk_chunk(binned, rec_b, cat_b, nn_b, depth, any_cat,
+                              packed)
+
+    _, leaves = lax.scan(chunk_step, 0, xs)
+    return leaves.reshape(t_total, binned.shape[0])

@@ -69,3 +69,19 @@ def pad_rows(n: int, num_shards: int) -> int:
     """Rows must split evenly across shards; callers mask the tail
     (reference analogue: pre_partition / CheckOrPartition, dataset.h:110)."""
     return (-n) % num_shards
+
+
+def predict_shard_pad(n: int, num_shards: int, ladder) -> Optional[int]:
+    """Padded row count for row-sharded bucketed predict, or None.
+
+    Requests above the serving ladder's largest rung can run as ONE
+    GSPMD-sharded program over this mesh instead of a host loop of
+    max-rung slices: each shard gets ``bucket_rows(ceil(n/S))`` rows, so
+    the compiled program is still keyed on a ladder rung (per shard) and
+    steady-state stays zero-recompile. None = the per-shard share
+    overflows the ladder too; the caller falls back to slicing.
+    """
+    from ..ops.predict import bucket_rows
+    per_shard = -(-n // num_shards)
+    rung = bucket_rows(per_shard, ladder)
+    return None if rung is None else rung * num_shards

@@ -145,6 +145,45 @@ def test_concurrent_predict_with_interleaved_update():
     assert bst.num_trees() == 16
 
 
+def test_concurrent_predict_mixed_batch_sizes():
+    """16 threads serve MIXED batch sizes through the bucketed inference
+    engine (ops/predict.py): every thread's result must equal its serial
+    reference bit-for-bit, and the append-pad device-tree cache must
+    survive concurrent rung warmups (the jit cache and the tree cache
+    are both shared mutable state under the read lock)."""
+    bst, X = _train(10)
+    rng = np.random.RandomState(11)
+    Xq = np.concatenate([X] * 3)[: 1400]
+    sizes = [7, 64, 333, 1400]           # spans two bucket rungs
+    ref = {s: bst.predict(Xq[:s]) for s in sizes}
+
+    errors = []
+    started = threading.Barrier(N_THREADS)
+
+    def serve(i):
+        try:
+            started.wait()
+            for j in range(3):
+                s = sizes[(i + j) % len(sizes)]
+                out = bst.predict(Xq[:s])
+                if not np.array_equal(out, ref[s]):
+                    raise AssertionError(
+                        f"thread {i}: size-{s} prediction diverged from "
+                        "the serial reference")
+        except Exception as err:  # pragma: no cover - the failure path
+            errors.append(err)
+
+    with guards.api_race_sanitizer() as san:
+        threads = [threading.Thread(target=serve, args=(i,))
+                   for i in range(N_THREADS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errors, errors
+    san.assert_no_races("16-thread mixed-batch predict")
+
+
 def test_concurrent_predict_matches_serial_exactly():
     bst, X = _train(8)
     want = bst.predict(X)

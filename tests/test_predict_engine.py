@@ -1,0 +1,395 @@
+"""Depth-batched inference engine (ops/predict.py).
+
+Covers the tentpole's contracts:
+  * bit-exact leaf-index parity of the depth walk vs the node-sweep
+    reference (numeric NaN defaults, categorical bitsets, EFB col_of,
+    multiclass), raw-score parity within float-accumulation tolerance;
+  * early-stop margin/freq semantics preserved under tree batching
+    (chunk boundaries land on the reference's iteration checkpoints);
+  * the bucket ladders (rows / trees / depth) and the zero-recompile
+    serving proof: after one warmup per rung, predicts at distinct batch
+    sizes compile nothing and move nothing device->host;
+  * the _device_trees_cache append-pad fix: mid-train predicts extend
+    the padded stack instead of re-uploading the whole model;
+  * 4-bit packed serving (tpu_bin_pack4): bit-identical predictions,
+    packed histogram gathers, host round-trip.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.analysis import guards
+from lightgbm_tpu.ops import predict as P
+from lightgbm_tpu.io.dataset import (pack4_eligible, pack4_matrix,
+                                     unpack4_matrix)
+
+from utils import FAST_PARAMS, binary_data, multiclass_data
+
+
+def _train(params=None, X=None, y=None, rounds=12):
+    if X is None:
+        X, y = binary_data()
+    p = dict(FAST_PARAMS, objective="binary", **(params or {}))
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p), rounds)
+    return bst, X
+
+
+def _both_engines(bst, fn):
+    """(batched_result, scan_result) of ``fn(bst)`` under each engine."""
+    out_new = fn(bst)
+    bst._gbdt.config.set({"tpu_predict_engine": "scan"})
+    try:
+        out_old = fn(bst)
+    finally:
+        bst._gbdt.config.set({"tpu_predict_engine": "batched"})
+    return out_new, out_old
+
+
+# ------------------------------------------------------------- ladders
+def test_bucket_ladder_helpers():
+    ladder = P.parse_bucket_ladder("auto")
+    assert ladder[0] == 1024 and ladder[-1] == 1 << 20
+    assert all(b == 2 * a for a, b in zip(ladder, ladder[1:]))
+    assert P.parse_bucket_ladder("4000,1000,2000") == (1000, 2000, 4000)
+    assert P.bucket_rows(1, ladder) == 1024
+    assert P.bucket_rows(1024, ladder) == 1024
+    assert P.bucket_rows(1025, ladder) == 2048
+    assert P.bucket_rows((1 << 20) + 1, ladder) is None
+    with pytest.raises(ValueError):
+        P.parse_bucket_ladder("0,-5")
+
+    assert P.tree_bucket(1, 16) == 16
+    assert P.tree_bucket(17, 16) == 32
+    assert P.tree_bucket(500, 16) == 512
+    assert P.depth_bucket(0) == 4
+    assert P.depth_bucket(9) == 16
+
+
+def test_early_stop_tbatch_alignment():
+    # chunks are k * (divisor of freq) <= the configured batch, so every
+    # iteration multiple of freq is a chunk boundary
+    assert P.early_stop_tbatch(1, 10, 16) == 10
+    assert P.early_stop_tbatch(3, 10, 16) == 15   # 3 * 5, 5 | 10
+    assert P.early_stop_tbatch(1, 7, 16) == 7
+    assert P.early_stop_tbatch(1, 64, 16) == 16   # 16 | 64
+    assert P.early_stop_tbatch(5, 7, 16) == 5     # only f=1 fits
+    for k, freq, tb in [(1, 10, 16), (3, 4, 16), (2, 9, 8), (4, 25, 12)]:
+        c = P.early_stop_tbatch(k, freq, tb)
+        assert c % k == 0 and (k * freq) % c == 0
+
+
+# ------------------------------------------------------------- parity
+def test_leaf_and_raw_parity_nan_defaults():
+    X, y = binary_data()
+    Xn = np.array(X, np.float64)
+    rng = np.random.RandomState(0)
+    Xn[rng.rand(*Xn.shape) < 0.08] = np.nan
+    bst, _ = _train({"use_missing": True}, Xn, y, rounds=15)
+    q = Xn[:257]
+    (leaf_new, raw_new), (leaf_old, raw_old) = _both_engines(
+        bst, lambda b: (b.predict(q, pred_leaf=True),
+                        b.predict(q, raw_score=True)))
+    assert np.array_equal(leaf_new, leaf_old)
+    np.testing.assert_allclose(raw_new, raw_old, atol=1e-5)
+
+
+def test_leaf_parity_categorical_bitsets():
+    rng = np.random.RandomState(1)
+    n = 900
+    Xc = rng.randn(n, 6)
+    Xc[:, 0] = rng.randint(0, 40, n)   # wide cats -> multi-word bitset
+    Xc[:, 1] = rng.randint(0, 6, n)
+    # label driven by category membership so bitset splits actually win
+    y = ((np.isin(Xc[:, 0], [1, 3, 5, 8, 13, 21, 34])
+          | (Xc[:, 1] > 3)) ^ (rng.rand(n) < 0.05)).astype(np.float64)
+    p = dict(FAST_PARAMS, objective="binary", max_cat_to_onehot=2)
+    bst = lgb.train(p, lgb.Dataset(Xc, label=y, params=p,
+                                   categorical_feature=[0, 1]), 15)
+    assert any(np.any(m.cat_bitset) for m in bst._gbdt.models), \
+        "test did not exercise categorical splits"
+    q = Xc[:300]
+    new, old = _both_engines(bst, lambda b: b.predict(q, pred_leaf=True))
+    assert np.array_equal(new, old)
+
+
+def test_walk_parity_efb_col_of():
+    """The walk's per-node EFB column translation (col_of) lands the same
+    leaves as route_one_tree on a bundled matrix."""
+    rng = np.random.RandomState(2)
+    n, groups, card = 900, 50, 6       # 300 one-hot cols (EFB needs >= 256)
+    X = np.zeros((n, groups * card), np.float64)
+    for g in range(groups):
+        X[np.arange(n), g * card + rng.randint(0, card, n)] = 1.0
+    y = (X[:, ::card].sum(1) + 0.3 * rng.randn(n) > 0.5).astype(np.float64)
+    p = dict(FAST_PARAMS, objective="binary", enable_bundle=True)
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p), 10)
+    g = bst._gbdt
+    assert g._efb is not None, "test did not exercise EFB"
+    # route the BUNDLED training matrix with col_of through both paths
+    binned = np.asarray(g._routing_binned())
+    trees, t_real = g._device_trees_plain()
+    nan_a, cat_a, col_of = g._route_args()
+    dev = jnp.asarray(binned)
+    old = [np.asarray(P.route_one_tree(
+        dev, trees.split_feature[i], trees.split_bin[i],
+        trees.cat_bitset[i], trees.default_left[i], trees.left_child[i],
+        trees.right_child[i], trees.num_nodes[i], nan_a, cat_a, col_of))
+        for i in range(t_real)]
+    depth = P.depth_bucket(g._models_max_depth(g.models))
+    st = lgb.boosting.gbdt.stack_trees(
+        g.models, trees.max_nodes, trees.leaf_value.shape[1],
+        pad_to=P.tree_bucket(t_real, 8))
+    new = np.asarray(P.predict_leaf_batched(
+        dev, st, nan_a, cat_a, depth=depth, tbatch=8, any_cat=True,
+        col_of=col_of))[:t_real]
+    assert np.array_equal(np.stack(old), new)
+
+
+def test_raw_parity_multiclass():
+    X, y = multiclass_data()
+    p = dict(FAST_PARAMS, objective="multiclass", num_class=3)
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p), 8)
+    q = X[:200]
+    new, old = _both_engines(bst, lambda b: b.predict(q))
+    np.testing.assert_allclose(new, old, atol=1e-6)
+    assert np.allclose(new.sum(1), 1.0, atol=1e-5)
+
+
+@pytest.mark.parametrize("freq", [1, 2, 3, 7])
+def test_early_stop_parity_under_tree_batching(freq):
+    bst, X = _train(rounds=20)
+    kw = dict(pred_early_stop=True, pred_early_stop_margin=0.4,
+              pred_early_stop_freq=freq)
+    q = X[:400]
+    new, old = _both_engines(bst, lambda b: b.predict(q, **kw))
+    np.testing.assert_allclose(new, old, atol=1e-6)
+    # and it genuinely fires (otherwise this test proves nothing)
+    assert not np.allclose(bst.predict(q), new)
+
+
+def test_rf_average_output_uses_real_tree_count():
+    X, y = binary_data()
+    p = dict(FAST_PARAMS, objective="binary", boosting="rf",
+             bagging_fraction=0.7, bagging_freq=1)
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p), 9)
+    q = X[:100]
+    new, old = _both_engines(bst, lambda b: b.predict(q))
+    # tree-count padding must not leak into the averaging divisor
+    np.testing.assert_allclose(new, old, atol=1e-6)
+
+
+# ------------------------------------------------- serving cache proof
+def test_steady_state_zero_recompile_zero_d2h_mixed_batches():
+    """The acceptance criterion: one warmup per bucket rung, then
+    predicts at 3 distinct batch sizes trigger 0 compile events and 0
+    host transfers."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(6000, 10)
+    y = (X[:, 0] + 0.5 * rng.randn(6000) > 0).astype(np.float64)
+    p = dict(FAST_PARAMS, objective="binary")
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p), 10)
+    g = bst._gbdt
+    for n in (600, 1500, 3500):          # warm rungs 1024, 2048, 4096
+        g.predict_raw_device(g.bin_matrix(X[:n])).block_until_ready()
+    with guards.steady_state_guard("mixed-batch serving") as cc:
+        outs = [g.predict_raw_device(g.bin_matrix(X[:n]))
+                for n in (900, 1800, 3000)]
+        for o in outs:
+            o.block_until_ready()
+    assert cc.lowerings == 0 and cc.backend_compiles == 0
+    # the padded device results agree with the host predict path
+    for n, o in zip((900, 1800, 3000), outs):
+        np.testing.assert_allclose(np.asarray(o)[0, :n],
+                                   bst.predict(X[:n], raw_score=True),
+                                   atol=1e-6)
+
+
+def test_predict_device_api():
+    bst, X = _train()
+    d = bst.predict_device(X[:77])
+    assert isinstance(d, jax.Array) and d.shape == (77,)
+    np.testing.assert_allclose(np.asarray(d),
+                               bst.predict(X[:77], raw_score=True),
+                               atol=1e-6)
+
+
+def test_predict_device_oversize_slices_on_device():
+    bst, X = _train()
+    bst._gbdt.config.set({"tpu_predict_buckets": "64,128"})
+    try:
+        d = bst.predict_device(X[:500])      # 500 rows >> max rung 128
+        assert isinstance(d, jax.Array) and d.shape == (500,)
+        ref = bst.predict(X[:500], raw_score=True)
+    finally:
+        bst._gbdt.config.set({"tpu_predict_buckets": "auto"})
+    np.testing.assert_allclose(np.asarray(d), ref, atol=1e-6)
+
+
+def test_predict_device_rejects_continue_trained(tmp_path):
+    X, y = binary_data()
+    p = dict(FAST_PARAMS, objective="binary")
+    b1 = lgb.train(p, lgb.Dataset(X, label=y, params=p), 5)
+    path = str(tmp_path / "base.txt")
+    b1.save_model(path)
+    b2 = lgb.train(p, lgb.Dataset(X, label=y, params=p), 3,
+                   init_model=path)
+    if getattr(b2, "_pre_model", None) is None:
+        pytest.skip("continue-training did not attach a base model")
+    with pytest.raises(NotImplementedError, match="continue-trained"):
+        b2.predict_device(X[:10])
+
+
+def test_oversize_request_slices_through_ladder():
+    bst, X = _train()
+    bst._gbdt.config.set({"tpu_predict_buckets": "64,128"})
+    try:
+        q = np.tile(X, (1, 1))[:600]      # 600 rows >> max rung 128
+        out = bst.predict(q)
+        bst._gbdt.config.set({"tpu_predict_buckets": "auto"})
+        ref = bst.predict(q)
+    finally:
+        bst._gbdt.config.set({"tpu_predict_buckets": "auto"})
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_device_trees_cache_appends_not_rebuilds():
+    """Satellite: mid-train predict must append-pad the cached stack."""
+    bst, X = _train(rounds=5)
+    g = bst._gbdt
+    key = (g._predict_cfg()[0], 0, None)
+    bst.predict(X[:50])
+    c0 = g._device_trees_cache[key]
+    assert c0 is not None and c0["t_real"] == 5
+    base_leaf = c0["st"].leaf_value
+    for _ in range(3):
+        bst.update()
+    bst.predict(X[:50])
+    c1 = g._device_trees_cache[key]
+    assert c1 is c0 and c1["t_real"] == 8
+    assert c1["t_bucket"] >= 8 and c1["t_bucket"] % key[0] == 0
+    # same bucket -> the padded arrays were updated in place, and the
+    # window beyond the old fill now holds the new trees
+    if c1["t_bucket"] == c0["t_bucket"]:
+        assert c1["st"].leaf_value.shape == base_leaf.shape
+    ref = np.asarray(P.predict_raw_scan(
+        jnp.asarray(g.bin_matrix(X[:50])), g._device_trees_plain()[0],
+        *g._pred_route_args(), np.int32(1), 1))
+    np.testing.assert_allclose(bst.predict(X[:50], raw_score=True),
+                               ref[0], atol=1e-6)
+
+
+def test_zero_row_predict_and_leaf():
+    bst, X = _train(rounds=5)
+    empty = X[:0]
+    assert bst.predict(empty).shape == (0,)
+    assert bst.predict(empty, pred_leaf=True).shape == (0, 5)
+
+
+def test_alternating_early_stop_does_not_thrash_cache(monkeypatch):
+    """Plain and early-stop predicts use different tree-chunk sizes; each
+    must keep its own cache slot instead of restacking the model per
+    call."""
+    import lightgbm_tpu.boosting.gbdt as gbdt_mod
+    bst, X = _train(rounds=8)
+    kw = dict(pred_early_stop=True, pred_early_stop_margin=0.4,
+              pred_early_stop_freq=10)
+    bst.predict(X[:50])
+    bst.predict(X[:50], **kw)          # fill both slots
+    calls = []
+    orig = gbdt_mod.stack_trees
+    monkeypatch.setattr(gbdt_mod, "stack_trees",
+                        lambda *a, **k: calls.append(1) or orig(*a, **k))
+    for _ in range(3):
+        bst.predict(X[:50])
+        bst.predict(X[:50], **kw)
+    assert not calls, "alternating predicts restacked the model"
+
+
+def test_rollback_invalidates_cache():
+    bst, X = _train(rounds=6)
+    before = bst.predict(X[:40], raw_score=True)
+    bst._gbdt.rollback_one_iter()
+    after = bst.predict(X[:40], raw_score=True)
+    key = (bst._gbdt._predict_cfg()[0], 0, None)
+    assert bst._gbdt._device_trees_cache[key]["t_real"] == 5
+    assert not np.allclose(before, after)
+
+
+def test_windowed_predict_matches_scan():
+    bst, X = _train(rounds=10)
+    q = X[:120]
+    new, old = _both_engines(
+        bst, lambda b: b.predict(q, start_iteration=3, num_iteration=4,
+                                 raw_score=True))
+    np.testing.assert_allclose(new, old, atol=1e-6)
+
+
+def test_best_iteration_windowed_serving_is_cached(monkeypatch):
+    """Booster.predict defaults num_iteration=best_iteration after
+    early-stopped training — THE common serving window. It must hit the
+    keyed device-tree cache, not restack the model per call."""
+    import lightgbm_tpu.boosting.gbdt as gbdt_mod
+    bst, X = _train(rounds=10)
+    bst.best_iteration = 7                 # as early stopping would set
+    bst.predict(X[:50])                    # fills the windowed slot
+    calls = []
+    orig = gbdt_mod.stack_trees
+    monkeypatch.setattr(gbdt_mod, "stack_trees",
+                        lambda *a, **k: calls.append(1) or orig(*a, **k))
+    ref = bst.predict(X[:50])
+    for _ in range(3):
+        np.testing.assert_array_equal(bst.predict(X[:50]), ref)
+    assert not calls, "windowed serving restacked the model per call"
+    bst.best_iteration = -1
+    np.testing.assert_allclose(
+        bst.predict(X[:50], num_iteration=7), ref, atol=1e-7)
+
+
+# ------------------------------------------------------- 4-bit packing
+def test_pack4_roundtrip_and_eligibility():
+    rng = np.random.RandomState(4)
+    for f in (6, 7):
+        m = rng.randint(0, 16, (40, f)).astype(np.uint8)
+        assert np.array_equal(unpack4_matrix(pack4_matrix(m), f), m)
+    with pytest.raises(ValueError):
+        pack4_matrix(np.zeros((3, 2), np.uint16))
+
+
+def test_pack4_predict_bit_identical():
+    X, y = binary_data()
+    base = dict(FAST_PARAMS, objective="binary", max_bin=15)
+    b0 = lgb.train(base, lgb.Dataset(X, label=y, params=base), 10)
+    p4 = dict(base, tpu_bin_pack4=True)
+    b1 = lgb.train(p4, lgb.Dataset(X, label=y, params=p4), 10)
+    assert b1._gbdt._pred_pack4
+    assert pack4_eligible(b1._gbdt.train_set.mappers)
+    q = X[:300]
+    assert np.array_equal(b0.predict(q), b1.predict(q))
+    assert np.array_equal(b0.predict(q, pred_leaf=True),
+                          b1.predict(q, pred_leaf=True))
+
+
+def test_pack4_falls_back_when_ineligible():
+    X, y = binary_data()
+    p = dict(FAST_PARAMS, objective="binary", max_bin=31,
+             tpu_bin_pack4=True)       # 31 bins do not fit a nibble
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p), 5)
+    assert not bst._gbdt._pred_pack4
+    assert bst.predict(X[:50]).shape == (50,)
+
+
+def test_pack4_histogram_block_parity():
+    from lightgbm_tpu.ops.histogram import histogram_block
+    rng = np.random.RandomState(5)
+    n, f, b = 512, 9, 16
+    binned = rng.randint(0, b, (n, f)).astype(np.uint8)
+    ch = rng.randn(n, 4).astype(np.float32)
+    full = histogram_block(jnp.asarray(binned), jnp.asarray(ch), b,
+                           impl="xla")
+    packed = histogram_block(jnp.asarray(pack4_matrix(binned)),
+                             jnp.asarray(ch), b, impl="xla",
+                             packed4_features=f)
+    assert np.array_equal(np.asarray(full), np.asarray(packed))

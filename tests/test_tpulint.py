@@ -130,6 +130,60 @@ def test_r002_tracer_branch(tmp_path):
     assert "R002" in codes(findings)
 
 
+def test_r002_unbucketed_predict_entry(tmp_path):
+    """Sub-check (d) seed: a serving entry point feeding the raw request
+    into a jitted callable keys the compiled program on the request
+    shape — every distinct batch size recompiles (the 26-97s serving
+    stalls the bucketed engine removed)."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def _scores(x):
+            return x * 2
+
+        def predict(data):
+            arr = jnp.asarray(data)
+            return _scores(arr)
+    """)
+    assert "R002" in codes(findings)
+
+
+def test_r002_bucketed_predict_entry_clean(tmp_path):
+    """Flowing the request through a bucket/pad-named call clears the
+    taint: the padded shape is a ladder rung, not the raw request size."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def _scores(x):
+            return x * 2
+
+        def predict(data, rung):
+            arr = pad_to_bucket(jnp.asarray(data), rung)
+            return _scores(arr)
+    """)
+    assert "R002" not in codes(findings)
+
+
+def test_r002_unbucketed_nonpredict_entry_not_flagged(tmp_path):
+    """Training-loop callers are not serving entries; raw-shape jit args
+    there are the normal fixed-shape train step."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+
+        @jax.jit
+        def _step(x):
+            return x + 1
+
+        def train_one_iter(batch):
+            return _step(batch)
+    """)
+    assert "R002" not in codes(findings)
+
+
 def test_r002_static_shape_branch_not_flagged(tmp_path):
     """x.shape is static at trace time — branching on it is fine even
     when x itself is traced."""
