@@ -67,22 +67,58 @@ def _clear_backend_cache(jax_mod):
         pass
 
 
-def _init_backend_with_retry(jax_mod, attempts=3, base_delay_s=5.0):
-    """Return the default device, retrying transient backend-init failures.
+# transient backend-init / device-enumeration failure signatures: TPU
+# runtimes mid-restart, gRPC channels to the TPU worker not yet up, libtpu
+# still claiming the chips from a previous process (the r05 bench death:
+# the retry loop matched only the first two patterns and the run died on a
+# "failed to connect" enumeration error the loop never saw)
+_TRANSIENT_BACKEND_ERRORS = (
+    "Unable to initialize backend",
+    "UNAVAILABLE", "Unavailable",
+    "DEADLINE_EXCEEDED", "Deadline Exceeded",
+    "failed to connect", "Failed to connect",
+    "Connection reset", "Socket closed",
+    "already in use",
+    "No visible TPU", "device enumeration",
+)
+
+
+def _init_backend_with_retry(jax_mod, attempts=None, base_delay_s=5.0):
+    """Return the default device, retrying transient backend-init AND
+    device-enumeration failures.
 
     TPU runtimes are occasionally mid-restart when the bench launches;
-    "Unable to initialize backend" / UNAVAILABLE errors then clear within
-    seconds. Each retry clears the backend cache first (see
-    _clear_backend_cache) so the re-init is real. Non-transient errors
-    re-raise immediately; the last transient attempt re-raises too, so the
-    driver still sees rc!=0 when the backend never comes up."""
+    init errors then clear within seconds. Device ENUMERATION can also
+    fail transiently (a gRPC connect error out of ``jax.devices()``, or a
+    backend that comes up with an empty device list while the worker
+    restarts) — the r05 bench run died on exactly that despite the init
+    retry, so enumeration failures retry through the same loop. Each
+    retry clears the backend cache first (see _clear_backend_cache) so
+    the re-init is real. Non-transient errors re-raise immediately; the
+    last transient attempt re-raises too, and main() converts the raise
+    into a structured failure stub so the BENCH row is never silently
+    absent."""
+    if attempts is None:
+        # env override rounded + re-guarded, never trusted raw (same
+        # convention as LGBM_TPU_FUSED_BS): a 0/negative/garbage value
+        # must not turn the retry loop into a silent None return
+        try:
+            attempts = int(os.environ.get("BENCH_INIT_ATTEMPTS", 5))
+        except ValueError:
+            sys.stderr.write("[bench] ignoring non-numeric "
+                             "BENCH_INIT_ATTEMPTS; using 5 attempts\n")
+            attempts = 5
+    attempts = max(1, attempts)
     for attempt in range(attempts):
         try:
-            return jax_mod.devices()[0]
+            devices = jax_mod.devices()
+            if not devices:
+                raise RuntimeError(
+                    "device enumeration returned an empty device list")
+            return devices[0]
         except Exception as err:  # noqa: BLE001 - classified below
             msg = str(err)
-            transient = ("Unable to initialize backend" in msg
-                         or "UNAVAILABLE" in msg or "Unavailable" in msg)
+            transient = any(t in msg for t in _TRANSIENT_BACKEND_ERRORS)
             if not transient or attempt == attempts - 1:
                 raise
             delay = base_delay_s * (2 ** attempt)
@@ -92,6 +128,50 @@ def _init_backend_with_retry(jax_mod, attempts=3, base_delay_s=5.0):
                 f"{delay:.0f}s\n")
             _clear_backend_cache(jax_mod)
             time.sleep(delay)
+
+
+def _emit_failure_stub(stage: str, err: BaseException) -> None:
+    """Print a STRUCTURED failure row and record it in BENCH_SHAPES.json.
+
+    The driver records the bench's one-line JSON; before round 6 a
+    backend that never came up raised straight through and the BENCH_r0x
+    row was silently absent (the r05 gap). Now the row always exists —
+    with ``value: null`` and the error inline — and the process still
+    exits nonzero so automation sees the failure."""
+    first_line = str(err).splitlines()[0][:300] if str(err) else repr(err)
+    payload = {
+        "stage": stage,
+        "error": first_line,
+        "error_type": type(err).__name__,
+        "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    try:
+        _record_shape("last_failure", payload)
+    except Exception as rec_err:  # noqa: BLE001 - the stub must not sink
+        sys.stderr.write(f"[bench] failed to record failure stub: "
+                         f"{rec_err}\n")
+    print(json.dumps({
+        "metric": f"bench-failed ({stage})",
+        "value": None,
+        "unit": "iters/sec/chip",
+        "vs_baseline": None,
+        "error": first_line,
+    }))
+
+
+def _timed_mean(fn, *args, reps=10):
+    """THE warm-up/rep timing discipline for fixed-rep microbench cells
+    (2 warm calls cover compile + cache fill, then the mean of ``reps``
+    back-to-back dispatches with one trailing sync). Every fixed-rep
+    section shares this helper so a change to the discipline cannot make
+    recorded BENCH_SHAPES cells inconsistent across sections."""
+    fn(*args).block_until_ready()
+    fn(*args).block_until_ready()
+    t0 = time.time()
+    for _ in range(reps):
+        out = fn(*args)
+    out.block_until_ready()
+    return (time.time() - t0) / reps
 
 
 def make_higgs_like(n, f, seed=7):
@@ -188,13 +268,7 @@ def run_hist_microbench(print_json=True):
     int_fn = jax.jit(lambda bn, ch: histogram_block(bn, ch, b, impl="auto"))
 
     def bench_one(fn, ch):
-        fn(binned, ch).block_until_ready()         # compile + warm
-        fn(binned, ch).block_until_ready()
-        t0 = time.time()
-        for _ in range(reps):
-            out = fn(binned, ch)
-        out.block_until_ready()
-        return (time.time() - t0) / reps
+        return _timed_mean(fn, binned, ch, reps=reps)
 
     t_f32 = bench_one(f32_fn, ch_f32)
     t_int = bench_one(int_fn, ch_int8)
@@ -221,12 +295,14 @@ def run_hist_microbench(print_json=True):
         sys.stderr.write(
             f"[bench-hist] mbatch={kb}: f32={t_kf * 1e3:.2f}ms "
             f"int8={t_ki * 1e3:.2f}ms ({n / t_ki / 1e6:.1f} Mrows/s)\n")
+    layout_sweep = _run_layout_sweep(jax, dev, n, f, reps)
     _record_shape("hist_micro", {
         "platform": dev.platform, "rows": n, "features": f, "bins": b,
         "f32_highest_ms": round(t_f32 * 1e3, 3),
         "int8_ms": round(t_int * 1e3, 3),
         "int8_speedup": round(speedup, 3),
         "mbatch_sweep": mb_sweep,
+        "layout_sweep": layout_sweep,
     })
     if print_json:
         print(json.dumps({
@@ -235,6 +311,91 @@ def run_hist_microbench(print_json=True):
             "unit": "x vs fp32-HIGHEST einsum",
             "vs_baseline": round(speedup / 2.0, 3),  # acceptance target 2x
         }))
+
+
+def _run_layout_sweep(jax, dev, n, f, reps):
+    """{u8, pack4} x {lane, sublane} x {f32, int8, int16-narrowed} at a
+    pack4-eligible shape (B=16) — the autotuner's data (ROADMAP item 5).
+
+    Every cell records rows/s plus its speedup vs the u8-lane-f32 cell of
+    the SAME shape, so "which engine wins where" is a table lookup, not
+    folklore. Cells whose engine needs a TPU backend (the sublane Mosaic
+    layout off-TPU) record a skip marker instead of silently vanishing —
+    a missing cell reads as "covered", a marked one as "not measured
+    here". Narrowed cells use quant_max=9 (num_grad_quant_bins=8 + the
+    stochastic-rounding +1)."""
+    import functools
+
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.histogram import histogram_block
+    from lightgbm_tpu.ops.pallas_histogram import pallas_available
+
+    b = 16                      # pack4- and sublane-eligible bin width
+    qmax = 9
+    rng = np.random.RandomState(1)
+    binned = rng.randint(0, b, (n, f)).astype(np.uint8)
+    from lightgbm_tpu.io.dataset import pack4_matrix
+    packed = pack4_matrix(binned)   # the trainer's canonical nibble order
+    codes = rng.randint(-qmax // 2, qmax // 2 + 1, (n, 4)).astype(np.int8)
+    codes[:, 1] = rng.randint(0, qmax, n)       # hess codes >= 0
+    codes[:, 2:] = 1
+    ch = {"f32": jnp.asarray(rng.randn(n, 4).astype(np.float32)),
+          "int8": jnp.asarray(codes), "int16n": jnp.asarray(codes)}
+    bins = {"u8": jnp.asarray(binned), "pack4": jnp.asarray(packed)}
+    on_tpu = pallas_available()
+
+    cells = {}
+    base_rps = None
+    for pk in ("u8", "pack4"):
+        for lay in ("lane", "sublane"):
+            for eng in ("f32", "int8", "int16n"):
+                key = f"{pk}-{lay}-{eng}"
+                if lay == "sublane" and eng == "int16n":
+                    cells[key] = {"skipped": "the narrowed engine is "
+                                             "XLA-side; register layout "
+                                             "does not apply"}
+                    continue
+                if lay == "sublane" and not on_tpu:
+                    cells[key] = {"skipped": "sublane is a Mosaic layout; "
+                                             "needs a TPU backend"}
+                    continue
+                kw = dict(num_bins=b,
+                          impl="pallas" if lay == "sublane" else "auto",
+                          layout=lay,
+                          packed4_features=f if pk == "pack4" else 0)
+                if eng == "int16n":
+                    kw.update(acc_bits=16, quant_max=qmax)
+                fn = jax.jit(functools.partial(histogram_block, **kw))
+                try:
+                    dt = _timed_mean(fn, bins[pk], ch[eng], reps=reps)
+                except Exception as err:  # noqa: BLE001 - record, move on
+                    cells[key] = {"error": str(err).splitlines()[0][:200]}
+                    continue
+                rps = n / dt
+                cells[key] = {"ms": round(dt * 1e3, 3),
+                              "rows_per_sec": round(rps)}
+                if key == "u8-lane-f32":
+                    base_rps = rps
+                sys.stderr.write(f"[bench-hist] {key}: {dt * 1e3:.2f}ms "
+                                 f"({rps / 1e6:.1f} Mrows/s)\n")
+    if base_rps:
+        for key, cell in cells.items():
+            if "rows_per_sec" in cell:
+                cell["speedup_vs_f32"] = round(
+                    cell["rows_per_sec"] / base_rps, 3)
+    quant_cells = {k: c.get("speedup_vs_f32") for k, c in cells.items()
+                   if ("int8" in k or "int16n" in k)
+                   and c.get("speedup_vs_f32")}
+    best_q = max(quant_cells, key=quant_cells.get) if quant_cells else None
+    if best_q:
+        sys.stderr.write(
+            f"[bench-hist] best quantized/narrowed cell: {best_q} "
+            f"({quant_cells[best_q]}x vs u8-lane-f32)\n")
+    return {"platform": dev.platform, "rows": n, "features": f, "bins": b,
+            "quant_max": qmax, "baseline_cell": "u8-lane-f32",
+            "cells": cells, "best_quantized_cell": best_q,
+            "best_quantized_speedup": quant_cells.get(best_q)
+            if best_q else None}
 
 
 def run_predict_microbench(print_json=True):
@@ -421,12 +582,42 @@ def run_ranking_bench():
     }))
 
 
-def main():
+def _bench_stage() -> str:
+    """The ONE env-precedence chain both the dispatcher and the failure
+    stub key on — a new bench mode added here is automatically labeled
+    correctly in "last_failure" rows."""
     if os.environ.get("BENCH_HIST_MICRO", "") == "1":
-        return run_hist_microbench()
+        return "hist-micro"
     if os.environ.get("BENCH_PREDICT", "") == "1":
-        return run_predict_microbench()
+        return "predict-micro"
     if os.environ.get("BENCH_RANKING", "") == "1":
+        return "ranking"
+    return "train"
+
+
+def main():
+    """Dispatch wrapper: any unhandled failure — the backend never coming
+    up after retries, an OOM mid-run — emits a structured stub row
+    (value null + the error inline, also recorded in BENCH_SHAPES.json
+    "last_failure") before re-raising, so the BENCH_r0x row is never
+    silently absent (the r05 gap)."""
+    stage = _bench_stage()
+    try:
+        return _main(stage)
+    except BaseException as err:
+        if isinstance(err, (KeyboardInterrupt, SystemExit)):
+            raise
+        _emit_failure_stub(stage, err)
+        raise
+
+
+def _main(stage=None):
+    stage = stage or _bench_stage()
+    if stage == "hist-micro":
+        return run_hist_microbench()
+    if stage == "predict-micro":
+        return run_predict_microbench()
+    if stage == "ranking":
         return run_ranking_bench()
     import jax
     # persistent compile cache: the full-config tree program takes ~2 min to

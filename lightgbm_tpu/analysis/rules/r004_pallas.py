@@ -30,6 +30,23 @@ the caller (ops/fused_split.py module docstring):
     ``pushes % mbatch`` remainder: without a drain function carrying
     that modulo, the last partial batch is silently dropped and every
     histogram whose block count is not a multiple of K is wrong.
+  * bins-on-sublanes layout contracts (round 6): a constant
+    ``hist_layout="sublane"`` needs ``num_bins <= 64`` (bins lie along
+    sublanes; wider counts cannot group features into the 128 MXU rows),
+    and the pending-ring VMEM budget is evaluated under BOTH layouts —
+    the sublane layout stages channels row-major, which the VMEM tiling
+    pads to the full 128-lane width (a 4-8x larger channel-slot term
+    that the ring-bytes formula must charge, ops/fused_split.py
+    fused_ring_bytes). The formula takes the RECORD width as its
+    ``num_cols`` — under RowLayout.packed4 that width is already the
+    nibble-packed one, so packing tightens the bound instead of
+    escaping it.
+  * pack4 nibble extraction (round 6): a right-shift that selects a
+    nibble (``>> 4`` or ``>> ((f & 1) * 4)``-shaped) from a packed bin
+    byte must mask the result with ``& 0xF`` — without the mask the
+    neighbour feature's high nibble rides along and every downstream
+    compare (one-hot, routing predicate) silently mismatches on half
+    the rows (ops/fused_split.py bin_col is the canonical site).
 """
 from __future__ import annotations
 
@@ -83,6 +100,7 @@ class PallasContractRule(Rule):
         for fn in module.functions.values():
             out.extend(self._check_defaults(module, fn))
         out.extend(self._check_ring_drain(module))
+        out.extend(self._check_nibble_masks(module, func_of))
         return out
 
     def _check_call(self, module, node: ast.Call, func_of) -> List[Finding]:
@@ -107,21 +125,63 @@ class PallasContractRule(Rule):
                 "fused_split call without num_rows= — the "
                 "pad >= block_size contract cannot be checked "
                 "statically and a short pad silently drops tail rows"))
+        out.extend(self._check_sublane(module, node, func_of, name))
         out.extend(self._check_mbatch(module, node, func_of, name))
         return out
+
+    def _check_sublane(self, module, node: ast.Call, func_of,
+                       name: str) -> List[Finding]:
+        """Constant-foldable bins-on-sublanes block-shape contract: a
+        sublane layout with num_bins > 64 cannot group features into the
+        128 MXU rows (ops/pallas_histogram.py _SUBLANE_MAX_BINS)."""
+        layout = bins = None
+        for kw in node.keywords:
+            if kw.arg in ("hist_layout", "layout") and \
+                    isinstance(kw.value, ast.Constant):
+                layout = kw.value.value
+            elif kw.arg == "num_bins" and \
+                    isinstance(kw.value, ast.Constant) and \
+                    isinstance(kw.value.value, int):
+                bins = kw.value.value
+        if layout != "sublane":
+            return []
+        if bins is None and name == "pallas_histogram" \
+                and len(node.args) >= 3 \
+                and isinstance(node.args[2], ast.Constant) \
+                and isinstance(node.args[2].value, int):
+            bins = node.args[2].value
+        if bins is None or bins <= 64:
+            return []
+        return [self.finding(
+            module, node, func_of(node),
+            f"{name}(hist_layout='sublane', num_bins={bins}): the "
+            "bins-on-sublanes layout supports num_bins <= 64 — wider bin "
+            "counts leave no room to group features into the 128 MXU "
+            "rows (bins lie along sublanes)")]
 
     def _check_mbatch(self, module, node: ast.Call, func_of,
                       name: str) -> List[Finding]:
         """Constant-foldable batched-M contracts: MXU-row bound + the
         pending ring's scoped-VMEM budget (both channel layouts)."""
         mb = bs = None
+        layouts = ("lane",)             # the parameter default
         for kw in node.keywords:
+            if kw.arg in ("hist_layout",) and \
+                    isinstance(kw.value, ast.Constant):
+                # constant layout: charge that layout's formula; a traced/
+                # computed layout charges both (conservative)
+                layouts = ((kw.value.value,)
+                           if kw.value.value in ("lane", "sublane")
+                           else ("lane", "sublane"))
             if isinstance(kw.value, ast.Constant) and \
                     isinstance(kw.value.value, int):
                 if kw.arg in _MBATCH_KWARGS:
                     mb = kw.value.value
                 elif kw.arg in _BLOCK_KWARGS:
                     bs = kw.value.value
+            elif kw.arg == "hist_layout" and \
+                    not isinstance(kw.value, ast.Constant):
+                layouts = ("lane", "sublane")
         if mb is None:
             return []
         out: List[Finding] = []
@@ -135,10 +195,13 @@ class PallasContractRule(Rule):
         if name == "fused_split" and bs is not None:
             from ...ops.fused_split import (_VMEM_RING_BUDGET,
                                             fused_ring_bytes)
-            # minimum 128-byte record width; bf16 >= int8 so checking
-            # both layouts reduces to the bf16 (quant=False) evaluation
-            worst = max(fused_ring_bytes(bs, 128, mb, quant=False),
-                        fused_ring_bytes(bs, 128, mb, quant=True))
+            # minimum 128-byte record width (packed4 layouts are NARROWER,
+            # so this floor covers them); evaluated for both channel
+            # dtypes AND both register layouts — the sublane layout's
+            # row-major channel slots pad to 128 lanes and must be charged
+            worst = max(
+                fused_ring_bytes(bs, 128, mb, quant=q, hist_layout=hl)
+                for q in (False, True) for hl in layouts)
             if worst > _VMEM_RING_BUDGET:
                 out.append(self.finding(
                     module, node, func_of(node),
@@ -180,6 +243,95 @@ class PallasContractRule(Rule):
             "function computes pushes % mbatch, so the last partial "
             "batch of staged histogram blocks is silently dropped "
             "whenever the block count is not a multiple of mbatch")]
+
+    # names whose reads plausibly hold a PACKED bin byte (two features
+    # per byte): the detector scopes to these so unrelated bit twiddling
+    # (word-index shifts, radix unpacks) stays out of view
+    _PACKY = ("pack", "nibble", "byte")
+
+    def _check_nibble_masks(self, module, func_of) -> List[Finding]:
+        """pack4 unpack sites must mask: ``X >> 4`` (or the dynamic
+        ``X >> ((f & 1) * 4)`` form) on a packed bin byte without an
+        ``& 0xF`` around it leaves the neighbour feature's nibble in the
+        result — flagged unless the shift sits under a BitAnd with 15."""
+        parents = {}
+        for node in ast.walk(module.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.RShift)):
+                continue
+            if not self._is_nibble_shift(node.right):
+                continue
+            if not self._touches_packed(module, node, parents):
+                continue
+            if self._masked_with_0xf(node, parents):
+                continue
+            out.append(self.finding(
+                module, node, func_of(node),
+                "pack4 nibble extract without the & 0xF mask: the shift "
+                "selects a nibble from a packed bin byte, but the "
+                "neighbour feature's nibble survives in the high bits — "
+                "every downstream bin compare silently mismatches "
+                "(mask the result with & 0xF)"))
+        return out
+
+    @staticmethod
+    def _is_nibble_shift(rhs: ast.AST) -> bool:
+        """Shift amounts that select a nibble: the constant 4, or an
+        expression multiplying by 4 (the ``(f & 1) * 4`` dynamic form)."""
+        if isinstance(rhs, ast.Constant):
+            return rhs.value == 4
+        for n in ast.walk(rhs):
+            if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Mult):
+                for side in (n.left, n.right):
+                    if isinstance(side, ast.Constant) and side.value == 4:
+                        return True
+        return False
+
+    def _touches_packed(self, module, node: ast.BinOp, parents) -> bool:
+        """Scope: the shifted value's name mentions a packed-byte source,
+        or the enclosing function is a pack4 helper."""
+        for n in ast.walk(node.left):
+            if isinstance(n, ast.Name) and \
+                    any(t in n.id.lower() for t in self._PACKY):
+                return True
+            if isinstance(n, ast.Attribute) and \
+                    any(t in n.attr.lower() for t in self._PACKY):
+                return True
+        cur = node
+        while cur in parents:
+            cur = parents[cur]
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and any(t in cur.name.lower()
+                            for t in ("pack", "nibble", "bin_col")):
+                return True
+        return False
+
+    @staticmethod
+    def _masked_with_0xf(node: ast.AST, parents) -> bool:
+        """True when an ancestor BitAnd masks with 15 (`& 0xF`, including
+        the dtype-wrapped `& jnp.uint8(0x0F)` form)."""
+        def is_0xf(n: ast.AST) -> bool:
+            if isinstance(n, ast.Constant) and n.value == 15:
+                return True
+            return (isinstance(n, ast.Call) and len(n.args) == 1
+                    and isinstance(n.args[0], ast.Constant)
+                    and n.args[0].value == 15)
+
+        cur = node
+        while cur in parents:
+            parent = parents[cur]
+            if isinstance(parent, ast.BinOp) and \
+                    isinstance(parent.op, ast.BitAnd) and \
+                    (is_0xf(parent.left) or is_0xf(parent.right)):
+                return True
+            if not isinstance(parent, (ast.BinOp, ast.UnaryOp)):
+                break
+            cur = parent
+        return False
 
     @staticmethod
     def _has_mbatch_rem(fn_node: ast.AST) -> bool:

@@ -221,6 +221,30 @@ def _pick_hist_mbatch(cfg) -> int:
     return max(1, min(k, 16))
 
 
+def _pick_hist_layout(cfg, num_bins: int) -> str:
+    """Resolve ``tpu_hist_layout``: the Mosaic one-hot register layout.
+
+    "sublane" lays bins along sublanes (B <= 64 only — wider bin counts
+    leave no room to group features into the 128 MXU rows); "auto"
+    resolves to "lane" until the BENCH_HIST_MICRO layout sweep says
+    otherwise for a shape (the sweep records both layouts per
+    {u8, pack4} x {f32, int8, int16-narrowed} cell)."""
+    mode = str(cfg.get("tpu_hist_layout", "auto")).lower()
+    if mode in ("", "auto", "lane"):
+        return "lane"
+    if mode != "sublane":
+        log.warning(f"tpu_hist_layout={mode!r} is not one of "
+                    "auto|lane|sublane; using the lane layout")
+        return "lane"
+    if num_bins > 64:
+        log.warning(
+            f"tpu_hist_layout=sublane needs num_bins <= 64 (got "
+            f"{num_bins}): bins lie along sublanes and wider counts "
+            "cannot group features into the 128 MXU rows; using lane")
+        return "lane"
+    return "sublane"
+
+
 def _validated_mbatch_env(value: str) -> int:
     """Round and re-guard an ``LGBM_TPU_HIST_MBATCH`` override (1-16)."""
     k = int(value)
@@ -709,6 +733,10 @@ class GBDT:
             log.warning("linear_tree is not supported with distributed "
                         "tree learners; training constant leaves")
         self._use_quant = bool(cfg.get("use_quantized_grad", False))
+        # set for real in _build_compact_step_fn (the int pipeline is
+        # compact-only); defaulting here keeps introspection safe on the
+        # masked path
+        self._quant_narrow_active = False
         self._quant_bins = int(cfg.get("num_grad_quant_bins", 4))
         self._quant_renew = bool(cfg.get("quant_train_renew_leaf", False))
         self._quant_stochastic = bool(cfg.get("stochastic_rounding", True))
@@ -772,6 +800,8 @@ class GBDT:
             fused_block=_pick_fused_block(cfg),
             fused_interpret=bool(cfg.get("tpu_fused_interpret", False)),
             hist_mbatch=_pick_hist_mbatch(cfg),
+            hist_layout=_pick_hist_layout(cfg,
+                                          int(train_set.max_num_bins)),
         )
 
         # serial-learner row storage: the compact grower physically
@@ -1037,11 +1067,35 @@ class GBDT:
         self._cx_grads = k if k > 1 else None
         gcols = 2 * k if k > 1 else 0
         e = k + gcols + 1 + (1 if has_w else 0) + 1
-        layout = RowLayout(num_features=int(self.binned.shape[1]), num_extra=e)
+        # pack4 TRAINING (reference: the 4-bit dense bin store,
+        # src/io/dense_bin.hpp DenseBin<true>): when every STORED column
+        # realizes <= 16 bins AND the shape-stable histogram width fits a
+        # nibble, the work/scratch bin columns nibble-pack — the streamed
+        # bin bytes (the fused kernel's dominant HBM traffic) halve, and
+        # every consumer unpacks per block/nibble at its read site
+        pack4_train = False
+        if bool(self.config.get("tpu_bin_pack4", False)):
+            from ..io.dataset import pack4_train_eligible
+            nb_max = int(np.asarray(self.num_bins_arr).max())
+            if pack4_train_eligible(np.asarray(self.num_bins_arr),
+                                    int(self.grower_params.num_bins)):
+                pack4_train = True
+            else:
+                log.warning(
+                    "tpu_bin_pack4=true: training keeps u8 bin columns — "
+                    "nibble packing needs every stored column to realize "
+                    f"<= 16 bins and max_bin <= 15 (histogram width "
+                    f"{int(self.grower_params.num_bins)}, widest column "
+                    f"{nb_max})")
+        layout = RowLayout(num_features=int(self.binned.shape[1]),
+                           num_extra=e, packed4=pack4_train)
         self._cx_label = k + gcols
         self._cx_weight = k + gcols + 1 if has_w else None
         self._cx_rowid = e - 1
         gp = self.grower_params
+        if pack4_train != gp.bin_pack4:
+            gp = gp._replace(bin_pack4=pack4_train)
+            self.grower_params = gp
         force_efb_fused = os.environ.get("LGBM_TPU_FORCE_FUSED_EFB", "") == "1"
         if os.environ.get("LGBM_TPU_FUSED_DUAL", "") == "0":
             gp = gp._replace(fused_dual=False)
@@ -1079,7 +1133,8 @@ class GBDT:
             # histogram alone would blow the ~16MB scoped limit
             from ..ops.fused_split import fused_block_cap
             c_rec = layout.num_cols
-            vmem_cap_bs = fused_block_cap(c_rec, gp.hist_mbatch)
+            vmem_cap_bs = fused_block_cap(c_rec, gp.hist_mbatch,
+                                          hist_layout=gp.hist_layout)
             bs = min(gp.fused_block, vmem_cap_bs)
             if os.environ.get("LGBM_TPU_FUSED_BS", ""):
                 # perf experiments; rounded + re-guarded, never trusted raw
@@ -1278,7 +1333,40 @@ class GBDT:
                 f"{self.num_data}*{quant_bins} exceeds the int32 histogram "
                 "range; using the dequantized-f32 histogram path")
         if quant_int:
-            gp = gp._replace(quant_hist=True)
+            gp = gp._replace(quant_hist=True, quant_max=quant_bins + 1)
+            # per-leaf bit-width narrowing (reference: GetHistBitsInLeaf,
+            # gradient_discretizer.cpp — renewed as leaves shrink): leaves
+            # whose code sums fit the packing radix take the packed-pair
+            # engine at HALF the contraction work, selected per leaf by a
+            # lax.cond in the compact grower (ops/grower_compact.py
+            # seg_hist). It rides the XLA segment-histogram walk — the
+            # fused Mosaic kernel histograms in-kernel on the int8 MXU
+            # path, where s32 accumulation is native and narrowing buys
+            # nothing.
+            from ..ops.histogram import narrow_chunk_rows
+            bits_cfg = int(self.config.get("tpu_quant_hist_bits", 0) or 0)
+            if bits_cfg not in (0, 16, 32):
+                log.warning(f"tpu_quant_hist_bits={bits_cfg} is not one of "
+                            "0 (auto) | 16 | 32; using 32-bit accumulation")
+                bits_cfg = 32
+            narrow_able = (narrow_chunk_rows(quant_bins + 1) > 0
+                           and gp.fused_block == 0)
+            if bits_cfg == 16 and not narrow_able:
+                log.warning(
+                    "tpu_quant_hist_bits=16 needs the XLA segment-"
+                    "histogram walk (tpu_fused=off) and a "
+                    "num_grad_quant_bins small enough for the packing "
+                    "radix; keeping 32-bit accumulation")
+            if bits_cfg == 16 and narrow_able:
+                gp = gp._replace(quant_narrow=True)
+            # auto (bits_cfg == 0) stays on the int8 -> int32 engine: the
+            # packed-pair engine's exactness radix caps its row chunks at
+            # narrow_chunk_rows (a few hundred), and the measured CPU
+            # sweep (BENCH_SHAPES layout_sweep) shows the chunking
+            # overhead eats the halved channel count at B <= 64 while
+            # int8 already beats the f32 einsum outright. Narrow is the
+            # measured opt-in until a backend's sweep row says otherwise.
+        self._quant_narrow_active = bool(quant_int and gp.quant_narrow)
         const_hess = bool(getattr(obj, "is_constant_hessian", False))
         feature_contri = self._feature_contri
         efb = self._efb

@@ -181,6 +181,23 @@ PARAMS: Dict[str, Tuple[Any, type, Tuple[str, ...]]] = {
     # sync reference path). The pending ring multiplies histogram-side
     # VMEM residency by K, so tpu_fused_block is re-clamped against it
     "tpu_hist_mbatch": (8, int, ("hist_mbatch",)),
+    # Mosaic one-hot register layout for the histogram engines: "lane"
+    # keeps bins along lanes (channel-major output, the batched-M
+    # block-diagonal path), "sublane" lays bins along sublanes for
+    # B <= 64 so the one-hot compare fills the register tile
+    # (ops/pallas_histogram.py _hist_kernel_sublane, ops/fused_split.py
+    # hist_flush). auto = lane; pick per-shape from the
+    # BENCH_SHAPES.json["hist_micro"]["layout_sweep"] measurements
+    "tpu_hist_layout": ("auto", str, ("hist_layout",)),
+    # per-leaf narrowed quantized accumulation (reference:
+    # GetHistBitsInLeaf): 0 = auto (currently the int8 -> int32 engine
+    # everywhere — the measured layout sweep shows the packed-pair
+    # engine's radix-capped chunks lose at B <= 64, so narrow is the
+    # measured OPT-IN), 16 = narrow where eligible (small leaves take
+    # the packed-pair engine: grad/hess and inbag/raw pairs share one
+    # f32 channel each — half the contraction work, bit-identical
+    # sums), 32 = always the int8 -> int32 engine
+    "tpu_quant_hist_bits": (0, int, ("quant_hist_bits",)),
     # data-parallel histogram reduction: reduce-scatter over the feature
     # axis + best-split all-gather vs full-histogram all-reduce
     # (ops/grower_compact.py hist_scatter)

@@ -612,8 +612,20 @@ def pack4_eligible(mappers) -> bool:
     bin matrix can store two columns per byte (``tpu_bin_pack4``). The
     check is per-ORIGINAL-feature: prediction inputs are binned in
     original feature space, so EFB bundling of the training matrix does
-    not affect eligibility."""
+    not affect eligibility. (Training eligibility is the STORED-column
+    twin — :func:`pack4_train_eligible`.)"""
     return bool(mappers) and all(m.num_bins <= 16 for m in mappers)
+
+
+def pack4_train_eligible(stored_num_bins, hist_bins: int) -> bool:
+    """Training-side pack4 eligibility (``tpu_bin_pack4`` on the compact
+    grower): every STORED column's realized bin count must fit a nibble —
+    under EFB that is the bundle-column width, which can exceed the
+    members' own bins — and the shape-stable histogram width
+    (``max_bin + 1``) must too, because the one-hot compare and the
+    routing predicate read nibble values 0..15."""
+    nb = np.asarray(stored_num_bins)
+    return bool(nb.size) and int(nb.max()) <= 16 and int(hist_bins) <= 16
 
 
 def pack4_matrix(binned: np.ndarray) -> np.ndarray:

@@ -52,34 +52,51 @@ from .split import go_left_pred
 
 
 class RowLayout(NamedTuple):
-    """Static description of the packed row record (part of the jit key)."""
+    """Static description of the packed row record (part of the jit key).
+
+    ``packed4``: the bin columns are NIBBLE-packed — two features per byte
+    (low nibble = even feature, high nibble = odd, the io/dataset.py
+    pack4_matrix layout; reference: the 4-bit dense bin store,
+    src/io/dense_bin.hpp DenseBin<true>). ``num_features`` stays the
+    LOGICAL feature count; ``feat_cols`` is the stored byte width. Every
+    consumer extracts nibbles with ``(byte >> 4*(f & 1)) & 0xF`` at its
+    read site, so the full-width matrix never materializes and the
+    streamed bin bytes halve (tpu_bin_pack4 training)."""
     num_features: int
     num_extra: int          # number of carried f32 columns (scores/label/...)
+    packed4: bool = False   # bin columns nibble-packed (two features/byte)
 
     @property
-    def grad_off(self) -> int:
+    def feat_cols(self) -> int:
+        """Stored bin byte columns (ceil(F/2) when nibble-packed)."""
+        if self.packed4:
+            return (self.num_features + 1) // 2
         return self.num_features
 
     @property
+    def grad_off(self) -> int:
+        return self.feat_cols
+
+    @property
     def hess_off(self) -> int:
-        return self.num_features + 4
+        return self.feat_cols + 4
 
     @property
     def cnt_off(self) -> int:
-        return self.num_features + 8
+        return self.feat_cols + 8
 
     @property
     def extra_off(self) -> int:
-        return self.num_features + 12
+        return self.feat_cols + 12
 
     @property
     def num_real_cols(self) -> int:
         """Columns carrying actual record bytes (rest is lane padding)."""
-        return self.num_features + 12 + 4 * self.num_extra
+        return self.feat_cols + 12 + 4 * self.num_extra
 
     @property
     def num_cols(self) -> int:
-        c = self.num_features + 12 + 4 * self.num_extra
+        c = self.num_real_cols
         # round lanes up to the full 128-lane tile: TPU HBM layouts pad the
         # minor dimension to 128 anyway (tiled storage), so this costs no
         # physical memory, and the fused Pallas kernel (ops/fused_split.py)
@@ -107,8 +124,15 @@ def pack_rows(
     pad_rows: int,
 ) -> jnp.ndarray:
     """Pack per-row arrays into the work matrix, padded by ``pad_rows``
-    garbage rows so blocked dynamic slices never clamp at the array end."""
+    garbage rows so blocked dynamic slices never clamp at the array end.
+
+    With ``layout.packed4`` a full-width [N, F] bin matrix nibble-packs
+    here (an already-packed [N, ceil(F/2)] matrix passes through)."""
     n = binned.shape[0]
+    if layout.packed4 and binned.shape[1] == layout.num_features:
+        if layout.num_features % 2:
+            binned = jnp.pad(binned, ((0, 0), (0, 1)))
+        binned = (binned[:, 0::2] | (binned[:, 1::2] << 4))
     parts = [
         binned.astype(jnp.uint8),
         _f32_to_u8(grad),
@@ -125,9 +149,13 @@ def pack_rows(
 
 
 def unpack_rows(work: jnp.ndarray, n: int, layout: RowLayout):
-    """Inverse of pack_rows (on the first ``n`` rows)."""
+    """Inverse of pack_rows (on the first ``n`` rows; packed4 layouts
+    unpack the nibbles back to the full [n, F] width)."""
     f = layout.num_features
-    binned = work[:n, :f]
+    binned = work[:n, :layout.feat_cols]
+    if layout.packed4:
+        from .packed import unpack4
+        binned = unpack4(binned, f)
     grad = _u8_to_f32(work[:n, layout.grad_off:layout.grad_off + 4])
     hess = _u8_to_f32(work[:n, layout.hess_off:layout.hess_off + 4])
     cnt = _u8_to_f32(work[:n, layout.cnt_off:layout.cnt_off + 4])
@@ -223,9 +251,14 @@ def partition_segment(
     is_cat: jnp.ndarray,     # bool
     cat_bitset: jnp.ndarray,  # [W] u32 bin bitset (categorical splits)
     block_size: int,
+    packed4: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Stably partition ``work[start:start+count]`` so left-child rows occupy
     ``[start, start+n_left)`` and right-child rows the remainder.
+
+    ``packed4``: bin columns are nibble-packed (RowLayout.packed4) — the
+    routing column reads the byte at ``feature >> 1`` and extracts the
+    nibble selected by ``feature & 1``.
 
     Returns the updated (work, scratch). Everything streams: per block one
     contiguous read, one one-hot compaction matmul, and carry-buffered
@@ -242,7 +275,12 @@ def partition_segment(
     def body(state):
         i, work, scratch, lbuf, lcnt, lptr, rbuf, rcnt, rptr = state
         blk = lax.dynamic_slice(work, (start + i * bs, 0), (bs, c))
-        col = lax.dynamic_slice_in_dim(blk, feature, 1, axis=1)[:, 0]
+        if packed4:
+            byte = lax.dynamic_slice_in_dim(
+                blk, feature >> 1, 1, axis=1)[:, 0].astype(jnp.int32)
+            col = (byte >> ((feature & 1) * 4)) & 0xF
+        else:
+            col = lax.dynamic_slice_in_dim(blk, feature, 1, axis=1)[:, 0]
         valid = iota < (count - i * bs)
         gl = go_left_pred(col, bin_, default_left, nan_bin, is_cat,
                           cat_bitset)
@@ -292,6 +330,9 @@ def segment_histogram(
     impl: str = "auto",
     quantized: bool = False,
     mbatch: int = 1,
+    acc_bits: int = 32,
+    quant_max: int = 127,
+    hist_layout: str = "lane",
 ) -> jnp.ndarray:            # [F, B, 4] f32 (int32 when quantized)
     """Histogram of one contiguous leaf segment, streamed in fixed blocks.
 
@@ -307,6 +348,12 @@ def segment_histogram(
     contraction runs int8 x int8 -> int32 on the MXU (ops/histogram.py).
     All four channels come back as exact int32 sums (the GBDT bounds
     global num_data * quant_bins inside int32 before selecting this path).
+
+    ``acc_bits=16`` (quantized only) selects the narrowed packed-pair
+    accumulation — bit-identical int32 sums at half the contraction work
+    where leaf bounds allow (ops/histogram.py _xla_histogram_narrow;
+    reference: GetHistBitsInLeaf). ``layout.packed4`` streams nibble-packed
+    bin bytes and unpacks per block inside histogram_block.
     """
     from .histogram import histogram_block
 
@@ -333,8 +380,10 @@ def segment_histogram(
             cw = (cw != 0.0).astype(jnp.float32)
             chans = jnp.stack([g * valid, h * valid, cw * valid, valid],
                               axis=1)
-        acc = acc + histogram_block(blk[:, :f], chans, b, impl=impl,
-                                    mbatch=mbatch)
+        acc = acc + histogram_block(
+            blk[:, :layout.feat_cols], chans, b, impl=impl, mbatch=mbatch,
+            packed4_features=f if layout.packed4 else 0,
+            layout=hist_layout, acc_bits=acc_bits, quant_max=quant_max)
         return j + 1, acc
 
     acc0 = jnp.zeros((f, b, 4), jnp.int32 if quantized else jnp.float32)

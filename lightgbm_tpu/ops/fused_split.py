@@ -112,29 +112,40 @@ _VMEM_RING_BUDGET = 4 << 20
 
 
 def fused_ring_bytes(block_size: int, num_cols: int, mbatch: int,
-                     quant: bool = False) -> int:
+                     quant: bool = False, hist_layout: str = "lane") -> int:
     """Scoped-VMEM bytes of the pending ring + its flush transients.
 
-    Counted per slot: the [bs, C] u8 bin block, the [8, bs] transposed
-    channel operand (bf16 padded to 16 sublanes / int8 to 32), the
-    row-concatenated one-hot of one feature group (<= 512 lanes bf16,
-    which covers the int8 layout too), and the [8K, K*bs] block-diagonal
-    channel operand of the batched contraction."""
+    Counted per slot: the [bs, C] u8 bin block (``num_cols`` already
+    reflects the nibble-packed width under RowLayout.packed4 — the packed
+    layout halves this term, it does not escape the accounting), the
+    channel operand, the row-concatenated one-hot of one feature group
+    (<= 512 lanes bf16, which covers the int8 layout too), and the
+    block-diagonal channel operand of the batched contraction.
+
+    ``hist_layout``: the lane layout stages channels TRANSPOSED [8, bs]
+    (bf16 padded to 16 sublanes / int8 to 32); the sublane layout stages
+    them row-major [bs, 8], which the VMEM tiling pads to the full
+    128-lane width — a 4-8x larger channel-slot term that must be charged,
+    not assumed away."""
     elt = 1 if quant else 2
     bins = mbatch * block_size * num_cols
-    cht = mbatch * (32 if quant else 16) * block_size * elt
+    if hist_layout == "sublane":
+        cht = mbatch * block_size * 128 * elt
+    else:
+        cht = mbatch * (32 if quant else 16) * block_size * elt
     oh = mbatch * block_size * 512 * elt
     diag = 8 * mbatch * mbatch * block_size * elt
     return bins + cht + oh + diag
 
 
-def fused_block_cap(num_cols: int, mbatch: int, quant: bool = False) -> int:
+def fused_block_cap(num_cols: int, mbatch: int, quant: bool = False,
+                    hist_layout: str = "lane") -> int:
     """Largest 32-multiple block size whose streaming buffers AND pending
     ring fit the scoped-VMEM caps (the automatic derivation and the
     LGBM_TPU_FUSED_BS clamp both go through here)."""
     bs = max(32, (_VMEM_STREAM_CAP // max(num_cols, 1)) // 32 * 32)
-    while bs > 32 and fused_ring_bytes(bs, num_cols, mbatch,
-                                       quant) > _VMEM_RING_BUDGET:
+    while bs > 32 and fused_ring_bytes(bs, num_cols, mbatch, quant,
+                                       hist_layout) > _VMEM_RING_BUDGET:
         bs -= 32
     return bs
 
@@ -191,7 +202,7 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
                   bitset_words: int, use_int8: bool,
                   interpret: bool, dual: bool,
                   hist_debug: str = "", quant: bool = False,
-                  mbatch: int = 1):
+                  mbatch: int = 1, hist_layout: str = "lane"):
     # dual=True: dual residency — rights land LIVE in the other array at the
     #   same offsets (RMW blends protect neighbour segments; auxbuf=[bs,C]
     #   rmw buffer, sem_aux=single DMA sem). The grower merges once per tree.
@@ -203,7 +214,21 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
     C = layout.num_cols
     B = num_bins
     BS_, F_pad, _ = _hist_packing(F, B)   # BS_: bin stride per feature
+    packed4 = layout.packed4
     i32 = jnp.int32
+
+    def bin_col(bins_i32, j):
+        """Bin column of LOGICAL feature ``j`` (static) as [bs, 1] i32.
+
+        packed4 records store two features per byte: the byte at column
+        j >> 1 carries feature j in the nibble selected by j & 1. The
+        & 0xF mask is load-bearing — without it the neighbour feature's
+        nibble rides along and every one-hot compare mismatches
+        (tpulint R004 flags unmasked pack4 nibble extracts)."""
+        if packed4:
+            byte = bins_i32[:, j // 2:j // 2 + 1]
+            return (byte >> (4 * (j % 2))) & 0xF
+        return bins_i32[:, j:j + 1]
 
     mode = sp_ref[_MODE]
     base = sp_ref[_BASE_T] * _A
@@ -363,7 +388,7 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
         lane spread was tried instead of the per-feature compare loop and
         lowers to far slower relayouts on this Mosaic toolchain (0.54 vs
         1.07 it/s on the 10.5M higgs bench)."""
-        bins = rows_u8.astype(i32)[:, :F]
+        bins = rows_u8.astype(i32)[:, :layout.feat_cols]
         # tightly packed: each feature spans B lanes (not 128-padded), so
         # B <= 64 fits 2+ features per lane tile; group widths and offsets
         # stay 128-aligned via the align unit from _hist_packing
@@ -381,7 +406,7 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
         while fc < F_pad:
             wc = min(w, F_pad - fc)
             oh = jnp.concatenate(
-                [((bins[:, fc + j:fc + j + 1] if fc + j < F else zero_col)
+                [((bin_col(bins, fc + j) if fc + j < F else zero_col)
                   == iota_b).astype(oh_t)
                  for j in range(wc)], axis=1)            # [BS, wc*BS_]
             part = lax.dot_general(
@@ -421,7 +446,62 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
         drain, or stale data from a previous ring wrap) are zeroed on the
         channel side, so whatever their bins one-hot into contributes
         exactly zero — counts stay bit-identical to the K=1 sync path and
-        int32 quantized sums stay exact."""
+        int32 quantized sums stay exact.
+
+        hist_layout="sublane" (tpu_hist_layout, the B <= 64 Mosaic
+        layout): the SAME staged operands contract with swapped roles —
+        channels stay row-major [bs, 8] (no transpose matmul per push),
+        tile into the [K*bs, 8K] lane-banded RHS, and the one-hot LHS
+        contracts over its sublane axis, so the output lands BIN-major
+        [group, 8K] with bins along sublanes; the K row-window partials
+        sit in lane bands and reduce with K-1 adds of [group, 8] slices.
+        Counts/int32 sums stay bit-identical (same products, regrouped)."""
+        bins_k = [pendbuf[t].astype(i32)[:, :layout.feat_cols]
+                  for t in range(mbatch)]
+        _, _, w = _hist_packing(F, B)
+        iota_b = lax.broadcasted_iota(i32, (bs, BS_), 1)
+        zero_col = jnp.full((bs, 1), -1, i32)
+        oh_t = jnp.int8 if quant else jnp.bfloat16
+        acc_t = jnp.int32 if quant else jnp.float32
+
+        def group_ohs(fc, wc):
+            return [jnp.concatenate(
+                [((bin_col(bins, fc + j) if fc + j < F else zero_col)
+                  == iota_b).astype(oh_t)
+                 for j in range(wc)], axis=1)             # [bs, wc*BS_]
+                for bins in bins_k]
+
+        if hist_layout == "sublane":
+            bands = []
+            for t in range(mbatch):
+                chR = pendch[t]                           # [bs, 8]
+                chR = jnp.where(n_valid > t, chR, jnp.zeros_like(chR))
+                parts = []
+                if t:
+                    parts.append(jnp.zeros((bs, t * 8), cht))
+                parts.append(chR)
+                if mbatch - 1 - t:
+                    parts.append(jnp.zeros((bs, (mbatch - 1 - t) * 8), cht))
+                bands.append(parts[0] if len(parts) == 1
+                             else jnp.concatenate(parts, axis=1))
+            ch_bd = (bands[0] if mbatch == 1
+                     else jnp.concatenate(bands, axis=0))  # [K*bs, 8K]
+            fc = 0
+            while fc < F_pad:
+                wc = min(w, F_pad - fc)
+                ohs = group_ohs(fc, wc)
+                oh = ohs[0] if mbatch == 1 \
+                    else jnp.concatenate(ohs, axis=0)      # [K*bs, wc*BS_]
+                part = lax.dot_general(
+                    oh, ch_bd, dimension_numbers=(((0,), (0,)), ((), ())),
+                    preferred_element_type=acc_t)          # [wc*BS_, 8K]
+                red = part[:, 0:8]
+                for t in range(1, mbatch):
+                    red = red + part[:, 8 * t:8 * (t + 1)]
+                hist_ref[fc * BS_:(fc + wc) * BS_, :] += red
+                fc += wc
+            return
+
         blocks = []
         for t in range(mbatch):
             chT = pendch[t]                               # [8, bs]
@@ -436,20 +516,10 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
                           else jnp.concatenate(parts, axis=1))
         ch_diag = (blocks[0] if mbatch == 1
                    else jnp.concatenate(blocks, axis=0))  # [8K, K*bs]
-        bins_k = [pendbuf[t].astype(i32)[:, :F] for t in range(mbatch)]
-        _, _, w = _hist_packing(F, B)
-        iota_b = lax.broadcasted_iota(i32, (bs, BS_), 1)
-        zero_col = jnp.full((bs, 1), -1, i32)
-        oh_t = jnp.int8 if quant else jnp.bfloat16
-        acc_t = jnp.int32 if quant else jnp.float32
         fc = 0
         while fc < F_pad:
             wc = min(w, F_pad - fc)
-            ohs = [jnp.concatenate(
-                [((bins[:, fc + j:fc + j + 1] if fc + j < F else zero_col)
-                  == iota_b).astype(oh_t)
-                 for j in range(wc)], axis=1)             # [bs, wc*BS_]
-                for bins in bins_k]
+            ohs = group_ohs(fc, wc)
             oh = ohs[0] if mbatch == 1 else jnp.concatenate(ohs, axis=0)
             part = lax.dot_general(
                 ch_diag, oh, dimension_numbers=(((1,), (0,)), ((), ())),
@@ -494,7 +564,12 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
         pushes = smem[_PEND]
         cur = lax.rem(pushes, mbatch)
         pendbuf[cur] = rows_u8
-        pendch[cur] = transpose_ch(assemble_ch8(rows_u8, mask_f32))
+        if hist_layout == "sublane":
+            # bins-on-sublanes flush contracts row-major channels — the
+            # per-push transpose matmul disappears entirely
+            pendch[cur] = assemble_ch8(rows_u8, mask_f32)
+        else:
+            pendch[cur] = transpose_ch(assemble_ch8(rows_u8, mask_f32))
         smem[_PEND] = pushes + 1
 
         @pl.when(cur == mbatch - 1)
@@ -584,7 +659,14 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
         @pl.when(mode == 0)
         def _():
             head = g_idx < start
-            col = jnp.sum(jnp.where(lane == feature, blk, 0), axis=1)
+            if packed4:
+                # two features per byte: select the byte column, then the
+                # nibble (the & 0xF mask strips the neighbour feature)
+                byte = jnp.sum(
+                    jnp.where(lane == (feature >> 1), blk, 0), axis=1)
+                col = (byte >> ((feature & 1) * 4)) & 0xF
+            else:
+                col = jnp.sum(jnp.where(lane == feature, blk, 0), axis=1)
             # routing predicate — mirrors ops/split.py go_left_pred
             gl_num = jnp.logical_or(
                 col <= bin_,
@@ -800,7 +882,7 @@ def _fused_kernel(sp_ref, bits_ref, work_in, scr_in, work_out, scr_out,
     jax.jit,
     static_argnames=("layout", "num_bins", "block_size", "bitset_words",
                      "interpret", "dual", "hist_debug", "num_rows", "quant",
-                     "mbatch"))
+                     "mbatch", "hist_layout"))
 def fused_split(
     work: jnp.ndarray,          # [N + pad, C] u8, C % 128 == 0
     scratch: jnp.ndarray,       # [N + pad, C] u8
@@ -826,6 +908,7 @@ def fused_split(
     num_rows: int = None,       # real (unpadded) row count, for pad checks
     quant: bool = False,        # packed int8 channel layout -> int32 hist
     mbatch: int = 8,            # batched-M pending-ring depth (1-16)
+    hist_layout: str = "lane",  # lane | sublane (tpu_hist_layout, B <= 64)
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One fused split. Returns (work', scratch', hist_smaller [F, B, 4]);
     the histogram is int32 when ``quant`` (quantized-gradient codes,
@@ -923,6 +1006,11 @@ def fused_split(
     W = bitset_words
     if quant:
         hist_debug = ""     # bisect probes assume the bf16 channel layout
+    if hist_layout not in ("lane", "sublane"):
+        raise ValueError(f"hist_layout must be 'lane' or 'sublane', "
+                         f"got {hist_layout!r}")
+    if hist_layout == "sublane":
+        hist_debug = ""     # bisect probes assume the lane accumulator
     mbatch = max(1, min(int(mbatch), 16))   # 8*mbatch <= 128 MXU rows
     # int8 MXU path needs one free padding lane for the receive indicator
     use_int8 = layout.num_real_cols < C
@@ -932,7 +1020,8 @@ def fused_split(
     kernel = functools.partial(
         _fused_kernel, layout=layout, num_bins=B, bs=bs, bitset_words=W,
         use_int8=use_int8, interpret=interpret, dual=dual,
-        hist_debug=hist_debug, quant=quant, mbatch=mbatch)
+        hist_debug=hist_debug, quant=quant, mbatch=mbatch,
+        hist_layout=hist_layout)
 
     work_o, scr_o, hist8 = pl.pallas_call(
         kernel,
@@ -960,24 +1049,34 @@ def fused_split(
                 (pltpu.VMEM((bs, C), jnp.uint8) if dual
                  else pltpu.VMEM((2, bs, C), jnp.uint8)),   # auxbuf
                 # batched-M pending ring: K staged bin blocks + their
-                # TRANSPOSED [8, bs] channel operands (hist_flush)
+                # channel operands — TRANSPOSED [8, bs] for the lane
+                # layout, row-major [bs, 8] for sublane (hist_flush)
                 pltpu.VMEM((mbatch, bs, C), jnp.uint8),   # pendbuf
-                pltpu.VMEM((mbatch, 8, bs), ch_t),        # pendch
+                (pltpu.VMEM((mbatch, bs, 8), ch_t)
+                 if hist_layout == "sublane"
+                 else pltpu.VMEM((mbatch, 8, bs), ch_t)),  # pendch
                 pltpu.SMEM((8,), jnp.int32),
             ],
         ),
         out_shape=[
             jax.ShapeDtypeStruct(work.shape, work.dtype),
             jax.ShapeDtypeStruct(scratch.shape, scratch.dtype),
-            jax.ShapeDtypeStruct((8, F_pad * BS_), hist_t),
+            (jax.ShapeDtypeStruct((F_pad * BS_, 8), hist_t)
+             if hist_layout == "sublane"
+             else jax.ShapeDtypeStruct((8, F_pad * BS_), hist_t)),
         ],
         input_output_aliases={2: 0, 3: 1},
         compiler_params=_SIDE_EFFECT_PARAMS,
         interpret=interpret,
     )(sp, cat_bitset, work, scratch)
 
-    hist8 = hist8.reshape(8, F_pad, BS_)[:, :F, :B]
-    hist = jnp.transpose(hist8[:4] + hist8[4:], (1, 2, 0))  # [F, B, 4]
+    if hist_layout == "sublane":
+        # bin-major accumulator: [F*BS_, 8] -> [F, B, 4] with no transpose
+        hb = hist8.reshape(F_pad, BS_, 8)[:F, :B, :]
+        hist = hb[:, :, :4] + hb[:, :, 4:]
+    else:
+        hist8 = hist8.reshape(8, F_pad, BS_)[:, :F, :B]
+        hist = jnp.transpose(hist8[:4] + hist8[4:], (1, 2, 0))  # [F, B, 4]
     return work_o, scr_o, hist
 
 

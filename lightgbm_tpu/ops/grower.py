@@ -114,6 +114,28 @@ class GrowerParams(NamedTuple):
     # (reference: gradient_discretizer.cpp + cuda_histogram_constructor
     # .cu:249-524); the per-iteration scales ride as traced args
     quant_hist: bool = False
+    # narrowed (16-bit) quantized accumulation (reference:
+    # GetHistBitsInLeaf, gradient_discretizer.cpp): leaves whose code sums
+    # fit the packing radix take the packed-pair engine — grad/hess and
+    # inbag/raw pairs share one f32 channel each, HALF the contraction
+    # work, bit-identical int32 sums (ops/histogram.py
+    # _xla_histogram_narrow); bits renew per split as leaves shrink
+    # (ops/renew.py hist_bits_in_leaf). XLA engine only — the MXU's int8
+    # dot accumulates s32 natively, so Mosaic paths gain nothing
+    quant_narrow: bool = False
+    # static |code| bound for the narrowed engine's packing radix
+    # (num_grad_quant_bins + 1; 127 = the raw int8 bound)
+    quant_max: int = 127
+    # 4-bit nibble-packed bin columns in the compact row records
+    # (tpu_bin_pack4 training; RowLayout.packed4 is the operative static
+    # key — this mirror keeps the knob visible on the params pytree)
+    bin_pack4: bool = False
+    # Mosaic one-hot register layout (tpu_hist_layout): "lane" = bins
+    # along lanes (channel-major output, the batched-M block-diagonal
+    # path), "sublane" = bins along sublanes for B <= 64
+    # (ops/pallas_histogram.py _hist_kernel_sublane,
+    # ops/fused_split.py hist_flush)
+    hist_layout: str = "lane"
     # batched-M histogram depth (env/param tpu_hist_mbatch): K staged row
     # blocks per one-hot contraction fill M = 8K of the 128 MXU rows —
     # the fused kernel's pending ring, the Mosaic kernel's window
@@ -319,9 +341,11 @@ def grow_tree(
             return voting_histogram(binned, chans, B, params.voting_shards,
                                     params.voting_k, params.split_params(),
                                     impl=params.hist_impl,
-                                    mbatch=params.hist_mbatch)
+                                    mbatch=params.hist_mbatch,
+                                    layout=params.hist_layout)
         return histogram(binned, chans, B, ax, impl=params.hist_impl,
-                         mbatch=params.hist_mbatch)
+                         mbatch=params.hist_mbatch,
+                         layout=params.hist_layout)
 
     if mono_types is None:
         mono_types = jnp.zeros((f,), jnp.int8)

@@ -156,6 +156,16 @@ def grow_tree_compact(
     n = n_real
     L = params.num_leaves
     B = params.num_bins
+    if layout.packed4 and B > 16:
+        raise ValueError(
+            f"RowLayout.packed4 needs every bin value to fit a nibble "
+            f"(num_bins <= 16, got {B}) — tpu_bin_pack4 training is only "
+            "eligible when all stored columns realize <= 16 bins")
+    if bool(params.bin_pack4) != bool(layout.packed4):
+        raise ValueError(
+            "GrowerParams.bin_pack4 and RowLayout.packed4 disagree — the "
+            "trainer must thread the pack4 decision through both (the "
+            "layout drives the kernels, the param the analysis rules)")
     F = layout.num_features          # stored columns (histogram space)
     F_scan = F + params.efb_virtual  # + virtual EFB features (scan space)
     feat_info = (num_bins_arr, nan_bin_arr, has_nan_arr, is_cat_arr)
@@ -278,10 +288,28 @@ def grow_tree_compact(
         return sp._replace(gain=jnp.where(depth_ok, sp.gain, _NEG_INF))
 
     def seg_hist(work, start, count):
-        return segment_histogram(work, start, count, layout, B,
-                                 params.hist_block, params.hist_impl,
-                                 quantized=quant,
-                                 mbatch=params.hist_mbatch)
+        def hist_with(acc_bits):
+            def fn(args):
+                w, s_, c_ = args
+                return segment_histogram(
+                    w, s_, c_, layout, B, params.hist_block,
+                    params.hist_impl, quantized=quant,
+                    mbatch=params.hist_mbatch, acc_bits=acc_bits,
+                    quant_max=params.quant_max,
+                    hist_layout=params.hist_layout)
+            return fn
+
+        if quant and params.quant_narrow:
+            # per-leaf hist-bits renewal (reference: GetHistBitsInLeaf,
+            # renewed as leaves shrink): narrow leaves take the packed-pair
+            # 16-bit engine, wide leaves the int8/int32 engine — both
+            # branches return identical int32 [F, B, 4] sums, so the cond
+            # is a pure engine-selection with bit-identical results
+            from .renew import hist_bits_in_leaf
+            bits = hist_bits_in_leaf(count, params.quant_max)
+            return lax.cond(bits == 16, hist_with(16), hist_with(32),
+                            (work, start, count))
+        return hist_with(32)((work, start, count))
 
     # ---- root ----
     if params.fused_block:
@@ -292,7 +320,7 @@ def grow_tree_compact(
             jnp.zeros((W,), jnp.uint32), layout, B, params.fused_block, W,
             interpret=params.fused_interpret, dual=params.fused_dual,
             hist_debug=params.fused_hist_debug, num_rows=n, quant=quant,
-            mbatch=params.hist_mbatch)
+            mbatch=params.hist_mbatch, hist_layout=params.hist_layout)
     else:
         root_loc = seg_hist(work, jnp.asarray(0, i32), jnp.asarray(n, i32))
     # data-parallel: histograms reduce over the mesh axis (reference: the
@@ -572,11 +600,13 @@ def grow_tree_compact(
                 interpret=params.fused_interpret,
                 smaller_left=left_smaller.astype(i32), side=side_p,
                 dual=params.fused_dual, hist_debug=params.fused_hist_debug,
-                num_rows=n, quant=quant, mbatch=params.hist_mbatch)
+                num_rows=n, quant=quant, mbatch=params.hist_mbatch,
+                hist_layout=params.hist_layout)
         else:
             work, scratch = partition_segment(
                 st.work, st.scratch, s_, m_eff, n_left_eff, f_col, b_, dl,
-                nan_bin_arr[f_], f_cat, bits, params.part_block)
+                nan_bin_arr[f_], f_cat, bits, params.part_block,
+                packed4=layout.packed4)
         leaf_start = st.leaf_start.at[best_leaf].set(
             jnp.where(applied, s_, st.leaf_start[best_leaf]))
         leaf_start = leaf_start.at[new_leaf].set(

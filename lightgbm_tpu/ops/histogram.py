@@ -99,6 +99,97 @@ def _xla_histogram(binned, channels, num_bins: int, mbatch: int = 1):
     return hist
 
 
+# narrowed (16-bit) quantized accumulation: the packed-pair radix. Two code
+# sums share one f32 channel exactly when the per-chunk sums stay below the
+# radix: with R = 4096 and chunk sums capped at R - 1 = 4095, the worst
+# packed chunk sum is R * 4095 + 4095 = 4095 * 4097 = 2^24 - 1 — the last
+# exactly-representable f32 integer, so no larger power-of-two radix works.
+_NARROW_RADIX = 4096
+_NARROW_SHIFT = 12
+
+
+def narrow_chunk_rows(quant_max: int) -> int:
+    """Largest row chunk whose packed-pair sums stay exact (128-multiple).
+
+    The bound: chunk * quant_max <= RADIX - 1 keeps the hess-code sum
+    strictly below the radix (unpackable) and the packed grad+hess sum
+    below 2^24 (exact in f32). Returns 0 when ``quant_max`` is too large
+    for even a 128-row chunk — callers must keep the int32 path then."""
+    c = ((_NARROW_RADIX - 1) // max(1, quant_max)) // 128 * 128
+    return c if c >= 128 else 0
+
+
+def _xla_histogram_narrow(binned, channels, num_bins: int, quant_max: int):
+    """16-bit narrowed quantized histogram (reference: the narrow hist-bits
+    mode of GradientDiscretizer::GetHistBitsInLeaf + the 16-bit packed
+    gradient-hessian histogram entries, gradient_discretizer.cpp).
+
+    The int8 grad/hess codes pack as ``P = qg * 4096 + qh`` and the {0,1}
+    count channels as ``W = inbag * 4096 + raw`` — TWO f32 channels instead
+    of four — and the one-hot contraction rides the fp32-HIGHEST MXU/BLAS
+    path. Per chunk the packed sums are exact f32 integers (see
+    narrow_chunk_rows), unpack to int32 with an arithmetic shift/mask pair,
+    and accumulate int32 across chunks, so the result is BIT-IDENTICAL to
+    the int8 x int8 -> int32 engine at half the contraction work."""
+    n, f = binned.shape
+    b = num_bins
+    if channels.shape[1] != 4:
+        raise ValueError(
+            f"acc_bits=16 packs the (qgrad, qhess, inbag, raw) channel "
+            f"quad; got {channels.shape[1]} channels — the narrowed "
+            "engine has no packing for other channel layouts")
+    chunk = narrow_chunk_rows(quant_max)
+    if not chunk:
+        raise ValueError(
+            f"acc_bits=16 needs quant_max <= {(_NARROW_RADIX - 1) // 128} "
+            f"(got {quant_max}): a 128-row chunk's code sums must stay "
+            "below the packing radix")
+    chunk = min(chunk, _chunk_rows(n, f, b))
+    iota = jnp.arange(b, dtype=jnp.int32)
+    radix = jnp.float32(_NARROW_RADIX)
+
+    def pack2(ch):
+        chf = ch.astype(jnp.float32)
+        p = chf[:, 0] * radix + chf[:, 1]       # qg*R + qh (qh >= 0 < R)
+        w = chf[:, 2] * radix + chf[:, 3]       # inbag*R + raw
+        return jnp.stack([p, w], axis=1)
+
+    def unpack2(part):
+        # exact integer-valued f32 -> int32, then split each packed sum
+        # with an arithmetic shift (floor division by the radix) and the
+        # low-bits mask — exact for negative grad sums too
+        pi = part.astype(jnp.int32)
+        hi = pi >> _NARROW_SHIFT
+        lo = pi & (_NARROW_RADIX - 1)
+        return jnp.stack([hi[..., 0], lo[..., 0], hi[..., 1], lo[..., 1]],
+                         axis=-1)               # [F, B, 4]
+
+    def contract(bc, cc):
+        onehot = (bc.astype(jnp.int32)[:, :, None] == iota) \
+            .astype(jnp.float32)
+        part = jnp.einsum("rfb,rk->fbk", onehot, pack2(cc),
+                          precision=lax.Precision.HIGHEST)
+        return unpack2(part)
+
+    if n <= chunk:
+        return contract(binned, channels)
+    n_chunks = -(-n // chunk)
+    pad = n_chunks * chunk - n
+    if pad:
+        binned = jnp.pad(binned, ((0, pad), (0, 0)))
+        channels = jnp.pad(channels, ((0, pad), (0, 0)))
+    binned_c = binned.reshape(n_chunks, chunk, f)
+    channels_c = channels.reshape(n_chunks, chunk, channels.shape[1])
+
+    def step(hist, inp):
+        bc, cc = inp
+        return hist + contract(bc, cc), None
+
+    hist0 = jnp.zeros((f, b, 4), jnp.int32)
+    hist, _ = lax.scan(step, hist0, (binned_c, channels_c))
+    return hist
+
+
 def dequantize_hist(hist: jax.Array, g_scale, h_scale) -> jax.Array:
     """int32 quantized histogram ``[..., 4+]`` -> f32.
 
@@ -144,6 +235,9 @@ def histogram_block(
     impl: str = "auto",
     mbatch: int = 1,
     packed4_features: int = 0,
+    layout: str = "lane",
+    acc_bits: int = 32,
+    quant_max: int = 127,
 ) -> jax.Array:             # [F, B, K] f32 (int32 for int8 channels)
     """Histogram of one already-sliced row block (no psum, no jit wrapper —
     call sites are inside jitted loops).
@@ -161,26 +255,47 @@ def histogram_block(
     ([BS, ceil(F/2)] u8, ``tpu_bin_pack4`` — io/dataset.py pack4_matrix)
     and is unpacked here, inside the jitted block loop, so only one
     block's full width ever materializes while the HBM-resident matrix
-    stays at half size. This is the engine-level hook for packed bin
-    matrices (parity-tested in tests/test_predict_engine.py);
-    ``tpu_bin_pack4`` currently packs SERVED request matrices only — no
-    trainer path feeds packed blocks yet (training matrices stay u8)."""
+    stays at half size. Fed by both the serving path and, since round 6,
+    the pack4 TRAINING path (ops/compact.py segment_histogram with a
+    ``RowLayout.packed4`` record layout).
+
+    ``layout`` selects the Mosaic one-hot register layout
+    (ops/pallas_histogram.py): "lane" keeps bins along lanes (channel-major
+    output), "sublane" lays bins along sublanes for B <= 64 so the one-hot
+    compare fills the register tile (tpu_hist_layout).
+
+    ``acc_bits=16`` selects the narrowed quantized accumulation for integer
+    channels (reference: GetHistBitsInLeaf): grad/hess and inbag/raw code
+    pairs pack into ONE f32 channel each (exact below the packing radix,
+    see narrow_chunk_rows), halving the contraction work; ``quant_max``
+    must bound |code| (the trainer passes num_grad_quant_bins + 1).
+    Results stay bit-identical int32."""
     if packed4_features:
         from .packed import unpack4
         binned = unpack4(binned, packed4_features)
+    quantized = jnp.issubdtype(channels.dtype, jnp.integer)
+    if acc_bits == 16 and quantized:
+        # narrowed engine: packed f32 channels through the fp32-HIGHEST
+        # contraction, exact int32 out (no Mosaic variant — the MXU's
+        # int8 path already accumulates s32 natively, so narrowing buys
+        # nothing there; this path wins where integer dots lack fast
+        # kernels, e.g. the XLA CPU backend)
+        return _xla_histogram_narrow(binned, channels, num_bins, quant_max)
     impl = _resolve_impl(impl, num_bins, binned.shape[1])
     if impl == "pallas":
         from .pallas_histogram import pallas_histogram
-        if jnp.issubdtype(channels.dtype, jnp.integer):
+        if quantized:
             return pallas_histogram(binned, channels, num_bins, mode="int8",
-                                    mbatch=mbatch)
-        return pallas_histogram(binned, channels, num_bins, mbatch=mbatch)
+                                    mbatch=mbatch, hist_layout=layout)
+        return pallas_histogram(binned, channels, num_bins, mbatch=mbatch,
+                                hist_layout=layout)
     return _xla_histogram(binned, channels, num_bins, mbatch=mbatch)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "axis_name", "impl",
-                                    "mbatch"))
+                                    "mbatch", "layout", "acc_bits",
+                                    "quant_max"))
 def histogram(
     binned: jax.Array,      # [N, F] uint8/uint16/int32
     channels: jax.Array,    # [N, K] f32
@@ -188,6 +303,9 @@ def histogram(
     axis_name: Optional[str] = None,
     impl: str = "auto",
     mbatch: int = 1,
+    layout: str = "lane",
+    acc_bits: int = 32,
+    quant_max: int = 127,
 ) -> jax.Array:             # [F, B, K] f32
     """Accumulate per-(feature, bin) sums of ``channels`` columns."""
     if impl == "pallas":
@@ -196,7 +314,8 @@ def histogram(
             raise RuntimeError(
                 "tpu_hist_impl=pallas requires a TPU backend; use 'xla'")
     hist = histogram_block(binned, channels, num_bins, impl=impl,
-                           mbatch=mbatch)
+                           mbatch=mbatch, layout=layout, acc_bits=acc_bits,
+                           quant_max=quant_max)
 
     if axis_name is not None:
         # distributed data-parallel: the reference reduce-scatters histograms over

@@ -147,6 +147,93 @@ def _hist_kernel(bins_ref, ch_ref, out_ref, *, num_bins: int, f_chunk: int,
         out_ref[:, fc * b:(fc + w) * b] += red
 
 
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+# sublane-layout constraint: bins lie along sublanes, so the padded per-
+# feature bin stride must leave room for at least one feature per 128-row
+# MXU tile — B <= 64 (the README's "bins-on-sublanes for B <= 64" case)
+_SUBLANE_MAX_BINS = 64
+
+
+def sublane_bin_stride(num_bins: int, mode: str) -> int:
+    """Per-feature sublane stride of the bins-on-sublanes one-hot.
+
+    Rounded up to the one-hot dtype's sublane tile (int8: 32, bf16: 16,
+    f32: 8) so the per-feature [stride, R] compare tiles concatenate along
+    sublanes without relayouts."""
+    tile = 32 if mode == "int8" else (8 if mode == "f32" else 16)
+    return _round_up(num_bins, tile)
+
+
+def _hist_kernel_sublane(bins_ref, ch_ref, out_ref, *, num_bins: int,
+                         b_sub: int, f_group: int, mode: str, mbatch: int):
+    """Bins-on-sublanes grid step (tpu_hist_layout=sublane, B <= 64).
+
+    The lane layout's per-feature one-hot compare produces a [R, B] tile —
+    at B <= 64 that fills under half of the 128 register lanes, and the
+    output M dimension is the 8 padded channels. Here the bins input
+    arrives FEATURE-major ([F, N], one XLA-side transpose like the channel
+    slab of the lane kernel), so the compare runs as
+    ``bins[f:f+1, :] == iota_sublane`` — a [b_sub, R] tile whose LANE
+    dimension is the full row block. A group of ``f_group`` features
+    concatenates along sublanes into the [f_group * b_sub, R] one-hot LHS
+    (M = 128 output rows at b_sub * f_group = 128), contracted against a
+    block-diagonal [R, KP * mbatch] channel RHS whose lane bands hold the
+    mbatch row windows — N = 8 * mbatch lanes. The per-window partial sums
+    land in separate lane bands of the [F * b_sub, KP * mbatch] output and
+    are reduced band-wise on the XLA side (exact for int32; f32 regroups
+    within ~1 ulp, same contract as the lane kernel's batched-M reduce).
+    """
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bins = bins_ref[:].astype(jnp.int32)          # [F, R] feature-major
+    ch = ch_ref[:]                                # [R, KP] row-major
+    f, r = bins.shape
+    assert f % f_group == 0
+    assert r % mbatch == 0
+    sub = r // mbatch
+
+    if mode == "int8":
+        oh_dtype, acc_dtype, precision = jnp.int8, jnp.int32, None
+    else:
+        oh_dtype = jnp.float32 if mode == "f32" else jnp.bfloat16
+        acc_dtype = jnp.float32
+        if mode != "f32":
+            ch = ch.astype(jnp.bfloat16)
+        precision = (lax.Precision.HIGHEST if mode == "f32"
+                     else lax.Precision.DEFAULT)
+    if mbatch > 1:
+        # block-diagonal [R, KP*mb] channel RHS: the KP lanes tile mb
+        # times and each band keeps only its own row window
+        tiled = jnp.concatenate([ch] * mbatch, axis=1)       # [R, KP*mb]
+        band = lax.broadcasted_iota(jnp.int32, tiled.shape, 1) // _K_PAD
+        win = lax.broadcasted_iota(jnp.int32, tiled.shape, 0) // sub
+        ch_rhs = jnp.where(band == win, tiled, jnp.zeros_like(tiled))
+    else:
+        ch_rhs = ch
+    # bins-on-SUBLANES iota: dimension 0 (pad sublanes past num_bins can
+    # never match a bin value, so they contribute exact zeros)
+    iota_b = lax.broadcasted_iota(jnp.int32, (b_sub, r), 0)
+
+    for fc in range(0, f, f_group):
+        oh = jnp.concatenate(
+            [(bins[fc + j:fc + j + 1, :] == iota_b).astype(oh_dtype)
+             for j in range(f_group)], axis=0)    # [G*b_sub, R]
+        part = lax.dot_general(
+            oh, ch_rhs,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=acc_dtype,
+            precision=precision,
+        )                                          # [G*b_sub, KP*mb]
+        out_ref[fc * b_sub:(fc + f_group) * b_sub, :] += part
+
+
 def _resolve_mbatch(mbatch: int, row_block: int) -> int:
     """Clamp the batched-M depth to a divisor of the row block (exact
     window partition) with 8*K <= 128 MXU rows and windows >= 128 lanes."""
@@ -159,7 +246,7 @@ def _resolve_mbatch(mbatch: int, row_block: int) -> int:
 @functools.partial(
     jax.jit,
     static_argnames=("num_bins", "row_block", "f_chunk", "mode", "interpret",
-                     "mbatch"))
+                     "mbatch", "hist_layout"))
 def pallas_histogram(
     binned: jax.Array,       # [N, F] uint8/int32
     channels: jax.Array,     # [N, K] f32 (int8 for mode='int8'), K <= 8
@@ -170,10 +257,16 @@ def pallas_histogram(
     mode: str = "split",     # split | bf16 | f32 | int8 (see module doc)
     interpret: bool = False,
     mbatch: int = 1,         # batched-M windows per row block (1-16)
+    hist_layout: str = "lane",   # lane | sublane (tpu_hist_layout)
 ) -> jax.Array:              # [F, B, K] f32 (int32 for mode='int8')
     n, f_in = binned.shape
     k = channels.shape[1]
     b = num_bins
+    if hist_layout == "sublane" and b > _SUBLANE_MAX_BINS:
+        raise ValueError(
+            f"hist_layout=sublane supports num_bins <= {_SUBLANE_MAX_BINS} "
+            f"(got {b}): bins lie along sublanes, and wider bin counts "
+            "leave no room to group features into the 128 MXU rows")
     # Mosaic VMEM scales ~ row_block * F * B * 0.83B (measured on v5e:
     # 138.7MB at [2048, 320] x B=256 against the 128MB budget); clamp the
     # row block so wide-F configs compile instead of OOMing vmem
@@ -197,9 +290,12 @@ def pallas_histogram(
         channels = jnp.concatenate([hi, lo], axis=1)  # [N, 2K]
 
     # pad rows to the block size (zero channels contribute nothing), features
-    # to the chunk width, and channels to the sublane width
+    # to the chunk/group width, and channels to the sublane width
+    b_sub = sublane_bin_stride(b, mode)
+    f_group = max(1, 128 // b_sub)
+    f_unit = f_group if hist_layout == "sublane" else f_chunk
     n_pad = (-n) % row_block
-    f_pad = (-f_in) % f_chunk
+    f_pad = (-f_in) % f_unit
     if n_pad or f_pad:
         binned = jnp.pad(binned, ((0, n_pad), (0, f_pad)))
     if n_pad:
@@ -209,6 +305,36 @@ def pallas_histogram(
         channels = jnp.pad(channels, ((0, 0), (0, _K_PAD - kc)))
     n_tot = n + n_pad
     f = f_in + f_pad
+
+    if hist_layout == "sublane":
+        # bins feed FEATURE-major (one XLA transpose — the mirror of the
+        # lane layout's channel slab) and channels stay row-major: the
+        # kernel's compare tiles then span the full row block on lanes
+        kernel = functools.partial(
+            _hist_kernel_sublane, num_bins=b, b_sub=b_sub, f_group=f_group,
+            mode=mode, mbatch=mbatch)
+        acc_dtype = jnp.int32 if mode == "int8" else jnp.float32
+        out = pl.pallas_call(
+            kernel,
+            grid=(n_tot // row_block,),
+            in_specs=[
+                pl.BlockSpec((f, row_block), lambda i: (0, i)),
+                pl.BlockSpec((row_block, _K_PAD), lambda i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((f * b_sub, _K_PAD * mbatch),
+                                   lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((f * b_sub, _K_PAD * mbatch),
+                                           acc_dtype),
+            interpret=interpret,
+        )(binned.T, channels)
+        # band-wise reduction of the mbatch row windows, then bin-major ->
+        # [F, B, K] (int32 adds exact; f32 regroups within ~1 ulp)
+        out = out.reshape(f, b_sub, mbatch, _K_PAD).sum(axis=2)
+        out = out[:f_in, :b, :]
+        if mode == "split":
+            return out[:, :, :k] + out[:, :, k:2 * k]
+        return out[:, :, :k]
+
     # channel-major slab: ONE XLA-side transpose instead of an in-kernel
     # Mosaic relayout per block (relayouts dominate on this toolchain)
     channels_t = channels.T                       # [KP, N]

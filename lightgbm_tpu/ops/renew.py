@@ -19,6 +19,33 @@ import jax.numpy as jnp
 from jax import lax
 
 
+# largest per-leaf (count * quant_max) product whose packed-pair chunk sums
+# stay exact — mirrors ops/histogram.py narrow_chunk_rows' radix bound at
+# the 16-bit hist-bits level (reference threshold: leaf sums that fit the
+# narrow histogram entry, gradient_discretizer.cpp GetHistBitsInLeaf)
+_NARROW_LEAF_MAX = 1 << 15
+
+
+def hist_bits_in_leaf(leaf_count, quant_max: int):
+    """Per-leaf histogram bit width for the quantized pipeline — 16 where
+    the leaf's worst-case code sums fit the narrow accumulate, else 32.
+
+    TPU-native port of GradientDiscretizer::GetHistBitsInLeaf
+    (gradient_discretizer.cpp): the reference renews each leaf's hist
+    bits from its row count after every split so shrinking leaves drop to
+    the narrow (packed) histogram. Here the decision is a traced scalar
+    the compact grower feeds to a ``lax.cond`` over the two statically
+    compiled segment-histogram variants (ops/grower_compact.py seg_hist):
+    narrow leaves take the packed-pair engine, wide leaves the int8/int32
+    engine — one program, per-leaf narrowing at run time.
+
+    ``leaf_count`` may be traced (i32/f32 row count); ``quant_max`` is the
+    static |code| bound (num_grad_quant_bins + 1)."""
+    cnt = jnp.asarray(leaf_count).astype(jnp.float32)
+    narrow = cnt * float(quant_max) < float(_NARROW_LEAF_MAX)
+    return jnp.where(narrow, 16, 32).astype(jnp.int32)
+
+
 @functools.partial(jax.jit, static_argnames=("num_leaves", "alpha"))
 def renew_leaf_quantile(
     residual: jax.Array,    # [N] f32 (label - current score)

@@ -45,6 +45,7 @@ def voting_histogram(
     split_params,
     impl: str = "auto",
     mbatch: int = 1,
+    layout: str = "lane",
 ) -> jnp.ndarray:              # [F, B, K] f32 (replicated)
     """Histogram with voting-capped communication: only the globally voted
     2k features carry reduced histograms; every other feature's histogram is
@@ -62,7 +63,7 @@ def voting_histogram(
     # so this is communication-free under GSPMD
     bs = binned.reshape(s, n_local, f)
     cs = chans.reshape(s, n_local, k)
-    local = _vmap_hist(bs, cs, b, impl, mbatch)        # [S, F, B, K]
+    local = _vmap_hist(bs, cs, b, impl, mbatch, layout)   # [S, F, B, K]
 
     # local votes (top-k features by local gain) and the global election
     gains = _vmap_gains(local, split_params)           # [S, F]
@@ -77,10 +78,11 @@ def voting_histogram(
     return full.at[sel].set(hist_sel)
 
 
-def _vmap_hist(bs, cs, b, impl, mbatch=1):
+def _vmap_hist(bs, cs, b, impl, mbatch=1, layout="lane"):
     import jax
     return jax.vmap(lambda x, c: histogram_block(x, c, b, impl=impl,
-                                                 mbatch=mbatch))(bs, cs)
+                                                 mbatch=mbatch,
+                                                 layout=layout))(bs, cs)
 
 
 def _vmap_gains(local, p):

@@ -144,7 +144,7 @@ def test_bench_reraises_non_transient_errors(monkeypatch):
     assert BrokenJax.calls == 1               # no pointless retries
 
 
-def test_bench_gives_up_after_three_transient_attempts(monkeypatch):
+def test_bench_gives_up_after_transient_attempts(monkeypatch):
     bench = _load_bench()
     sleeps = []
     monkeypatch.setattr(bench.time, "sleep", sleeps.append)
@@ -158,5 +158,46 @@ def test_bench_gives_up_after_three_transient_attempts(monkeypatch):
 
     with pytest.raises(RuntimeError, match="Unable to initialize"):
         bench._init_backend_with_retry(DownJax())
-    assert DownJax.calls == 3
-    assert sleeps == [5.0, 10.0]              # exponential backoff
+    assert DownJax.calls == 5                 # hardened round-6 default
+    assert sleeps == [5.0, 10.0, 20.0, 40.0]  # exponential backoff
+
+
+def test_bench_retries_enumeration_failures(monkeypatch):
+    """The r05 gap: device ENUMERATION died on a gRPC connect error the
+    init retry never matched, and an empty device list slipped through —
+    both now retry through the same loop."""
+    bench = _load_bench()
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+
+    class EnumFlaky:
+        calls = 0
+
+        def devices(self):
+            EnumFlaky.calls += 1
+            if EnumFlaky.calls == 1:
+                raise RuntimeError("failed to connect to all addresses")
+            if EnumFlaky.calls == 2:
+                return []                     # worker mid-restart
+            return ["tpu:0"]
+
+    assert bench._init_backend_with_retry(EnumFlaky()) == "tpu:0"
+    assert EnumFlaky.calls == 3
+
+
+def test_bench_failure_stub_recorded(monkeypatch, tmp_path):
+    """An unrecoverable failure emits the structured stub row (value null
+    + error inline) AND records it in BENCH_SHAPES.json, so the BENCH_r0x
+    row is never silently absent."""
+    import json as _json
+    bench = _load_bench()
+    rec = tmp_path / "BENCH_SHAPES.json"
+    monkeypatch.setattr(bench.os.path, "dirname", lambda p: str(tmp_path))
+    out = []
+    monkeypatch.setattr("builtins.print", out.append)
+    bench._emit_failure_stub("train", RuntimeError("backend never up"))
+    row = _json.loads(out[-1])
+    assert row["value"] is None
+    assert "backend never up" in row["error"]
+    recorded = _json.loads(rec.read_text())["last_failure"]
+    assert recorded["stage"] == "train"
+    assert recorded["error_type"] == "RuntimeError"

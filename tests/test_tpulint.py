@@ -454,6 +454,82 @@ def test_r004_pending_ring_missing_drain(tmp_path):
     assert not clean
 
 
+def test_r004_sublane_layout_bins_bound(tmp_path):
+    """Bins-on-sublanes needs num_bins <= 64 (round 6): a constant
+    sublane call with wider bins is a static contract violation."""
+    findings = lint_snippet(tmp_path, """
+        def caller(binned, ch):
+            return pallas_histogram(binned, ch, num_bins=256,
+                                    hist_layout="sublane")
+    """)
+    r4 = [f for f in findings if f.rule == "R004"]
+    assert len(r4) == 1 and "sublane" in r4[0].message
+    clean = lint_snippet(tmp_path, """
+        def caller(binned, ch):
+            return pallas_histogram(binned, ch, num_bins=64,
+                                    hist_layout="sublane")
+    """, name="clean_sublane.py")
+    assert not clean
+
+
+def test_r004_sublane_ring_budget_charged(tmp_path):
+    """The sublane layout's row-major channel slots pad to 128 lanes —
+    a block size that fits the lane ring must still be rejected when the
+    call selects sublane and the padded slots blow the budget."""
+    lane_ok = lint_snippet(tmp_path, """
+        def caller(work, scratch, args, n):
+            return fused_split(work, scratch, *args, block_size=384,
+                               num_rows=n, mbatch=8,
+                               hist_layout="lane")
+    """, name="lane_ring.py")
+    assert not [f for f in lane_ok if "VMEM" in f.message]
+    sub = lint_snippet(tmp_path, """
+        def caller(work, scratch, args, n):
+            return fused_split(work, scratch, *args, block_size=384,
+                               num_rows=n, mbatch=8,
+                               hist_layout="sublane")
+    """, name="sub_ring.py")
+    r4 = [f for f in sub if f.rule == "R004" and "VMEM" in f.message]
+    assert len(r4) == 1, [f.render() for f in sub]
+
+
+def test_r004_pack4_nibble_mask_detector(tmp_path):
+    """pack4 unpack sites must mask with & 0xF (round 6): the unmasked
+    shift leaves the neighbour feature's nibble in the high bits."""
+    findings = lint_snippet(tmp_path, """
+        def unpack_bins(packed_byte, feature):
+            lo = packed_byte & 0xF
+            hi = packed_byte >> 4
+            return lo, hi
+    """)
+    r4 = [f for f in findings if f.rule == "R004"]
+    assert len(r4) == 1 and "0xF" in r4[0].message
+    dyn = lint_snippet(tmp_path, """
+        def bin_col(packed_bins, j):
+            byte = packed_bins[:, j // 2]
+            return byte >> ((j & 1) * 4)
+    """, name="dyn_shift.py")
+    assert [f for f in dyn if f.rule == "R004"]
+    clean = lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def unpack_bins(packed_byte, feature):
+            lo = packed_byte & jnp.uint8(0x0F)
+            hi = (packed_byte >> 4) & jnp.uint8(0x0F)
+            dyn = (packed_byte >> ((feature & 1) * 4)) & 0xF
+            return lo, hi, dyn
+    """, name="clean_nibble.py")
+    assert not clean
+    # unrelated shifts (word indices, radix unpacks) stay out of scope
+    unrelated = lint_snippet(tmp_path, """
+        def radix_unpack(sums):
+            word = sums >> 5
+            hi = sums >> 12
+            return word, hi
+    """, name="unrelated_shift.py")
+    assert not unrelated
+
+
 # ---------------------------------------------------------------- R005
 def test_r005_operand_shape_counting(tmp_path):
     """The seed case: parallel/comm_accounting.py:65 pre-fix (ADVICE r5
