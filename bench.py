@@ -71,16 +71,23 @@ def _clear_backend_cache(jax_mod):
 # runtimes mid-restart, gRPC channels to the TPU worker not yet up, libtpu
 # still claiming the chips from a previous process (the r05 bench death:
 # the retry loop matched only the first two patterns and the run died on a
-# "failed to connect" enumeration error the loop never saw)
-_TRANSIENT_BACKEND_ERRORS = (
-    "Unable to initialize backend",
-    "UNAVAILABLE", "Unavailable",
-    "DEADLINE_EXCEEDED", "Deadline Exceeded",
-    "failed to connect", "Failed to connect",
-    "Connection reset", "Socket closed",
-    "already in use",
-    "No visible TPU", "device enumeration",
-)
+# "failed to connect" enumeration error the loop never saw). The canonical
+# list lives in lightgbm_tpu.parallel.multihost.TRANSIENT_ERRORS (shared
+# with the collective watchdog's retry classifier); the literal below is
+# only the standalone-bench fallback.
+try:
+    from lightgbm_tpu.parallel.multihost import (
+        TRANSIENT_ERRORS as _TRANSIENT_BACKEND_ERRORS)
+except ImportError:  # standalone bench without the package on sys.path
+    _TRANSIENT_BACKEND_ERRORS = (
+        "Unable to initialize backend",
+        "UNAVAILABLE", "Unavailable",
+        "DEADLINE_EXCEEDED", "Deadline Exceeded",
+        "failed to connect", "Failed to connect",
+        "Connection reset", "Socket closed",
+        "already in use",
+        "No visible TPU", "device enumeration",
+    )
 
 
 def _init_backend_with_retry(jax_mod, attempts=None, base_delay_s=5.0):
@@ -111,6 +118,7 @@ def _init_backend_with_retry(jax_mod, attempts=None, base_delay_s=5.0):
     attempts = max(1, attempts)
     for attempt in range(attempts):
         try:
+            _fire_fault("backend_init", attempt=attempt + 1)
             devices = jax_mod.devices()
             if not devices:
                 raise RuntimeError(
@@ -128,6 +136,75 @@ def _init_backend_with_retry(jax_mod, attempts=None, base_delay_s=5.0):
                 f"{delay:.0f}s\n")
             _clear_backend_cache(jax_mod)
             time.sleep(delay)
+
+
+def _fire_fault(site, **ctx):
+    """Chaos hook (lightgbm_tpu/analysis/faultinject.py): lets the
+    fault-injection tests exercise the bench's backend-retry and
+    checkpoint-resume paths deterministically. A no-op when the package
+    is absent (bench.py stays runnable standalone) or no spec is armed."""
+    try:
+        from lightgbm_tpu.analysis.faultinject import active_plan
+    except ImportError:  # pragma: no cover - standalone bench
+        return
+    active_plan().fire(site, **ctx)
+
+
+def _resumable_update_loop(bst, make_booster, target_iters, ckpt_dir,
+                           ckpt_freq=5, keep=2, max_retries=5,
+                           base_delay_s=5.0):
+    """Advance ``bst`` to ``target_iters`` total iterations, checkpointing
+    every ``ckpt_freq`` and RESUMING from the latest snapshot after a
+    transient backend death instead of restarting from iteration 0 (the
+    r05/r06 death mode the init-retry loop alone could not close: a run
+    that died mid-boosting lost every completed iteration). A failure
+    that keeps recurring with NO forward progress gives up after
+    ``max_retries`` resume attempts (with exponential backoff between
+    them) so a persistently-down backend falls through to the structured
+    failure stub instead of busy-looping. Returns the (possibly rebuilt)
+    booster at ``target_iters``."""
+    from lightgbm_tpu.io import checkpoint as ckpt_mod
+    retries, last_progress = 0, -1
+    while bst.current_iteration() < target_iters:
+        try:
+            _fire_fault("bench_update", iteration=bst.current_iteration() + 1)
+            bst.update()
+            done = bst.current_iteration()
+            if ckpt_dir and done % ckpt_freq == 0:
+                bst.save_checkpoint(ckpt_dir, keep=keep)
+        except Exception as err:  # noqa: BLE001 - classified below
+            msg = str(err)
+            transient = any(t in msg for t in _TRANSIENT_BACKEND_ERRORS)
+            if not ckpt_dir or not transient:
+                raise
+            reached = bst.current_iteration()
+            if reached > last_progress:
+                retries, last_progress = 0, reached
+            retries += 1
+            if retries > max_retries:
+                sys.stderr.write(
+                    f"[bench] giving up after {max_retries} resume "
+                    f"attempts with no progress past iteration "
+                    f"{last_progress}\n")
+                raise
+            delay = base_delay_s * (2 ** (retries - 1))
+            sys.stderr.write(
+                f"[bench] transient failure mid-run at iteration "
+                f"{reached}: {msg.splitlines()[0][:200]}; resuming from "
+                f"checkpoint in {delay:.0f}s "
+                f"(attempt {retries}/{max_retries})\n")
+            time.sleep(delay)
+            bst = make_booster()
+            state = ckpt_mod.load_latest(ckpt_dir)
+            if state is not None:
+                try:
+                    bst._restore_checkpoint(state)
+                except ValueError as verr:
+                    sys.stderr.write(f"[bench] ignoring incompatible "
+                                     f"checkpoint: {verr}\n")
+            sys.stderr.write(f"[bench] resumed at iteration "
+                             f"{bst.current_iteration()}\n")
+    return bst
 
 
 def _emit_failure_stub(stage: str, err: BaseException) -> None:
@@ -668,21 +745,59 @@ def _main(stage=None):
     ds.construct()
     construct_s = time.time() - t0
 
-    bst = lgb.Booster(params, ds)
+    # resume-aware long rounds (BENCH_CHECKPOINT_DIR): the booster
+    # checkpoints every BENCH_CHECKPOINT_FREQ iterations and a transient
+    # backend death mid-run — or a fresh bench invocation after a process
+    # death — resumes from the last snapshot instead of iteration 0
+    ckpt_dir = os.environ.get("BENCH_CHECKPOINT_DIR", "")
+    ckpt_freq = max(1, int(os.environ.get("BENCH_CHECKPOINT_FREQ", "5")))
+
+    def make_booster():
+        return lgb.Booster(params, ds)
+
+    bst = make_booster()
+    if ckpt_dir:
+        from lightgbm_tpu.io import checkpoint as ckpt_mod
+        state = ckpt_mod.load_latest(ckpt_dir)
+        if state is not None:
+            try:
+                bst._restore_checkpoint(state)
+                sys.stderr.write(f"[bench] resumed from checkpoint at "
+                                 f"iteration {bst.current_iteration()}\n")
+            except ValueError as err:  # stale dir from a different shape
+                sys.stderr.write(f"[bench] ignoring incompatible "
+                                 f"checkpoint in {ckpt_dir}: {err}\n")
     t_run0 = time.time()
     t0 = time.time()
-    for _ in range(WARMUP):
-        bst.update()
+    if ckpt_dir:
+        bst = _resumable_update_loop(bst, make_booster, WARMUP,
+                                     ckpt_dir, ckpt_freq)
+    else:
+        for _ in range(WARMUP):
+            bst.update()
     bst._gbdt._flush_trees()
     warmup_s = time.time() - t0
 
     t0 = time.time()
-    for _ in range(ITERS):
-        bst.update()
+    timed_from = bst.current_iteration()
+    if ckpt_dir:
+        bst = _resumable_update_loop(bst, make_booster, WARMUP + ITERS,
+                                     ckpt_dir, ckpt_freq)
+    else:
+        for _ in range(ITERS):
+            bst.update()
     bst._gbdt._flush_trees()  # materialize: forces all device work to finish
     train_s = time.time() - t0
 
-    iters_per_sec = ITERS / train_s
+    # rate over the updates ACTUALLY performed this invocation: a resumed
+    # round runs fewer than ITERS in the timed loop, and dividing by the
+    # nominal count would record inflated throughput in the BENCH_r0x row
+    timed_iters = bst.current_iteration() - timed_from
+    if ckpt_dir and timed_iters < ITERS:
+        sys.stderr.write(f"[bench] timed loop ran {timed_iters}/{ITERS} "
+                         "updates (checkpoint resume); rate uses the "
+                         "actual count\n")
+    iters_per_sec = (timed_iters / train_s) if timed_iters > 0 else 0.0
     # AUC sanity on the training data (separability check, not a quality bench)
     auc = None
     sample = slice(0, min(ROWS, 200_000))

@@ -30,7 +30,7 @@ __all__ = [
     "Dataset", "Booster", "Config", "Sequence",
     "train", "cv", "CVBooster",
     "early_stopping", "log_evaluation", "record_evaluation", "reset_parameter",
-    "EarlyStopException",
+    "EarlyStopException", "TrainingInterrupted",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "plot_importance", "plot_metric", "plot_split_value_histogram",
     "plot_tree", "create_tree_digraph",
@@ -52,6 +52,9 @@ def __getattr__(name):
     if name == "register_parser":
         from .io.loader import register_parser
         return register_parser
+    if name == "TrainingInterrupted":
+        from .parallel.multihost import TrainingInterrupted
+        return TrainingInterrupted
     if name in _PLOTTING:
         from . import plotting as _pl
         return getattr(_pl, name)

@@ -1,0 +1,266 @@
+"""Deterministic fault-injection harness (chaos layer) for recovery paths.
+
+The checkpoint/resume, collective-deadline, and bench-retry machinery all
+exist to survive failures that are rare and non-deterministic in the wild:
+a preempted TPU worker (the BENCH_r05 death), a pod barrier that never
+returns, a snapshot half-written when the VM disappears. This module makes
+every one of those failures *injectable on demand*, so each recovery path
+is exercised deterministically in tier-1 instead of trusted.
+
+Faults are described by a compact spec string, driven by the
+``LGBM_TPU_FAULTS`` environment variable or the ``tpu_fault_spec`` config
+parameter::
+
+    kill@iteration=3                 raise SimulatedKill before iteration 3
+    hang@step=2:seconds=60           sleep 60s inside the watchdog-wrapped
+                                     training step of iteration 2
+    transient@backend_init=1:count=2 fail the first two backend-init
+                                     attempts with a transient error
+    transient@bench_update=7         fail bench's 7th update transiently
+    corrupt@snapshot=2               corrupt the 2nd snapshot file written
+    corrupt@snapshot=2:mode=flip     ... by flipping payload bytes instead
+                                     of truncating
+
+Multiple faults join with ``;``. Each fault fires ``count`` times
+(default 1) and then disarms, so "transient failure then recovery" is a
+single spec. Sites fired by the production code:
+
+======================  =====================================================
+``iteration``           engine.train, before each boosting iteration
+                        (``iteration=`` matches the 0-based loop index)
+``step``                inside the collective-deadline watchdog, just before
+                        ``booster.update()`` (``iteration=`` 0-based)
+``barrier``             parallel/mesh.py sync_barrier (ordinal, 1-based)
+``backend_init``        bench.py backend init/enumeration attempts and
+                        parallel/multihost.py bootstrap (ordinal, 1-based)
+``snapshot``            io/checkpoint.py after a snapshot file lands
+                        (ordinal, 1-based; ``corrupt`` rewrites the file)
+``bench_update``        bench.py resumable update loop, before each update
+                        (``iteration=`` 1-based absolute iteration)
+======================  =====================================================
+
+Injection sites call :func:`active_plan` and ``fire()`` — a no-op
+``NullPlan`` when no spec is set, so the hot paths pay one attribute call.
+Tests install plans explicitly with :func:`inject` (a context manager)
+instead of mutating the environment.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import time
+from typing import Dict, List, Optional
+
+from ..utils import log
+
+#: the message transient-fault injections carry — matches the
+#: bench/bootstrap transient-error classifiers by substring
+TRANSIENT_MESSAGE = "Unable to initialize backend (injected transient fault)"
+
+
+class SimulatedKill(BaseException):
+    """An injected ``kill -9``: escapes every ``except Exception`` handler
+    (it subclasses BaseException) so NO cleanup-path snapshot is written —
+    recovery must come from the last periodic snapshot, exactly like a
+    real preemption."""
+
+
+class FaultSpecError(ValueError):
+    """Malformed LGBM_TPU_FAULTS / tpu_fault_spec string."""
+
+
+_KINDS = ("kill", "hang", "transient", "corrupt")
+_SITES = ("iteration", "step", "barrier", "backend_init", "snapshot",
+          "bench_update")
+
+
+@dataclasses.dataclass
+class Fault:
+    kind: str                    # kill | hang | transient | corrupt
+    site: str                    # one of _SITES
+    at: int                      # iteration/ordinal to START firing at
+    #                              (fires while count remains); -1 = always
+    count: int = 1               # fires before disarming; -1 = unlimited
+    seconds: float = 3600.0      # hang sleep
+    mode: str = "truncate"       # corrupt: truncate | flip
+    fired: int = 0
+
+    def spent(self) -> bool:
+        return self.count >= 0 and self.fired >= self.count
+
+
+def parse_spec(spec: str) -> List[Fault]:
+    """Parse ``kind@site=at[:key=val...]`` clauses joined by ``;``."""
+    faults: List[Fault] = []
+    for clause in (c.strip() for c in spec.split(";")):
+        if not clause:
+            continue
+        if "@" not in clause:
+            raise FaultSpecError(
+                f"fault clause {clause!r} needs kind@site=at")
+        kind, _, rest = clause.partition("@")
+        kind = kind.strip().lower()
+        if kind not in _KINDS:
+            raise FaultSpecError(
+                f"unknown fault kind {kind!r} (one of {_KINDS})")
+        parts = rest.split(":")
+        site, _, at_s = parts[0].partition("=")
+        site = site.strip().lower()
+        if site not in _SITES:
+            raise FaultSpecError(
+                f"unknown fault site {site!r} (one of {_SITES})")
+        try:
+            at = int(at_s) if at_s.strip() not in ("", "*") else -1
+        except ValueError:
+            raise FaultSpecError(
+                f"fault clause {clause!r}: non-integer position {at_s!r}")
+        fault = Fault(kind=kind, site=site, at=at)
+        for extra in parts[1:]:
+            key, _, val = extra.partition("=")
+            key = key.strip().lower()
+            if key == "count":
+                fault.count = int(val)
+            elif key == "seconds":
+                fault.seconds = float(val)
+            elif key == "mode":
+                if val not in ("truncate", "flip"):
+                    raise FaultSpecError(
+                        f"corrupt mode {val!r} (truncate|flip)")
+                fault.mode = val
+            else:
+                raise FaultSpecError(
+                    f"unknown fault option {key!r} in {clause!r}")
+        faults.append(fault)
+    return faults
+
+
+def corrupt_file(path: str, mode: str = "truncate") -> None:
+    """Damage a snapshot file in place (simulates a torn write that an
+    atomic rename would normally prevent — e.g. direct disk corruption)."""
+    size = os.path.getsize(path)
+    if mode == "truncate":
+        with open(path, "r+b") as fh:
+            fh.truncate(max(size // 2, 1))
+    else:  # flip payload bytes mid-file
+        with open(path, "r+b") as fh:
+            fh.seek(max(size // 2, 0))
+            chunk = fh.read(8)
+            fh.seek(max(size // 2, 0))
+            fh.write(bytes(b ^ 0xFF for b in chunk))
+
+
+class FaultPlan:
+    """A parsed fault set plus per-site fire ordinals."""
+
+    def __init__(self, faults: List[Fault]):
+        self.faults = faults
+        self._site_ordinal: Dict[str, int] = {}
+
+    def fire(self, site: str, **ctx) -> None:
+        """Trigger any armed fault matching ``site`` at this position.
+
+        Sites that pass ``iteration=`` match on it; others match on the
+        1-based per-site fire ordinal. ``at`` is the FIRST position a
+        fault fires at; it keeps firing at subsequent positions while
+        ``count`` remains (so ``transient@backend_init=1:count=2`` fails
+        the first two attempts, as documented)."""
+        ordinal = self._site_ordinal.get(site, 0) + 1
+        self._site_ordinal[site] = ordinal
+        position = ctx.get("iteration", ordinal)
+        for f in self.faults:
+            if f.site != site or f.spent():
+                continue
+            if f.at >= 0 and position < f.at:
+                continue
+            f.fired += 1
+            self._trigger(f, ctx)
+
+    def _trigger(self, f: Fault, ctx: dict) -> None:
+        where = f"{f.site}@{ctx.get('iteration', self._site_ordinal[f.site])}"
+        if f.kind == "kill":
+            log.warning(f"[faultinject] simulated kill at {where}")
+            raise SimulatedKill(f"injected kill at {where}")
+        if f.kind == "hang":
+            log.warning(f"[faultinject] injected hang at {where} "
+                        f"({f.seconds:.0f}s)")
+            time.sleep(f.seconds)
+            return
+        if f.kind == "transient":
+            log.warning(f"[faultinject] injected transient failure at "
+                        f"{where}")
+            raise RuntimeError(TRANSIENT_MESSAGE)
+        if f.kind == "corrupt":
+            path = ctx.get("path")
+            if path and os.path.exists(path):
+                log.warning(f"[faultinject] corrupting snapshot {path} "
+                            f"({f.mode})")
+                corrupt_file(path, f.mode)
+
+
+class NullPlan:
+    """Armed when no spec is set: fire() is a no-op."""
+
+    faults: List[Fault] = []
+
+    def fire(self, site: str, **ctx) -> None:
+        return None
+
+
+_NULL = NullPlan()
+_installed: Optional[FaultPlan] = None
+_env_plan: Optional[FaultPlan] = None
+_env_spec: Optional[str] = None
+_config_plan: Optional[FaultPlan] = None
+_config_spec: Optional[str] = None
+
+
+def install(plan: Optional[FaultPlan]) -> None:
+    """Install (or clear, with None) an explicit plan — wins over env."""
+    global _installed
+    _installed = plan
+
+
+@contextlib.contextmanager
+def inject(spec: str):
+    """Context manager: arm ``spec`` for the block, restore after.
+
+    Yields the plan so tests can assert ``fired`` counters."""
+    plan = FaultPlan(parse_spec(spec))
+    prev = _installed
+    install(plan)
+    try:
+        yield plan
+    finally:
+        install(prev)
+
+
+def active_plan(config=None):
+    """The currently armed plan: explicit install > LGBM_TPU_FAULTS env >
+    config ``tpu_fault_spec`` > NullPlan.
+
+    Env- and config-driven plans are built once per distinct spec value
+    and keep their fire counters for the life of the process (a
+    ``count=1`` fault fires once per process, like a real one-off
+    failure would). A config-armed plan is STICKY: once a config carrying
+    ``tpu_fault_spec`` has been seen (engine.train setup), the plan also
+    serves the sites that have no config in hand (snapshot writes,
+    barriers, bench hooks); a later config with an empty spec disarms it."""
+    global _env_plan, _env_spec, _config_plan, _config_spec
+    if _installed is not None:
+        return _installed
+    spec = os.environ.get("LGBM_TPU_FAULTS", "")
+    if spec:
+        if spec != _env_spec:
+            _env_plan = FaultPlan(parse_spec(spec))
+            _env_spec = spec
+        return _env_plan
+    if config is not None:
+        try:
+            cspec = str(config.get("tpu_fault_spec", "") or "")
+        except Exception:
+            cspec = ""
+        if cspec != _config_spec:
+            _config_plan = FaultPlan(parse_spec(cspec)) if cspec else None
+            _config_spec = cspec
+    return _config_plan if _config_plan is not None else _NULL

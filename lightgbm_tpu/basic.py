@@ -651,6 +651,57 @@ class Booster:
                 gbdt._compact["step"] = None
         return self
 
+    # -- checkpoint / resume (io/checkpoint.py) ------------------------------
+    def _capture_checkpoint(self, callback_states: Optional[Dict] = None
+                            ) -> Dict[str, Any]:
+        """Complete training-state snapshot dict (gbdt state + the
+        booster-level early-stopping bests + engine callback states)."""
+        state = self._gbdt.capture_training_state()
+        state["best_iteration"] = int(self.best_iteration)
+        state["best_score"] = copy.deepcopy(self.best_score)
+        if callback_states:
+            state["callbacks"] = callback_states
+        return state
+
+    @read_locked
+    def save_checkpoint(self, directory: str, keep: int = 3,
+                        callback_states: Optional[Dict] = None):
+        """Write an atomic training snapshot to ``directory``.
+
+        Pending device trees flush first (one batched transfer), then the
+        complete state lands via write-temp-fsync-rename with a checksum
+        and keep-last-``keep`` rotation (io/checkpoint.py). Multi-host:
+        every process participates in the (collective) state fetch but
+        only process 0 writes — all ranks resume from the one file.
+        Returns the snapshot path (None on non-writing ranks)."""
+        from .io.checkpoint import write_snapshot
+        self._gbdt._flush_trees()
+        state = self._capture_checkpoint(callback_states)
+        import jax
+        if jax.process_index() != 0:
+            return None
+        return write_snapshot(directory, int(state["iteration"]), state,
+                              keep=keep)
+
+    @write_locked
+    def _restore_checkpoint(self, state: Dict[str, Any],
+                            callbacks=None) -> None:
+        """Rebind this booster to a snapshot (raises ValueError when the
+        snapshot is structurally incompatible with this run)."""
+        reason = self._gbdt.snapshot_compatible(state)
+        if reason is not None:
+            raise ValueError(reason)
+        self._gbdt.restore_training_state(state)
+        self.best_iteration = int(state.get("best_iteration", -1))
+        self.best_score = state.get("best_score", {}) or {}
+        saved = state.get("callbacks") or {}
+        for cb in callbacks or ():
+            key = getattr(cb, "_ckpt_key", None)
+            cb_state = getattr(cb, "state", None)
+            if key and key in saved and isinstance(cb_state, dict):
+                cb_state.clear()
+                cb_state.update(copy.deepcopy(saved[key]))
+
     # -- evaluation ----------------------------------------------------------
     @write_locked
     def eval_train(self, feval=None):

@@ -35,6 +35,27 @@ class DART(GBDT):
         self.tree_weight: List[float] = []
         self.sum_weight = 0.0
 
+    def capture_training_state(self):
+        """DART drop state rides the snapshot: the drop RNG stream and the
+        per-tree weights drive which trees future iterations drop, so a
+        bit-identical resume must restore them exactly (reference: the
+        same fields DART carries across TrainOneIter calls, dart.hpp:97)."""
+        state = super().capture_training_state()
+        state["dart"] = {
+            "rng": self._rng.get_state(),
+            "tree_weight": list(self.tree_weight),
+            "sum_weight": float(self.sum_weight),
+        }
+        return state
+
+    def restore_training_state(self, state):
+        super().restore_training_state(state)
+        dart = state.get("dart")
+        if dart is not None:
+            self._rng.set_state(dart["rng"])
+            self.tree_weight = list(dart["tree_weight"])
+            self.sum_weight = float(dart["sum_weight"])
+
     def _select_drop(self) -> List[int]:
         """(reference: DART::DroppingTrees, dart.hpp:97)"""
         drop: List[int] = []

@@ -417,6 +417,18 @@ def _init_score_matrix(init_score, k: int, n: int) -> np.ndarray:
     return arr.reshape(k, n)
 
 
+def _device_put_like(arr, like):
+    """Place a host snapshot array back on the device(s) of an existing
+    array, preserving its sharding. ``make_array_from_callback`` hands each
+    process only the shards it addresses, so the same global host array
+    restores correctly on 1 chip, a mesh, or a multi-host pod."""
+    arr = np.asarray(arr)
+    if isinstance(like, jax.Array):
+        return jax.make_array_from_callback(
+            arr.shape, like.sharding, lambda idx: arr[idx])
+    return jnp.asarray(arr)
+
+
 @jax.jit
 def _add_leaf_outputs(score_row, leaf_value, row_leaf):
     return score_row + leaf_value[row_leaf]
@@ -2232,6 +2244,137 @@ class GBDT:
         del self.models[len(self.models) - k:]
         self._device_trees_cache = None
         self.iter_ -= 1
+
+    # -- checkpoint / resume (io/checkpoint.py; reference: the model-text
+    # snapshots of gbdt.cpp:250-254 + init_model warm starts — here the
+    # snapshot is the COMPLETE optimizer state so resume is bit-identical)
+    def snapshot_compatible(self, state) -> Optional[str]:
+        """Reason this training run cannot resume from ``state`` (None =
+        compatible). Structural checks only — a resumed run is expected to
+        use the same params as the interrupted one."""
+        if not isinstance(state, dict) or state.get("format") != 1:
+            return "unknown snapshot format"
+        meta = state.get("meta", {})
+        want = {"boosting": self.boosting_type, "num_data": self._n_real,
+                "trees_per_iteration": self.num_tree_per_iteration,
+                "num_leaves": self.max_leaves}
+        for key, val in want.items():
+            if meta.get(key) != val:
+                return f"{key}: snapshot has {meta.get(key)!r}, " \
+                       f"this run has {val!r}"
+        names = [n for n, _ in state.get("valid_scores", ())]
+        if names != [vs.name for vs in self.valid_sets]:
+            return (f"validation sets differ (snapshot {names}, run "
+                    f"{[vs.name for vs in self.valid_sets]})")
+        expect_compact = bool(self._use_compact
+                              and int(state.get("iteration", 0)) >= 1)
+        if (state.get("compact") is not None) != expect_compact:
+            return ("row-storage layout differs (compact vs masked grower "
+                    "— tpu_grower or data size changed)")
+        return None
+
+    def capture_training_state(self) -> Dict[str, Any]:
+        """Host snapshot of the complete training state.
+
+        The ONLY planned device->host transfers outside stop checks: one
+        batched fetch per ``tpu_checkpoint_freq`` boundary, off the jit
+        hot path (the steady-state guard asserts exactly this in
+        tests/test_checkpoint.py). Covers everything a bit-identical
+        resume needs: trees, iteration counter, cached train/valid
+        scores, sampling/feature RNG state, bagging cache, CEGB state,
+        the compact grower's permuted row records, and (via subclass
+        hooks) DART drop state."""
+        self._flush_trees()
+        with self._trees_mu:
+            models = list(self.models)
+        strat = self.sample_strategy
+        bag_cached = getattr(strat, "_cached", None)
+        obj = self.objective
+        pos_biases = getattr(obj, "pos_biases", None)
+        state: Dict[str, Any] = {
+            "format": 1,
+            "meta": {
+                "boosting": self.boosting_type,
+                "num_data": self._n_real,
+                "trees_per_iteration": self.num_tree_per_iteration,
+                "num_leaves": self.max_leaves,
+            },
+            "iteration": int(self.iter_),
+            "models": models,
+            "shrinkage_rate": float(self.shrinkage_rate),
+            "init_scores": list(self._init_scores),
+            "has_init_score": bool(self._has_init_score),
+            "train_score": _to_host(self.train_score),
+            "valid_scores": [(vs.name, _to_host(vs.score))
+                             for vs in self.valid_sets],
+            "feat_rng": self._feat_rng.get_state(),
+            "bag_cached": None if bag_cached is None
+            else _to_host(bag_cached),
+            "cegb_used": None if self._cegb_used is None
+            else _to_host(self._cegb_used),
+            "cegb_charged": None if self._cegb_charged is None
+            else _to_host(self._cegb_charged),
+            "pos_biases": None if pos_biases is None
+            else _to_host(pos_biases),
+            "linear_any_split": bool(getattr(self, "_linear_any_split",
+                                             False)),
+            "compact": None,
+        }
+        if self._compact is not None:
+            # the permuted row records ARE load-bearing for bit-identity:
+            # histogram/score summation order follows the physical row
+            # order, so resume must restore the exact bytes, not rebuild
+            # from the original order
+            c = self._compact
+            state["compact"] = {
+                "work": _to_host(c["work"]),
+                "scratch": _to_host(c["scratch"]),
+                "epoch": int(c["epoch"]),
+            }
+        return state
+
+    def restore_training_state(self, state: Dict[str, Any]) -> None:
+        """Rebind this (freshly constructed) trainer to a snapshot. The
+        caller validates ``snapshot_compatible`` first."""
+        with self._trees_mu:
+            self.models = list(state["models"])
+            self._dev_trees = []
+            self._device_trees_cache = None
+        self.iter_ = int(state["iteration"])
+        self.shrinkage_rate = float(state["shrinkage_rate"])
+        self._init_scores = list(state["init_scores"])
+        self._has_init_score = bool(state["has_init_score"])
+        self.train_score = _device_put_like(state["train_score"],
+                                            self.train_score)
+        for vs, (name, arr) in zip(self.valid_sets, state["valid_scores"]):
+            vs.score = _device_put_like(arr, vs.score)
+        self._feat_rng.set_state(state["feat_rng"])
+        if state.get("bag_cached") is not None \
+                and hasattr(self.sample_strategy, "_cached"):
+            self.sample_strategy._cached = _device_put_like(
+                state["bag_cached"], self.sample_strategy._cached)
+        if state.get("cegb_used") is not None:
+            self._cegb_used = _device_put_like(state["cegb_used"],
+                                               self._cegb_used)
+        if state.get("cegb_charged") is not None:
+            self._cegb_charged = _device_put_like(state["cegb_charged"],
+                                                  self._cegb_charged)
+        if state.get("pos_biases") is not None \
+                and self.objective is not None:
+            self.objective.pos_biases = _device_put_like(
+                state["pos_biases"], getattr(self.objective, "pos_biases",
+                                             None))
+        self._linear_any_split = bool(state.get("linear_any_split", False))
+        comp = state.get("compact")
+        if comp is not None:
+            if self._compact is None:
+                self._setup_compact_state()
+            c = self._compact
+            c["work"] = _device_put_like(comp["work"], c["work"])
+            c["scratch"] = _device_put_like(comp["scratch"], c["scratch"])
+            c["epoch"] = int(comp["epoch"])
+            c["perm_epoch"] = -1
+            c["perm"] = None
 
     def _routing_binned(self) -> jax.Array:
         """Binned rows in the same order as the cached train scores (the

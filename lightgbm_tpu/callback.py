@@ -101,7 +101,14 @@ def reset_parameter(**kwargs) -> Callable:
 
 def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                    verbose: bool = True, min_delta: float = 0.0) -> Callable:
-    """(reference: callback.py:454 _EarlyStoppingCallback)"""
+    """(reference: callback.py:454 _EarlyStoppingCallback)
+
+    The tracking state (best score/iteration per metric) lives in a plain
+    picklable dict exposed as ``callback.state`` so training checkpoints
+    can include it — a resumed run then early-stops at exactly the same
+    iteration as an uninterrupted one (io/checkpoint.py; engine.py
+    captures/restores it by the callback's ``_ckpt_key``).
+    """
     if stopping_rounds <= 0:
         raise ValueError("stopping_rounds should be greater than zero.")
 
@@ -116,25 +123,28 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
         state["best_score"] = []
         state["best_iter"] = []
         state["best_list"] = []
-        state["cmp"] = []
+        state["higher_better"] = []
         for _, _, _, higher_better in env.evaluation_result_list:
-            if higher_better:
-                state["best_score"].append(float("-inf"))
-                state["cmp"].append(
-                    lambda cur, best: cur > best + min_delta)
-            else:
-                state["best_score"].append(float("inf"))
-                state["cmp"].append(
-                    lambda cur, best: cur < best - min_delta)
+            state["best_score"].append(
+                float("-inf") if higher_better else float("inf"))
+            state["higher_better"].append(bool(higher_better))
             state["best_iter"].append(0)
             state["best_list"].append(None)
+
+    def _improved(value: float, best: float, higher_better: bool) -> bool:
+        return value > best + min_delta if higher_better \
+            else value < best - min_delta
 
     def _callback(env: CallbackEnv) -> None:
         # re-init at the first iteration of every train() run so a callback
         # object reused across calls (e.g. one early_stopping shared by all
         # cv() folds) does not carry best_score/best_iter over
-        # (reference: callback.py _EarlyStoppingCallback.__call__)
-        if env.iteration == env.begin_iteration:
+        # (reference: callback.py _EarlyStoppingCallback.__call__).
+        # A checkpoint-resumed run starts past begin_iteration: init then
+        # only if no snapshot state was restored into ``state`` (a restored
+        # dict already has best_score and must continue, not reset)
+        if env.iteration == env.begin_iteration or \
+                "best_score" not in state:
             _init(env)
         if not state["enabled"]:
             return
@@ -150,7 +160,8 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                     metric != env.evaluation_result_list[0][1]:
                 continue
             first_metric_seen = True
-            if state["cmp"][i](value, state["best_score"][i]):
+            if _improved(value, state["best_score"][i],
+                         state["higher_better"][i]):
                 state["best_score"][i] = value
                 state["best_iter"][i] = env.iteration
                 state["best_list"][i] = list(env.evaluation_result_list)
@@ -177,4 +188,6 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                                          state["best_list"][i])
 
     _callback.order = 30
+    _callback.state = state           # checkpoint-visible (picklable)
+    _callback._ckpt_key = "early_stopping"
     return _callback

@@ -221,6 +221,23 @@ PARAMS: Dict[str, Tuple[Any, type, Tuple[str, ...]]] = {
     # 4-bit nibble packing of served request matrices when every feature
     # has <= 16 bins (io/dataset.py pack4_matrix; halves request HBM)
     "tpu_bin_pack4": (False, bool, ("bin_pack4",)),
+    # fault tolerance (io/checkpoint.py, parallel/multihost.py watchdog,
+    # analysis/faultinject.py): atomic full-state snapshots every
+    # tpu_checkpoint_freq iterations into tpu_checkpoint_dir (keep-last-k
+    # rotation); lgb.train auto-resumes from the latest valid snapshot.
+    # Unlike snapshot_freq (model text only), these snapshots carry the
+    # complete optimizer state and resume BIT-IDENTICALLY.
+    "tpu_checkpoint_dir": ("", str, ("checkpoint_dir",)),
+    "tpu_checkpoint_freq": (0, int, ("checkpoint_freq",)),
+    "tpu_checkpoint_keep": (3, int, ("checkpoint_keep",)),
+    # collective watchdog: a multihost bootstrap / training step that
+    # exceeds the deadline raises a structured TrainingInterrupted (after
+    # a final snapshot) instead of hanging the pod; 0 disables
+    "tpu_collective_deadline_s": (0.0, float, ("collective_deadline",)),
+    "tpu_collective_retries": (3, int, ()),
+    # deterministic chaos spec (analysis/faultinject.py), e.g.
+    # "kill@iteration=3;corrupt@snapshot=2"; env LGBM_TPU_FAULTS wins
+    "tpu_fault_spec": ("", str, ()),
     # snapshot / continue
     "snapshot_freq": (-1, int, ("save_period",)),
     "input_model": ("", str, ("model_input", "model_in")),

@@ -124,6 +124,61 @@ def train(
     snapshot_freq = int(params.get("snapshot_freq", -1) or -1)
     snapshot_out = str(params.get("output_model", "LightGBM_model.txt"))
 
+    # fault tolerance: full-state checkpoints + collective watchdog
+    # (io/checkpoint.py, parallel/multihost.py; see config.py knobs)
+    cfg = booster.config
+    ckpt_dir = str(cfg.get("tpu_checkpoint_dir", "") or "")
+    ckpt_freq = int(cfg.get("tpu_checkpoint_freq", 0) or 0)
+    ckpt_keep = int(cfg.get("tpu_checkpoint_keep", 3) or 3)
+    deadline = float(cfg.get("tpu_collective_deadline_s", 0.0) or 0.0)
+    from .analysis.faultinject import active_plan
+    from .parallel.multihost import TrainingInterrupted, run_with_deadline
+    plan = active_plan(cfg)
+    all_cbs = cbs_before + cbs_after
+
+    def _callback_states():
+        out = {}
+        for cb in all_cbs:
+            key = getattr(cb, "_ckpt_key", None)
+            st = getattr(cb, "state", None)
+            if key and isinstance(st, dict):
+                out[key] = copy.deepcopy(st)
+        return out
+
+    def _write_checkpoint():
+        booster.save_checkpoint(ckpt_dir, keep=ckpt_keep,
+                                callback_states=_callback_states())
+
+    start_iteration = 0
+    if ckpt_dir:
+        from .io import checkpoint as ckpt_mod
+        found = ckpt_mod.load_latest(ckpt_dir)
+        # multi-host: every rank must agree on the resume point BEFORE any
+        # state is restored — a rank that cannot see the snapshot (dir not
+        # on a shared filesystem, torn read) would otherwise start at 0
+        # while the others start at N, desyncing every collective in the
+        # step. On disagreement all ranks start fresh, which is safe.
+        import jax
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils as _mu
+            it = -1 if found is None else int(found["iteration"])
+            all_its = np.asarray(_mu.process_allgather(np.int64(it)))
+            if not (all_its == all_its[0]).all():
+                log.warning(
+                    f"checkpoint resume iteration disagrees across ranks "
+                    f"({list(map(int, all_its))}); is tpu_checkpoint_dir "
+                    f"on a shared filesystem? starting fresh on all ranks")
+                found = None
+        if found is not None:
+            try:
+                booster._restore_checkpoint(found, callbacks=all_cbs)
+                start_iteration = int(found["iteration"])
+                log.info(f"Resuming from checkpoint at iteration "
+                         f"{start_iteration} ({ckpt_dir})")
+            except ValueError as err:
+                log.warning(f"ignoring incompatible checkpoint in "
+                            f"{ckpt_dir}: {err}")
+
     # profiling (reference aux: USE_TIMETAG timers; here a jax.profiler
     # trace of the device programs, viewable in TensorBoard/Perfetto)
     trace_dir = str(params.get("tpu_trace_dir", "") or "")
@@ -135,13 +190,25 @@ def train(
 
     try:
         evaluation_result_list: List = []
-        for i in range(num_boost_round):
+        for i in range(start_iteration, num_boost_round):
             for cb in cbs_before:
                 cb(callback_mod.CallbackEnv(
                     model=booster, params=params, iteration=i,
                     begin_iteration=0, end_iteration=num_boost_round,
                     evaluation_result_list=None))
-            finished = booster.update()
+            plan.fire("iteration", iteration=i)
+            if deadline > 0:
+                # collective watchdog: a hung distributed step surfaces as
+                # a structured TrainingInterrupted (handled below with a
+                # final snapshot) instead of stalling the pod silently
+                def _step(i=i):
+                    plan.fire("step", iteration=i)
+                    return booster.update()
+                finished = run_with_deadline(
+                    _step, deadline, f"boosting iteration {i}")
+            else:
+                plan.fire("step", iteration=i)
+                finished = booster.update()
 
             evaluation_result_list = []
             if (valid_sets is not None and (booster._valid_names
@@ -166,10 +233,32 @@ def train(
             if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
                 finished = booster._gbdt._flush_trees() or finished
                 booster.save_model(f"{snapshot_out}.snapshot_iter_{i + 1}")
+            # full-state checkpoint tick: the ONE planned device->host
+            # fetch outside stop checks (atomic write, keep-last-k)
+            if ckpt_dir and ckpt_freq > 0 and (i + 1) % ckpt_freq == 0:
+                finished = booster._gbdt._flush_trees() or finished
+                _write_checkpoint()
             if finished:
                 log.info("Finished training (no further splits possible)")
                 break
 
+    except TrainingInterrupted as err:
+        # a deadline fired (hung collective / preempted peer): write a
+        # best-effort final snapshot, then surface the structured error.
+        # The snapshot itself runs under a deadline — when the hung step
+        # still holds the booster lock or the device state is
+        # unfetchable, resume falls back to the last periodic snapshot.
+        if ckpt_dir:
+            try:
+                run_with_deadline(_write_checkpoint,
+                                  max(deadline, 30.0),
+                                  "final interrupt snapshot")
+                log.warning(f"training interrupted ({err}); final "
+                            f"snapshot written to {ckpt_dir}")
+            except BaseException as snap_err:  # noqa: BLE001 - best effort
+                log.warning(f"training interrupted ({err}); final "
+                            f"snapshot failed: {snap_err}")
+        raise
     finally:
         if trace_ctx is not None:
             trace_ctx.__exit__(None, None, None)

@@ -71,6 +71,59 @@ def pad_rows(n: int, num_shards: int) -> int:
     return (-n) % num_shards
 
 
+_barrier_seq = 0
+
+
+def sync_barrier(tag: str, deadline_s: float = 0.0) -> None:
+    """Named cross-process barrier with an optional watchdog deadline.
+
+    Multi-process runs block until every rank arrives — the reference's
+    ``Network::``AllReduce-as-barrier between training phases. A rank
+    that never arrives (preempted worker, wedged runtime) used to hang
+    the whole pod silently; under a positive ``deadline_s`` the wait
+    surfaces as a structured ``TrainingInterrupted`` instead
+    (parallel/multihost.py watchdog), and the training engine snapshots
+    before exiting. Single-process runs only fire the fault-injection
+    hook (so dryrun chaos tests exercise the same code path tier-1 runs
+    on CPU).
+
+    The wait goes through the coordination-service KV barrier
+    (``wait_at_barrier``), which works on every backend — the XLA
+    collective inside ``multihost_utils.sync_global_devices`` is not
+    implemented for multiprocess CPU, which the 2-process dryrun tests
+    rely on. Barrier ids carry a per-process sequence number; ranks call
+    barriers in program order, so the ids line up across the pod.
+    """
+    from ..analysis.faultinject import active_plan
+    from .multihost import run_with_deadline
+
+    global _barrier_seq
+    _barrier_seq += 1
+    seq = _barrier_seq
+
+    def _sync():
+        active_plan().fire("barrier", tag=tag)
+        if jax.process_count() <= 1:
+            return
+        client = None
+        try:
+            from jax._src import distributed
+            client = distributed.global_state.client
+        except Exception:  # pragma: no cover - jax internals moved
+            pass
+        if client is not None:
+            # the KV timeout backstops the watchdog: keep it LARGER than
+            # deadline_s so a hang surfaces as TrainingInterrupted first
+            timeout_s = deadline_s * 2 if deadline_s > 0 else 600.0
+            client.wait_at_barrier(f"lgbm_tpu_{tag}_{seq}",
+                                   int(timeout_s * 1000))
+        else:
+            from jax.experimental import multihost_utils as mu
+            mu.sync_global_devices(f"{tag}_{seq}")
+
+    run_with_deadline(_sync, deadline_s, f"barrier {tag!r}")
+
+
 def predict_shard_pad(n: int, num_shards: int, ladder) -> Optional[int]:
     """Padded row count for row-sharded bucketed predict, or None.
 

@@ -35,11 +35,106 @@ from __future__ import annotations
 
 import os
 import socket
-from typing import List, Optional, Tuple
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
 
 from ..utils import log
 
 _initialized = False
+
+
+class TrainingInterrupted(RuntimeError):
+    """A collective/step blew its deadline (or a preemption surfaced).
+
+    The structured replacement for a silent pod hang: carries what was
+    running and the deadline that fired, and the training engine writes a
+    best-effort final snapshot before re-raising it (engine.py), so a
+    preemptible run loses at most the iterations since the last
+    ``tpu_checkpoint_freq`` tick."""
+
+    def __init__(self, what: str, deadline_s: float = 0.0):
+        super().__init__(
+            f"{what} exceeded its {deadline_s:.1f}s deadline"
+            if deadline_s else what)
+        self.what = what
+        self.deadline_s = deadline_s
+
+
+#: transient bootstrap/collective failure signatures (the TPU runtime
+#: mid-restart family; matches the fault injector's TRANSIENT_MESSAGE).
+#: This is the ONE canonical list — bench.py imports it (with a
+#: standalone fallback) for its backend-init/resume retry classifiers.
+TRANSIENT_ERRORS = (
+    "Unable to initialize backend",
+    "UNAVAILABLE", "Unavailable",
+    "DEADLINE_EXCEEDED", "Deadline Exceeded",
+    "failed to connect", "Failed to connect",
+    "Connection reset", "Socket closed",
+    "already in use",
+    "No visible TPU", "device enumeration",
+)
+
+
+def run_with_deadline(fn: Callable, deadline_s: float, what: str, *,
+                      retries: int = 0, backoff_s: float = 1.0):
+    """Run ``fn()`` under a wall-clock watchdog.
+
+    ``fn`` executes in a daemon worker thread; if it has not finished
+    within ``deadline_s`` a structured :class:`TrainingInterrupted` is
+    raised in the caller (the reference's socket linkers fail their
+    connects after ``time_out`` minutes the same way,
+    src/network/linkers_socket.cpp connect retry loop). ``deadline_s <= 0``
+    runs ``fn`` inline with no watchdog (retries still apply).
+
+    Transient failures (:data:`TRANSIENT_ERRORS` substrings) retry up to
+    ``retries`` times with exponential backoff — the bootstrap analogue of
+    the reference's per-linker connect retries.
+
+    Caveat: a worker that blows its deadline is abandoned, not killed
+    (Python cannot safely interrupt a thread blocked in native code). The
+    caller is expected to snapshot and exit — the leaked thread dies with
+    the process, which is the point of the final snapshot.
+    """
+    attempt = 0
+    while True:
+        try:
+            if deadline_s and deadline_s > 0:
+                box: dict = {}
+                done = threading.Event()
+
+                def _runner():
+                    try:
+                        box["value"] = fn()
+                    except BaseException as err:  # noqa: BLE001 - re-raised
+                        box["error"] = err
+                    finally:
+                        done.set()
+
+                worker = threading.Thread(
+                    target=_runner, daemon=True,
+                    name=f"lgbm-tpu-watchdog[{what}]")
+                worker.start()
+                if not done.wait(deadline_s):
+                    raise TrainingInterrupted(what, deadline_s)
+                if "error" in box:
+                    raise box["error"]
+                return box.get("value")
+            return fn()
+        except TrainingInterrupted:
+            raise
+        except Exception as err:  # noqa: BLE001 - classified below
+            msg = str(err)
+            transient = any(t in msg for t in TRANSIENT_ERRORS)
+            if not transient or attempt >= retries:
+                raise
+            delay = backoff_s * (2 ** attempt)
+            attempt += 1
+            log.warning(
+                f"{what}: transient failure (attempt {attempt}/"
+                f"{retries}): {msg.splitlines()[0][:200]}; retrying in "
+                f"{delay:.1f}s")
+            time.sleep(delay)
 
 
 def _parse_machines(machines: str, machine_list_file: str) -> List[str]:
@@ -265,9 +360,29 @@ def init_distributed(config) -> bool:
             "(reference: config.h machines / linkers_socket.cpp)")
     log.info(f"Initializing multi-host training: rank {process_id}/"
              f"{num_machines}, coordinator {coordinator}")
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=num_machines,
-        process_id=process_id)
+    # the bootstrap barrier is the first place a preempted/half-up pod
+    # hangs: run it under the collective watchdog (deadline + exponential
+    # backoff on transient failures) so a dead coordinator surfaces as a
+    # structured TrainingInterrupted, not a silent stall (reference:
+    # linkers_socket.cpp retries each connect and fails after time_out)
+    deadline = float(config.get("tpu_collective_deadline_s", 0.0) or 0.0)
+    retries = int(config.get("tpu_collective_retries", 3) or 0)
+    from ..analysis.faultinject import active_plan
+
+    def _bootstrap():
+        active_plan(config).fire("backend_init")
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_machines,
+            process_id=process_id)
+
+    run_with_deadline(_bootstrap, deadline,
+                      f"multi-host bootstrap (rank {process_id}, "
+                      f"coordinator {coordinator})", retries=retries)
     _initialized = True
+    # post-bootstrap barrier under the same watchdog: proves every rank
+    # actually came up before dataset construction starts (a half-up pod
+    # otherwise hangs later, inside the first bin-mapper sync)
+    from .mesh import sync_barrier
+    sync_barrier("lgbm-tpu-bootstrap", deadline_s=deadline)
     return True

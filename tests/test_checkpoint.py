@@ -1,0 +1,630 @@
+"""Preemption-safe training: atomic checkpoints, crash/resume parity,
+fault injection, collective deadlines.
+
+The contract under test (io/checkpoint.py, analysis/faultinject.py,
+parallel/multihost.py, engine.py):
+
+* snapshots land atomically (write-temp-fsync-rename + SHA-256) and a
+  corrupted/truncated file is skipped back to the previous valid one;
+* a run killed at an arbitrary iteration (via the fault injector — a
+  ``kill -9`` stand-in that escapes every ``except Exception``) resumes
+  from ``tpu_checkpoint_dir`` to a BIT-IDENTICAL model vs. the
+  uninterrupted run — trees and predictions — including with bagging,
+  GOSS, DART, the compact/quantized grower, and early stopping;
+* checkpointing does not break the steady-state contract: 0 recompiles,
+  and device->host transfers happen ONLY at ``tpu_checkpoint_freq``
+  ticks;
+* a hung collective/step surfaces as a structured
+  ``TrainingInterrupted`` with a final snapshot written, not a silent
+  hang.
+"""
+import importlib.util
+import os
+import pickle
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.analysis import faultinject, guards
+from lightgbm_tpu.io import checkpoint as ckpt
+from lightgbm_tpu.parallel.multihost import (TrainingInterrupted,
+                                             run_with_deadline)
+
+from utils import FAST_PARAMS, binary_data, train_test_split_simple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _trees(bst) -> str:
+    """Model text minus the parameter dump (the checkpoint knobs appear
+    there by design; tree bit-identity is what resume guarantees)."""
+    return bst.model_to_string().split("\nparameters:")[0]
+
+
+def _params(**kw):
+    p = dict(FAST_PARAMS)
+    p.update(objective="binary", learning_rate=0.1, seed=7, verbosity=-1)
+    p.update(kw)
+    return p
+
+
+def _dataset():
+    X, y = binary_data()
+    return X, lgb.Dataset(X, label=y)
+
+
+# ================================================= io/checkpoint.py units
+class TestSnapshotFiles:
+    def test_write_read_roundtrip(self, tmp_path):
+        state = {"iteration": 5, "models": ["t0", "t1"],
+                 "arr": np.arange(7.0)}
+        path = ckpt.write_snapshot(str(tmp_path), 5, state)
+        assert os.path.basename(path) == "snapshot_iter_000000005.ckpt"
+        back = ckpt.read_snapshot(path)
+        assert back["iteration"] == 5
+        assert back["models"] == ["t0", "t1"]
+        np.testing.assert_array_equal(back["arr"], state["arr"])
+
+    def test_no_temp_files_left_behind(self, tmp_path):
+        ckpt.write_snapshot(str(tmp_path), 1, {"iteration": 1})
+        ckpt.write_snapshot(str(tmp_path), 2, {"iteration": 2})
+        leftovers = [n for n in os.listdir(tmp_path)
+                     if n.startswith(".snapshot_tmp_")]
+        assert not leftovers
+
+    def test_truncated_snapshot_detected(self, tmp_path):
+        path = ckpt.write_snapshot(str(tmp_path), 1,
+                                   {"iteration": 1, "x": list(range(100))})
+        faultinject.corrupt_file(path, "truncate")
+        with pytest.raises(ckpt.SnapshotCorrupt, match="torn write"):
+            ckpt.read_snapshot(path)
+
+    def test_bitflipped_snapshot_detected(self, tmp_path):
+        path = ckpt.write_snapshot(str(tmp_path), 1,
+                                   {"iteration": 1, "x": list(range(100))})
+        faultinject.corrupt_file(path, "flip")
+        with pytest.raises(ckpt.SnapshotCorrupt, match="checksum"):
+            ckpt.read_snapshot(path)
+
+    def test_bad_magic_detected(self, tmp_path):
+        path = tmp_path / "snapshot_iter_000000001.ckpt"
+        path.write_bytes(b"NOTACKPT" + b"\x00" * 64)
+        with pytest.raises(ckpt.SnapshotCorrupt, match="magic"):
+            ckpt.read_snapshot(str(path))
+
+    def test_load_latest_skips_corrupt_to_previous_valid(self, tmp_path):
+        ckpt.write_snapshot(str(tmp_path), 4, {"iteration": 4, "tag": "ok"})
+        newest = ckpt.write_snapshot(str(tmp_path), 8,
+                                     {"iteration": 8, "tag": "newest"})
+        faultinject.corrupt_file(newest, "flip")
+        state = ckpt.load_latest(str(tmp_path))
+        assert state is not None and state["tag"] == "ok"
+        assert state["iteration"] == 4
+
+    def test_load_latest_empty(self, tmp_path):
+        assert ckpt.load_latest(str(tmp_path)) is None
+        assert ckpt.load_latest(str(tmp_path / "missing")) is None
+
+    def test_keep_last_k_rotation(self, tmp_path):
+        for i in range(1, 7):
+            ckpt.write_snapshot(str(tmp_path), i, {"iteration": i}, keep=3)
+        iters = [it for it, _ in ckpt.list_snapshots(str(tmp_path))]
+        assert iters == [4, 5, 6]
+
+    def test_keep_nonpositive_keeps_everything(self, tmp_path):
+        for i in range(1, 5):
+            ckpt.write_snapshot(str(tmp_path), i, {"iteration": i}, keep=0)
+        assert len(ckpt.list_snapshots(str(tmp_path))) == 4
+
+    def test_undecodable_payload_detected(self, tmp_path):
+        # valid header + checksum over garbage that is not a pickle
+        import hashlib
+        payload = b"\x00garbage, not a pickle"
+        blob = (ckpt.MAGIC + len(payload).to_bytes(8, "big")
+                + hashlib.sha256(payload).digest() + payload)
+        path = tmp_path / "snapshot_iter_000000003.ckpt"
+        path.write_bytes(blob)
+        with pytest.raises(ckpt.SnapshotCorrupt, match="undecodable"):
+            ckpt.read_snapshot(str(path))
+
+
+# ============================================== faultinject.py spec units
+class TestFaultSpec:
+    def test_parse_clauses(self):
+        faults = faultinject.parse_spec(
+            "kill@iteration=3; hang@step=2:seconds=9.5;"
+            "transient@backend_init=1:count=2;"
+            "corrupt@snapshot=2:mode=flip")
+        kinds = [(f.kind, f.site, f.at) for f in faults]
+        assert kinds == [("kill", "iteration", 3), ("hang", "step", 2),
+                         ("transient", "backend_init", 1),
+                         ("corrupt", "snapshot", 2)]
+        assert faults[1].seconds == 9.5
+        assert faults[2].count == 2
+        assert faults[3].mode == "flip"
+
+    @pytest.mark.parametrize("bad", [
+        "kill",                       # no @site
+        "vaporize@iteration=1",       # unknown kind
+        "kill@nowhere=1",             # unknown site
+        "kill@iteration=x",           # non-integer position
+        "corrupt@snapshot=1:mode=zap",  # bad corrupt mode
+        "kill@iteration=1:wat=1",     # unknown option
+    ])
+    def test_parse_errors(self, bad):
+        with pytest.raises(faultinject.FaultSpecError):
+            faultinject.parse_spec(bad)
+
+    def test_fault_fires_then_disarms(self):
+        with faultinject.inject("transient@backend_init=*:count=2") as plan:
+            for _ in range(2):
+                with pytest.raises(RuntimeError,
+                                   match="Unable to initialize backend"):
+                    plan.fire("backend_init")
+            plan.fire("backend_init")       # spent: no-op
+            assert plan.faults[0].fired == 2
+
+    def test_inject_restores_previous_plan(self):
+        assert isinstance(faultinject.active_plan(), faultinject.NullPlan)
+        with faultinject.inject("kill@iteration=1"):
+            assert faultinject.active_plan() is not None
+            assert not isinstance(faultinject.active_plan(),
+                                  faultinject.NullPlan)
+        assert isinstance(faultinject.active_plan(), faultinject.NullPlan)
+
+    def test_at_with_count_fires_consecutive_positions(self):
+        """The documented 'transient@backend_init=1:count=2' fails the
+        first TWO attempts: ``at`` is where firing starts, not a single
+        exact match."""
+        with faultinject.inject("transient@backend_init=1:count=2") as plan:
+            for _ in range(2):
+                with pytest.raises(RuntimeError):
+                    plan.fire("backend_init")
+            plan.fire("backend_init")       # third attempt: recovered
+            assert plan.faults[0].fired == 2
+
+    def test_config_spec_reaches_configless_sites(self, tmp_path):
+        """tpu_fault_spec armed via params must drive the sites that hold
+        no config (snapshot writes): corrupt@snapshot fires from a pure
+        config spec."""
+        X, y = binary_data()
+        params = _params(tpu_fault_spec="corrupt@snapshot=1",
+                         tpu_checkpoint_dir=str(tmp_path),
+                         tpu_checkpoint_freq=2)
+        try:
+            lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=4)
+        finally:
+            # disarm the sticky config plan for later tests
+            faultinject.active_plan({"tpu_fault_spec": ""})
+        snaps = ckpt.list_snapshots(str(tmp_path))
+        assert len(snaps) == 2
+        with pytest.raises(ckpt.SnapshotCorrupt):
+            ckpt.read_snapshot(snaps[0][1])      # corrupted by the spec
+        ckpt.read_snapshot(snaps[1][1])          # count spent: valid
+
+    def test_kill_escapes_except_exception(self):
+        """SimulatedKill models kill -9: no `except Exception` cleanup
+        handler may swallow it (no mid-death snapshot)."""
+        with faultinject.inject("kill@iteration=0"):
+            with pytest.raises(faultinject.SimulatedKill):
+                try:
+                    faultinject.active_plan().fire("iteration", iteration=0)
+                except Exception:       # noqa: BLE001 - the point
+                    pytest.fail("SimulatedKill caught by except Exception")
+
+
+# ===================================================== kill/resume parity
+def _train(params, rounds, valid=False, callbacks=None):
+    X, y = binary_data()
+    if valid:
+        Xt, yt, Xv, yv = train_test_split_simple(X, y)
+        ds = lgb.Dataset(Xt, label=yt)
+        vsets = [lgb.Dataset(Xv, label=yv, reference=ds)]
+        bst = lgb.train(params, ds, num_boost_round=rounds,
+                        valid_sets=vsets, callbacks=list(callbacks or ()))
+        return bst, Xt
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(params, ds, num_boost_round=rounds,
+                    callbacks=list(callbacks or ()))
+    return bst, X
+
+
+def _kill_and_resume(params, rounds, kill_at, tmp_path, freq=3,
+                     valid=False, callbacks=None):
+    """Train with checkpointing, die at ``kill_at``, resume; return the
+    resumed booster."""
+    p = dict(params, tpu_checkpoint_dir=str(tmp_path),
+             tpu_checkpoint_freq=freq)
+    with faultinject.inject(f"kill@iteration={kill_at}"):
+        with pytest.raises(faultinject.SimulatedKill):
+            _train(p, rounds, valid=valid, callbacks=callbacks)
+    bst, X = _train(p, rounds, valid=valid, callbacks=callbacks)
+    return bst, X
+
+
+PARITY_CONFIGS = {
+    "masked": {},
+    "compact": {"tpu_grower": "compact", "stop_check_freq": 10_000},
+    "bagging": {"bagging_fraction": 0.7, "bagging_freq": 2},
+    "goss": {"data_sample_strategy": "goss"},
+    "dart": {"boosting": "dart", "drop_rate": 0.5},
+    "quantized": {"tpu_grower": "compact", "max_bin": 31,
+                  "stop_check_freq": 10_000},
+}
+
+
+class TestKillResumeParity:
+    @pytest.mark.parametrize("name", sorted(PARITY_CONFIGS))
+    def test_bit_identical_model(self, name, tmp_path):
+        params = _params(**PARITY_CONFIGS[name])
+        ref, X = _train(params, 10)
+        res, _ = _kill_and_resume(params, 10, kill_at=7, tmp_path=tmp_path)
+        assert _trees(ref) == _trees(res), \
+            f"{name}: resumed trees differ from uninterrupted run"
+        np.testing.assert_array_equal(ref.predict(X), res.predict(X))
+
+    @pytest.mark.parametrize("kill_at", [1, 4, 9])
+    def test_arbitrary_kill_points(self, kill_at, tmp_path):
+        """Death before the first snapshot (restart from 0), right on a
+        tick, and mid-interval all resume bit-identically."""
+        params = _params()
+        ref, X = _train(params, 10)
+        res, _ = _kill_and_resume(params, 10, kill_at=kill_at,
+                                  tmp_path=tmp_path)
+        assert _trees(ref) == _trees(res)
+        np.testing.assert_array_equal(ref.predict(X), res.predict(X))
+
+    def test_double_kill_then_resume(self, tmp_path):
+        """Two successive deaths (the second during the resumed run)
+        still converge to the uninterrupted model."""
+        params = _params(tpu_checkpoint_dir=str(tmp_path),
+                         tpu_checkpoint_freq=2)
+        ref, X = _train(_params(), 12)
+        for kill_at in (5, 9):
+            with faultinject.inject(f"kill@iteration={kill_at}"):
+                with pytest.raises(faultinject.SimulatedKill):
+                    _train(params, 12)
+        res, _ = _train(params, 12)
+        assert _trees(ref) == _trees(res)
+        np.testing.assert_array_equal(ref.predict(X), res.predict(X))
+
+    def test_early_stopping_same_iteration(self, tmp_path):
+        """A resumed run early-stops at exactly the same iteration with
+        the same bests as the uninterrupted run (the callback state rides
+        the snapshot)."""
+        params = _params(learning_rate=0.3)    # stops around iter 19
+        ref, X = _train(params, 40, valid=True,
+                        callbacks=[lgb.early_stopping(3, verbose=False)])
+        assert 7 < ref.num_trees() < 40        # the kill lands mid-run
+        res, _ = _kill_and_resume(
+            params, 40, kill_at=7, tmp_path=tmp_path, valid=True,
+            callbacks=[lgb.early_stopping(3, verbose=False)])
+        assert ref.best_iteration == res.best_iteration
+        assert ref.best_score == res.best_score
+        assert ref.num_trees() == res.num_trees()
+        np.testing.assert_array_equal(ref.predict(X), res.predict(X))
+
+    def test_resume_adds_early_stopping_not_in_killed_run(self, tmp_path):
+        """A resumed run may attach callbacks the killed run did not have:
+        early_stopping whose state is absent from the snapshot must
+        initialize mid-run instead of crashing."""
+        params = _params(learning_rate=0.3)
+        p = dict(params, tpu_checkpoint_dir=str(tmp_path),
+                 tpu_checkpoint_freq=3)
+        with faultinject.inject("kill@iteration=7"):
+            with pytest.raises(faultinject.SimulatedKill):
+                _train(p, 40, valid=True)        # no early stopping
+        res, X = _train(p, 40, valid=True,
+                        callbacks=[lgb.early_stopping(3, verbose=False)])
+        assert res.best_iteration > 6            # stopped, post-resume
+        assert res.num_trees() < 40
+
+    def test_resume_from_corrupted_newest_falls_back(self, tmp_path):
+        """corrupt@snapshot chaos: the newest snapshot is damaged after
+        landing; resume transparently uses the previous valid one and
+        still reaches the bit-identical model."""
+        params = _params()
+        ref, X = _train(params, 10)
+        p = dict(params, tpu_checkpoint_dir=str(tmp_path),
+                 tpu_checkpoint_freq=2)
+        with faultinject.inject(
+                "corrupt@snapshot=3:mode=flip;kill@iteration=7"):
+            with pytest.raises(faultinject.SimulatedKill):
+                _train(p, 10)
+        # snapshot 3 (iteration 6) is corrupt: resume starts at 4
+        state = ckpt.load_latest(str(tmp_path))
+        assert state["iteration"] == 4
+        res, _ = _train(p, 10)
+        assert _trees(ref) == _trees(res)
+        np.testing.assert_array_equal(ref.predict(X), res.predict(X))
+
+    def test_incompatible_snapshot_ignored(self, tmp_path):
+        """A snapshot from a structurally different run (num_leaves) is
+        rejected with a warning and training starts fresh."""
+        p1 = _params(num_leaves=15, tpu_checkpoint_dir=str(tmp_path),
+                     tpu_checkpoint_freq=2)
+        _train(p1, 6)
+        assert ckpt.load_latest(str(tmp_path)) is not None
+        p2 = _params(num_leaves=7, tpu_checkpoint_dir=str(tmp_path),
+                     tpu_checkpoint_freq=0)      # read-only: no overwrite
+        bst, X = _train(p2, 6)
+        ref, _ = _train(_params(num_leaves=7), 6)
+        assert _trees(bst) == _trees(ref)
+
+    def test_finished_run_snapshot_resumes_to_noop(self, tmp_path):
+        """Resuming at num_boost_round trains zero extra iterations."""
+        p = _params(tpu_checkpoint_dir=str(tmp_path),
+                    tpu_checkpoint_freq=2)
+        first, X = _train(p, 6)
+        again, _ = _train(p, 6)
+        assert again.num_trees() == first.num_trees() == 6
+        np.testing.assert_array_equal(first.predict(X), again.predict(X))
+
+
+# ======================================== steady-state contract under ckpt
+def test_steady_state_zero_compiles_transfers_only_at_ticks():
+    """With checkpointing enabled the training loop stays at 0 recompiles
+    and 0 device->host transfers OUTSIDE snapshot ticks: every update()
+    runs under the d2h guard; the guard is lifted only for the
+    tpu_checkpoint_freq-boundary save_checkpoint call (the ONE planned
+    fetch)."""
+    import tempfile
+    rng = np.random.RandomState(3)
+    X = rng.randn(900, 8).astype(np.float32)
+    y = (X[:, 0] - 0.4 * X[:, 1] > 0).astype(np.float64)
+    params = _params(tpu_grower="compact", stop_check_freq=10_000)
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params, ds)
+    for _ in range(3):                  # warmup: compiles happen here
+        bst.update()
+    with tempfile.TemporaryDirectory() as d:
+        with guards.compile_counter() as cc:
+            for i in range(6):
+                with guards.no_host_transfers():
+                    bst.update()
+                if (i + 1) % 3 == 0:    # the planned snapshot tick
+                    bst.save_checkpoint(d)
+        assert len(ckpt.list_snapshots(d)) == 2
+    assert cc.lowerings == 0, "checkpointing broke the 0-recompile contract"
+    assert cc.backend_compiles == 0
+
+
+def test_snapshot_capture_is_a_real_host_fetch():
+    """Negative control for the tick contract: capturing a snapshot DOES
+    materialize device state — under the d2h guard it raises. Transfers
+    therefore occur exactly when save_checkpoint is called, i.e. only at
+    tpu_checkpoint_freq boundaries in the engine loop."""
+    X, y = binary_data()
+    params = _params()
+    bst = lgb.Booster(params, lgb.Dataset(X, label=y, params=params))
+    bst.update()
+    with pytest.raises(guards.HostTransferError):
+        with guards.no_host_transfers():
+            bst._capture_checkpoint()
+
+
+# ===================================== collective deadlines / watchdog
+class TestWatchdog:
+    def test_returns_value_inline_when_disabled(self):
+        assert run_with_deadline(lambda: 41 + 1, 0.0, "inline") == 42
+
+    def test_returns_value_under_deadline(self):
+        assert run_with_deadline(lambda: "ok", 5.0, "fast fn") == "ok"
+
+    def test_deadline_fires_structured(self):
+        t0 = time.time()
+        with pytest.raises(TrainingInterrupted) as err:
+            run_with_deadline(lambda: time.sleep(30), 0.3, "hung step")
+        assert time.time() - t0 < 10          # did NOT wait the 30s
+        assert err.value.what == "hung step"
+        assert err.value.deadline_s == 0.3
+        assert "deadline" in str(err.value)
+
+    def test_worker_exception_propagates(self):
+        with pytest.raises(ZeroDivisionError):
+            run_with_deadline(lambda: 1 // 0, 5.0, "failing fn")
+
+    def test_transient_retries_with_backoff(self, monkeypatch):
+        delays = []
+        monkeypatch.setattr(time, "sleep", delays.append)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RuntimeError("Unable to initialize backend: retry me")
+            return "recovered"
+
+        assert run_with_deadline(flaky, 0.0, "bootstrap", retries=3,
+                                 backoff_s=1.0) == "recovered"
+        assert calls["n"] == 3
+        assert delays == [1.0, 2.0]           # exponential backoff
+
+    def test_non_transient_never_retries(self):
+        calls = {"n": 0}
+
+        def broken():
+            calls["n"] += 1
+            raise ValueError("num_leaves must be positive")
+
+        with pytest.raises(ValueError):
+            run_with_deadline(broken, 0.0, "bootstrap", retries=5)
+        assert calls["n"] == 1
+
+    def test_injected_step_hang_interrupts_with_final_snapshot(
+            self, tmp_path):
+        """The acceptance path: a hang injected into the distributed step
+        surfaces as TrainingInterrupted AND a final snapshot lands, so
+        resume continues to the bit-identical model."""
+        # deadline must clear the compile-heavy early iterations (the
+        # watchdog measures wall clock, compiles included) while staying
+        # far below the injected 120s hang
+        params = _params(tpu_checkpoint_dir=str(tmp_path),
+                         tpu_checkpoint_freq=1,
+                         tpu_collective_deadline_s=10.0)
+        with faultinject.inject("hang@step=4:seconds=120"):
+            with pytest.raises(TrainingInterrupted):
+                _train(params, 8)
+        state = ckpt.load_latest(str(tmp_path))
+        assert state is not None and state["iteration"] == 4
+        res, X = _train(params, 8)
+        ref, _ = _train(_params(), 8)
+        assert _trees(ref) == _trees(res)
+        np.testing.assert_array_equal(ref.predict(X), res.predict(X))
+
+    def test_barrier_hang_interrupts(self):
+        """mesh.sync_barrier under a deadline: an injected never-arriving
+        rank surfaces as TrainingInterrupted (single-process dryrun runs
+        the same code path the pod does)."""
+        from lightgbm_tpu.parallel.mesh import sync_barrier
+        sync_barrier("smoke")                  # no deadline: fine
+        with faultinject.inject("hang@barrier=2:seconds=60"):
+            sync_barrier("ok-tick", deadline_s=5.0)
+            with pytest.raises(TrainingInterrupted) as err:
+                sync_barrier("hung-tick", deadline_s=0.3)
+        assert "hung-tick" in err.value.what
+
+    def test_bootstrap_transient_then_recovery(self, monkeypatch):
+        """multihost bootstrap: injected transient backend-init failures
+        are retried with backoff (the r05 death mode), then succeed."""
+        monkeypatch.setattr(time, "sleep", lambda s: None)
+        calls = {"n": 0}
+
+        def fake_bootstrap():
+            faultinject.active_plan().fire("backend_init")
+            calls["n"] += 1
+            return "up"
+
+        with faultinject.inject("transient@backend_init=*:count=2"):
+            out = run_with_deadline(fake_bootstrap, 0.0, "bootstrap",
+                                    retries=3, backoff_s=0.0)
+        assert out == "up" and calls["n"] == 1
+
+
+# =============================================== bench.py resume satellite
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_ckpt_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_resumable_loop_survives_transient_death(tmp_path):
+    """bench._resumable_update_loop: a transient backend death mid-run
+    rebuilds the booster from the last snapshot and finishes at the
+    target iteration count — bit-identical to a straight run."""
+    bench = _load_bench()
+    X, y = binary_data()
+    params = _params()
+
+    ref = lgb.Booster(params, lgb.Dataset(X, label=y, params=params))
+    for _ in range(10):
+        ref.update()
+
+    ds = lgb.Dataset(X, label=y, params=params)
+
+    def make_booster():
+        return lgb.Booster(params, ds)
+
+    bst = make_booster()
+    with faultinject.inject("transient@bench_update=7"):
+        bst = bench._resumable_update_loop(
+            bst, make_booster, 10, str(tmp_path), ckpt_freq=2,
+            base_delay_s=0.0)
+    assert bst.current_iteration() == 10
+    assert _trees(bst) == _trees(ref)
+
+
+def test_bench_loop_gives_up_without_progress(tmp_path):
+    """A persistently-recurring 'transient' failure (no forward progress
+    between resumes) exhausts max_retries and re-raises instead of
+    busy-looping forever."""
+    bench = _load_bench()
+    X, y = binary_data()
+    params = _params()
+    ds = lgb.Dataset(X, label=y, params=params)
+
+    def make_booster():
+        return lgb.Booster(params, ds)
+
+    bst = make_booster()
+    with faultinject.inject("transient@bench_update=3:count=-1") as plan:
+        with pytest.raises(RuntimeError, match="Unable to initialize"):
+            bench._resumable_update_loop(
+                bst, make_booster, 10, str(tmp_path), ckpt_freq=2,
+                max_retries=2, base_delay_s=0.0)
+        # initial attempt + 2 capped retries, then give up
+        assert plan.faults[0].fired == 3
+
+
+def test_bench_loop_reraises_without_checkpoint_dir(tmp_path):
+    """No checkpoint dir => no resume loop heroics: the transient error
+    propagates (the outer stage retry owns it)."""
+    bench = _load_bench()
+    X, y = binary_data()
+    params = _params()
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params, ds)
+    with faultinject.inject("transient@bench_update=2"):
+        with pytest.raises(RuntimeError, match="Unable to initialize"):
+            bench._resumable_update_loop(bst, lambda: bst, 5, "")
+
+
+# ===================================== multihost-dryrun chaos (slow lane)
+@pytest.mark.slow
+def test_two_process_barrier_hang_surfaces_structured(tmp_path):
+    """A real 2-process pod where rank 1 never reaches the barrier: rank 0
+    must exit with a structured TrainingInterrupted (not hang) within the
+    deadline, and its final snapshot hook must have run."""
+    import socket
+    import subprocess
+    import sys
+
+    worker = tmp_path / "barrier_worker.py"
+    worker.write_text("""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+rank = int(sys.argv[1]); port = sys.argv[2]
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=2, process_id=rank)
+from lightgbm_tpu.parallel.mesh import sync_barrier
+from lightgbm_tpu.parallel.multihost import TrainingInterrupted
+if rank == 1:
+    import time
+    time.sleep(120)          # never arrives
+    sys.exit(0)
+try:
+    sync_barrier("chaos", deadline_s=5.0)
+except TrainingInterrupted as err:
+    print("STRUCTURED_INTERRUPT", err.what, flush=True)
+    # hard-exit: the abandoned barrier thread would otherwise wedge the
+    # distributed client's atexit shutdown — the production analogue is
+    # "snapshot then exit", which engine.py does before re-raising
+    os._exit(0)
+print("BARRIER_PASSED_UNEXPECTEDLY", flush=True)
+os._exit(1)
+""")
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker), str(rank), str(port)],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    out0, _ = procs[0].communicate(timeout=120)
+    procs[1].kill()
+    procs[1].communicate()
+    assert procs[0].returncode == 0, f"rank 0 failed:\n{out0}"
+    assert "STRUCTURED_INTERRUPT" in out0

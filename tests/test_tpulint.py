@@ -91,6 +91,77 @@ def test_r001_host_code_not_flagged(tmp_path):
     assert not findings
 
 
+def test_r001_snapshot_io_in_jit_flagged(tmp_path):
+    """Seed: checkpoint/snapshot file I/O (open, pickle.dump, fsync)
+    reachable from jit-traced code is a host-sync finding."""
+    findings = lint_snippet(tmp_path, """
+        import os
+        import pickle
+
+        import jax
+
+        @jax.jit
+        def step_with_snapshot(x):
+            with open("/tmp/snap.ckpt", "wb") as fh:
+                pickle.dump(x, fh)
+                os.fsync(fh.fileno())
+            return x * 2
+    """)
+    assert codes(findings).count("R001") >= 3
+
+
+def test_r001_snapshot_io_reached_from_jit_flagged(tmp_path):
+    """Same hazard one call away: a snapshot helper referenced from a
+    jitted step is jit-reachable and its file I/O is flagged."""
+    findings = lint_snippet(tmp_path, """
+        import pickle
+
+        import jax
+
+        def save_state(path, state):
+            with open(path, "wb") as fh:
+                pickle.dump(state, fh)
+
+        @jax.jit
+        def step(x):
+            save_state("/tmp/s.ckpt", x)
+            return x
+    """)
+    assert "R001" in codes(findings)
+
+
+def test_r001_snapshot_writer_pinned_even_off_jit(tmp_path):
+    """A pickle-and-fsync writer is a snapshot-writer site even in host
+    code: every such function must be a reviewed, deliberate tick (the
+    shipped io/checkpoint.py::write_snapshot carries the allowlist
+    anchor)."""
+    findings = lint_snippet(tmp_path, """
+        import os
+        import pickle
+
+        def write_state(path, state):
+            blob = pickle.dumps(state)
+            with open(path, "wb") as fh:
+                fh.write(blob)
+                fh.flush()
+                os.fsync(fh.fileno())
+    """)
+    assert "R001" in codes(findings)
+    assert "snapshot-writer site" in findings[0].message
+
+
+def test_r001_snapshot_reader_not_flagged(tmp_path):
+    """Reading a snapshot on the host is fine: no pickle.dump, no jit."""
+    findings = lint_snippet(tmp_path, """
+        import pickle
+
+        def read_state(path):
+            with open(path, "rb") as fh:
+                return pickle.loads(fh.read())
+    """)
+    assert not findings
+
+
 # ---------------------------------------------------------------- R002
 def test_r002_jit_in_loop(tmp_path):
     findings = lint_snippet(tmp_path, """
