@@ -56,6 +56,8 @@ from ..utils import rwlock as _rwlock
 
 _LOWER_EVENT = "/jax/core/compile/jaxpr_to_mlir_module_duration"
 _BACKEND_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_REQUEST_EVENT = "/jax/compilation_cache/compile_requests_use_cache"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
 
 #: jax.Array methods/properties through which host materialization funnels
 _FUNNELS = ("_value", "__array__", "item", "tolist", "__float__",
@@ -85,6 +87,32 @@ class CompileCount:
 
 
 @contextlib.contextmanager
+def _monitoring_listener(callback, register, unregister_name: str):
+    """Register a jax.monitoring listener for the duration of the block.
+
+    On exit the listener is deactivated (it stops forwarding to
+    ``callback``) and best-effort unregistered via the private
+    ``jax._src.monitoring`` API — the public unregister landed after
+    0.4.37, and a deactivated listener staying registered is harmless."""
+    state = {"active": True}
+
+    def _listener(*args, **kw) -> None:
+        if state["active"]:
+            callback(*args, **kw)
+
+    register(_listener)
+    try:
+        yield
+    finally:
+        state["active"] = False
+        try:
+            from jax._src import monitoring as _mon
+            getattr(_mon, unregister_name)(_listener)
+        except Exception:
+            pass
+
+
+@contextlib.contextmanager
 def compile_counter() -> Iterator[CompileCount]:
     """Count jit compilations inside the ``with`` block.
 
@@ -96,26 +124,84 @@ def compile_counter() -> Iterator[CompileCount]:
         cc.assert_no_compiles("post-warmup boosting")
     """
     counts = CompileCount()
-    state = {"active": True}
 
-    def _listener(event: str, duration_secs: float = 0.0, **kw) -> None:
-        if not state["active"]:
-            return
+    def _on_event(event: str, duration_secs: float = 0.0, **kw) -> None:
         if event == _LOWER_EVENT:
             counts.lowerings += 1
         elif event == _BACKEND_EVENT:
             counts.backend_compiles += 1
 
-    monitoring.register_event_duration_secs_listener(_listener)
-    try:
+    with _monitoring_listener(
+            _on_event, monitoring.register_event_duration_secs_listener,
+            "_unregister_event_duration_listener_by_callback"):
         yield counts
-    finally:
-        state["active"] = False
-        try:  # public unregister API landed after 0.4.37
-            from jax._src import monitoring as _mon
-            _mon._unregister_event_duration_listener_by_callback(_listener)
+
+
+@dataclasses.dataclass
+class CacheCount:
+    """Persistent-compile-cache lookups observed inside a guarded region."""
+    requests: int = 0
+    hits: int = 0
+
+    @property
+    def misses(self) -> int:
+        return self.requests - self.hits
+
+
+@contextlib.contextmanager
+def cache_counter() -> Iterator[CacheCount]:
+    """Count persistent-compilation-cache lookups inside the ``with`` block.
+
+    ``requests`` counts backend compiles that consulted the cache
+    (``/jax/compilation_cache/compile_requests_use_cache``), ``hits`` the
+    ones served from it. A warm cache (``tpu_compile_cache_dir`` pointed
+    at a previous run's directory, fresh process) shows hits == requests:
+    lowering still happens, the XLA backend compile is skipped. Counts
+    stay zero when no cache dir is configured."""
+    counts = CacheCount()
+
+    def _on_event(event: str, **kw) -> None:
+        if event == _CACHE_REQUEST_EVENT:
+            # jax emits the request event on EVERY backend compile, cache
+            # dir or not — only count consultations of a real cache, so
+            # cache-disabled runs read 0/0 instead of all-miss
+            if jax.config.jax_compilation_cache_dir:
+                counts.requests += 1
+        elif event == _CACHE_HIT_EVENT:
+            counts.hits += 1
+
+    with _monitoring_listener(_on_event, monitoring.register_event_listener,
+                              "_unregister_event_listener_by_callback"):
+        yield counts
+
+
+def configure_compile_cache(cache_dir) -> bool:
+    """Point jax's persistent compilation cache at ``cache_dir``.
+
+    The ``tpu_compile_cache_dir`` wiring: resumed/checkpointed runs and
+    repeated bench rounds relower but skip every backend compile whose
+    fingerprint is already on disk. The size/compile-time admission
+    thresholds are zeroed so every step program qualifies (the default
+    1 s floor would reject most CPU-backend programs). Changing the
+    directory after a compile already ran re-arms jax's once-per-task
+    cache-enable decision via ``reset_cache``. Returns True when a cache
+    directory is active, False for an empty/unset path (no-op)."""
+    path = str(cache_dir or "").strip()
+    if not path:
+        return False
+    # thresholds zero unconditionally: the dir may already be set (e.g.
+    # via JAX_COMPILATION_CACHE_DIR) with the 1 s admission floor intact,
+    # which would silently reject most CPU-backend step programs
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    if jax.config.jax_compilation_cache_dir != path:
+        jax.config.update("jax_compilation_cache_dir", path)
+        try:
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()  # drop the cached is-cache-used decision
         except Exception:
-            pass  # deactivated listener stays registered, harmless
+            pass
+    return True
 
 
 @contextlib.contextmanager

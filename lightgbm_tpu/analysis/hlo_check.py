@@ -33,8 +33,12 @@ Contract schema (one JSON object per mode)::
       "int_dot_s32": true,       # narrow-int dots must accumulate in s32
       "require_integer_dot": false,  # quant mode: the int path must be live
       "stable_fingerprint": true,
-      "measured": {...}          # collective_bytes() at generation time —
-    }                            #   scripts/verify_contracts.py diffs this
+      "measured": {...},         # collective_bytes() at generation time —
+                                 #   scripts/verify_contracts.py diffs this
+      "measured_baseline": {...} # overlap modes only: the overlap=off
+    }                            #   lowering's accounting — every kind's
+                                 #   bytes must MATCH "measured" (overlap
+                                 #   hides latency, never adds traffic)
 
 The harness half (``capture_mode``) trains a tiny Booster with
 ``LGBM_TPU_COMM_ACCOUNTING=1`` so ``boosting/gbdt.py`` records the
@@ -129,6 +133,53 @@ MODE_TEMPLATES: Dict[str, dict] = {
         "require": [],
         "require_integer_dot": True,
         "problem": {"n": 509, "f": 8, "seed": 0},
+    },
+    # -- async histogram-collective overlap (tpu_hist_overlap) ----------
+    # The overlap modes carry a ``baseline_params`` override: --update
+    # captures the overlap=off program too and records its accounting as
+    # ``measured_baseline``; check_overlap_parity then fails the gate if
+    # ANY collective kind moves different bytes with overlap on — overlap
+    # hides latency, it never adds traffic (only the collective COUNT may
+    # grow: one reduce per feature group instead of one for the slab).
+    # ``async_twins`` admits the corresponding ``-start`` ops with the
+    # same byte budgets: the CPU backend lowers the group collectives
+    # synchronously (measured start-bytes 0), an async backend splits
+    # each into a -start/-done pair that overlaps the next group's
+    # contraction — the same schedule freedom the grouping exists for.
+    "data_scatter_overlap": {
+        "description": "data-parallel compact grower, reduce-scatter "
+                       "histograms, tpu_hist_overlap=on: the owned "
+                       "feature slice reduces in 2 groups, each group's "
+                       "collective issued while the next group still "
+                       "contracts — byte budgets identical to the "
+                       "single-collective baseline, only the count grows",
+        "params": dict(_BASE, tpu_grower="compact", tree_learner="data",
+                       tpu_hist_scatter="on", tpu_hist_overlap="on"),
+        "baseline_params": {"tpu_hist_overlap": "off"},
+        "num_devices": 8,
+        "program": "compact_step_k0",
+        "require": ["reduce-scatter"],
+        "require_integer_dot": False,
+        "async_twins": True,
+        # 16 features / 8 shards = 2 owned columns per shard — the
+        # smallest problem where the 2-group split is live
+        "problem": {"n": 509, "f": 16, "seed": 0},
+    },
+    "voting_overlap": {
+        "description": "voting-parallel learner, tpu_hist_overlap=on: the "
+                       "2k elected histograms reduce in 2 groups, one "
+                       "cross-shard all-reduce per group pipelined under "
+                       "the next group's gather — same elected bytes as "
+                       "the single all-reduce baseline",
+        "params": dict(_BASE, tree_learner="voting", top_k=2,
+                       tpu_hist_overlap="on"),
+        "baseline_params": {"tpu_hist_overlap": "off"},
+        "num_devices": 8,
+        "program": "step",
+        "require": ["all-reduce"],
+        "require_integer_dot": False,
+        "async_twins": True,
+        "problem": {"n": 509, "f": 64, "seed": 1},
     },
 }
 
@@ -239,6 +290,41 @@ def check_int_dots(hlo_text: str, contract: dict) -> List[ContractFinding]:
     return out
 
 
+def check_overlap_parity(contract: dict,
+                         measured: Optional[dict] = None
+                         ) -> List[ContractFinding]:
+    """Overlap never adds traffic: with ``measured_baseline`` present
+    (the overlap=off lowering of the same mode), every collective kind
+    must move exactly the bytes the baseline moves — grouping a
+    histogram reduce splits ONE collective into N, it must not grow,
+    shrink, or re-route what crosses the links. The collective COUNT is
+    exempt (one reduce per feature group IS the mechanism).
+
+    ``measured`` is the LIVE capture's accounting (verify_mode passes
+    it); without it the check degrades to diffing the two stored fields
+    of the checked-in contract, which cannot see current-lowering
+    drift."""
+    base = contract.get("measured_baseline")
+    if not base:
+        return []
+    name = contract["mode"]
+    cur = measured if measured is not None \
+        else contract.get("measured", {})
+    out: List[ContractFinding] = []
+    # "total" is the sum of the kinds — diffing it too would report every
+    # drift twice
+    for kind in sorted((set(base) | set(cur)) - {"count", "total"}):
+        if cur.get(kind, 0) != base.get(kind, 0):
+            out.append(ContractFinding(
+                name, "overlap-bytes",
+                f"'{kind}' moves {cur.get(kind, 0)} B with overlap on vs "
+                f"{base.get(kind, 0)} B in the overlap=off baseline — "
+                "tpu_hist_overlap must hide collective latency without "
+                "changing collective traffic (same addends per element, "
+                "same bytes per link)"))
+    return out
+
+
 def check_fingerprint(history: Sequence[str],
                       contract: dict) -> List[ContractFinding]:
     name = contract["mode"]
@@ -344,6 +430,11 @@ def verify_mode(mode: str, contract: Optional[dict] = None,
     captured = captured or capture_mode(mode)
     findings = check_hlo(captured.hlo_text, contract)
     findings += check_fingerprint(captured.history, contract)
+    # parity against the CURRENT lowering, not the contract's own stored
+    # measurement — a backend upgrade that reshapes the overlap
+    # collectives must fail this gate, not wait for --update
+    findings += check_overlap_parity(
+        contract, measured=collective_bytes(captured.hlo_text))
     return findings
 
 
@@ -355,16 +446,25 @@ def build_contract(mode: str, captured: Optional[CapturedMode] = None
     acct = collective_bytes(captured.hlo_text)
     observed = sorted(k for k, v in acct.items()
                       if k not in ("total", "count") and v > 0)
-    return {
+    budgets = {k: acct[k] for k in observed}
+    if t.get("async_twins"):
+        # admit the -start half of each observed collective at the same
+        # byte budget: async backends split every group reduce into a
+        # -start/-done pair (the overlap the grouping exists for); the
+        # sync CPU lowering just never uses the allowance
+        for k in observed:
+            if not k.endswith("-start"):
+                budgets.setdefault(f"{k}-start", acct[k])
+    contract = {
         "mode": mode,
         "description": t["description"],
         "params": t["params"],
         "num_devices": t["num_devices"],
         "program": t["program"],
         "collectives": {
-            "allow": observed,
+            "allow": sorted(budgets),
             "require": list(t["require"]),
-            "max_bytes": {k: acct[k] for k in observed},
+            "max_bytes": budgets,
         },
         "forbid_host_ops": True,
         "int_dot_s32": True,
@@ -372,6 +472,13 @@ def build_contract(mode: str, captured: Optional[CapturedMode] = None
         "stable_fingerprint": True,
         "measured": {k: v for k, v in sorted(acct.items())},
     }
+    if "baseline_params" in t:
+        bt = dict(t, params=dict(t["params"], **t["baseline_params"]))
+        base_cap = capture_mode(mode, bt)
+        contract["measured_baseline"] = {
+            k: v for k, v in sorted(collective_bytes(
+                base_cap.hlo_text).items())}
+    return contract
 
 
 def verify_contracts(modes: Sequence[str] = MODES, update: bool = False,

@@ -1,6 +1,6 @@
 """R002 — recompilation hazards.
 
-Four sub-checks:
+Five sub-checks:
 
   (a) ``jax.jit(...)`` called inside a loop — a fresh jitted callable (and
       a fresh compile-cache entry) per iteration; hoist the jit out of the
@@ -25,6 +25,19 @@ Four sub-checks:
       a call whose name mentions bucket/pad/tile/shard (e.g.
       ``_pad_request_to_bucket``, ``np.pad``); deliberately unbucketed
       reference paths carry an allowlist anchor.
+  (e) a leaf-count- or depth-derived value (``num_leaves``/``max_leaves``/
+      ``max_depth`` names, attributes, or config ``.get`` reads) entering
+      the grower-step jit key — a ``GrowerParams`` construction or a
+      ``grower_params._replace`` update's ``num_leaves=``/``max_depth=``
+      keywords, or the arguments of a jitted step/grow callable — WITHOUT
+      flowing through a rung/bucket-named mapping (``leaf_rung``,
+      ``depth_rung``, ``bucketed_tree_shape``): the step program is then
+      keyed on the exact tree shape and every (num_leaves, max_depth)
+      pair lowers a fresh program (the 35-97 s training warmups
+      BENCH_SHAPES.json recorded before the bucketed step ladder). A
+      rung/bucket-named mapping function returning the raw leaf/depth
+      value is flagged too — that IS the deliberate exact-keyed escape
+      hatch (``tpu_step_buckets=off``) and carries an allowlist anchor.
 """
 from __future__ import annotations
 
@@ -33,7 +46,8 @@ import re
 from typing import List, Set
 
 from .base import (Finding, ModuleInfo, PackageInfo, Rule, JIT_NAMES,
-                   call_name, expr_references, traced_names)
+                   _plain_name_targets, call_name, expr_references,
+                   traced_names)
 
 
 def _bool_context_traced(test: ast.AST, traced) -> bool:
@@ -66,6 +80,7 @@ class RecompileRule(Rule):
         out.extend(self._unhashable_static_defaults(module))
         out.extend(self._tracer_branches(module, package))
         out.extend(self._unbucketed_entry_shapes(module, package))
+        out.extend(self._unbucketed_step_keys(module, package))
         return out
 
     # (a) ------------------------------------------------------------
@@ -200,4 +215,124 @@ class RecompileRule(Rule):
                                 "recompiles; pad to a bucket ladder "
                                 "first (ops/predict.py bucket_rows)"))
                             break
+        return out
+
+    # (e) ------------------------------------------------------------
+    #: names/attributes that carry a raw tree-shape budget
+    _LEAFDEPTH_RE = re.compile(
+        r"num_leaves|max_leaves|num_leaf|leaf_count|max_depth", re.I)
+    #: calls that map a raw budget onto the step ladder
+    _RUNG_RE = re.compile(r"rung|bucket", re.I)
+    #: jitted callables that are grower steps
+    _STEP_CALLEE_RE = re.compile(r"step|grow", re.I)
+
+    def _rung_clears(self, expr: ast.AST) -> bool:
+        """Does ``expr`` contain a rung/bucket-named mapping call?"""
+        return any(isinstance(c, ast.Call)
+                   and (call_name(c) or "")
+                   and self._RUNG_RE.search(call_name(c))
+                   for c in ast.walk(expr))
+
+    def _leafdepth_refs(self, expr: ast.AST, tainted: Set[str]) -> bool:
+        """Does ``expr`` reference a raw leaf-count/depth value?
+
+        True for names in the taint set or matching the leaf/depth
+        pattern, ``obj.max_depth``-style attributes, and config reads
+        (``cfg.get("num_leaves", ...)``) — except inside a rung/bucket-
+        named mapping call, whose result is a ladder key, not a raw
+        budget."""
+        def walk(n: ast.AST) -> bool:
+            if isinstance(n, ast.Call):
+                cname = call_name(n) or ""
+                if cname and self._RUNG_RE.search(cname):
+                    return False          # mapped: the subtree is clean
+                if cname.rsplit(".", 1)[-1] == "get" and n.args and \
+                        isinstance(n.args[0], ast.Constant) and \
+                        isinstance(n.args[0].value, str) and \
+                        self._LEAFDEPTH_RE.search(n.args[0].value):
+                    return True
+            if isinstance(n, ast.Name):
+                return n.id in tainted \
+                    or bool(self._LEAFDEPTH_RE.search(n.id))
+            if isinstance(n, ast.Attribute) and \
+                    self._LEAFDEPTH_RE.search(n.attr):
+                return True
+            return any(walk(c) for c in ast.iter_child_nodes(n))
+        return walk(expr)
+
+    def _unbucketed_step_keys(self, module: ModuleInfo,
+                              package: PackageInfo) -> List[Finding]:
+        out: List[Finding] = []
+        for fn in module.functions.values():
+            tainted: Set[str] = set()
+            is_mapping_fn = bool(self._RUNG_RE.search(fn.basename))
+            # SOURCE order, like sub-check (d): a rung-mapping assignment
+            # upstream of the key construction actually clears
+            ordered = sorted(fn.own_nodes(),
+                             key=lambda n: (getattr(n, "lineno", 0),
+                                            getattr(n, "col_offset", 0)))
+            for node in ordered:
+                if isinstance(node, ast.Assign):
+                    names = [leaf for t in node.targets
+                             for leaf in _plain_name_targets(t)]
+                    if self._rung_clears(node.value):
+                        tainted.difference_update(names)
+                    elif self._leafdepth_refs(node.value, tainted):
+                        tainted.update(names)
+                    else:
+                        tainted.difference_update(names)
+                elif isinstance(node, ast.Return) and is_mapping_fn:
+                    # a rung/bucket mapping passing the raw budget through
+                    # IS the exact-keyed escape hatch — deliberate parity
+                    # paths (tpu_step_buckets=off) carry an allowlist anchor
+                    v = node.value
+                    rets = [v] if isinstance(v, ast.Name) else \
+                        [e for e in v.elts if isinstance(e, ast.Name)] \
+                        if isinstance(v, ast.Tuple) else []
+                    if any(e.id in tainted
+                           or self._LEAFDEPTH_RE.search(e.id)
+                           for e in rets):
+                        out.append(self.finding(
+                            module, node, fn.qualname,
+                            "rung/bucket mapping returns the raw "
+                            "leaf/depth budget — the exact-keyed escape "
+                            "hatch compiles one step program per "
+                            "(num_leaves, max_depth) pair; deliberate "
+                            "parity paths (tpu_step_buckets=off) need an "
+                            "allowlist anchor"))
+                elif isinstance(node, ast.Call):
+                    cname = call_name(node) or ""
+                    base = cname.rsplit(".", 1)[-1]
+                    if base == "GrowerParams" or (
+                            "grower_params" in cname and base == "_replace"):
+                        for kw in node.keywords:
+                            if kw.arg in ("num_leaves", "max_depth") and \
+                                    self._leafdepth_refs(kw.value, tainted):
+                                out.append(self.finding(
+                                    module, node, fn.qualname,
+                                    f"grower-step jit key takes the raw "
+                                    f"'{kw.arg}' — every (num_leaves, "
+                                    "max_depth) pair lowers a fresh step "
+                                    "program; map it through the bucketed "
+                                    "ladder first (ops/grower.py "
+                                    "leaf_rung/depth_rung, "
+                                    "gbdt.bucketed_tree_shape)"))
+                                break
+                    elif self._STEP_CALLEE_RE.search(base) and \
+                            any(f.jit_decorated
+                                for f in package._callees(module, base)):
+                        for arg in list(node.args) + \
+                                [kw.value for kw in node.keywords]:
+                            if self._leafdepth_refs(arg, tainted) and \
+                                    not self._rung_clears(arg):
+                                out.append(self.finding(
+                                    module, node, fn.qualname,
+                                    "jitted grower step fed a raw "
+                                    "leaf/depth budget — the compiled "
+                                    "program is keyed on the exact tree "
+                                    "shape and every budget recompiles; "
+                                    "key on the rung and pass the budget "
+                                    "as a traced scalar (ops/grower.py "
+                                    "leaf_rung/depth_rung)"))
+                                break
         return out

@@ -624,9 +624,23 @@ class Booster:
         gbdt.learning_rate = float(self.config.learning_rate)
         gbdt.shrinkage_rate = gbdt.learning_rate
         old_gp = gbdt.grower_params
+        from .boosting.gbdt import (_pick_hist_overlap, _pick_step_buckets,
+                                    bucketed_tree_shape)
+        # re-resolve the ladder/overlap knobs from the JUST-updated config,
+        # not the _setup_train-era attributes — reset_parameter(
+        # {"tpu_step_buckets": "off"}) must actually take the exact-keyed
+        # escape hatch, and the hist-overlap on/off bench toggle must not
+        # be a silent no-op
+        gbdt._step_buckets = _pick_step_buckets(self.config)
+        key_leaves, key_depth = bucketed_tree_shape(
+            gbdt._step_buckets,
+            int(self.config.num_leaves), int(self.config.max_depth))
+        gbdt._max_depth_cfg = int(self.config.max_depth)
         gbdt.grower_params = gbdt.grower_params._replace(
-            num_leaves=int(self.config.num_leaves),
-            max_depth=int(self.config.max_depth),
+            num_leaves=key_leaves,
+            max_depth=key_depth,
+            step_buckets=gbdt._step_buckets,
+            hist_overlap=_pick_hist_overlap(self.config),
             lambda_l1=float(self.config.lambda_l1),
             lambda_l2=float(self.config.lambda_l2),
             min_data_in_leaf=float(self.config.min_data_in_leaf),

@@ -31,7 +31,8 @@ from ..io.dataset import BinnedDataset
 from ..metrics import Metric
 from ..objectives import Objective
 from ..ops.compact import RowLayout, pack_rows, segments_to_leaf_vectors
-from ..ops.grower import GrowerParams, TreeArrays, grow_tree
+from ..ops.grower import (GrowerParams, TreeArrays, depth_rung, grow_tree,
+                          leaf_rung)
 from ..ops.grower_compact import grow_tree_compact
 from ..ops.predict import (StackedTrees, bucket_rows, depth_bucket,
                            early_stop_tbatch, parse_bucket_ladder,
@@ -288,6 +289,52 @@ def _clamp_block(block: int, n: int, floor: int = 128) -> int:
     return max(block, floor)
 
 
+def _pick_step_buckets(cfg) -> bool:
+    """Resolve ``tpu_step_buckets``: the bucketed grower-step ladder.
+
+    On (the default), the step program's jit key carries the power-of-two
+    leaf RUNG and the {unlimited, bounded} depth bucket instead of the
+    exact (num_leaves, max_depth) pair — the actual budgets ride as traced
+    scalars, so every configuration in a rung shares one compiled program
+    (and one persistent-compile-cache entry). ``off`` is the exact-keyed
+    escape hatch for parity benching."""
+    mode = str(cfg.get("tpu_step_buckets", "auto")).lower()
+    if mode in ("off", "0", "false"):
+        return False
+    if mode not in ("", "auto", "on", "1", "true"):
+        log.warning(f"tpu_step_buckets={mode!r} is not one of "
+                    "auto|on|off; the ladder stays on")
+    return True
+
+
+def _pick_hist_overlap(cfg) -> int:
+    """Resolve ``tpu_hist_overlap``: async histogram-collective overlap.
+
+    ``on`` builds each leaf histogram in 2 feature groups with one
+    psum_scatter/all-reduce per group, issued while the next group still
+    accumulates (double-buffered hist slots) — the collective hides under
+    the MXU contraction and total collective bytes are unchanged. Only
+    meaningful on the distributed learners; the serial program ignores
+    it. ``auto`` stays off until a real-TPU sweep says otherwise."""
+    mode = str(cfg.get("tpu_hist_overlap", "auto")).lower()
+    if mode in ("on", "1", "true"):
+        return 2
+    if mode not in ("", "auto", "off", "0", "false"):
+        log.warning(f"tpu_hist_overlap={mode!r} is not one of "
+                    "auto|on|off; overlap stays off")
+    return 0
+
+
+def bucketed_tree_shape(step_buckets: bool, num_leaves: int,
+                        max_depth: int) -> Tuple[int, int]:
+    """(num_leaves, max_depth) as they enter the GrowerParams jit key:
+    the (leaf rung, depth bucket) pair under the step ladder, the exact
+    values on the ``tpu_step_buckets=off`` escape hatch."""
+    if step_buckets:
+        return leaf_rung(num_leaves), depth_rung(max_depth)
+    return num_leaves, max_depth
+
+
 class HostTree:
     """Host-side copy of one grown tree (numpy struct-of-arrays)."""
 
@@ -527,6 +574,17 @@ class GBDT:
         self._use_compact = False
         self._compact = None
         self.tree_learner = "serial"
+        # defaults for boosters constructed without a train set (model
+        # load); _setup_train overwrites them from the config
+        self._step_buckets = False
+        self._max_depth_cfg = int(config.get("max_depth", -1))
+        # persistent XLA compilation cache (tpu_compile_cache_dir): armed
+        # before the first jit of this booster so training AND predict-only
+        # programs can skip their backend compiles on a warm cache
+        cache_dir = config.get("tpu_compile_cache_dir", "")
+        if cache_dir:
+            from ..analysis.guards import configure_compile_cache
+            configure_compile_cache(cache_dir)
 
         if train_set is not None:
             self._setup_train(train_set)
@@ -773,9 +831,18 @@ class GBDT:
                 fpad(fcv, 1.0)) if self._f_pad else jnp.asarray(fcv)
         else:
             self._feature_contri = None
+        # bucketed step ladder (the compile-once training contract): the
+        # jit key carries (leaf rung, depth bucket), the actual budgets
+        # ride as traced scalars through _step_budget_args()
+        self._step_buckets = _pick_step_buckets(cfg)
+        self._max_depth_cfg = int(cfg.get("max_depth", -1))
+        key_leaves, key_depth = bucketed_tree_shape(
+            self._step_buckets, self.max_leaves, self._max_depth_cfg)
         self.grower_params = GrowerParams(
-            num_leaves=self.max_leaves,
-            max_depth=int(cfg.get("max_depth", -1)),
+            num_leaves=key_leaves,
+            max_depth=key_depth,
+            step_buckets=self._step_buckets,
+            hist_overlap=_pick_hist_overlap(cfg),
             num_bins=int(train_set.max_num_bins),
             lambda_l1=float(cfg.get("lambda_l1", 0.0)),
             lambda_l2=float(cfg.get("lambda_l2", 0.0)),
@@ -933,6 +1000,19 @@ class GBDT:
         self._comm_hlo_history = {}
         self._comm_hlo_sigs = {}
 
+    def _step_budget_args(self) -> Tuple[jax.Array, jax.Array]:
+        """(leaf_budget, depth_budget) — the ACTUAL tree budgets as traced
+        i32 scalars for the bucketed step ladder. Device scalars are cached
+        per value so steady-state iterations re-feed the same arrays
+        (passed on the exact-keyed path too, where the growers ignore them
+        — dead args keep one call signature per mode)."""
+        vals = (int(self.max_leaves), int(self._max_depth_cfg))
+        cached = getattr(self, "_budget_cache", None)
+        if cached is None or cached[0] != vals:
+            self._budget_cache = (vals, (jnp.asarray(vals[0], jnp.int32),
+                                         jnp.asarray(vals[1], jnp.int32)))
+        return self._budget_cache[1]
+
     def _build_step_fn(self):
         """One fused, jitted train step per tree: mask gradients, grow, renew,
         shrink, update the train score — a single XLA program, zero host syncs
@@ -945,7 +1025,10 @@ class GBDT:
         nan_bin_arr = self.nan_bin_arr
         has_nan_arr = self.has_nan_arr
         is_cat_arr = self.is_cat_arr
-        max_leaves = self.max_leaves
+        # leaf-array length of the grown trees: the RUNG under the step
+        # ladder (renew scatters and liveness masks must match the
+        # grower's padded leaf arrays, not the user's leaf count)
+        max_leaves = self.grower_params.num_leaves
 
         mono_types = self._mono_types
         inter_sets = self._inter_sets
@@ -960,7 +1043,7 @@ class GBDT:
 
         def step(binned, score_k, grad_k, hess_k, mask, feat_mask,
                  shrinkage, bynode_key, cegb_used, true_grad_k, true_hess_k,
-                 extra_key, cegb_charged):
+                 extra_key, cegb_charged, leaf_budget, depth_budget):
             # binned is an argument, not a closure: multi-process global
             # arrays cannot be captured as jit constants
             # grad_k/hess_k arrive already quantized when use_quantized_grad
@@ -975,14 +1058,16 @@ class GBDT:
                     mono_types, inter_sets, bynode_key, cegb_coupled,
                     cegb_used, extra_key, feature_contri,
                     self._forced_splits, cegb_lazy=self._cegb_lazy,
-                    cegb_charged0=cegb_charged)
+                    cegb_charged0=cegb_charged, leaf_budget=leaf_budget,
+                    depth_budget=depth_budget)
             else:
                 tree, row_leaf = grow_tree(
                     binned, g, h, mask, num_bins_arr, nan_bin_arr,
                     has_nan_arr, is_cat_arr, feat_mask, grower_params,
                     mono_types, inter_sets, bynode_key, cegb_coupled,
                     cegb_used, extra_key, feature_contri,
-                    self._forced_splits)
+                    self._forced_splits, leaf_budget=leaf_budget,
+                    depth_budget=depth_budget)
             if use_cegb:
                 cegb_used = _tree_used_features(tree, binned.shape[1],
                                                 cegb_used)
@@ -1304,7 +1389,8 @@ class GBDT:
         n = self._compact["nl"]          # per-shard rows (serial: all rows)
         n_real_g = self._n_real
         rid_off = (self._compact["layout"].extra_off + 4 * self._cx_rowid)
-        max_leaves = self.max_leaves
+        # rung-sized leaf arrays under the step ladder (see _build_step_fn)
+        max_leaves = gp.num_leaves
         num_bins_arr = self.num_bins_arr
         nan_bin_arr = self.nan_bin_arr
         has_nan_arr = self.has_nan_arr
@@ -1401,7 +1487,7 @@ class GBDT:
 
         def step(work, scratch, scores, bag_w, use_stored_bag, feat_mask,
                  shrinkage, bynode_key, cegb_used, quant_key, extra_key,
-                 ext_g=None, ext_h=None, *, k):
+                 leaf_budget, depth_budget, ext_g=None, ext_h=None, *, k):
             pad_n = work.shape[0] - n
 
             w_col = jnp.where(use_stored_bag, col(work, layout.cnt_off),
@@ -1475,7 +1561,8 @@ class GBDT:
                 work, scratch, num_bins_arr, nan_bin_arr, has_nan_arr,
                 is_cat_arr, feat_mask, layout, gp, n,
                 mono_types, inter_sets, bynode_key, cegb_coupled, cegb_used,
-                extra_key, feature_contri, efb, quant_scales=quant_scales)
+                extra_key, feature_contri, efb, quant_scales=quant_scales,
+                leaf_budget=leaf_budget, depth_budget=depth_budget)
             if use_cegb:
                 cegb_used = _tree_used_features(tree, layout.num_features,
                                                 cegb_used)
@@ -1559,7 +1646,7 @@ class GBDT:
         krow = P(None, DATA_AXIS)
         rep = P()
         in_specs = (row2, row2, krow, P(DATA_AXIS), rep, rep, rep, rep,
-                    rep, rep, rep)
+                    rep, rep, rep, rep, rep)
         if ext_grads:
             in_specs = in_specs + (P(DATA_AXIS), P(DATA_AXIS))
         # outputs: (tree pytree — replicated, work, scratch, scores,
@@ -1672,7 +1759,7 @@ class GBDT:
                 self._cegb_state(),
                 jax.random.fold_in(self._quant_key, self.iter_),
                 jax.random.fold_in(self._extra_key, self.num_total_trees),
-                *ext_args, k=k)
+                *self._step_budget_args(), *ext_args, k=k)
             c["work"], c["scratch"] = work, scratch
             c["epoch"] += 1
             self.train_score = scores
@@ -2012,7 +2099,7 @@ class GBDT:
                 self._cegb_state(),
                 true_grad[cur_tree_id], true_hess[cur_tree_id],
                 jax.random.fold_in(self._extra_key, self.num_total_trees),
-                self._cegb_charged_state())
+                self._cegb_charged_state(), *self._step_budget_args())
             if self._linear:
                 split_ok = self._linear_tree_iter(
                     tree, row_leaf, true_grad[cur_tree_id],
@@ -2159,10 +2246,11 @@ class GBDT:
             return tree
         residual = obj.label - self.train_score[cur_tree_id]
         w = mask if self.row_weight is None else mask * self.row_weight
+        rung = self.grower_params.num_leaves
         renewed = renew_leaf_quantile(
-            residual, w, row_leaf, self.max_leaves, float(obj.renew_alpha))
+            residual, w, row_leaf, rung, float(obj.renew_alpha))
         # only leaves that exist keep renewed values (others stay at 0)
-        live = jnp.arange(self.max_leaves) < tree.num_leaves
+        live = jnp.arange(rung) < tree.num_leaves
         return tree._replace(
             leaf_value=jnp.where(live, renewed, tree.leaf_value))
 

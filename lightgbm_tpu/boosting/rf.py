@@ -61,6 +61,7 @@ class RF(GBDT):
             mask = jnp.ones((n,), jnp.float32)
         feat_mask = self._feature_mask()
         n_prev = float(self.iter_)
+        leaf_budget, depth_budget = self._step_budget_args()
 
         for cur_tree_id in range(k):
             g = grad[cur_tree_id] * mask
@@ -75,6 +76,7 @@ class RF(GBDT):
                 self._cegb_coupled, self._cegb_state(),
                 _jax.random.fold_in(self._extra_key, self.num_total_trees),
                 self._feature_contri, self._forced_splits,
+                leaf_budget=leaf_budget, depth_budget=depth_budget,
             )
             if self._use_cegb:
                 from .gbdt import _tree_used_features
@@ -130,7 +132,8 @@ class RF(GBDT):
         from ..ops.renew import renew_leaf_quantile
         residual = obj.label - self._init_scores[cur_tree_id]
         w = mask if self.row_weight is None else mask * self.row_weight
+        rung = self.grower_params.num_leaves   # rung-sized leaf arrays
         renewed = renew_leaf_quantile(
-            residual, w, row_leaf, self.max_leaves, float(obj.renew_alpha))
-        live = jnp.arange(self.max_leaves) < tree.num_leaves
+            residual, w, row_leaf, rung, float(obj.renew_alpha))
+        live = jnp.arange(rung) < tree.num_leaves
         return tree._replace(leaf_value=jnp.where(live, renewed, tree.leaf_value))

@@ -202,6 +202,25 @@ PARAMS: Dict[str, Tuple[Any, type, Tuple[str, ...]]] = {
     # axis + best-split all-gather vs full-histogram all-reduce
     # (ops/grower_compact.py hist_scatter)
     "tpu_hist_scatter": ("auto", str, ()),  # auto | on | off
+    # bucketed grower-step ladder (compile-once training): the step
+    # program's jit key carries the power-of-two leaf RUNG and the
+    # {unlimited, bounded} depth bucket instead of the exact
+    # (num_leaves, max_depth) pair — actual budgets ride as traced
+    # scalars, so a full run compiles O(1) step programs and every
+    # config in a rung shares one persistent-cache entry
+    # (ops/grower.py leaf_rung/depth_rung). off = exact-keyed parity path
+    "tpu_step_buckets": ("auto", str, ("step_buckets",)),  # auto | on | off
+    # persistent XLA compilation cache: resumed/checkpointed runs and
+    # repeated bench rounds skip backend compilation entirely
+    # (jax_compilation_cache_dir; hits/misses counted by
+    # analysis/guards.cache_counter and recorded in BENCH rows)
+    "tpu_compile_cache_dir": ("", str, ("compile_cache_dir",)),
+    # async histogram-collective overlap (data-parallel / voting): build
+    # each leaf histogram in 2 feature groups and reduce each group
+    # separately — group g's psum_scatter/all-reduce issues while group
+    # g+1 still accumulates (double-buffered hist slots); collective
+    # bytes unchanged, trees bit-identical (ops/grower_compact.py)
+    "tpu_hist_overlap": ("auto", str, ("hist_overlap",)),  # auto | on | off
     # fused per-split Mosaic kernel (partition + smaller-child histogram in
     # one streamed walk, ops/fused_split.py): auto = on with a TPU backend
     "tpu_fused": ("auto", str, ()),         # auto | on | off

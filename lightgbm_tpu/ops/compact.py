@@ -333,6 +333,8 @@ def segment_histogram(
     acc_bits: int = 32,
     quant_max: int = 127,
     hist_layout: str = "lane",
+    feat_idx=None,           # static int sequence: stored columns to build
+    chunk_f: int = 0,        # feature width the row-chunk size derives from
 ) -> jnp.ndarray:            # [F, B, 4] f32 (int32 when quantized)
     """Histogram of one contiguous leaf segment, streamed in fixed blocks.
 
@@ -354,6 +356,14 @@ def segment_histogram(
     where leaf bounds allow (ops/histogram.py _xla_histogram_narrow;
     reference: GetHistBitsInLeaf). ``layout.packed4`` streams nibble-packed
     bin bytes and unpacks per block inside histogram_block.
+
+    ``feat_idx`` restricts the build to a feature GROUP (hist_overlap):
+    only those stored columns are histogrammed, in the given order, so
+    the distributed grower can issue one collective per group while the
+    next group's walk still accumulates. ``chunk_f`` then pins the XLA
+    engines' row-chunk size to the FULL feature width — the group build
+    keeps the full-width call's accumulation order and stays
+    bit-identical to the corresponding slice of the ungrouped histogram.
     """
     from .histogram import histogram_block
 
@@ -361,6 +371,12 @@ def segment_histogram(
     b = num_bins
     bs = block_size
     c = work.shape[1]
+    if feat_idx is not None:
+        if layout.packed4:
+            raise ValueError("feat_idx feature groups need byte-addressed "
+                             "bin columns; packed4 layouts build ungrouped")
+        feat_idx = jnp.asarray(feat_idx, jnp.int32)
+        f = int(feat_idx.shape[0])
     nblocks = (count + bs - 1) // bs
     iota = jnp.arange(bs, dtype=jnp.int32)
 
@@ -380,10 +396,14 @@ def segment_histogram(
             cw = (cw != 0.0).astype(jnp.float32)
             chans = jnp.stack([g * valid, h * valid, cw * valid, valid],
                               axis=1)
+        cols = blk[:, :layout.feat_cols]
+        if feat_idx is not None:
+            cols = jnp.take(cols, feat_idx, axis=1)
         acc = acc + histogram_block(
-            blk[:, :layout.feat_cols], chans, b, impl=impl, mbatch=mbatch,
+            cols, chans, b, impl=impl, mbatch=mbatch,
             packed4_features=f if layout.packed4 else 0,
-            layout=hist_layout, acc_bits=acc_bits, quant_max=quant_max)
+            layout=hist_layout, acc_bits=acc_bits, quant_max=quant_max,
+            chunk_f=chunk_f)
         return j + 1, acc
 
     acc0 = jnp.zeros((f, b, 4), jnp.int32 if quantized else jnp.float32)

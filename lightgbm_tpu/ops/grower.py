@@ -44,9 +44,44 @@ from jax import lax
 
 from .histogram import histogram
 from .split import (SplitParams, SplitResult, best_split, child_output,
-                    go_left_pred, leaf_output)
+                    depth_gate, go_left_pred, leaf_output)
 
 _NEG_INF = -1e30
+
+# rescan PRNG domain separator (compact grower's monotone-intermediate
+# rescan): a fixed first fold keeps the extra_trees rescan draws
+# independent of the leaf-array size — a rung-padded program
+# (step_buckets) draws the same thresholds as the exact-keyed one — and
+# out of the node-draw fold domain (direct folds stay <= 2*num_leaves+2
+# < this for every legal num_leaves)
+_RESCAN_FOLD_STRIDE = 1 << 20
+
+
+def leaf_rung(num_leaves: int) -> int:
+    """Power-of-two leaf-count rung of the bucketed step ladder.
+
+    The grower's per-leaf state arrays (histogram cache, best-split cache,
+    segment table) and its ``fori_loop`` trip count are sized by the jit
+    key's ``num_leaves``; keying on the RUNG instead of the exact count
+    means every ``num_leaves`` in (rung/2, rung] lowers the same program —
+    inactive leaves are masked segments with zero-weight histograms, and
+    the actual budget rides as a traced scalar (``leaf_budget``)."""
+    r = 2
+    while r < num_leaves:
+        r *= 2
+    return r
+
+
+def depth_rung(max_depth: int) -> int:
+    """Depth bucket of the step-ladder key.
+
+    Training programs carry no depth-dependent shapes (depth only gates
+    candidate gains), so the depth axis of the ladder collapses to two
+    buckets: -1 = unlimited (the gate compiles away), +1 = bounded (the
+    actual bound is the traced ``depth_budget``). That is the <= O(log
+    max_depth) end of the compile-budget contract — one bounded-depth
+    program per leaf rung, not one per max_depth value."""
+    return -1 if max_depth <= 0 else 1
 
 
 class GrowerParams(NamedTuple):
@@ -151,6 +186,18 @@ class GrowerParams(NamedTuple):
     # (ReduceScatter + SyncUpGlobalBestSplit,
     # data_parallel_tree_learner.cpp:223-300)
     hist_scatter: int = 0
+    # bucketed step ladder (tpu_step_buckets): ``num_leaves`` holds the
+    # power-of-two LEAF RUNG (leaf_rung) and ``max_depth`` the DEPTH
+    # BUCKET (depth_rung: -1 unlimited / +1 bounded); the actual budgets
+    # arrive as the traced scalars (leaf_budget, depth_budget), so one
+    # program serves every (num_leaves, max_depth) in the rung
+    step_buckets: bool = False
+    # async histogram-collective overlap (tpu_hist_overlap): > 1 = build
+    # the local histogram in that many feature groups and reduce each
+    # group separately, issuing group g's psum_scatter/all-reduce while
+    # group g+1 still accumulates (double-buffered hist slots) — comm
+    # hides under the contraction, collective bytes unchanged
+    hist_overlap: int = 0
 
     def split_params(self) -> SplitParams:
         return SplitParams(
@@ -259,7 +306,7 @@ class GrowerState(NamedTuple):
 def _leaf_best_split(hist3, pg, ph, pc, feat_info, feat_mask, depth,
                      params: GrowerParams, mono_types=None, cmin=None,
                      cmax=None, pout=0.0, cegb_pen=None, extra_key=None,
-                     feature_contri=None):
+                     feature_contri=None, depth_budget=None):
     num_bins_arr, nan_bin_arr, has_nan_arr, is_cat_arr = feat_info
     sp = best_split(
         hist3, pg, ph, pc,
@@ -267,8 +314,8 @@ def _leaf_best_split(hist3, pg, ph, pc, feat_info, feat_mask, depth,
         params.split_params(), mono_types, cmin, cmax, pout, depth, cegb_pen,
         extra_key, feature_contri,
     )
-    depth_ok = jnp.logical_or(params.max_depth <= 0, depth < params.max_depth)
-    return sp._replace(gain=jnp.where(depth_ok, sp.gain, _NEG_INF))
+    return sp._replace(gain=depth_gate(sp.gain, depth, params.max_depth,
+                                       depth_budget))
 
 
 def node_feature_mask(feat_mask, used, inter_sets, key, params):
@@ -312,14 +359,32 @@ def grow_tree(
     forced: Optional[tuple] = None,   # (leaf[J], feature[J], bin[J]) arrays
     cegb_lazy: Optional[jax.Array] = None,     # [F] tradeoff*lazy costs
     cegb_charged0: Optional[jax.Array] = None,  # [F, N] bool (persisted)
+    leaf_budget: Optional[jax.Array] = None,   # i32 actual leaf budget
+    depth_budget: Optional[jax.Array] = None,  # i32 actual depth bound
 ):
     """Grow one tree; returns (TreeArrays, row_leaf [N] i32), plus the
     updated [F, N] charged-rows bitmap when ``cegb_lazy`` is set (lazy
     feature penalties persist per (row, feature) across the whole model —
     reference: feature_used_in_data_, cost_effective_gradient_boosting
-    .hpp:62,125)."""
+    .hpp:62,125).
+
+    ``params.step_buckets``: ``params.num_leaves`` is the power-of-two
+    rung and ``leaf_budget``/``depth_budget`` carry the ACTUAL budgets as
+    traced scalars — rounds past the leaf budget are masked no-ops and
+    the padded leaves stay zero-weight segments, so the grown tree is
+    bit-identical to the exact-keyed program while the jit key stays on
+    (rung, depth bucket, mode, dtype)."""
     n, f = binned.shape
     L = params.num_leaves
+    if params.step_buckets and leaf_budget is None:
+        raise ValueError("params.step_buckets needs the traced leaf_budget "
+                         "(the rung is the jit key, not the leaf count)")
+    if params.step_buckets and params.max_depth > 0 and depth_budget is None:
+        raise ValueError("params.step_buckets with the bounded depth "
+                         "bucket needs the traced depth_budget (max_depth "
+                         "is the bucket sentinel, not the actual bound)")
+    dbudget = depth_budget if (params.step_buckets
+                               and params.max_depth > 0) else None
     use_lazy = cegb_lazy is not None
     if use_lazy and cegb_charged0 is None:
         cegb_charged0 = jnp.zeros((f, n), bool)
@@ -334,18 +399,29 @@ def grow_tree(
     # slice per split instead of a strided column gather from [N, F])
     binned_t = binned.T
 
+    # voting with 2k >= F elects every feature — the vote is a no-op, so
+    # the grower must run the data-parallel program EXACTLY (same
+    # histogram chunking, same parent-minus-smaller subtraction): the
+    # fresh-both-children voting variant rounds its f32 sums differently
+    # and the last-ulp gain noise flips split tie-breaks vs the data
+    # learner (the pre-PR-8 tier-1 voting-parity failure)
+    voting_live = (params.voting_k > 0 and params.voting_shards > 1
+                   and min(2 * params.voting_k, f) < f)
+
     def hist3(mask):
         chans = jnp.stack([grad * mask, hess * mask, cnt_weight * mask], axis=1)
-        if params.voting_k > 0 and params.voting_shards > 1:
+        if voting_live:
             from ..parallel.voting import voting_histogram
             return voting_histogram(binned, chans, B, params.voting_shards,
                                     params.voting_k, params.split_params(),
                                     impl=params.hist_impl,
                                     mbatch=params.hist_mbatch,
-                                    layout=params.hist_layout)
+                                    layout=params.hist_layout,
+                                    overlap=params.hist_overlap)
         return histogram(binned, chans, B, ax, impl=params.hist_impl,
                          mbatch=params.hist_mbatch,
-                         layout=params.hist_layout)
+                         layout=params.hist_layout,
+                         overlap=params.hist_overlap)
 
     if mono_types is None:
         mono_types = jnp.zeros((f,), jnp.int8)
@@ -368,7 +444,7 @@ def grow_tree(
         fn = lambda h, pg, ph, pc, fm, cmn, cmx, po, pen, ek: \
             _leaf_best_split(
                 h, pg, ph, pc, feat_info, fm, depth, params, mono_types,
-                cmn, cmx, po, pen, ek, feature_contri)
+                cmn, cmx, po, pen, ek, feature_contri, dbudget)
         return jax.vmap(fn)(h2, pg2, ph2, pc2, fm2, cmin2, cmax2, pout2,
                             cegb_pen2, ek2)
 
@@ -401,7 +477,7 @@ def grow_tree(
         root_hist, root_g, root_h, root_c, feat_info, root_fm,
         jnp.asarray(0, jnp.int32), params, mono_types,
         -big, big, root_out, pen_root,
-        jax.random.fold_in(extra_key, 0), feature_contri,
+        jax.random.fold_in(extra_key, 0), feature_contri, dbudget,
     )
 
     i32 = jnp.int32
@@ -453,6 +529,11 @@ def grow_tree(
         gains = jnp.where(leaf_alive, st.bs_gain, _NEG_INF)
         best_leaf = jnp.argmax(gains).astype(i32)
         valid = gains[best_leaf] > 0.0
+        if params.step_buckets:
+            # rounds past the traced leaf budget are inert — the rung's
+            # remaining iterations run the same program with zero trip
+            # counts, exactly like a post-early-stop round
+            valid = jnp.logical_and(valid, k < leaf_budget - 1)
         applied = jnp.logical_and(valid, jnp.logical_not(st.done))
         done = jnp.logical_or(st.done, jnp.logical_not(valid))
 
@@ -473,6 +554,13 @@ def grow_tree(
             fleaf, ffeat, fbin = forced
             j_forced = fleaf.shape[0]
             is_forced = k < j_forced
+            if params.step_buckets:
+                # forced splits must respect the traced budget too: the
+                # rung loop runs rounds the exact-keyed num_leaves-1 loop
+                # never had, and an ungated is_forced would re-enable
+                # `applied` past leaf_budget (e.g. a forced schedule
+                # parsed under a larger pre-reset_parameter num_leaves)
+                is_forced = jnp.logical_and(is_forced, k < leaf_budget - 1)
             kf = jnp.minimum(k, j_forced - 1)
             best_leaf = jnp.where(is_forced, fleaf[kf], best_leaf)
             f_ = jnp.where(is_forced, ffeat[kf], f_)
@@ -628,7 +716,7 @@ def grow_tree(
         def compute_children(bs):
             (leaf_hist, bs_gain, bs_feature, bs_bin, bs_dl, bs_lg, bs_lh,
              bs_lc, bs_bits, bs_catl2) = bs
-            if params.voting_k > 0 and params.voting_shards > 1:
+            if voting_live:
                 # voting elects a DIFFERENT feature subset per histogram
                 # (unvoted features are zeroed), so parent-minus-smaller
                 # subtraction would mix inconsistent elected sets — build

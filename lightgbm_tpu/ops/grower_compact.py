@@ -41,8 +41,8 @@ from jax import lax
 from .compact import (RowLayout, partition_segment, segment_histogram,
                       segments_to_leaf_vectors)
 from .fused_split import fused_split
-from .grower import GrowerParams, TreeArrays, _NEG_INF
-from .split import (apply_efb_bitset, best_split, child_output,
+from .grower import _RESCAN_FOLD_STRIDE, GrowerParams, TreeArrays, _NEG_INF
+from .split import (apply_efb_bitset, best_split, child_output, depth_gate,
                     extend_hist_efb, leaf_output, left_rows_of_split)
 
 
@@ -131,6 +131,8 @@ def grow_tree_compact(
     efb=None,   # (col_of_ext, route_cat_ext, off_ext, nb_ext, dbin_ext,
     #              orig_of_ext) — see io/efb.py / gbdt._setup_efb
     quant_scales=None,   # (g_scale, h_scale) traced f32 (params.quant_hist)
+    leaf_budget=None,    # i32 traced actual leaf budget (step_buckets)
+    depth_budget=None,   # i32 traced actual depth bound (step_buckets)
 ):
     """Grow one tree; returns (TreeArrays, row_leaf [N], work', scratch',
     leaf_start [L], leaf_nrows [L]) — per-row outputs in the post-tree
@@ -156,6 +158,15 @@ def grow_tree_compact(
     n = n_real
     L = params.num_leaves
     B = params.num_bins
+    if params.step_buckets and leaf_budget is None:
+        raise ValueError("params.step_buckets needs the traced leaf_budget "
+                         "(the rung is the jit key, not the leaf count)")
+    if params.step_buckets and params.max_depth > 0 and depth_budget is None:
+        raise ValueError("params.step_buckets with the bounded depth "
+                         "bucket needs the traced depth_budget (max_depth "
+                         "is the bucket sentinel, not the actual bound)")
+    dbudget = depth_budget if (params.step_buckets
+                               and params.max_depth > 0) else None
     if layout.packed4 and B > 16:
         raise ValueError(
             f"RowLayout.packed4 needs every bin value to fit a nibble "
@@ -283,11 +294,15 @@ def grow_tree_compact(
         if params.efb_virtual:
             # a bundled winner routes as a ready-made bitset on its column
             sp = apply_efb_bitset(sp, efb, F, B)
-        depth_ok = jnp.logical_or(params.max_depth <= 0,
-                                  depth < params.max_depth)
-        return sp._replace(gain=jnp.where(depth_ok, sp.gain, _NEG_INF))
+        return sp._replace(gain=depth_gate(sp.gain, depth, params.max_depth,
+                                           dbudget))
 
-    def seg_hist(work, start, count):
+    def seg_hist(work, start, count, cols=None):
+        # ``cols``: static stored-column subset of a hist_overlap feature
+        # group; chunk_f pins the engines' row chunking to the full width
+        # so the group build matches the ungrouped histogram bitwise
+        chunk_f = F if cols is not None else 0
+
         def hist_with(acc_bits):
             def fn(args):
                 w, s_, c_ = args
@@ -296,7 +311,8 @@ def grow_tree_compact(
                     params.hist_impl, quantized=quant,
                     mbatch=params.hist_mbatch, acc_bits=acc_bits,
                     quant_max=params.quant_max,
-                    hist_layout=params.hist_layout)
+                    hist_layout=params.hist_layout,
+                    feat_idx=cols, chunk_f=chunk_f)
             return fn
 
         if quant and params.quant_narrow:
@@ -311,6 +327,89 @@ def grow_tree_compact(
                             (work, start, count))
         return hist_with(32)((work, start, count))
 
+    # ---- async histogram-collective overlap (tpu_hist_overlap) ----
+    # Build the per-leaf histogram in G feature groups and reduce each
+    # group with its OWN collective, issued while the next group's walk
+    # still accumulates — XLA's async scheduler hides the psum/
+    # psum_scatter under the remaining MXU contraction. Grouping never
+    # changes which shard-local addends reach an element, so trees stay
+    # bit-identical and total collective bytes are unchanged.
+    G = params.hist_overlap if (ax and params.hist_overlap > 1) else 0
+    if G:
+        from .histogram import overlap_groups
+        _gb = overlap_groups(F_h, G)      # bounds over the owned width
+        if len(_gb) < 2:
+            G = 0                          # one feature: nothing to group
+    # the fused Mosaic kernel and packed4 walks produce the local
+    # histogram whole — they keep the single build and group only the
+    # reduction (collective-collective pipelining, no compute overlap)
+    grouped_build = bool(G) and not params.fused_block \
+        and not layout.packed4
+
+    def _reduce_group(part):
+        if scatter:
+            return lax.psum_scatter(part, ax, scatter_dimension=0,
+                                    tiled=True)
+        return lax.psum(part, ax)
+
+    def _grouped_reduce(local):
+        """reduce_hist with one collective per feature group (the
+        precomputed-local path: fused kernel / packed4 walks)."""
+        parts = []
+        if scatter:
+            padded = jnp.pad(local, ((0, f_pad_sc), (0, 0), (0, 0))) \
+                if f_pad_sc else local
+            resh = padded.reshape(S_sc, F_loc, B, 4)
+            for lo, hi in _gb:
+                parts.append(_reduce_group(
+                    resh[:, lo:hi].reshape(S_sc * (hi - lo), B, 4)))
+        else:
+            for lo, hi in _gb:
+                parts.append(_reduce_group(local[lo:hi]))
+        return jnp.concatenate(parts, axis=0)
+
+    def reduce_any(local):
+        return _grouped_reduce(local) if G else reduce_hist(local)
+
+    def seg_hist_reduced(work, start, count):
+        """(local [F, B, 4], reduced [F_h, B, 4]) histogram of one leaf
+        segment. Under hist_overlap each feature group's collective is
+        constructed right after that group's streamed walk, dependence-
+        free of the later groups — the overlap the reference gets from
+        its socket ReduceScatter running beside the next group's kernel
+        (data_parallel_tree_learner.cpp:223-300)."""
+        if not grouped_build:
+            loc = seg_hist(work, start, count)
+            return loc, reduce_any(loc)
+        parts_loc, parts_red, all_cols = [], [], []
+        for lo, hi in _gb:
+            if scatter:
+                # group g owns sub-range [lo, hi) of EVERY shard's feature
+                # slice, so the reassembled scatter output keeps the
+                # ownership map (shard i <-> global [i*F_loc, (i+1)*F_loc))
+                pos = [i * F_loc + t
+                       for i in range(S_sc) for t in range(lo, hi)]
+                cols = [p for p in pos if p < F]
+            else:
+                pos = cols = list(range(lo, hi))
+            loc_g = seg_hist(work, start, count, cols=tuple(cols))
+            part = loc_g
+            if len(cols) < len(pos):
+                # pad features (scatter rounding) carry zero histograms
+                idx = [j for j, p in enumerate(pos) if p < F]
+                part = jnp.zeros((len(pos), B, 4), loc_g.dtype) \
+                    .at[jnp.asarray(idx, i32)].set(loc_g)
+            parts_loc.append(loc_g)
+            all_cols.extend(cols)
+            parts_red.append(_reduce_group(part))
+        loc_cat = jnp.concatenate(parts_loc, axis=0)
+        if scatter:
+            loc_full = jnp.zeros((F, B, 4), loc_cat.dtype) \
+                .at[jnp.asarray(all_cols, i32)].set(loc_cat)
+        else:
+            loc_full = loc_cat
+        return loc_full, jnp.concatenate(parts_red, axis=0)
+
     # ---- root ----
     if params.fused_block:
         # hist-only mode of the fused Mosaic kernel (ops/fused_split.py)
@@ -321,12 +420,14 @@ def grow_tree_compact(
             interpret=params.fused_interpret, dual=params.fused_dual,
             hist_debug=params.fused_hist_debug, num_rows=n, quant=quant,
             mbatch=params.hist_mbatch, hist_layout=params.hist_layout)
+        root_hist = reduce_any(root_loc)
     else:
-        root_loc = seg_hist(work, jnp.asarray(0, i32), jnp.asarray(n, i32))
-    # data-parallel: histograms reduce over the mesh axis (reference: the
-    # ReduceScatter of per-feature histograms, data_parallel_tree_learner
-    # .cpp:223-300); split decisions then replicate bit-identically
-    root_hist = reduce_hist(root_loc)
+        # data-parallel: histograms reduce over the mesh axis (reference:
+        # the ReduceScatter of per-feature histograms,
+        # data_parallel_tree_learner.cpp:223-300); split decisions then
+        # replicate bit-identically
+        root_loc, root_hist = seg_hist_reduced(
+            work, jnp.asarray(0, i32), jnp.asarray(n, i32))
     # every feature's bins sum to the global totals (each row lands in
     # exactly one bin per feature), so feature 0 gives the root sums;
     # under hist_scatter the shard's slice may be all padding, so the
@@ -421,6 +522,11 @@ def grow_tree_compact(
         gains = jnp.where(leaf_alive, st.bs_gain, _NEG_INF)
         best_leaf = jnp.argmax(gains).astype(i32)
         valid = gains[best_leaf] > 0.0
+        if params.step_buckets:
+            # rounds past the traced leaf budget are inert: the rung's
+            # remaining iterations stream zero-trip partition/histogram
+            # walks, exactly like a post-early-stop round
+            valid = jnp.logical_and(valid, k < leaf_budget - 1)
         applied = jnp.logical_and(valid, jnp.logical_not(st.done))
         done = jnp.logical_or(st.done, jnp.logical_not(valid))
 
@@ -634,12 +740,13 @@ def grow_tree_compact(
         parent_hist = st.leaf_hist[best_leaf].reshape(F_h, B, 4)
         if params.fused_block:
             hist_small_loc = hist_small_fused
+            hist_small = reduce_any(hist_small_loc)
         else:
             s_small = jnp.where(left_smaller, s_, s_ + n_left_loc)
             m_small = jnp.where(left_smaller, n_left_eff,
                                 m_eff - n_left_eff)
-            hist_small_loc = seg_hist(work, s_small, m_small)
-        hist_small = reduce_hist(hist_small_loc)
+            hist_small_loc, hist_small = seg_hist_reduced(
+                work, s_small, m_small)
         hist_large = parent_hist - hist_small
         hist_left = jnp.where(left_smaller, hist_small, hist_large)
         hist_right = jnp.where(left_smaller, hist_large, hist_small)
@@ -879,7 +986,18 @@ def grow_tree_compact(
                         leaf_hess[i], leaf_cnt[i], leaf_depth[i],
                         leaf_fmask[i], cmn_a[i], cmx_a[i], leaf_pout[i],
                         pen_cur,
-                        jax.random.fold_in(extra_key, (3 + k) * L + i))
+                        # chained fold under a fixed domain separator:
+                        # rescan draws must not depend on the leaf-array
+                        # size, or a rung-padded program (step_buckets)
+                        # would draw different extra_trees thresholds than
+                        # the exact-keyed one; folding (separator, k, i)
+                        # stepwise instead of a (3+k)*stride+i product
+                        # keeps traced-i32 arithmetic in range at any
+                        # num_leaves and cannot re-enter the node-draw
+                        # fold domain (2k+2 < the separator)
+                        jax.random.fold_in(jax.random.fold_in(
+                            jax.random.fold_in(
+                                extra_key, _RESCAN_FOLD_STRIDE), k), i))
                     return (sp.gain, sp.feature, sp.bin, sp.default_left,
                             sp.left_grad, sp.left_hess, sp.left_count,
                             sp.left_rows.astype(i32), sp.cat_bitset,

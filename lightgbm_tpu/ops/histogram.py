@@ -44,7 +44,8 @@ def _chunk_rows(n: int, f: int, b: int) -> int:
     return max(128, min(c, max(128, n)))
 
 
-def _xla_histogram(binned, channels, num_bins: int, mbatch: int = 1):
+def _xla_histogram(binned, channels, num_bins: int, mbatch: int = 1,
+                   chunk_f: int = 0):
     n, f = binned.shape
     k = channels.shape[1]
     b = num_bins
@@ -52,8 +53,12 @@ def _xla_histogram(binned, channels, num_bins: int, mbatch: int = 1):
     # design): the XLA engine's analogue of staging K row blocks per MXU
     # issue is contracting K chunks of rows in ONE einsum — the scan trip
     # count drops K-fold and XLA sees a K-times-deeper contraction to
-    # tile, instead of K back-to-back launches over small one-hots
-    chunk = _chunk_rows(n, f, b) * max(1, int(mbatch))
+    # tile, instead of K back-to-back launches over small one-hots.
+    # ``chunk_f`` overrides the feature count the row-chunk size derives
+    # from: a feature-GROUP call (hist_overlap) must keep the full-width
+    # call's chunk boundaries, or the f32 accumulation order changes and
+    # the grouped histogram stops being bit-identical to the full one
+    chunk = _chunk_rows(n, chunk_f or f, b) * max(1, int(mbatch))
     chunk = max(128, min(chunk, -(-max(n, 1) // 128) * 128))
     iota = jnp.arange(b, dtype=jnp.int32)
 
@@ -119,7 +124,8 @@ def narrow_chunk_rows(quant_max: int) -> int:
     return c if c >= 128 else 0
 
 
-def _xla_histogram_narrow(binned, channels, num_bins: int, quant_max: int):
+def _xla_histogram_narrow(binned, channels, num_bins: int, quant_max: int,
+                          chunk_f: int = 0):
     """16-bit narrowed quantized histogram (reference: the narrow hist-bits
     mode of GradientDiscretizer::GetHistBitsInLeaf + the 16-bit packed
     gradient-hessian histogram entries, gradient_discretizer.cpp).
@@ -144,7 +150,7 @@ def _xla_histogram_narrow(binned, channels, num_bins: int, quant_max: int):
             f"acc_bits=16 needs quant_max <= {(_NARROW_RADIX - 1) // 128} "
             f"(got {quant_max}): a 128-row chunk's code sums must stay "
             "below the packing radix")
-    chunk = min(chunk, _chunk_rows(n, f, b))
+    chunk = min(chunk, _chunk_rows(n, chunk_f or f, b))
     iota = jnp.arange(b, dtype=jnp.int32)
     radix = jnp.float32(_NARROW_RADIX)
 
@@ -238,6 +244,7 @@ def histogram_block(
     layout: str = "lane",
     acc_bits: int = 32,
     quant_max: int = 127,
+    chunk_f: int = 0,
 ) -> jax.Array:             # [F, B, K] f32 (int32 for int8 channels)
     """Histogram of one already-sliced row block (no psum, no jit wrapper —
     call sites are inside jitted loops).
@@ -269,7 +276,12 @@ def histogram_block(
     pairs pack into ONE f32 channel each (exact below the packing radix,
     see narrow_chunk_rows), halving the contraction work; ``quant_max``
     must bound |code| (the trainer passes num_grad_quant_bins + 1).
-    Results stay bit-identical int32."""
+    Results stay bit-identical int32.
+
+    ``chunk_f``: feature count the XLA engines derive their row-chunk
+    size from, when the call covers only a feature GROUP of a wider
+    build (hist_overlap) — same chunk boundaries keep the f32 sums
+    bit-identical to the full-width call."""
     if packed4_features:
         from .packed import unpack4
         binned = unpack4(binned, packed4_features)
@@ -280,8 +292,12 @@ def histogram_block(
         # int8 path already accumulates s32 natively, so narrowing buys
         # nothing there; this path wins where integer dots lack fast
         # kernels, e.g. the XLA CPU backend)
-        return _xla_histogram_narrow(binned, channels, num_bins, quant_max)
-    impl = _resolve_impl(impl, num_bins, binned.shape[1])
+        return _xla_histogram_narrow(binned, channels, num_bins, quant_max,
+                                     chunk_f=chunk_f)
+    # resolve 'auto' from the FULL build width when this call covers only
+    # a feature group (chunk_f): engine choice must match the ungrouped
+    # call or the grouped sums lose bit-identity across the f32 engines
+    impl = _resolve_impl(impl, num_bins, chunk_f or binned.shape[1])
     if impl == "pallas":
         from .pallas_histogram import pallas_histogram
         if quantized:
@@ -289,13 +305,26 @@ def histogram_block(
                                     mbatch=mbatch, hist_layout=layout)
         return pallas_histogram(binned, channels, num_bins, mbatch=mbatch,
                                 hist_layout=layout)
-    return _xla_histogram(binned, channels, num_bins, mbatch=mbatch)
+    return _xla_histogram(binned, channels, num_bins, mbatch=mbatch,
+                          chunk_f=chunk_f)
+
+
+def overlap_groups(f: int, overlap: int):
+    """Contiguous feature-group bounds for the async-collective overlap.
+
+    Splits ``f`` features into ``overlap`` near-equal contiguous groups
+    (empty tail groups dropped): the distributed histogram build issues
+    one collective per group as soon as that group's contraction
+    finishes, so group g's reduce rides under group g+1's MXU work."""
+    g = max(1, int(overlap))
+    per = -(-f // g)
+    return [(lo, min(lo + per, f)) for lo in range(0, f, per)]
 
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "axis_name", "impl",
                                     "mbatch", "layout", "acc_bits",
-                                    "quant_max"))
+                                    "quant_max", "overlap"))
 def histogram(
     binned: jax.Array,      # [N, F] uint8/uint16/int32
     channels: jax.Array,    # [N, K] f32
@@ -306,13 +335,34 @@ def histogram(
     layout: str = "lane",
     acc_bits: int = 32,
     quant_max: int = 127,
+    overlap: int = 0,
 ) -> jax.Array:             # [F, B, K] f32
-    """Accumulate per-(feature, bin) sums of ``channels`` columns."""
+    """Accumulate per-(feature, bin) sums of ``channels`` columns.
+
+    ``overlap`` > 1 with an ``axis_name`` builds the histogram in that
+    many contiguous feature groups with ONE psum per group, each issued
+    while the next group still contracts (tpu_hist_overlap) — XLA's
+    async scheduler hides the collective under the remaining MXU work.
+    ``chunk_f`` pins the engines' row-chunk size to the full width, so
+    the grouped sums are bit-identical to the ungrouped ones, and the
+    per-element psum addends are unchanged — same bytes, same result."""
     if impl == "pallas":
         from .pallas_histogram import pallas_available
         if not pallas_available():
             raise RuntimeError(
                 "tpu_hist_impl=pallas requires a TPU backend; use 'xla'")
+    f = binned.shape[1]
+    if axis_name is not None and overlap > 1 and f > 1:
+        parts = []
+        for lo, hi in overlap_groups(f, overlap):
+            part = histogram_block(
+                binned[:, lo:hi], channels, num_bins, impl=impl,
+                mbatch=mbatch, layout=layout, acc_bits=acc_bits,
+                quant_max=quant_max, chunk_f=f)
+            # the reduce of group g is independent of group g+1's
+            # contraction: XLA issues it async (-start/-done twins)
+            parts.append(lax.psum(part, axis_name))
+        return jnp.concatenate(parts, axis=0)
     hist = histogram_block(binned, channels, num_bins, impl=impl,
                            mbatch=mbatch, layout=layout, acc_bits=acc_bits,
                            quant_max=quant_max)

@@ -137,6 +137,22 @@ def child_output(sum_grad, sum_hess, cnt, p: SplitParams, l2=None,
     return w
 
 
+def depth_gate(gain, depth, max_depth: int, depth_budget=None):
+    """Mask a split candidate's gain by the tree-depth limit.
+
+    The exact-keyed path bakes the static ``max_depth`` into the program
+    (the unlimited case compiles away entirely). Under the bucketed step
+    ladder (``GrowerParams.step_buckets``) the jit key carries only the
+    DEPTH BUCKET — ``max_depth`` is -1 (unlimited) or +1 (bounded) — and
+    the actual bound rides as the traced scalar ``depth_budget``, so one
+    program serves every bounded depth at a given leaf rung."""
+    if depth_budget is not None:
+        ok = depth < depth_budget
+    else:
+        ok = jnp.logical_or(max_depth <= 0, depth < max_depth)
+    return jnp.where(ok, gain, _NEG_INF)
+
+
 def monotone_penalty_factor(depth, penalty: float):
     """(reference: ComputeMonotoneSplitGainPenalty,
     monotone_constraints.hpp:357)"""

@@ -46,11 +46,17 @@ def voting_histogram(
     impl: str = "auto",
     mbatch: int = 1,
     layout: str = "lane",
+    overlap: int = 0,
 ) -> jnp.ndarray:              # [F, B, K] f32 (replicated)
     """Histogram with voting-capped communication: only the globally voted
     2k features carry reduced histograms; every other feature's histogram is
     zero (its candidate splits then fail the min_data gate, exactly like the
-    reference never scanning unvoted features)."""
+    reference never scanning unvoted features).
+
+    ``overlap`` > 1 (tpu_hist_overlap) reduces the elected features in
+    that many groups — one cross-shard all-reduce per group instead of a
+    single [2k, B, K] reduce, so the groups' collectives pipeline. Same
+    addends per element: bit-identical results, unchanged total bytes."""
     n, f = binned.shape
     k = chans.shape[1]
     b = num_bins
@@ -58,6 +64,17 @@ def voting_histogram(
     n_local = n // s
     top_k = min(top_k, f)
     k2 = min(2 * top_k, f)
+
+    # NOTE: 2k >= F (a full election) never reaches this function — the
+    # grower's voting_live gate (ops/grower.py hist3) routes it to the
+    # EXACT data-parallel histogram program instead, because the
+    # per-shard vmap'd accumulation below orders its f32 sums differently
+    # from the global chunked einsum and the last-ulp gain noise used to
+    # flip split tie-breaks against the data learner (the pre-PR-8
+    # tier-1 voting-parity failure)
+    if k2 >= f:  # not an assert: must survive python -O
+        raise ValueError("full election (2k >= F) must take the "
+                         "data-parallel histogram")
 
     # per-shard local histograms: the leading axis keeps the row sharding,
     # so this is communication-free under GSPMD
@@ -73,8 +90,17 @@ def voting_histogram(
     sel = jnp.argsort(-score)[:k2]                     # [2k] elected features
 
     # reduce ONLY the elected features' histograms across shards
-    hist_sel = jnp.sum(jnp.take(local, sel, axis=1), axis=0)   # [2k, B, K]
     full = jnp.zeros((f, b, k), jnp.float32)
+    if overlap > 1 and k2 > 1:
+        from ..ops.histogram import overlap_groups
+        for lo, hi in overlap_groups(k2, overlap):
+            sel_g = sel[lo:hi]
+            # each group's cross-shard sum is an independent all-reduce:
+            # XLA pipelines group g's collective under group g+1's gather
+            hist_g = jnp.sum(jnp.take(local, sel_g, axis=1), axis=0)
+            full = full.at[sel_g].set(hist_g)
+        return full
+    hist_sel = jnp.sum(jnp.take(local, sel, axis=1), axis=0)   # [2k, B, K]
     return full.at[sel].set(hist_sel)
 
 

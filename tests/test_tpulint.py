@@ -316,6 +316,84 @@ def test_r002_interprocedural_static_helper_not_flagged(tmp_path):
     assert "R002" in codes(dirty)
 
 
+def test_r002_unbucketed_grower_key(tmp_path):
+    """Sub-check (e) seed: the raw config (num_leaves, max_depth) entering
+    the GrowerParams jit key compiles one step program per exact tree
+    shape — the 35-97 s training warmups the bucketed step ladder
+    removed."""
+    findings = lint_snippet(tmp_path, """
+        def setup(cfg):
+            gp = GrowerParams(
+                num_leaves=int(cfg.get("num_leaves", 31)),
+                max_depth=int(cfg.get("max_depth", -1)))
+            return gp
+    """)
+    assert "R002" in codes(findings)
+
+
+def test_r002_rung_mapped_grower_key_clean(tmp_path):
+    """Flowing the budgets through a rung/bucket-named mapping clears the
+    taint: the jit key carries the ladder rung, not the raw budget."""
+    findings = lint_snippet(tmp_path, """
+        def leaf_rung(n):
+            r = 2
+            while r < n:
+                r *= 2
+            return r
+
+        def setup(cfg):
+            rung = leaf_rung(int(cfg.get("num_leaves", 31)))
+            gp = GrowerParams(num_leaves=rung, max_depth=-1)
+            return gp
+    """)
+    assert "R002" not in codes(findings)
+
+
+def test_r002_grower_key_replace_update(tmp_path):
+    """The _replace-style key update (basic.py reset_parameter) is a sink
+    too: re-keying on a raw budget mid-run recompiles just like the
+    initial construction."""
+    findings = lint_snippet(tmp_path, """
+        def reset(self, booster):
+            booster.grower_params = booster.grower_params._replace(
+                num_leaves=int(self.config.num_leaves))
+            return booster
+    """)
+    assert "R002" in codes(findings)
+
+
+def test_r002_jitted_step_fed_raw_budget(tmp_path):
+    """A jitted grower step called with a leaf-count-derived argument keys
+    the program on the exact budget; the rung belongs in the key and the
+    budget in a traced scalar."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+
+        @jax.jit
+        def grow_step(binned, budget):
+            return binned
+
+        def train(binned, cfg):
+            leaves = int(cfg.get("num_leaves", 31))
+            return grow_step(binned, leaves)
+    """)
+    assert "R002" in codes(findings)
+
+
+def test_r002_raw_return_in_rung_mapping(tmp_path):
+    """Sub-check (e) also pins the escape hatch: a rung/bucket mapping
+    returning the raw budget IS the exact-keyed path and must carry an
+    allowlist anchor (the shipped tpu_step_buckets=off branch in
+    gbdt.bucketed_tree_shape does)."""
+    findings = lint_snippet(tmp_path, """
+        def tree_shape_bucket(bucketed, num_leaves, max_depth):
+            if bucketed:
+                return 2 * num_leaves, 1
+            return num_leaves, max_depth
+    """)
+    assert "R002" in codes(findings)
+
+
 # ---------------------------------------------------------------- R003
 def test_r003_dtype_drift(tmp_path):
     findings = lint_snippet(tmp_path, """
