@@ -604,6 +604,164 @@ def run_predict_microbench(print_json=True):
         }))
 
 
+def run_serving_bench(print_json=True):
+    """BENCH_SERVING=1: sustained-QPS sweep through the micro-batch
+    coalescer (lightgbm_tpu/serving/) with mixed request sizes.
+
+    Open-loop offered load: BENCH_SERVING_THREADS client threads pace
+    submissions to each BENCH_SERVING_QPS level for
+    BENCH_SERVING_DURATION_S, cycling BENCH_SERVING_SIZES rows per
+    request, WITHOUT waiting for responses — so queue pressure (and load
+    shedding) is real. Per level: p50/p99 end-to-end latency (submit ->
+    completion, from the ServeFuture timestamps), achieved QPS,
+    shed/timeout rates. The whole traffic phase runs post-warmup under a
+    compile counter — the serving steady state must lower NOTHING
+    (compile_events_steady == 0 is the acceptance gate from ISSUE 9).
+    Results land in BENCH_SHAPES.json["serving"]; a failure emits the
+    structured stub row like every other stage."""
+    import jax
+
+    dev = _init_backend_with_retry(jax)
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.analysis import guards
+    from lightgbm_tpu.serving import ServerOverloaded, ServingTimeout
+
+    train_rows = int(float(os.environ.get("BENCH_SERVING_TRAIN_ROWS",
+                                          20_000)))
+    feats = int(os.environ.get("BENCH_FEATURES", 28))
+    leaves = int(os.environ.get("BENCH_SERVING_LEAVES", 63))
+    rounds = int(os.environ.get("BENCH_SERVING_TREES", 20))
+    ladder = os.environ.get("BENCH_SERVING_BUCKETS", "256,1024,4096")
+    tick_ms = float(os.environ.get("BENCH_SERVING_TICK_MS", 2.0))
+    deadline_ms = float(os.environ.get("BENCH_SERVING_DEADLINE_MS", 2000.0))
+    queue_max = int(os.environ.get("BENCH_SERVING_QUEUE_MAX", 16384))
+    duration_s = float(os.environ.get("BENCH_SERVING_DURATION_S", 3.0))
+    threads = int(os.environ.get("BENCH_SERVING_THREADS", 8))
+    qps_levels = [int(float(q)) for q in os.environ.get(
+        "BENCH_SERVING_QPS", "100,300,1000").split(",")]
+    sizes = [int(s) for s in os.environ.get(
+        "BENCH_SERVING_SIZES", "1,8,64,256").split(",")]
+
+    X, y = make_higgs_like(train_rows, feats)
+    params = {
+        "objective": "binary", "num_leaves": leaves, "max_bin": 63,
+        "learning_rate": 0.1, "min_data_in_leaf": 20, "verbosity": -1,
+        "stop_check_freq": 10_000, "tpu_predict_buckets": ladder,
+    }
+    t0 = time.time()
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params), rounds)
+    sys.stderr.write(f"[bench-serving] trained {rounds} x {leaves}-leaf "
+                     f"trees in {time.time() - t0:.1f}s\n")
+
+    server = bst.serve(tick_ms=tick_ms, queue_max=queue_max,
+                       deadline_ms=deadline_ms)
+    warm = server.registry.warm_stats()
+    sys.stderr.write(f"[bench-serving] warm: rungs={warm['rungs']} "
+                     f"in {warm['seconds']}s ({warm['lowerings']} "
+                     f"lowerings)\n")
+
+    import threading as _threading
+    rng = np.random.RandomState(5)
+    pool = rng.randn(max(sizes), feats).astype(np.float32)
+    levels = {}
+    with guards.compile_counter() as steady_cc:
+        for qps in qps_levels:
+            futs, sheds, misc_errors = [], [0], [0]
+            mu = _threading.Lock()
+            t_end = time.monotonic() + duration_s
+            interval = threads / max(qps, 1)
+
+            def client(idx):
+                k = idx
+                nxt = time.monotonic()
+                while True:
+                    now = time.monotonic()
+                    if now >= t_end:
+                        return
+                    if now < nxt:
+                        time.sleep(min(nxt - now, 0.01))
+                        continue
+                    nxt += interval
+                    size = sizes[k % len(sizes)]
+                    k += threads
+                    try:
+                        f = server.submit(pool[:size])
+                        with mu:
+                            futs.append(f)
+                    except ServerOverloaded:
+                        with mu:
+                            sheds[0] += 1
+                    except Exception:  # noqa: BLE001 - counted below
+                        with mu:
+                            misc_errors[0] += 1
+
+            ts = [_threading.Thread(target=client, args=(i,))
+                  for i in range(threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            # settle: every admitted request completes or times out
+            lat, timeouts, failed, rows_done = [], 0, 0, 0
+            for f in futs:
+                try:
+                    f.result()
+                    lat.append(f.latency_s)
+                    rows_done += f.n
+                except ServingTimeout:
+                    timeouts += 1
+                except Exception:  # noqa: BLE001 - recorded as failure
+                    failed += 1
+            offered = len(futs) + sheds[0] + misc_errors[0]
+            lat_ms = np.asarray(lat) * 1e3 if lat else np.array([])
+            cell = {
+                "offered_qps": round(offered / duration_s, 1),
+                "achieved_qps": round(len(lat) / duration_s, 1),
+                # rows actually served, not completed-count x mean size:
+                # shedding is size-biased (big submits shed first), which
+                # would otherwise overstate rows/s exactly under overload
+                "rows_per_sec": round(rows_done / duration_s),
+                "p50_ms": round(float(np.percentile(lat_ms, 50)), 2)
+                if lat else None,
+                "p99_ms": round(float(np.percentile(lat_ms, 99)), 2)
+                if lat else None,
+                "shed_rate": round(sheds[0] / max(offered, 1), 4),
+                "timeout_rate": round(timeouts / max(offered, 1), 4),
+                "failed": failed + misc_errors[0],
+            }
+            levels[str(qps)] = cell
+            sys.stderr.write(
+                f"[bench-serving] qps={qps}: achieved="
+                f"{cell['achieved_qps']} p50={cell['p50_ms']}ms "
+                f"p99={cell['p99_ms']}ms shed={cell['shed_rate']:.1%} "
+                f"timeout={cell['timeout_rate']:.1%}\n")
+    server.close(drain=True)
+    stats = server.stats
+    sys.stderr.write(f"[bench-serving] steady compile events: "
+                     f"{steady_cc.lowerings} (must be 0); "
+                     f"coalescer stats: {stats}\n")
+    top = levels[str(qps_levels[-1])]
+    _record_shape("serving", {
+        "platform": dev.platform, "trees": rounds, "leaves": leaves,
+        "features": feats, "ladder": warm["rungs"],
+        "tick_ms": tick_ms, "deadline_ms": deadline_ms,
+        "queue_max_rows": queue_max, "sizes": sizes,
+        "duration_s": duration_s, "levels": levels,
+        "warmup": warm,
+        "compile_events_steady": steady_cc.lowerings,
+        "coalescer": stats,
+    })
+    if print_json:
+        print(json.dumps({
+            "metric": f"serving p99 @ {qps_levels[-1]} qps "
+                      f"(mixed sizes {sizes})",
+            "value": top["p99_ms"],
+            "unit": "ms",
+            # acceptance: 0 steady-state compiles; encode it in the row
+            "vs_baseline": steady_cc.lowerings,
+        }))
+
+
 def run_ranking_bench():
     """Lambdarank at MS-LTR scale: pair-block chunking + NDCG under load."""
     import jax
@@ -667,6 +825,8 @@ def _bench_stage() -> str:
         return "hist-micro"
     if os.environ.get("BENCH_PREDICT", "") == "1":
         return "predict-micro"
+    if os.environ.get("BENCH_SERVING", "") == "1":
+        return "serving"
     if os.environ.get("BENCH_RANKING", "") == "1":
         return "ranking"
     return "train"
@@ -694,6 +854,8 @@ def _main(stage=None):
         return run_hist_microbench()
     if stage == "predict-micro":
         return run_predict_microbench()
+    if stage == "serving":
+        return run_serving_bench()
     if stage == "ranking":
         return run_ranking_bench()
     import jax

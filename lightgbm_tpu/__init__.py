@@ -31,6 +31,8 @@ __all__ = [
     "train", "cv", "CVBooster",
     "early_stopping", "log_evaluation", "record_evaluation", "reset_parameter",
     "EarlyStopException", "TrainingInterrupted",
+    "PredictionServer", "ModelRegistry", "ServingError", "ServingTimeout",
+    "ServerOverloaded", "ServerClosed", "SwapFailed",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "plot_importance", "plot_metric", "plot_split_value_histogram",
     "plot_tree", "create_tree_digraph",
@@ -55,6 +57,13 @@ def __getattr__(name):
     if name == "TrainingInterrupted":
         from .parallel.multihost import TrainingInterrupted
         return TrainingInterrupted
+    if name in ("PredictionServer", "ModelRegistry", "ServingError",
+                "ServingTimeout", "ServerOverloaded", "ServerClosed",
+                "SwapFailed"):
+        # serving layer loads lazily: the coalescer thread machinery is
+        # only wanted by processes that actually serve
+        from . import serving as _serving
+        return getattr(_serving, name)
     if name in _PLOTTING:
         from . import plotting as _pl
         return getattr(_pl, name)

@@ -37,6 +37,17 @@ single spec. Sites fired by the production code:
                         (ordinal, 1-based; ``corrupt`` rewrites the file)
 ``bench_update``        bench.py resumable update loop, before each update
                         (``iteration=`` 1-based absolute iteration)
+``request``             serving/server.py submit, before admission control
+                        (ordinal, 1-based)
+``coalesce_tick``       serving/coalescer.py, after a batch is popped and
+                        before it is served (ordinal, 1-based; ``hang`` =
+                        a slow tick, ``kill`` = a dead serving worker)
+``warmup``              Booster.warm_predict_ladder, before each ladder
+                        rung is compiled (ordinal, 1-based)
+``swap``                serving/registry.py, inside the deadline-guarded
+                        hot-swap commit, before the active-model flip
+                        (ordinal, 1-based; a ``hang`` past the swap
+                        deadline must roll back)
 ======================  =====================================================
 
 Injection sites call :func:`active_plan` and ``fire()`` — a no-op
@@ -72,7 +83,7 @@ class FaultSpecError(ValueError):
 
 _KINDS = ("kill", "hang", "transient", "corrupt")
 _SITES = ("iteration", "step", "barrier", "backend_init", "snapshot",
-          "bench_update")
+          "bench_update", "request", "coalesce_tick", "warmup", "swap")
 
 
 @dataclasses.dataclass

@@ -204,6 +204,75 @@ def configure_compile_cache(cache_dir) -> bool:
     return True
 
 
+#: shared device-enumeration probe state: a wedged backend pins exactly
+#: ONE blocked thread process-wide (periodic readiness polling reuses the
+#: in-flight enumeration), and once a backend has come up enumeration is
+#: jax's cached lookup, called inline with no thread at all
+_device_probe = {"mu": threading.Lock(), "thread": None, "box": None,
+                 "initialized": False}
+
+
+def device_healthcheck(deadline_s: float = 5.0) -> dict:
+    """Device-reachability probe for serving health endpoints.
+
+    Returns ``{"ok", "platform", "device_count", "error"}`` without ever
+    raising — and without ever HANGING: ``jax.devices()`` on a fresh
+    process synchronously initializes the backend, which on a wedged TPU
+    runtime blocks for the full init timeout (the BENCH_r05 death mode;
+    on some hosts plugin discovery never returns at all). The first
+    enumeration therefore runs in a single SHARED daemon thread waited
+    on for ``deadline_s``: a blown deadline reports ``ok: False``, and
+    every later probe re-waits on the SAME blocked thread instead of
+    leaking one watchdog worker per poll. After one successful
+    enumeration the backend is cached and the probe calls inline.
+    ``deadline_s <= 0`` disables the watchdog (may block)."""
+
+    def _summarize(devices):
+        if not devices:
+            return {"ok": False, "platform": None, "device_count": 0,
+                    "error": "device enumeration returned an empty list"}
+        _device_probe["initialized"] = True
+        return {"ok": True, "platform": devices[0].platform,
+                "device_count": len(devices), "error": None}
+
+    def _failure(err):
+        msg = str(err).splitlines()[0][:200] if str(err) else repr(err)
+        return {"ok": False, "platform": None, "device_count": 0,
+                "error": msg}
+
+    if _device_probe["initialized"] or not deadline_s or deadline_s <= 0:
+        try:
+            return _summarize(jax.devices())
+        except Exception as err:  # noqa: BLE001 - probe must not raise
+            return _failure(err)
+    with _device_probe["mu"]:
+        thread, box = _device_probe["thread"], _device_probe["box"]
+        if thread is None or not thread.is_alive():
+            # no probe in flight (fresh, or the last one finished and was
+            # consumed): start one
+            box = {"done": threading.Event()}
+
+            def _enumerate(b=box):
+                try:
+                    b["devices"] = jax.devices()
+                except BaseException as err:  # noqa: BLE001 - reported
+                    b["error"] = err
+                finally:
+                    b["done"].set()
+
+            thread = threading.Thread(target=_enumerate, daemon=True,
+                                      name="lgbm-tpu-device-probe")
+            _device_probe["thread"], _device_probe["box"] = thread, box
+            thread.start()
+    if not box["done"].wait(deadline_s):
+        return {"ok": False, "platform": None, "device_count": 0,
+                "error": f"device enumeration still blocked after "
+                         f"{deadline_s:.0f}s (backend init wedged)"}
+    if "error" in box:
+        return _failure(box["error"])
+    return _summarize(box.get("devices"))
+
+
 @contextlib.contextmanager
 def no_host_transfers() -> Iterator[None]:
     """Raise ``HostTransferError`` on any device->host materialization.

@@ -8,12 +8,13 @@ from .r004_pallas import PallasContractRule
 from .r005_collectives import CollectiveAccountingRule
 from .r006_axis import AxisNameRule
 from .r007_api_race import ApiRaceRule
+from .r008_serving import ServingContractRule
 
 ALL_RULES = (HostSyncRule, RecompileRule, DtypeDriftRule,
              PallasContractRule, CollectiveAccountingRule,
-             AxisNameRule, ApiRaceRule)
+             AxisNameRule, ApiRaceRule, ServingContractRule)
 
 __all__ = ["Finding", "ModuleInfo", "PackageInfo", "Rule", "ALL_RULES",
            "HostSyncRule", "RecompileRule", "DtypeDriftRule",
            "PallasContractRule", "CollectiveAccountingRule",
-           "AxisNameRule", "ApiRaceRule"]
+           "AxisNameRule", "ApiRaceRule", "ServingContractRule"]

@@ -1,0 +1,217 @@
+"""R008 — serving entry points must bound their queues and their waits.
+
+The resilient-serving contract (serving/): a request path either answers
+within its deadline or fails with a structured error — it never parks a
+caller on an unbounded queue or an untimed wait. Two hazards rot that
+contract silently:
+
+  * an UNBOUNDED queue on a request path (``queue.Queue()`` with no
+    maxsize, ``collections.deque()`` with no maxlen, or ``SimpleQueue``
+    which cannot be bounded): under a slow tick the queue absorbs every
+    incoming request and converts overload into unbounded latency for
+    ALL of them — admission control (``tpu_serve_queue_max`` +
+    ``ServerOverloaded``) is the load-shedding alternative;
+  * a BLOCKING wait with no timeout on the request path (``.get()`` /
+    ``.result()`` / ``.wait()`` / ``.join()`` with neither a positional
+    timeout nor ``timeout=``, and the producer-side twin ``.put(item)``
+    without ``block=False``/``timeout=``): one wedged device dispatch
+    then wedges the caller — or a full bounded queue wedges every
+    submitter — forever, instead of raising ``ServingTimeout``
+    (``tpu_serve_deadline_ms``) or shedding (``ServerOverloaded``).
+
+Scope: code is "serving-scoped" when its module lives under a
+``serving`` package/path, its enclosing class matches ``Serv``/
+``Coalesc`` (``PredictionServer``, ``MicroBatchCoalescer``, ...), or its
+enclosing function is a serving entry (``serve*``/``submit*``/
+``enqueue*``). The ONE deliberate blocking wait — the graceful-drain
+join in ``coalescer.close`` — carries an allowlist anchor.
+
+``x.get(key)`` (dict-style) and ``wait(deadline)`` (positional timeout)
+are not findings; the blocking spellings are — including the evasive
+ones: ``get(True)``, ``get(True, None)``, ``result(None)``,
+``timeout=None``.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from .base import Finding, ModuleInfo, PackageInfo, Rule, call_name
+
+#: class names that put their methods in serving scope
+_CLASS_RE = re.compile(r"Serv|Coalesc")
+#: function basenames that are serving entry points on their own
+_FUNC_RE = re.compile(r"^(serve|submit|enqueue)", re.I)
+#: module path components that put the whole module in serving scope
+_MODULE_COMPONENT = "serving"
+
+#: queue constructors and how they are bounded:
+#: name -> (bounding parameter, positional index of that parameter)
+_QUEUE_CTORS = {
+    "Queue": ("maxsize", 0),
+    "LifoQueue": ("maxsize", 0),
+    "PriorityQueue": ("maxsize", 0),
+    "deque": ("maxlen", 1),
+}
+#: inherently unbounded request containers
+_UNBOUNDABLE = {"SimpleQueue"}
+
+#: attribute calls that block forever without a timeout
+_BLOCKING_ATTRS = {"get", "result", "wait", "join"}
+
+
+def _timeout_kw(node: ast.Call) -> Optional[ast.AST]:
+    return next((kw.value for kw in node.keywords
+                 if kw.arg == "timeout"), None)
+
+
+def _is_none_const(value: Optional[ast.AST]) -> bool:
+    return isinstance(value, ast.Constant) and value.value is None
+
+
+def _put_blocks(node: ast.Call) -> bool:
+    """``q.put(item)`` on a FULL bounded queue blocks the submitter
+    forever — the producer-side twin of the untimed ``get``. Non-blocking
+    forms are fine: ``put_nowait``, ``put(item, False)``,
+    ``put(item, block=False)``, or a non-None ``timeout=``."""
+    timeout = _timeout_kw(node)
+    if timeout is not None:
+        return _is_none_const(timeout)      # timeout=None still blocks
+    for kw in node.keywords:
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return False
+    if len(node.args) > 1 and isinstance(node.args[1], ast.Constant) \
+            and node.args[1].value is False:
+        return False
+    return True
+
+
+def _wait_blocks(node: ast.Call) -> bool:
+    """Does this get/result/wait/join call block without a bound?
+
+    ``get``'s first positional is BLOCK (queue API), not a timeout — and
+    dict-style ``d.get(key)`` lands in the same slot — so for ``get``
+    only the unmistakably blocking forms are findings: no arguments,
+    ``get(True)``, ``get(True, None)``, or ``timeout=None``. For
+    ``result``/``wait``/``join`` the first positional IS the timeout:
+    blocking means no arguments or an explicit None."""
+    timeout = _timeout_kw(node)
+    if timeout is not None:
+        return _is_none_const(timeout)
+    if node.func.attr == "get":
+        if not node.args:
+            return True
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and first.value is True:
+            return len(node.args) < 2 or _is_none_const(node.args[1])
+        return False
+    if not node.args:
+        return True
+    return _is_none_const(node.args[0])
+
+
+def _module_in_scope(module: ModuleInfo) -> bool:
+    parts = module.path.replace("\\", "/").split("/")
+    names = {p[:-3] if p.endswith(".py") else p for p in parts}
+    if _MODULE_COMPONENT in names:
+        return True
+    dotted = module.dotted or ""
+    return f".{_MODULE_COMPONENT}." in f".{dotted}."
+
+
+def _bound_arg(node: ast.Call, param: str, pos: int) -> Optional[ast.AST]:
+    for kw in node.keywords:
+        if kw.arg == param:
+            return kw.value
+    if len(node.args) > pos:
+        return node.args[pos]
+    return None
+
+
+def _is_unbounded_value(value: Optional[ast.AST]) -> bool:
+    """No bound given, or an explicit unbounded sentinel (None, <= 0)."""
+    if value is None:
+        return True
+    if isinstance(value, ast.Constant):
+        v = value.value
+        if v is None:
+            return True
+        if isinstance(v, (int, float)) and v <= 0:
+            return True
+    return False
+
+
+class ServingContractRule(Rule):
+    code = "R008"
+    title = "unbounded queue / untimed wait on a serving request path"
+
+    def check(self, module: ModuleInfo, package: PackageInfo
+              ) -> List[Finding]:
+        out: List[Finding] = []
+        module_scope = _module_in_scope(module)
+
+        def walk(node: ast.AST, qual: str, in_scope: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                child_qual, child_scope = qual, in_scope
+                if isinstance(child, ast.ClassDef):
+                    child_qual = (f"{qual}.{child.name}"
+                                  if qual != "<module>" else child.name)
+                    child_scope = in_scope or bool(
+                        _CLASS_RE.search(child.name))
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    child_qual = (f"{qual}.{child.name}"
+                                  if qual != "<module>" else child.name)
+                    child_scope = in_scope or bool(
+                        _FUNC_RE.search(child.name))
+                elif isinstance(child, ast.Call) and in_scope:
+                    self._check_call(module, child, qual, out)
+                walk(child, child_qual, child_scope)
+
+        walk(module.tree, "<module>", module_scope)
+        return out
+
+    def _check_call(self, module: ModuleInfo, node: ast.Call, qual: str,
+                    out: List[Finding]) -> None:
+        name = call_name(node) or ""
+        base = name.rsplit(".", 1)[-1]
+        if base in _UNBOUNDABLE:
+            out.append(self.finding(
+                module, node, qual,
+                f"{base} is an unbounded request queue — a slow tick "
+                "turns overload into unbounded latency for every queued "
+                "request; use a bounded queue with admission control "
+                "(tpu_serve_queue_max -> ServerOverloaded)"))
+            return
+        if base in _QUEUE_CTORS:
+            param, pos = _QUEUE_CTORS[base]
+            if _is_unbounded_value(_bound_arg(node, param, pos)):
+                out.append(self.finding(
+                    module, node, qual,
+                    f"{base} constructed without a {param} bound on a "
+                    "serving path — the request queue must shed load "
+                    "(tpu_serve_queue_max -> ServerOverloaded), not "
+                    "grow without bound"))
+            return
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "put" and node.args and \
+                _put_blocks(node):
+            out.append(self.finding(
+                module, node, qual,
+                ".put() without block=False/timeout on a serving path "
+                "blocks the SUBMITTER forever once the bounded queue "
+                "fills — shed at the admission edge instead "
+                "(put_nowait -> ServerOverloaded)"))
+            return
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _BLOCKING_ATTRS:
+            if _wait_blocks(node):
+                out.append(self.finding(
+                    module, node, qual,
+                    f".{node.func.attr}() without a timeout on a serving "
+                    "path blocks forever when a tick wedges — carry the "
+                    "request deadline (tpu_serve_deadline_ms -> "
+                    "ServingTimeout); the deliberate graceful-drain join "
+                    "needs an allowlist anchor"))

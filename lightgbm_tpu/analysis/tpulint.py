@@ -19,6 +19,10 @@ for the same reason — correctness tooling as a first-class layer):
         sharded values gather explicitly before host readback
   R007  public Booster/Dataset methods hold the _api_lock rwlock;
         mutating methods take the write side
+  R008  serving request paths shed load and time out: no unbounded
+        queues (maxsize/maxlen mandatory, SimpleQueue banned), no
+        blocking get/result/wait/join without a timeout, no blocking
+        put without block=False/timeout
 
 Deliberate exceptions live in the checked-in allowlist
 (analysis/tpulint.allow), one entry per line:

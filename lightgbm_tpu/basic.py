@@ -779,17 +779,8 @@ class Booster:
     ) -> np.ndarray:
         """(reference: Booster.predict, basic.py:4701 → Predictor)"""
         inner = self._gbdt
-        # params-level prediction controls (reference: start_iteration_predict
-        # / num_iteration_predict, config.h predict section)
-        src = self.params or {}
-        if start_iteration == 0 and int(src.get("start_iteration_predict",
-                                                0) or 0) > 0:
-            start_iteration = int(src["start_iteration_predict"])
-        if num_iteration is None and int(src.get("num_iteration_predict",
-                                                 -1) or -1) > 0:
-            num_iteration = int(src["num_iteration_predict"])
-        if num_iteration is None:
-            num_iteration = self.best_iteration if self.best_iteration > 0 else None
+        start_iteration, num_iteration = self._predict_window(
+            start_iteration, num_iteration)
         arr = np.asarray(_maybe_series(data), dtype=np.float64)
         pre = getattr(self, "_pre_model", None)
         # global tree-window semantics across loaded + new trees (reference:
@@ -818,22 +809,7 @@ class Booster:
                 raise NotImplementedError(
                     "pred_contrib with start_iteration != 0 is not supported")
             return self._predict_contrib(arr, num_iteration)
-        early = None
-        want_early = kwargs.get(
-            "pred_early_stop",
-            bool(self.params and self.params.get("pred_early_stop")))
-        if want_early:
-            # the reference only early-stops classification predictions
-            # (predictor.hpp NeedAccuratePrediction gate)
-            obj_name = getattr(inner.objective, "name", "")
-            if obj_name == "binary" or inner.num_tree_per_iteration > 1:
-                src = self.params or {}
-                early = (
-                    float(kwargs.get(
-                        "pred_early_stop_margin",
-                        src.get("pred_early_stop_margin", 10.0))),
-                    int(kwargs.get("pred_early_stop_freq",
-                                   src.get("pred_early_stop_freq", 10))))
+        early = self._predict_early_stop(kwargs)
         raw = (inner.predict_raw_matrix(arr, own_cut, own_start, early)
                if not own_empty else None)   # [K, N]
         if not pre_empty:
@@ -863,22 +839,9 @@ class Booster:
         transfers are the request upload and the final [K, rung] -> [K, N]
         device-side slice. Loaded-from-file models predict on the host
         path and are not supported here."""
-        inner = self._gbdt
-        if not hasattr(inner, "predict_raw_device"):
-            raise NotImplementedError(
-                "predict_device needs a trained booster (models loaded "
-                "from file predict on the host path; use predict())")
-        if getattr(self, "_pre_model", None) is not None:
-            # the loaded base model routes on the host (raw-value
-            # thresholds); silently serving only the new trees would be
-            # wrong — predict() merges both windows correctly
-            raise NotImplementedError(
-                "predict_device does not support continue-trained "
-                "boosters (the loaded base model predicts on the host "
-                "path); use predict()")
-        if num_iteration is None:
-            num_iteration = (self.best_iteration
-                             if self.best_iteration > 0 else None)
+        inner = self._device_serving_inner()
+        start_iteration, num_iteration = self._predict_window(
+            start_iteration, num_iteration)
         arr = np.asarray(_maybe_series(data), dtype=np.float64)
         if arr.ndim == 1:
             arr = arr.reshape(1, -1)
@@ -901,12 +864,179 @@ class Booster:
             raw = inner.predict_raw_device(binned, num_iteration,
                                            start_iteration)[:, :n]
         if inner.average_output:
-            with inner._trees_mu:
-                t_real = len(inner._model_window(num_iteration,
-                                                 start_iteration))
-            raw = raw / max(t_real // max(inner.num_tree_per_iteration, 1),
-                            1)
+            raw = raw / inner._average_divisor(num_iteration,
+                                               start_iteration)
         return raw[0] if raw.shape[0] == 1 else raw.T
+
+    def _predict_window(self, start_iteration: int,
+                        num_iteration: Optional[int]):
+        """Params-level prediction-window resolution shared by every
+        prediction entry (reference: start_iteration_predict /
+        num_iteration_predict, config.h predict section; default window
+        cuts at best_iteration after early-stopped training)."""
+        src = self.params or {}
+        if start_iteration == 0 and int(src.get("start_iteration_predict",
+                                                0) or 0) > 0:
+            start_iteration = int(src["start_iteration_predict"])
+        if num_iteration is None and int(src.get("num_iteration_predict",
+                                                 -1) or -1) > 0:
+            num_iteration = int(src["num_iteration_predict"])
+        if num_iteration is None:
+            num_iteration = (self.best_iteration
+                             if self.best_iteration > 0 else None)
+        return start_iteration, num_iteration
+
+    def _predict_early_stop(self, kwargs=None):
+        """Resolved ``(margin, freq)`` pair or None: the pred_early_stop
+        controls shared by predict() and predict_serving. The reference
+        only early-stops classification predictions (predictor.hpp
+        NeedAccuratePrediction gate)."""
+        kwargs = kwargs or {}
+        src = self.params or {}
+        want = kwargs.get("pred_early_stop",
+                          bool(src.get("pred_early_stop")))
+        if not want:
+            return None
+        inner = self._gbdt
+        obj_name = getattr(inner.objective, "name", "")
+        if obj_name != "binary" and inner.num_tree_per_iteration <= 1:
+            return None
+        return (float(kwargs.get("pred_early_stop_margin",
+                                 src.get("pred_early_stop_margin", 10.0))),
+                int(kwargs.get("pred_early_stop_freq",
+                               src.get("pred_early_stop_freq", 10))))
+
+    def _device_serving_inner(self):
+        """The trained GBDT behind the device serving fast path, or a
+        ``NotImplementedError`` naming why this booster cannot take it
+        (loaded-from-file and continue-trained models predict on the host
+        path — see predict_device)."""
+        inner = self._gbdt
+        if not hasattr(inner, "predict_raw_device"):
+            raise NotImplementedError(
+                "device serving needs a trained booster (models loaded "
+                "from file predict on the host path; use predict())")
+        if getattr(self, "_pre_model", None) is not None:
+            raise NotImplementedError(
+                "device serving does not support continue-trained "
+                "boosters (the loaded base model predicts on the host "
+                "path); use predict()")
+        return inner
+
+    @read_locked
+    def predict_serving(self, data: _ArrayLike, raw_score: bool = False,
+                        start_iteration: int = 0,
+                        num_iteration: Optional[int] = None):
+        """One coalesced serving batch: ``(padded host scores, n_valid)``.
+
+        The serving twin of :meth:`predict`: bins the request, routes it
+        through the bucketed device engine, applies the objective's
+        output conversion at the PADDED rung shape, and returns the
+        padded host array — callers (serving/coalescer.py) slice their
+        per-request rows on the host, so no device op ever carries a
+        request-dependent shape. That is the coalescer's zero-recompile
+        contract: :meth:`predict_device`'s device-side ``[:, :n]`` slice
+        would lower one trivial program per distinct request size.
+
+        Rows ``[:n_valid]`` of the result equal ``predict(data)``
+        bit-for-bit (row routing, score sums, and the elementwise output
+        conversion are all per-row independent, so padding rows change
+        nothing). Shape ``[rung]`` for binary/regression, ``[rung, K]``
+        for multiclass. The request must fit the bucket ladder.
+
+        Honors the same params-level controls predict() does — the
+        start_iteration_predict / num_iteration_predict window and the
+        pred_early_stop margin/freq approximation (both per-row
+        independent, so parity survives batching)."""
+        inner = self._device_serving_inner()
+        start_iteration, num_iteration = self._predict_window(
+            start_iteration, num_iteration)
+        early = self._predict_early_stop()
+        arr = np.asarray(_maybe_series(data), dtype=np.float64)
+        if arr.ndim == 1:
+            arr = arr.reshape(1, -1)
+        n = arr.shape[0]
+        binned = inner.bin_matrix(arr)
+        raw = np.asarray(inner.predict_raw_device(
+            binned, num_iteration, start_iteration,
+            early_stop=early))                            # [K, rung] host
+        if inner.average_output:
+            raw = raw / inner._average_divisor(num_iteration,
+                                               start_iteration)
+        k = raw.shape[0]
+        out = raw[0] if k == 1 else raw.T
+        if raw_score or inner.objective is None:
+            return out, n
+        # elementwise (sigmoid) / per-row (softmax) conversion on the
+        # padded shape: one eager program per rung, warmed alongside the
+        # predict program by warm_predict_ladder
+        return np.asarray(inner.objective.convert_output(out)), n
+
+    @read_locked
+    def warm_predict_ladder(self, max_rows: Optional[int] = None,
+                            start_iteration: int = 0,
+                            num_iteration: Optional[int] = None
+                            ) -> Dict[str, Any]:
+        """Pre-compile the serving bucket ladder; returns warmup stats.
+
+        Pushes one dummy request per row rung (ops/predict.warmup_rungs)
+        through the full serving path — binning, the bucketed predict
+        program, and the output conversion — so a server that warms
+        before taking traffic compiles NOTHING in steady state, and a
+        hot-swap candidate warms before the swap commits. With
+        ``tpu_compile_cache_dir`` set, a restarted process re-arms the
+        whole ladder from the persistent cache with zero backend
+        compiles (the returned ``cache`` counters prove it: hits ==
+        requests, misses == 0 on a warm cache).
+
+        Stats: ``rungs`` warmed, ``seconds``, ``lowerings`` /
+        ``backend_compiles`` spent, and the persistent-cache ``cache``
+        ``{requests, hits, misses}``. ``max_rows`` caps the rung
+        enumeration (``tpu_serve_warm_max_rows``); the scan escape-hatch
+        engine recompiles per shape by design and reports ``skipped``."""
+        import time as _time
+
+        from .analysis import guards
+        from .analysis.faultinject import active_plan
+        from .ops.predict import parse_bucket_ladder, warmup_rungs
+        inner = self._device_serving_inner()
+        cfg = inner.config
+        if str(cfg.get("tpu_predict_engine", "batched")).lower() == "scan":
+            return {"rungs": [], "seconds": 0.0,
+                    "skipped": "tpu_predict_engine=scan recompiles per "
+                               "shape by design"}
+        if max_rows is None:
+            max_rows = int(cfg.get("tpu_serve_warm_max_rows", 0) or 0)
+        ladder = parse_bucket_ladder(cfg.get("tpu_predict_buckets", "auto"))
+        rungs = warmup_rungs(ladder, max_rows)
+        n_feat = inner.train_set.num_total_features
+        plan = active_plan(cfg)
+        t0 = _time.time()
+        with guards.compile_counter() as cc, \
+                guards.cache_counter() as cache:
+            for rung in rungs:
+                # ordinal-matched site (no iteration= kwarg): warmup=N
+                # means the Nth rung warmed this process
+                plan.fire("warmup", rung=rung)
+                dummy = np.zeros((rung, n_feat), np.float32)
+                self.predict_serving(dummy, start_iteration=start_iteration,
+                                     num_iteration=num_iteration)
+        return {"rungs": list(rungs), "seconds": round(_time.time() - t0, 3),
+                "lowerings": cc.lowerings,
+                "backend_compiles": cc.backend_compiles,
+                "cache": {"requests": cache.requests, "hits": cache.hits,
+                          "misses": cache.misses}}
+
+    @read_locked
+    def serve(self, **kwargs):
+        """Stand up a :class:`~lightgbm_tpu.serving.PredictionServer` on
+        this booster: micro-batch coalescing over the bucket ladder,
+        bounded admission, per-request deadlines, and hot-swap-ready
+        model registry. Keyword arguments override the ``tpu_serve_*``
+        config knobs (``tick_ms``, ``queue_max``, ``deadline_ms``,
+        ``warm_max_rows``, ``warm``, ``version``)."""
+        from .serving import PredictionServer
+        return PredictionServer(self, **kwargs)
 
     def _predict_contrib(self, arr, num_iteration):
         """Exact TreeSHAP contributions [N, K*(F+1)] (reference:

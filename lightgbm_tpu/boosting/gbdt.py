@@ -2728,6 +2728,18 @@ class GBDT:
         num_shards = len(self.mesh.devices.ravel())
         return predict_shard_pad(n, num_shards, ladder) is not None
 
+    def _average_divisor(self, num_iteration: Optional[int],
+                         start_iteration: int) -> int:
+        """RF ``average_output`` divisor: the iteration count actually
+        accumulated in the prediction window after start/num slicing
+        (reference: num_iteration_for_pred_). The ONE implementation
+        behind every averaging prediction path — predict_raw_binned,
+        Booster.predict_device and Booster.predict_serving."""
+        with self._trees_mu:
+            t_real = len(self._model_window(num_iteration,
+                                            start_iteration))
+        return max(t_real // max(self.num_tree_per_iteration, 1), 1)
+
     def predict_raw_binned(self, binned,
                            num_iteration: Optional[int] = None,
                            start_iteration: int = 0,
@@ -2760,13 +2772,8 @@ class GBDT:
             raw = np.asarray(self.predict_raw_device(
                 binned, num_iteration, start_iteration, early_stop))[:, :n]
         if self.average_output:
-            # divide by the iteration count actually accumulated (after the
-            # start/num slicing), reference: num_iteration_for_pred_
-            with self._trees_mu:
-                t_real = len(self._model_window(num_iteration,
-                                                start_iteration))
-            n_iters = t_real // max(self.num_tree_per_iteration, 1)
-            raw = raw / max(n_iters, 1)
+            raw = raw / self._average_divisor(num_iteration,
+                                              start_iteration)
         return raw
 
     def bin_matrix(self, arr: np.ndarray) -> np.ndarray:

@@ -240,6 +240,26 @@ PARAMS: Dict[str, Tuple[Any, type, Tuple[str, ...]]] = {
     # 4-bit nibble packing of served request matrices when every feature
     # has <= 16 bins (io/dataset.py pack4_matrix; halves request HBM)
     "tpu_bin_pack4": (False, bool, ("bin_pack4",)),
+    # serving layer (lightgbm_tpu/serving/): the async micro-batch
+    # coalescer aggregates concurrent predict requests into one
+    # rung-sized device batch per tick, with per-request deadlines,
+    # a bounded admission queue (structured ServerOverloaded instead of
+    # unbounded latency), and pre-warmed hot-swappable models
+    "tpu_serve_tick_ms": (5.0, float, ("serve_tick_ms",)),
+    # admission bound, in ROWS queued (not requests): a submit that would
+    # push the queue past it raises ServerOverloaded (load shedding)
+    "tpu_serve_queue_max": (8192, int, ("serve_queue_max",)),
+    # default per-request deadline: a request not served by then gets a
+    # structured ServingTimeout instead of waiting forever
+    "tpu_serve_deadline_ms": (1000.0, float, ("serve_deadline_ms",)),
+    # cap (in rows) on the ladder rungs pre-compiled at deploy/warmup
+    # time; 0 warms the FULL tpu_predict_buckets ladder (on the auto
+    # ladder that is rungs up to 1M rows — minutes of compiles and a
+    # 1M-row dummy request per rung, so the default caps at 16k and the
+    # full warm is an explicit opt-in). The coalescer never builds a
+    # batch larger than its largest warmed rung, so the post-warmup
+    # serving steady state compiles nothing
+    "tpu_serve_warm_max_rows": (16384, int, ("serve_warm_max_rows",)),
     # fault tolerance (io/checkpoint.py, parallel/multihost.py watchdog,
     # analysis/faultinject.py): atomic full-state snapshots every
     # tpu_checkpoint_freq iterations into tpu_checkpoint_dir (keep-last-k

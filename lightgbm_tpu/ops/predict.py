@@ -111,6 +111,23 @@ def bucket_rows(n: int, ladder: Sequence[int]) -> Optional[int]:
     return None
 
 
+def warmup_rungs(ladder: Sequence[int],
+                 max_rows: Optional[int] = None) -> Tuple[int, ...]:
+    """The row rungs a serving warmup pre-compiles (smallest first).
+
+    One warm predict per returned rung compiles the full program set a
+    coalescer can hit in steady state: with the model's tree bucket and
+    depth bucket fixed, the row rung is the only remaining jit-key axis.
+    ``max_rows`` caps the enumeration (warming the 1M rung host-pads a
+    1M-row dummy request, which a small serving box may not want);
+    ``None``/``0`` warms the full ladder, and at least the smallest rung
+    is always returned so a warmed server has a usable batch bound.
+    """
+    rungs = tuple(r for r in ladder
+                  if not max_rows or max_rows <= 0 or r <= max_rows)
+    return rungs if rungs else (min(ladder),)
+
+
 def tree_bucket(t: int, tbatch: int) -> int:
     """Tree-count bucket: the smallest ``tbatch * 2**j`` >= t.
 

@@ -1,0 +1,25 @@
+"""Resilient serving layer over the bucketed inference engine.
+
+ROADMAP item 3's service tier (the robustness analogue of PR 7, aimed at
+inference): an async micro-batch coalescer that aggregates concurrent
+small ``predict`` requests into one rung-sized device batch per tick
+(riding the zero-recompile bucket ladder of ops/predict.py and the
+Booster rwlock), bounded admission with structured load shedding,
+per-request deadlines, a pre-warmed multi-model registry with atomic
+hot-swap and automatic rollback, and health/readiness probes. CLI entry:
+``scripts/serve``.
+
+Entry point: ``Booster.serve(...)`` or :class:`PredictionServer`
+directly. See README "Serving".
+"""
+from .coalescer import MicroBatchCoalescer, ServeFuture
+from .errors import (ServerClosed, ServerOverloaded, ServingError,
+                     ServingTimeout, SwapFailed)
+from .registry import ModelRegistry
+from .server import PredictionServer
+
+__all__ = [
+    "PredictionServer", "ModelRegistry", "MicroBatchCoalescer",
+    "ServeFuture", "ServingError", "ServingTimeout", "ServerOverloaded",
+    "ServerClosed", "SwapFailed",
+]

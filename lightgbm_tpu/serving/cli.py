@@ -1,0 +1,123 @@
+"""``scripts/serve`` — stand up a PredictionServer from the command line.
+
+A thin operational wrapper over the library API (the reference ships the
+same split: ``lightgbm`` the CLI vs the C API serving entry points).
+Trains (or auto-resumes, via ``tpu_checkpoint_dir``) a booster on a CSV,
+pre-warms the serving ladder, then either:
+
+  * ``--probe``: print the health/readiness JSON and exit 0 iff ready
+    (the k8s-style readiness gate — wire it to your orchestrator); or
+  * serve: read CSV feature rows from stdin (one request per line),
+    micro-batch them through the coalescer, print one prediction per
+    line; EOF drains gracefully and dumps the serving stats to stderr.
+
+Example::
+
+    scripts/serve train.csv --rounds 50 --param num_leaves=63 \
+        --tick-ms 2 --deadline-ms 500 --probe
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def _parse_params(pairs: List[str]) -> dict:
+    out: dict = {}
+    for pair in pairs or ():
+        key, sep, value = pair.partition("=")
+        if not sep:
+            raise SystemExit(f"--param expects key=value, got {pair!r}")
+        for cast in (int, float):
+            try:
+                out[key] = cast(value)
+                break
+            except ValueError:
+                continue
+        else:
+            out[key] = value
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="serve", description=__doc__.splitlines()[0])
+    ap.add_argument("data", help="training CSV (label in --label-col)")
+    ap.add_argument("--label-col", type=int, default=0,
+                    help="label column index in the CSV (default 0)")
+    ap.add_argument("--rounds", type=int, default=20,
+                    help="boosting rounds to train before serving")
+    ap.add_argument("--param", action="append", default=[],
+                    help="extra training param key=value (repeatable), "
+                         "e.g. --param objective=binary")
+    ap.add_argument("--tick-ms", type=float, default=None,
+                    help="coalescer tick (tpu_serve_tick_ms)")
+    ap.add_argument("--queue-max", type=int, default=None,
+                    help="admission bound in rows (tpu_serve_queue_max)")
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="per-request deadline (tpu_serve_deadline_ms)")
+    ap.add_argument("--warm-max-rows", type=int, default=None,
+                    help="cap the warmed ladder rungs "
+                         "(tpu_serve_warm_max_rows; 0 = full ladder)")
+    ap.add_argument("--checkpoint-dir", default="",
+                    help="tpu_checkpoint_dir: training auto-resumes from "
+                         "the newest valid snapshot (PR 7) and, combined "
+                         "with --compile-cache-dir, a restarted server "
+                         "re-arms its ladder with zero backend compiles")
+    ap.add_argument("--compile-cache-dir", default="",
+                    help="tpu_compile_cache_dir: persistent XLA cache for "
+                         "warmup across restarts")
+    ap.add_argument("--raw-score", action="store_true",
+                    help="serve raw scores (skip objective conversion)")
+    ap.add_argument("--probe", action="store_true",
+                    help="print health JSON and exit 0 iff ready")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+
+    arr = np.loadtxt(args.data, delimiter=",", ndmin=2)
+    y = arr[:, args.label_col]
+    x = np.delete(arr, args.label_col, axis=1)
+    params = {"verbosity": -1}
+    params.update(_parse_params(args.param))
+    if args.checkpoint_dir:
+        params.setdefault("tpu_checkpoint_dir", args.checkpoint_dir)
+        params.setdefault("tpu_checkpoint_freq",
+                          max(args.rounds // 4, 1))
+    if args.compile_cache_dir:
+        params.setdefault("tpu_compile_cache_dir", args.compile_cache_dir)
+    booster = lgb.train(params, lgb.Dataset(x, label=y, params=params),
+                        num_boost_round=args.rounds)
+    server = booster.serve(
+        tick_ms=args.tick_ms, queue_max=args.queue_max,
+        deadline_ms=args.deadline_ms, warm_max_rows=args.warm_max_rows,
+        raw_score=args.raw_score)
+    try:
+        health = server.health()
+        if args.probe:
+            print(json.dumps(health, indent=1, sort_keys=True, default=str))
+            return 0 if health["ready"] else 1
+        sys.stderr.write(
+            f"[serve] ready={health['ready']} warm_rungs="
+            f"{health['warm_rungs']}; reading CSV rows from stdin\n")
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            row = np.array([float(t) for t in line.split(",")],
+                           np.float64)
+            out = server.predict(row.reshape(1, -1))
+            val = np.asarray(out).ravel()
+            print(",".join(f"{v:.10g}" for v in val), flush=True)
+        return 0
+    finally:
+        server.close(drain=True)
+        sys.stderr.write(f"[serve] stats: {json.dumps(server.stats)}\n")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,368 @@
+"""Async micro-batch coalescer: many small requests, one device batch.
+
+The serving tentpole's core loop. Concurrent ``predict`` requests land in
+a BOUNDED queue (admission control: a submit that would exceed
+``tpu_serve_queue_max`` rows raises :class:`ServerOverloaded` instead of
+growing latency without bound); a single worker thread wakes per tick,
+sweeps expired requests into :class:`ServingTimeout`, pops a batch no
+larger than the largest WARMED ladder rung, and hands it to the server's
+serve callback as ONE device dispatch. The reference serves single rows
+through its dedicated fast-path configs
+(``LGBM_BoosterPredictFor*SingleRowFast``, src/c_api.cpp); on TPU the
+same workload wants the opposite shape — aggregate rows until they fill
+a bucket rung, because the rung, not the row, is the unit the compiled
+program serves for free.
+
+Resilience contract:
+
+  * every admitted request is COMPLETED exactly once — with a response
+    from exactly one model version, or with a structured error
+    (timeout/closed/serving failure). Nothing hangs;
+  * a slow tick (injected ``hang@coalesce_tick``) converts into load
+    shedding at the admission edge, never into an unbounded queue;
+  * a killed worker (injected ``kill@coalesce_tick``) fails its in-flight
+    batch structurally and RESPAWNS — the queue keeps draining
+    (``worker_restarts`` in the stats records it);
+  * ``close(drain=True)`` stops admission, serves everything already
+    queued, then joins the worker (the one deliberate blocking wait in
+    the serving layer — R008 allowlist anchor).
+"""
+from __future__ import annotations
+
+import collections
+import copy
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ..analysis.faultinject import active_plan
+from ..utils import log
+from .errors import (ServerClosed, ServerOverloaded, ServingError,
+                     ServingTimeout)
+
+
+class ServeFuture:
+    """Completion handle for one submitted request.
+
+    ``result()`` is deadline-bounded by construction: with no explicit
+    ``timeout`` it waits until the request's own deadline plus a small
+    grace window (the server guarantees a structured completion by then),
+    so no caller of the serving API can block forever (tpulint R008)."""
+
+    #: extra wait past the request deadline before result() gives up —
+    #: covers the tick that is busy serving when the deadline passes
+    _GRACE_S = 5.0
+
+    def __init__(self, arr, deadline_s: Optional[float],
+                 deadline_ms: float):
+        self.arr = arr                      # [n, F] float request rows
+        self.n = int(arr.shape[0])
+        self.deadline = (time.monotonic() + deadline_s
+                         if deadline_s is not None else None)
+        self.deadline_ms = deadline_ms
+        self.version = None                 # model version that answered
+        self.created_at = time.monotonic()
+        self.completed_at: Optional[float] = None
+        self._value = None
+        self._error: Optional[BaseException] = None
+        self._event = threading.Event()
+        self._mu = threading.Lock()     # completion CAS: exactly one
+        #                                 outcome wins (worker vs the
+        #                                 client-side timeout in result)
+
+    # -- completion (worker side) -------------------------------------------
+    def _complete(self, version, value) -> None:
+        with self._mu:
+            if self._event.is_set():
+                return
+            self.version = version
+            self._value = value
+            self.arr = None     # release the request rows: callers keep
+            #                     futures around for latency/version stats
+            self.completed_at = time.monotonic()
+            self._event.set()
+
+    def _fail(self, err: BaseException) -> None:
+        with self._mu:
+            if self._event.is_set():
+                return
+            self._error = err
+            # arr is NOT cleared here: a client-side result() timeout may
+            # fire while this future sits in a popped in-flight batch,
+            # and the worker still concatenates from arr — only
+            # _complete (the worker, done with the rows) releases it
+            self.completed_at = time.monotonic()
+            self._event.set()
+
+    # -- consumption (client side) ------------------------------------------
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.completed_at is None:
+            return None
+        return self.completed_at - self.created_at
+
+    def result(self, timeout: Optional[float] = None):
+        if timeout is None:
+            if self.deadline is not None:
+                timeout = max(self.deadline - time.monotonic(), 0.0) \
+                    + self._GRACE_S
+            else:
+                timeout = 60.0          # bounded even without a deadline
+        if not self._event.wait(timeout):
+            # record the timeout AS the future's outcome (CAS: if the
+            # worker completes in this same instant, its result stands) —
+            # client-visible state and the future never disagree
+            self._fail(ServingTimeout("request", self.deadline_ms
+                                      or timeout * 1000.0))
+        if self._error is not None:
+            # a FRESH copy per raise: concurrent/repeated result() calls
+            # must not mutate one shared instance's __traceback__ across
+            # threads (errors carry __reduce__ state for exact copies)
+            raise copy.copy(self._error)
+        return self._value
+
+
+class MicroBatchCoalescer:
+    """The bounded queue + tick worker behind a PredictionServer.
+
+    ``serve_batch`` is called from the worker thread with a non-empty
+    list of :class:`ServeFuture` and must complete every one of them
+    (the server's implementation snapshots ONE model version per call,
+    so a batch is never split across models)."""
+
+    def __init__(self, serve_batch: Callable[[List[ServeFuture]], None],
+                 *, tick_ms: float, queue_max_rows: int,
+                 max_batch_rows: int, fault_config=None,
+                 name: str = "serve"):
+        if queue_max_rows < 1:
+            raise ValueError("tpu_serve_queue_max must be >= 1 row")
+        if max_batch_rows < 1:
+            raise ValueError("max_batch_rows must be >= 1")
+        self._serve_batch = serve_batch
+        self._tick_s = max(float(tick_ms), 0.0) / 1000.0
+        self._queue_max_rows = int(queue_max_rows)
+        self._max_batch_rows = int(max_batch_rows)
+        self._fault_config = fault_config
+        self._cv = threading.Condition()
+        # each request holds >= 1 row and admission rejects past the row
+        # bound first, so maxlen (a hard REQUEST cap) is never the
+        # mechanism that drops — it is the structural guarantee R008 asks
+        # for: no unbounded request queue in a serving path
+        self._q = collections.deque(maxlen=self._queue_max_rows)
+        self._rows = 0                      # rows currently queued
+        self._closing = False
+        self._closed = False
+        self.stats = {
+            "submitted": 0, "served_requests": 0, "served_rows": 0,
+            "ticks": 0, "shed": 0, "timeouts": 0, "errors": 0,
+            "worker_restarts": 0, "max_queue_rows": 0,
+        }
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"lgbm-tpu-{name}-coalescer")
+        self._thread.start()
+
+    # -- admission (any thread) ---------------------------------------------
+    def submit(self, arr, deadline_s: Optional[float],
+               deadline_ms: float) -> ServeFuture:
+        n = int(arr.shape[0])
+        if n < 1:
+            raise ValueError("empty request (0 rows)")
+        if n > self._max_batch_rows:
+            raise ValueError(
+                f"request of {n} rows exceeds the largest warmed serving "
+                f"rung ({self._max_batch_rows}); slice it or warm a "
+                "larger ladder (tpu_serve_warm_max_rows / "
+                "tpu_predict_buckets)")
+        if n > self._queue_max_rows:
+            # structurally unservable, not transient overload: admission
+            # could NEVER accept it, even on an idle server
+            raise ValueError(
+                f"request of {n} rows exceeds the admission bound "
+                f"(tpu_serve_queue_max={self._queue_max_rows}); slice it "
+                "or raise the bound")
+        fut = ServeFuture(arr, deadline_s, deadline_ms)
+        with self._cv:
+            if self._closing or self._closed:
+                raise ServerClosed("server is draining/closed; "
+                                   "request rejected")
+            self.stats["submitted"] += 1
+            if self._rows + n > self._queue_max_rows:
+                self.stats["shed"] += 1
+                raise ServerOverloaded(self._rows, self._queue_max_rows)
+            self._q.append(fut)
+            self._rows += n
+            self.stats["max_queue_rows"] = max(
+                self.stats["max_queue_rows"], self._rows)
+            self._cv.notify_all()
+        return fut
+
+    def queue_depth_rows(self) -> int:
+        with self._cv:
+            return self._rows
+
+    def worker_alive(self) -> bool:
+        return self._thread.is_alive()
+
+    @property
+    def max_batch_rows(self) -> int:
+        return self._max_batch_rows
+
+    def set_max_batch_rows(self, rows: int) -> None:
+        """Re-bound the per-tick batch after a model swap (the new active
+        model's largest warmed rung)."""
+        if rows < 1:
+            raise ValueError("max_batch_rows must be >= 1")
+        with self._cv:
+            self._max_batch_rows = int(rows)
+
+    def set_fault_config(self, config) -> None:
+        """Re-point the coalesce_tick fault site at the new active
+        model's config after a swap — a candidate carrying
+        ``tpu_fault_spec`` must arm (and a disarmed one must not stay
+        armed) from the moment it serves."""
+        self._fault_config = config
+
+    # -- worker -------------------------------------------------------------
+    def _pop_batch(self) -> Optional[List[ServeFuture]]:
+        """Next batch (possibly empty after a deadline sweep), or None to
+        exit. Blocks in SHORT bounded waits so close() is always
+        responsive."""
+        with self._cv:
+            while not self._q:
+                if self._closing:
+                    return None
+                self._cv.wait(timeout=0.05)
+            if self._tick_s > 0 and not self._closing:
+                # the coalescing window: let concurrent submitters join
+                # this tick's batch before it is cut. Re-wait until the
+                # FULL window elapses — each submit's notify would
+                # otherwise cut the wait (and the batch) at the first
+                # concurrent arrival — but cut immediately once the
+                # queue already fills the batch (waiting longer can only
+                # add latency: nothing more fits this tick)
+                end = time.monotonic() + self._tick_s
+                while not self._closing \
+                        and self._rows < self._max_batch_rows:
+                    remaining = end - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(timeout=remaining)
+            now = time.monotonic()
+            batch: List[ServeFuture] = []
+            rows = 0
+            while self._q:
+                r = self._q[0]
+                if r.deadline is not None and now >= r.deadline:
+                    self._q.popleft()
+                    self._rows -= r.n
+                    self.stats["timeouts"] += 1
+                    r._fail(ServingTimeout("request expired in queue",
+                                           r.deadline_ms))
+                    continue
+                if r.n > self._max_batch_rows:
+                    # admitted before a hot-swap shrank the warmed-rung
+                    # bound: serving it now would compile in the request
+                    # path — fail structurally instead
+                    self._q.popleft()
+                    self._rows -= r.n
+                    self.stats["errors"] += 1
+                    r._fail(ServingError(
+                        f"request of {r.n} rows exceeds the active "
+                        f"model's largest warmed rung "
+                        f"({self._max_batch_rows}) after a model swap; "
+                        "resubmit in smaller slices"))
+                    continue
+                if batch and rows + r.n > self._max_batch_rows:
+                    break                   # next tick's batch
+                self._q.popleft()
+                self._rows -= r.n
+                batch.append(r)
+                rows += r.n
+            return batch
+
+    def _drain_loop(self) -> None:
+        while True:
+            batch = self._pop_batch()
+            if batch is None:
+                return
+            if not batch:
+                continue
+            rows = sum(r.n for r in batch)
+            # count BEFORE the futures complete: clients synchronize on
+            # result(), so a stats read right after it must already see
+            # this batch (rolled back below if the tick fails)
+            with self._cv:
+                self.stats["ticks"] += 1
+                self.stats["served_requests"] += len(batch)
+                self.stats["served_rows"] += rows
+            try:
+                # the slow-tick / worker-kill injection point: fired
+                # OUTSIDE the queue lock, so a hanging tick converts into
+                # admission-side shedding, never into blocked submitters
+                active_plan(self._fault_config).fire(
+                    "coalesce_tick", requests=len(batch))
+                self._serve_batch(batch)
+            except BaseException as err:  # noqa: BLE001 - classified below
+                with self._cv:
+                    self.stats["ticks"] -= 1
+                    self.stats["served_requests"] -= len(batch)
+                    self.stats["served_rows"] -= rows
+                    self.stats["errors"] += 1
+                # one FRESH exception per future: concurrent result()
+                # raises would otherwise mutate a shared instance's
+                # __traceback__/__context__ across client threads
+                msg = (str(err) if isinstance(err, ServingError)
+                       else f"serving tick failed: {err!r}")
+                for r in batch:
+                    r._fail(ServingError(msg))
+                if not isinstance(err, Exception):
+                    raise           # a worker kill: respawn boundary below
+
+    def _run(self) -> None:
+        while True:
+            try:
+                self._drain_loop()
+                return                      # clean drain/close exit
+            except BaseException as err:  # noqa: BLE001 - supervisor
+                # the injected worker kill (faultinject.SimulatedKill) or
+                # an unexpected crash: the in-flight batch already failed
+                # structurally in _drain_loop; respawn so the queue keeps
+                # draining instead of wedging
+                log.warning(f"[serving] worker died ({err!r}); respawning")
+                with self._cv:
+                    self.stats["worker_restarts"] += 1
+                    if self._closing:
+                        return
+
+    # -- shutdown -----------------------------------------------------------
+    def close(self, drain: bool = True,
+              timeout_s: Optional[float] = None) -> None:
+        """Stop admission; serve (``drain=True``) or fail (``False``)
+        whatever is queued; join the worker. Safe to call twice."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closing = True
+            if not drain:
+                while self._q:
+                    r = self._q.popleft()
+                    self._rows -= r.n
+                    r._fail(ServerClosed("server closed before serving "
+                                         "this request"))
+            self._cv.notify_all()
+        if timeout_s is not None:
+            self._thread.join(timeout_s)
+        else:
+            # the deliberate blocking drain: every queued request is
+            # served (or structurally failed) before close returns
+            self._thread.join()             # R008 allowlist anchor: drain
+        with self._cv:
+            self._closed = True
+            while self._q:                  # worker died / join timed out
+                r = self._q.popleft()
+                self._rows -= r.n
+                r._fail(ServerClosed("server closed before serving this "
+                                     "request"))

@@ -1,0 +1,65 @@
+"""Structured serving errors.
+
+The resilient-serving contract (the inference analogue of PR 7's
+``TrainingInterrupted``): overload, deadline misses, shutdown, and failed
+hot-swaps surface as TYPED errors a caller can branch on, never as
+unbounded latency or a wedged queue. The reference's C API reports the
+same classes of failure through ``LGBM_GetLastError`` strings
+(src/c_api.cpp API_BEGIN/API_END); here they are first-class exceptions.
+"""
+from __future__ import annotations
+
+
+class ServingError(RuntimeError):
+    """Base class for all structured serving failures."""
+
+
+class ServingTimeout(ServingError):
+    """A request's deadline passed before a response was produced.
+
+    Raised by ``ServeFuture.result`` and attached to requests the
+    coalescer sweeps out of the queue after their deadline (a slow tick
+    must convert waiting into a bounded, typed failure)."""
+
+    def __init__(self, what: str, deadline_ms: float):
+        super().__init__(
+            f"{what}: deadline of {deadline_ms:.0f} ms exceeded")
+        self.what = what
+        self.deadline_ms = deadline_ms
+
+    def __reduce__(self):
+        # copy/pickle must reconstruct through the real ctor (args holds
+        # the FORMATTED message, not the ctor signature) — ServeFuture
+        # raises a fresh copy per result() call
+        return (type(self), (self.what, self.deadline_ms))
+
+
+class ServerOverloaded(ServingError):
+    """Admission control rejected a request: the bounded queue is full.
+
+    Load shedding — the queue never grows past ``tpu_serve_queue_max``
+    rows; callers back off or retry elsewhere instead of stacking
+    unbounded latency onto every in-flight request."""
+
+    def __init__(self, queued_rows: int, queue_max: int):
+        super().__init__(
+            f"serving queue full ({queued_rows}/{queue_max} rows queued); "
+            "request shed")
+        self.queued_rows = queued_rows
+        self.queue_max = queue_max
+
+    def __reduce__(self):
+        return (type(self), (self.queued_rows, self.queue_max))
+
+
+class ServerClosed(ServingError):
+    """The server is draining or shut down; no new requests admitted."""
+
+
+class SwapFailed(ServingError):
+    """A model hot-swap did not commit; the previous model stays active.
+
+    Raised when the candidate's warmup or health check fails, or when
+    the commit blows its deadline (an injected hang-mid-swap) — in every
+    case the registry rolls back automatically and live traffic keeps
+    serving the old model."""

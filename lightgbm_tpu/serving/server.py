@@ -1,0 +1,240 @@
+"""PredictionServer: the resilient serving facade over one booster fleet.
+
+Composes the three serving pieces — the bounded micro-batch coalescer
+(coalescer.py), the pre-warmed hot-swap registry (registry.py), and the
+device fast path (``Booster.predict_serving``) — into the service layer
+ROADMAP item 3 asks for: concurrent small requests aggregate into one
+rung-sized device batch per tick, admission is bounded, every request
+carries a deadline, models swap atomically with rollback, and liveness
+is observable through ``health()``/``ready()`` probes.
+
+Typical use::
+
+    server = booster.serve(tick_ms=2.0, deadline_ms=500)
+    fut = server.submit(X_small)             # async, micro-batched
+    y = fut.result()                         # == booster.predict(X_small)
+    server.deploy("v2", retrained_booster)   # pre-warmed atomic swap
+    server.close(drain=True)                 # graceful shutdown
+
+Throughput/latency numbers live in BENCH_SHAPES.json["serving"]
+(bench.py BENCH_SERVING=1).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..analysis import guards
+from ..analysis.faultinject import active_plan
+from ..ops.predict import parse_bucket_ladder, warmup_rungs
+from .coalescer import MicroBatchCoalescer, ServeFuture
+from .registry import ModelRegistry
+
+
+class PredictionServer:
+    """Micro-batching, deadline-aware, hot-swappable serving front."""
+
+    def __init__(self, booster=None, *, registry: Optional[ModelRegistry]
+                 = None, version: str = "v0",
+                 tick_ms: Optional[float] = None,
+                 queue_max: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 warm: bool = True, warm_max_rows: Optional[int] = None,
+                 raw_score: bool = False, swap_deadline_s: float = 30.0):
+        self._registry = registry if registry is not None else ModelRegistry()
+        self._raw_score = bool(raw_score)
+        self._swap_deadline_s = float(swap_deadline_s)
+        self._closed = False
+        self._mu = threading.Lock()
+        if booster is not None:
+            self._registry.deploy(version, booster, warm=warm,
+                                  warm_max_rows=warm_max_rows,
+                                  deadline_s=self._swap_deadline_s)
+        _, active = self._registry.active()     # requires a deployed model
+        cfg = active._gbdt.config
+        self._fault_config = cfg
+        tick_ms = (float(cfg.get("tpu_serve_tick_ms", 5.0))
+                   if tick_ms is None else float(tick_ms))
+        queue_max = (int(cfg.get("tpu_serve_queue_max", 8192))
+                     if queue_max is None else int(queue_max))
+        self._deadline_ms = (float(cfg.get("tpu_serve_deadline_ms", 1000.0))
+                             if deadline_ms is None else float(deadline_ms))
+        if warm_max_rows is None:
+            warm_max_rows = int(cfg.get("tpu_serve_warm_max_rows", 0) or 0)
+        self._warm_max_rows = warm_max_rows
+        self._n_features = active._gbdt.train_set.num_total_features
+        self._coalescer = MicroBatchCoalescer(
+            self._serve_batch, tick_ms=tick_ms, queue_max_rows=queue_max,
+            max_batch_rows=self._resolve_max_batch(active),
+            fault_config=cfg)
+
+    # -- batch bound ---------------------------------------------------------
+    def _resolve_max_batch(self, booster, version: Optional[str] = None
+                           ) -> int:
+        """The largest batch a tick may cut: the given (default: active)
+        model's largest WARMED rung (so steady state never compiles),
+        falling back to the largest rung warmup WOULD cover when warm
+        stats are absent (an unwarmed server pays its compiles in the
+        first ticks)."""
+        stats = self._registry.warm_stats(version)
+        if stats and stats.get("rungs"):
+            return int(max(stats["rungs"]))
+        ladder = parse_bucket_ladder(
+            booster._gbdt.config.get("tpu_predict_buckets", "auto"))
+        return int(max(warmup_rungs(ladder, self._warm_max_rows)))
+
+    # -- request path --------------------------------------------------------
+    def submit(self, data, deadline_ms: Optional[float] = None
+               ) -> ServeFuture:
+        """Enqueue one request; returns its :class:`ServeFuture`.
+
+        Raises structured errors at the admission edge:
+        ``ServerOverloaded`` (bounded queue full), ``ServerClosed``
+        (draining), ``ValueError`` (shape/size). ``deadline_ms``
+        overrides ``tpu_serve_deadline_ms``; ``<= 0`` disables the
+        deadline for this request (the future still bounds its own
+        ``result()`` wait)."""
+        active_plan(self._fault_config).fire("request")
+        # snapshot the request: submit is async, and np.asarray aliases a
+        # caller-owned float64 buffer — a client reusing its buffer would
+        # otherwise have queued requests served with overwritten rows
+        arr = np.array(data, dtype=np.float64, copy=True)
+        if arr.ndim == 1:
+            arr = arr.reshape(1, -1)
+        if arr.ndim != 2 or arr.shape[1] != self._n_features:
+            raise ValueError(
+                f"request shape {arr.shape} does not match the active "
+                f"model's {self._n_features} features")
+        if deadline_ms is None:
+            deadline_ms = self._deadline_ms
+        deadline_s = (deadline_ms / 1000.0) if deadline_ms > 0 else None
+        return self._coalescer.submit(
+            arr, deadline_s, deadline_ms if deadline_ms > 0 else 0.0)
+
+    def predict(self, data, deadline_ms: Optional[float] = None,
+                timeout: Optional[float] = None):
+        """Synchronous convenience: ``submit(...).result(...)`` —
+        micro-batched with every other in-flight request, equal to the
+        active booster's ``predict(data)``."""
+        return self.submit(data, deadline_ms).result(timeout=timeout)
+
+    def _serve_batch(self, batch) -> None:
+        """One tick: pin ONE model snapshot, run the concatenated batch
+        through the device engine at a warmed rung, slice per-request
+        rows on the host. A request is never split across models."""
+        version, booster = self._registry.active()
+        rows = sum(r.n for r in batch)
+        if rows > self._resolve_max_batch(booster, version):
+            # the batch was cut under the PREVIOUS model's warmed-rung
+            # bound and a swap landed before this pin: serving it would
+            # compile in the request path (or overflow the new ladder) —
+            # raise, and the coalescer fails every request structurally
+            # (and counts the tick as an error, not as served)
+            from .errors import ServingError
+            raise ServingError(
+                f"batch of {rows} rows exceeds model {version!r}'s "
+                "largest warmed rung (hot-swap landed mid-tick); "
+                "resubmit")
+        if len(batch) == 1:
+            x = batch[0].arr
+        else:
+            x = np.concatenate([r.arr for r in batch], axis=0)
+        out, _ = booster.predict_serving(x, raw_score=self._raw_score)
+        off = 0
+        for r in batch:
+            # copy: the padded rung buffer must not stay pinned by views
+            r._complete(version, np.array(out[off:off + r.n]))
+            off += r.n
+
+    # -- model management ----------------------------------------------------
+    def deploy(self, version: str, booster, *, warm: bool = True,
+               deadline_s: Optional[float] = None) -> Dict:
+        """Pre-warm ``booster`` and atomically hot-swap it in (see
+        ModelRegistry.deploy); live traffic keeps flowing on the old
+        model until the commit lands, and a failed warmup/health check/
+        deadline rolls back automatically."""
+        stats = self._registry.deploy(
+            version, booster, warm=warm, warm_max_rows=self._warm_max_rows,
+            deadline_s=self._swap_deadline_s if deadline_s is None
+            else float(deadline_s))
+        self._after_model_change()
+        return stats
+
+    def rollback(self) -> str:
+        """Re-activate the previously active model version."""
+        v = self._registry.rollback()
+        self._after_model_change()
+        return v
+
+    def warm(self) -> Dict:
+        """Warm the active model's ladder now (servers constructed with
+        ``warm=False`` are not ready() until this runs)."""
+        stats = self._registry.warm_active(self._warm_max_rows)
+        self._after_model_change()
+        return stats
+
+    def _after_model_change(self) -> None:
+        _, active = self._registry.active()
+        with self._mu:
+            self._n_features = active._gbdt.train_set.num_total_features
+            self._fault_config = active._gbdt.config
+            self._coalescer.set_fault_config(active._gbdt.config)
+            self._coalescer.set_max_batch_rows(
+                self._resolve_max_batch(active))
+
+    @property
+    def registry(self) -> ModelRegistry:
+        return self._registry
+
+    # -- probes --------------------------------------------------------------
+    def health(self) -> Dict[str, Any]:
+        """Liveness/readiness snapshot: device reachability, warm-program
+        presence, queue depth, counters. Never raises — a health probe
+        must answer during the exact failures it exists to surface."""
+        device = guards.device_healthcheck()
+        active = self._registry.active_version()
+        warm = self._registry.warm_stats(active) or {}
+        stats = dict(self._coalescer.stats)
+        ready = bool(device["ok"] and active is not None
+                     and warm.get("rungs") and not self._closed
+                     and self._coalescer.worker_alive())
+        return {
+            "ready": ready,
+            "closed": self._closed,
+            "device": device,
+            "active_version": active,
+            "versions": self._registry.versions(),
+            "warm_rungs": list(warm.get("rungs") or []),
+            "queue_depth_rows": self._coalescer.queue_depth_rows(),
+            "max_batch_rows": self._coalescer.max_batch_rows,
+            "worker_alive": self._coalescer.worker_alive(),
+            "swaps": self._registry.swaps,
+            "failed_swaps": self._registry.failed_swaps,
+            "stats": stats,
+        }
+
+    def ready(self) -> bool:
+        """Readiness gate: device up, a warmed model active, worker
+        alive, not draining."""
+        return self.health()["ready"]
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        return dict(self._coalescer.stats)
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self, drain: bool = True,
+              timeout_s: Optional[float] = None) -> None:
+        """Graceful shutdown: stop admission, drain (or fail) the queue,
+        join the worker."""
+        self._closed = True
+        self._coalescer.close(drain=drain, timeout_s=timeout_s)
+
+    def __enter__(self) -> "PredictionServer":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close(drain=exc == (None, None, None))
+        return False

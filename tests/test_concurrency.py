@@ -220,6 +220,67 @@ def test_deepcopy_of_trained_booster_still_works():
     assert copy.deepcopy(ds) is not ds
 
 
+# ----------------------------------------------- serving coalescer traffic
+def test_coalescer_hotswap_mixed_sizes_under_sanitizer():
+    """ISSUE 9: 16 threads push MIXED batch sizes through the serving
+    coalescer while a hot-swap lands mid-stream. Every request must get a
+    response from EXACTLY ONE model version (bit-equal to that version's
+    serial prediction), the rwlock discipline must stay race-free under
+    the sanitizer, and the post-warmup steady state — including the
+    pre-warmed swap itself — must compile nothing."""
+    bst1, X = _train(8, tpu_predict_buckets="32,256")
+    bst2, _ = _train(13, tpu_predict_buckets="32,256")
+    Xq = np.concatenate([X] * 2)[:200]
+    sizes = [1, 7, 33, 200]                  # spans both bucket rungs
+    ref1 = {s: bst1.predict(Xq[:s]) for s in sizes}
+    ref2 = {s: bst2.predict(Xq[:s]) for s in sizes}
+    # pre-warm BOTH models' ladders (and conversion programs) so the
+    # guarded region below — traffic AND the mid-stream deploy — holds
+    # the zero-recompile serving contract end to end
+    bst1.warm_predict_ladder()
+    bst2.warm_predict_ladder()
+
+    srv = bst1.serve(tick_ms=1.0, queue_max=4096, deadline_ms=5000.0)
+    results, errors = [], []
+    started = threading.Barrier(N_THREADS + 1)
+
+    def client(i):
+        try:
+            started.wait()
+            for j in range(6):
+                s = sizes[(i + j) % len(sizes)]
+                fut = srv.submit(Xq[:s])
+                results.append((s, fut.result(), fut.version))
+        except Exception as err:  # pragma: no cover - the failure path
+            errors.append(err)
+
+    try:
+        with guards.api_race_sanitizer() as san, \
+                guards.compile_counter() as cc:
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(N_THREADS)]
+            for t in threads:
+                t.start()
+            started.wait()
+            srv.deploy("v2", bst2)           # hot-swap lands mid-stream
+            for t in threads:
+                t.join()
+        assert not errors, errors[:3]
+        assert len(results) == N_THREADS * 6
+        versions = {v for _, _, v in results}
+        assert versions and versions <= {"v0", "v2"}
+        for s, out, v in results:
+            ref = ref1 if v == "v0" else ref2
+            assert np.array_equal(out, ref[s]), \
+                f"size-{s} response is not version {v}'s prediction — " \
+                "a mixed-model or torn response"
+        san.assert_no_races("16-thread coalesced serving + hot-swap")
+        cc.assert_no_compiles("serving steady state across a hot-swap")
+        assert srv.stats["ticks"] < len(results)   # batching happened
+    finally:
+        srv.close(drain=False, timeout_s=5.0)
+
+
 # ------------------------------------------------------------- sanitizer
 def test_sanitizer_quiet_under_real_lock():
     bst, X = _train(5)

@@ -1,0 +1,454 @@
+"""Resilient serving layer (lightgbm_tpu/serving/): micro-batch
+coalescing, deadlines/shedding, atomic hot-swap + rollback, probes.
+
+The ISSUE 9 acceptance surface: under injected faults (hang mid-swap,
+slow tick, worker kill) the server returns structured errors or rolls
+back — never a wedged queue or a mixed-model response — and the
+post-warmup steady state compiles nothing. Faults are driven by
+analysis/faultinject.py's serving sites (coalesce_tick / swap / warmup /
+request) with the same count/disarm semantics training uses.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.analysis import faultinject, guards
+from lightgbm_tpu.ops.predict import parse_bucket_ladder, warmup_rungs
+from lightgbm_tpu.serving import (ModelRegistry, ServerClosed,
+                                  ServerOverloaded, ServeFuture,
+                                  ServingError, ServingTimeout, SwapFailed)
+
+from utils import FAST_PARAMS, binary_data, multiclass_data
+
+#: a tiny two-rung ladder so warmup compiles exactly two predict programs
+LADDER = "32,256"
+
+
+def _params(**kw):
+    return dict(FAST_PARAMS, objective="binary",
+                tpu_predict_buckets=LADDER, **kw)
+
+
+@pytest.fixture(scope="module")
+def boosters():
+    X, y = binary_data()
+    b1 = lgb.train(_params(), lgb.Dataset(X, label=y), 8)
+    b2 = lgb.train(_params(), lgb.Dataset(X, label=y), 12)
+    return b1, b2, X
+
+
+@pytest.fixture
+def server(boosters):
+    b1, _, _ = boosters
+    srv = b1.serve(tick_ms=1.0, queue_max=512, deadline_ms=3000.0)
+    yield srv
+    srv.close(drain=False, timeout_s=5.0)
+
+
+# ------------------------------------------------------------ enumeration
+def test_warmup_rungs_enumeration():
+    ladder = parse_bucket_ladder("32,256,1024")
+    assert warmup_rungs(ladder) == (32, 256, 1024)
+    assert warmup_rungs(ladder, max_rows=300) == (32, 256)
+    assert warmup_rungs(ladder, max_rows=0) == (32, 256, 1024)
+    # a cap below every rung still yields a usable batch bound
+    assert warmup_rungs(ladder, max_rows=8) == (32,)
+
+
+def test_warm_predict_ladder_stats(boosters):
+    b1, _, _ = boosters
+    stats = b1.warm_predict_ladder()
+    assert stats["rungs"] == [32, 256]
+    assert set(stats["cache"]) == {"requests", "hits", "misses"}
+    # re-warm in the same process: the jit cache is already hot
+    again = b1.warm_predict_ladder()
+    assert again["lowerings"] == 0 and again["backend_compiles"] == 0
+
+
+# ------------------------------------------------------- serving fast path
+def test_predict_serving_padded_parity(boosters):
+    b1, _, X = boosters
+    out, n = b1.predict_serving(X[:10])
+    assert out.shape == (32,) and n == 10        # padded to the rung
+    np.testing.assert_array_equal(out[:n], b1.predict(X[:10]))
+    raw, _ = b1.predict_serving(X[:10], raw_score=True)
+    np.testing.assert_array_equal(raw[:n], b1.predict(X[:10],
+                                                      raw_score=True))
+
+
+def test_predict_serving_honors_predict_window_params(boosters):
+    """predict()'s params-level window overrides
+    (num_iteration_predict / start_iteration_predict) apply to the
+    serving path too — parity is bit-for-bit, windows included."""
+    _, _, X = boosters
+    y = (X[:, 1] > 0).astype(float)
+    bst = lgb.train(_params(num_iteration_predict=2),
+                    lgb.Dataset(X, label=y), 6)
+    out, n = bst.predict_serving(X[:9])
+    np.testing.assert_array_equal(out[:n], bst.predict(X[:9]))
+    # and the override really is a 2-iteration window, not the full model
+    assert not np.array_equal(out[:n], bst.predict(X[:9],
+                                                   num_iteration=6))
+
+
+def test_predict_serving_honors_pred_early_stop(boosters):
+    """pred_early_stop is per-row, so its approximation survives
+    batching — serving parity includes it."""
+    _, _, X = boosters
+    y = (X[:, 2] > 0).astype(float)
+    bst = lgb.train(_params(pred_early_stop=True,
+                            pred_early_stop_margin=0.5,
+                            pred_early_stop_freq=2),
+                    lgb.Dataset(X, label=y), 8)
+    out, n = bst.predict_serving(X[:15])
+    np.testing.assert_array_equal(out[:n], bst.predict(X[:15]))
+
+
+def test_scan_engine_booster_rejected_by_serving(boosters):
+    """tpu_predict_engine=scan recompiles per shape by design: a server
+    on it could never reach readiness, so deploy refuses up front."""
+    _, _, X = boosters
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train(_params(tpu_predict_engine="scan"),
+                    lgb.Dataset(X, label=y), 2)
+    with pytest.raises(SwapFailed, match="scan"):
+        bst.serve()
+    assert "skipped" in bst.warm_predict_ladder()   # library API still up
+
+
+def test_predict_serving_multiclass_shape():
+    X, y = multiclass_data()
+    params = dict(FAST_PARAMS, objective="multiclass", num_class=3,
+                  tpu_predict_buckets=LADDER)
+    bst = lgb.train(params, lgb.Dataset(X, label=y), 3)
+    out, n = bst.predict_serving(X[:7])
+    assert out.shape == (32, 3) and n == 7
+    np.testing.assert_array_equal(out[:n], bst.predict(X[:7]))
+
+
+def test_coalescer_batches_concurrent_requests(server, boosters):
+    b1, _, X = boosters
+    refs = {s: b1.predict(X[:s]) for s in (3, 17, 40)}
+    barrier = threading.Barrier(12)
+    results, errors = {}, []
+
+    def client(i):
+        try:
+            s = (3, 17, 40)[i % 3]
+            barrier.wait()
+            results[i] = (s, server.submit(X[:s]).result())
+        except Exception as err:  # pragma: no cover - failure path
+            errors.append(err)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for i, (s, out) in results.items():
+        np.testing.assert_array_equal(out, refs[s])
+    stats = server.stats
+    # coalescing happened: 12 concurrent requests took fewer ticks
+    assert stats["served_requests"] == 12
+    assert stats["ticks"] < 12
+
+
+def test_sync_predict_equals_booster_predict(server, boosters):
+    b1, _, X = boosters
+    np.testing.assert_array_equal(server.predict(X[:5]), b1.predict(X[:5]))
+    one = server.predict(X[0])                   # 1-row request path
+    np.testing.assert_array_equal(one, b1.predict(X[:1]))
+
+
+def test_zero_steady_state_recompiles_mixed_sizes(server, boosters):
+    _, _, X = boosters
+    server.predict(X[:40])                        # touch both rungs once
+    server.predict(X[:200])
+    with guards.compile_counter() as cc:
+        for _ in range(3):
+            futs = [server.submit(X[:s]) for s in (1, 5, 17, 32, 64, 200)]
+            for f in futs:
+                f.result()
+    cc.assert_no_compiles("post-warmup serving steady state")
+
+
+# --------------------------------------------------- deadlines & shedding
+def test_future_result_is_deadline_bounded():
+    fut = ServeFuture(np.zeros((1, 4)), deadline_s=0.05, deadline_ms=50.0)
+    t0 = time.monotonic()
+    with pytest.raises(ServingTimeout):
+        fut.result(timeout=0.1)
+    assert time.monotonic() - t0 < 5.0
+    # the synthesized timeout IS the future's outcome (completion is a
+    # CAS): a worker finishing later cannot overwrite it, and repeat
+    # reads agree with the first
+    fut._complete("v", 1.0)
+    with pytest.raises(ServingTimeout):
+        fut.result()
+    ok = ServeFuture(np.zeros((1, 4)), deadline_s=5.0, deadline_ms=5000.0)
+    ok._complete("v", 1.0)
+    assert ok.result() == 1.0 and ok.version == "v"
+    assert ok.latency_s is not None
+
+
+def test_request_expired_in_queue_gets_structured_timeout(boosters):
+    b1, _, X = boosters
+    srv = b1.serve(tick_ms=1.0, queue_max=64, deadline_ms=3000.0)
+    try:
+        with faultinject.inject("hang@coalesce_tick=1:seconds=0.5"):
+            first = srv.submit(X[:1])             # pops + hangs the tick
+            time.sleep(0.05)
+            doomed = srv.submit(X[:1], deadline_ms=100.0)
+            with pytest.raises(ServingTimeout):
+                doomed.result()
+            assert np.isfinite(first.result(timeout=5.0)).all()
+        assert srv.stats["timeouts"] >= 1
+    finally:
+        srv.close(drain=False, timeout_s=5.0)
+
+
+def test_slow_tick_sheds_instead_of_growing_queue(boosters):
+    """ISSUE 9 satellite: a slow tick (injected hang@coalesce_tick) must
+    convert overload into ServerOverloaded at the admission edge; the
+    queue never exceeds tpu_serve_queue_max rows, and the server serves
+    normally once the fault disarms."""
+    b1, _, X = boosters
+    srv = b1.serve(tick_ms=1.0, queue_max=8, deadline_ms=3000.0)
+    try:
+        with faultinject.inject(
+                "hang@coalesce_tick=1:count=2:seconds=0.4") as plan:
+            srv.submit(X[:1])                     # tick 1 pops this, hangs
+            time.sleep(0.05)
+            shed, admitted = 0, []
+            for _ in range(30):
+                try:
+                    admitted.append(srv.submit(X[:1]))
+                except ServerOverloaded:
+                    shed += 1
+            assert shed > 0
+            assert srv.stats["max_queue_rows"] <= 8
+            for f in admitted:                    # bounded completion
+                assert np.isfinite(f.result(timeout=10.0)).all()
+            assert plan.faults[0].fired >= 1
+        # recovery: fault disarmed, normal service
+        np.testing.assert_array_equal(srv.predict(X[:3]), b1.predict(X[:3]))
+        assert srv.stats["shed"] == shed
+    finally:
+        srv.close(drain=False, timeout_s=5.0)
+
+
+def test_killed_worker_respawns_and_queue_keeps_draining(boosters):
+    b1, _, X = boosters
+    srv = b1.serve(tick_ms=1.0, queue_max=64, deadline_ms=3000.0)
+    try:
+        with faultinject.inject("kill@coalesce_tick=1"):
+            doomed = srv.submit(X[:2])
+            with pytest.raises(ServingError):
+                doomed.result()
+        deadline = time.monotonic() + 5.0
+        while (not srv.stats["worker_restarts"]
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert srv.stats["worker_restarts"] >= 1
+        assert srv.health()["worker_alive"]
+        np.testing.assert_array_equal(srv.predict(X[:4]), b1.predict(X[:4]))
+    finally:
+        srv.close(drain=False, timeout_s=5.0)
+
+
+def test_transient_request_fault_surfaces_at_submit(server, boosters):
+    _, _, X = boosters
+    with faultinject.inject("transient@request=1"):
+        with pytest.raises(RuntimeError, match="injected transient"):
+            server.submit(X[:1])
+    assert np.isfinite(server.predict(X[:1])).all()
+
+
+# ----------------------------------------------------- hot-swap / rollback
+def test_hot_swap_serves_exactly_one_version(boosters):
+    b1, b2, X = boosters
+    ref1, ref2 = b1.predict(X[:20]), b2.predict(X[:20])
+    assert not np.array_equal(ref1, ref2)
+    srv = b1.serve(tick_ms=1.0, deadline_ms=3000.0)
+    try:
+        stop, results, errors = threading.Event(), [], []
+
+        def hammer():
+            while not stop.is_set():
+                f = srv.submit(X[:20])
+                try:
+                    results.append((f.result(), f.version))
+                except Exception as err:  # pragma: no cover
+                    errors.append(err)
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)
+        srv.deploy("v2", b2)                     # mid-stream atomic swap
+        time.sleep(0.1)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:2]
+        versions = {v for _, v in results}
+        assert versions <= {"v0", "v2"} and "v2" in versions
+        for out, v in results:
+            np.testing.assert_array_equal(out, ref1 if v == "v0" else ref2)
+        assert srv.health()["active_version"] == "v2"
+        # rollback re-activates v0
+        assert srv.rollback() == "v0"
+        np.testing.assert_array_equal(srv.predict(X[:20]), ref1)
+    finally:
+        srv.close(drain=False, timeout_s=5.0)
+
+
+def test_hang_mid_swap_rolls_back(boosters):
+    """ISSUE 9 acceptance: a swap commit that hangs past its deadline is
+    abandoned via the epoch token — SwapFailed, the old model stays
+    active, and the abandoned commit can never land later."""
+    b1, b2, X = boosters
+    srv = b1.serve(tick_ms=1.0, deadline_ms=3000.0)
+    try:
+        with faultinject.inject("hang@swap=1:seconds=3"):
+            with pytest.raises(SwapFailed, match="did not commit"):
+                srv.deploy("v2", b2, deadline_s=0.5)
+        h = srv.health()
+        assert h["active_version"] == "v0" and h["failed_swaps"] == 1
+        np.testing.assert_array_equal(srv.predict(X[:6]), b1.predict(X[:6]))
+        time.sleep(3.0)                          # abandoned worker wakes...
+        assert srv.health()["active_version"] == "v0"   # ...token refused
+        srv.deploy("v2", b2)                     # clean swap still works
+        assert srv.health()["active_version"] == "v2"
+    finally:
+        srv.close(drain=False, timeout_s=5.0)
+
+
+def test_failed_warmup_rolls_back(boosters):
+    b1, b2, X = boosters
+    srv = b1.serve(tick_ms=1.0, deadline_ms=3000.0)
+    try:
+        with faultinject.inject("transient@warmup=1"):
+            with pytest.raises(SwapFailed, match="warmup/health"):
+                srv.deploy("v2", b2)
+        assert srv.health()["active_version"] == "v0"
+        assert srv.health()["failed_swaps"] == 1
+        np.testing.assert_array_equal(srv.predict(X[:4]), b1.predict(X[:4]))
+    finally:
+        srv.close(drain=False, timeout_s=5.0)
+
+
+def test_registry_guards():
+    reg = ModelRegistry()
+    with pytest.raises(ServingError, match="no active model"):
+        reg.active()
+    with pytest.raises(ServingError, match="no previous"):
+        reg.rollback()
+    with pytest.raises(SwapFailed, match="cannot take the device"):
+        reg.deploy("v0", object())
+
+
+def test_registry_version_conflict_and_retire(boosters):
+    b1, b2, _ = boosters
+    reg = ModelRegistry()
+    reg.deploy("a", b1, warm=False, health_check=False)
+    with pytest.raises(SwapFailed, match="already deployed"):
+        reg.deploy("a", b2, warm=False, health_check=False)
+    reg.deploy("b", b2, warm=False, health_check=False)
+    with pytest.raises(ServingError, match="cannot retire the active"):
+        reg.retire("b")
+    reg.retire("a")
+    assert reg.versions() == ["b"]
+
+
+# -------------------------------------------------- drain / close / probes
+def test_graceful_drain_completes_everything(boosters):
+    b1, _, X = boosters
+    srv = b1.serve(tick_ms=5.0, queue_max=512, deadline_ms=5000.0)
+    futs = [srv.submit(X[:3]) for _ in range(20)]
+    srv.close(drain=True)                         # blocking drain
+    assert all(f.done() for f in futs)
+    ref = b1.predict(X[:3])
+    for f in futs:
+        np.testing.assert_array_equal(f.result(), ref)
+    with pytest.raises(ServerClosed):
+        srv.submit(X[:1])
+    assert not srv.ready()
+
+
+def test_close_without_drain_fails_queued_structurally(boosters):
+    b1, _, X = boosters
+    srv = b1.serve(tick_ms=1.0, deadline_ms=3000.0)
+    with faultinject.inject("hang@coalesce_tick=1:seconds=0.3"):
+        srv.submit(X[:1])
+        time.sleep(0.05)
+        queued = [srv.submit(X[:1]) for _ in range(4)]
+        srv.close(drain=False, timeout_s=5.0)
+    done = [f for f in queued if f.done()]
+    for f in done:
+        with pytest.raises(ServerClosed):
+            f.result()
+
+
+def test_health_and_readiness_probes(boosters):
+    b1, _, X = boosters
+    srv = b1.serve(tick_ms=1.0, warm=False)
+    try:
+        h = srv.health()
+        assert h["device"]["ok"] and h["device"]["platform"] == "cpu"
+        assert h["active_version"] == "v0" and not h["warm_rungs"]
+        assert not h["ready"]                     # unwarmed != ready
+        stats = srv.warm()
+        assert stats["rungs"] == [32, 256]
+        assert srv.ready()
+        assert srv.health()["max_batch_rows"] == 256
+        assert json.dumps(srv.health(), default=str)   # probe serializes
+    finally:
+        srv.close(drain=False, timeout_s=5.0)
+
+
+def test_oversized_and_malformed_requests_rejected(server, boosters):
+    _, _, X = boosters
+    with pytest.raises(ValueError, match="largest warmed"):
+        server.submit(np.zeros((1000, X.shape[1])))
+    with pytest.raises(ValueError, match="features"):
+        server.submit(np.zeros((2, X.shape[1] + 3)))
+    with pytest.raises(ValueError, match="empty"):
+        server.submit(np.zeros((0, X.shape[1])))
+
+
+# The compile-cache-across-restarts satellite test lives in
+# tests/test_zz_serving_cache.py: its jax.clear_caches() calls (the
+# process-restart stand-in) would force every LATER-collected test file
+# to re-lower its programs, so it must run at the end of the suite.
+
+
+# ----------------------------------------------------------- bench & CLI
+def test_bench_stage_labels_serving(monkeypatch):
+    import bench
+    monkeypatch.setenv("BENCH_SERVING", "1")
+    monkeypatch.delenv("BENCH_HIST_MICRO", raising=False)
+    monkeypatch.delenv("BENCH_PREDICT", raising=False)
+    assert bench._bench_stage() == "serving"
+
+
+def test_cli_probe_reports_ready(tmp_path, capsys):
+    from lightgbm_tpu.serving.cli import main
+    rng = np.random.RandomState(0)
+    X = rng.randn(80, 4)
+    y = (X[:, 0] > 0).astype(float)
+    csv = tmp_path / "train.csv"
+    np.savetxt(csv, np.column_stack([y, X]), delimiter=",")
+    rc = main([str(csv), "--rounds", "2", "--probe",
+               "--param", "objective=binary", "--param", "max_bin=15",
+               "--param", "num_leaves=4", "--param", "min_data_in_leaf=5",
+               "--param", f"tpu_predict_buckets={LADDER}"])
+    assert rc == 0
+    health = json.loads(capsys.readouterr().out)
+    assert health["ready"] and health["warm_rungs"] == [32, 256]

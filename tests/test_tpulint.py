@@ -942,6 +942,110 @@ def test_r007_shipped_api_is_locked():
         [f.render() for f in findings]
 
 
+# ---------------------------------------------------------------- R008
+def test_r008_unbounded_queue_flagged(tmp_path):
+    """Seed: a serving class enqueuing into a maxsize-less queue — the
+    slow-tick overload turns into unbounded latency instead of shedding."""
+    findings = lint_snippet(tmp_path, """
+        import queue
+
+        class RequestServer:
+            def __init__(self):
+                self.q = queue.Queue()
+
+            def submit(self, req):
+                self.q.put_nowait(req)
+    """)
+    r8 = [f for f in findings if f.rule == "R008"]
+    assert len(r8) == 1 and "maxsize" in r8[0].message
+
+
+def test_r008_simplequeue_and_unbounded_deque_flagged(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import collections
+        import queue
+
+        class Coalescer:
+            def __init__(self):
+                self.q = collections.deque()
+                self.sq = queue.SimpleQueue()
+    """)
+    r8 = [f for f in findings if f.rule == "R008"]
+    assert len(r8) == 2
+    assert any("maxlen" in f.message for f in r8)
+    assert any("SimpleQueue" in f.message for f in r8)
+
+
+def test_r008_blocking_without_timeout_flagged(tmp_path):
+    """Seed: request-path waits with no deadline — a wedged tick then
+    wedges every caller instead of raising ServingTimeout."""
+    findings = lint_snippet(tmp_path, """
+        def serve_one(q, out, fut, fut2, ev):
+            item = q.get()
+            also = q.get(True)          # queue block flag, not a timeout
+            out.put(item)
+            late = fut2.result(None)    # explicit-None timeout blocks too
+            ev.wait(timeout=None)
+            return fut.result(), item, also, late
+    """)
+    r8 = [f for f in findings if f.rule == "R008"]
+    assert len(r8) == 6
+    assert all("timeout" in f.message for f in r8)
+    assert any(".put()" in f.message for f in r8)   # producer-side twin
+
+
+def test_r008_bounded_and_deadlined_clean(tmp_path):
+    """Bounded queues + deadline-carrying waits are the contract; also:
+    dict-style .get(key) and positional-timeout waits are not findings."""
+    findings = lint_snippet(tmp_path, """
+        import collections
+        import queue
+
+        class PredictionServer:
+            def __init__(self, cfg):
+                self.q = queue.Queue(maxsize=64)
+                self.dq = collections.deque(maxlen=cfg.get("cap", 8))
+
+            def submit(self, req, done, table):
+                self.q.put(req, timeout=0.5)
+                self.q.put(req, False)
+                done.wait(0.5)
+                table.get(req)              # dict-style get: not a wait
+                return req.result(timeout=1.0)
+    """)
+    assert not [f for f in findings if f.rule == "R008"]
+
+
+def test_r008_non_serving_scope_not_flagged(tmp_path):
+    """The rule is scoped: the same patterns outside serving-named
+    modules/classes/functions (training workers, IO pools) are not
+    serving entry points."""
+    findings = lint_snippet(tmp_path, """
+        import queue
+
+        class TrainWorker:
+            def __init__(self):
+                self.q = queue.Queue()
+
+            def run(self, fut):
+                return fut.result()
+    """)
+    assert not [f for f in findings if f.rule == "R008"]
+
+
+def test_r008_shipped_serving_layer_needs_only_the_drain_anchor():
+    """The shipped serving package has exactly one R008 finding — the
+    deliberate graceful-drain join — and it is allowlist-anchored."""
+    path = os.path.join(PKG_DIR, "serving")
+    findings, errors = lint_paths([path])
+    assert not errors
+    r8 = [f for f in findings if f.rule == "R008"]
+    assert len(r8) == 1 and r8[0].func.endswith("close"), \
+        [f.render() for f in r8]
+    entries, _ = load_allowlist(DEFAULT_ALLOWLIST)
+    assert not apply_allowlist(r8, entries)
+
+
 # ------------------------------------------------------------ allowlist
 def test_allowlist_suppresses_and_tracks_usage(tmp_path):
     snippet = tmp_path / "mod.py"
