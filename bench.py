@@ -730,6 +730,14 @@ def run_serving_bench(print_json=True):
                 "failed": failed + misc_errors[0],
             }
             levels[str(qps)] = cell
+            # same schema as the training rows: when BENCH_METRICS_PATH is
+            # armed, each level also lands in the unified metrics stream
+            # (shed-rate beside compile counts — scripts/obs reads both)
+            if os.environ.get("BENCH_METRICS_PATH"):
+                from lightgbm_tpu.obs import metrics as obs_metrics
+                s = obs_metrics.stream_for(os.environ["BENCH_METRICS_PATH"])
+                if s is not None:
+                    s.emit("serving_level", qps=qps, **cell)
             sys.stderr.write(
                 f"[bench-serving] qps={qps}: achieved="
                 f"{cell['achieved_qps']} p50={cell['p50_ms']}ms "
@@ -888,6 +896,27 @@ def _main(stage=None):
     else:
         X, y = make_higgs_like(ROWS, FEATURES)
 
+    # unified telemetry (ISSUE 10): the per-iteration metrics stream is
+    # the ONE source the BENCH row's counters come from — the booster
+    # emits cumulative phase-keyed compile counts per update, bench adds
+    # window marks, and obs/summarize.bench_counters diffs them (the
+    # inline compile_counter guards below stay as the fallback when the
+    # stream is absent)
+    metrics_path = os.environ.get(
+        "BENCH_METRICS_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".bench_metrics.jsonl"))
+    from lightgbm_tpu.obs import metrics as obs_metrics
+    from lightgbm_tpu.obs import summarize as obs_summarize
+    mstream = obs_metrics.stream_for(metrics_path)
+
+    def _mark(name):
+        from lightgbm_tpu.analysis import guards as _g
+        if mstream is not None:
+            mstream.emit("mark", name=name,
+                         compiles=_g.phase_compile_counts(),
+                         cache=_g.global_cache_counts())
+
     params = {
         "objective": "binary",
         "metric": "auc",
@@ -898,6 +927,7 @@ def _main(stage=None):
         "verbosity": -1,
         # bench runs sync-free; one stop check at the end
         "stop_check_freq": 10_000,
+        "tpu_metrics_path": metrics_path,
     }
     if sparse:
         # binary one-hot features: a small sample fully determines the bins,
@@ -932,6 +962,7 @@ def _main(stage=None):
                                  f"checkpoint in {ckpt_dir}: {err}\n")
     t_run0 = time.time()
     t0 = time.time()
+    _mark("warmup_start")
     # count warmup lowerings + persistent-cache lookups: with the step
     # ladder (tpu_step_buckets) compile_events is the O(1) rung budget, and
     # a warm BENCH_CACHE_DIR shows cache hits == requests (backend compile
@@ -963,6 +994,7 @@ def _main(stage=None):
                 bst.update()
         bst._gbdt._flush_trees()
     warmup_s = time.time() - t0
+    _mark("warmup_end")
 
     t0 = time.time()
     timed_from = bst.current_iteration()
@@ -975,6 +1007,18 @@ def _main(stage=None):
                 bst.update()
         bst._gbdt._flush_trees()  # materialize: all device work finishes
     train_s = time.time() - t0
+    _mark("steady_end")
+    # the unified-schema counters: derived from the metrics stream (the
+    # booster's cumulative per-iteration records + the marks above); the
+    # inline counters remain the fallback for a missing/partial stream.
+    # Gated on THIS run's stream being live — a stale file from a prior
+    # invocation would otherwise hand the row the old run's numbers
+    stream_row = (obs_summarize.bench_counters(metrics_path)
+                  if mstream is not None else None) or {}
+    if stream_row:
+        sys.stderr.write(
+            f"[bench] counters from metrics stream {metrics_path}: "
+            f"{json.dumps(stream_row)}\n")
 
     # rate over the updates ACTUALLY performed this invocation: a resumed
     # round runs fewer than ITERS in the timed loop, and dividing by the
@@ -1048,16 +1092,23 @@ def _main(stage=None):
         "compile_s": round(compile_s, 1), "auc": auc,
         "wall_to_auc_s": wall_to_auc,
         "wall_to_auc_target": tta_target,
-        # compile-time ladder accounting (ISSUE 8): distinct programs
-        # lowered during warmup (the rung budget under tpu_step_buckets),
+        # compile-time ladder accounting (ISSUE 8) via the unified metrics
+        # stream (ISSUE 10): distinct programs lowered during warmup (the
+        # rung budget under tpu_step_buckets) WITH phase attribution,
         # steady-state lowerings (must be 0), and persistent-cache
         # hit/miss so warm BENCH_CACHE_DIR rounds are distinguishable
-        "warmup_seconds": round(warmup_s, 1),
-        "compile_events": warm_cc.lowerings,
-        "compile_events_steady": steady_cc.lowerings,
-        "compile_cache": {"requests": warm_cache.requests,
-                          "hits": warm_cache.hits,
-                          "misses": warm_cache.misses},
+        "warmup_seconds": stream_row.get("warmup_seconds",
+                                         round(warmup_s, 1)),
+        "compile_events": stream_row.get("compile_events",
+                                         warm_cc.lowerings),
+        "compile_events_by_phase": stream_row.get("compile_events_by_phase"),
+        "compile_events_steady": stream_row.get("compile_events_steady",
+                                                steady_cc.lowerings),
+        "compile_cache": stream_row.get(
+            "compile_cache", {"requests": warm_cache.requests,
+                              "hits": warm_cache.hits,
+                              "misses": warm_cache.misses}),
+        "metrics_stream": metrics_path if stream_row else None,
     })
     print(json.dumps({
         "metric": f"synthetic-{shape}{ROWS // 1_000_000}M-"
@@ -1065,10 +1116,14 @@ def _main(stage=None):
         "value": round(iters_per_sec, 3),
         "unit": "iters/sec/chip",
         "vs_baseline": round(iters_per_sec / BASELINE_ITERS_PER_SEC, 3),
-        "warmup_seconds": round(warmup_s, 1),
-        "compile_events": warm_cc.lowerings,
-        "compile_cache_hits": warm_cache.hits,
-        "compile_cache_misses": warm_cache.misses,
+        "warmup_seconds": stream_row.get("warmup_seconds",
+                                         round(warmup_s, 1)),
+        "compile_events": stream_row.get("compile_events",
+                                         warm_cc.lowerings),
+        "compile_cache_hits": stream_row.get(
+            "compile_cache", {}).get("hits", warm_cache.hits),
+        "compile_cache_misses": stream_row.get(
+            "compile_cache", {}).get("misses", warm_cache.misses),
     }))
 
 

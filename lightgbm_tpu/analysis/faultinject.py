@@ -189,6 +189,12 @@ class FaultPlan:
 
     def _trigger(self, f: Fault, ctx: dict) -> None:
         where = f"{f.site}@{ctx.get('iteration', self._site_ordinal[f.site])}"
+        # black-box entry BEFORE the fault acts: a kill escapes every
+        # handler, but the ring (dumped by the crash/interrupt handlers,
+        # or at the next checkpoint tick) names the site that fired
+        from ..obs import flight
+        flight.note("fault_fire", site=f.site, kind=f.kind, at=where,
+                    fired=f.fired)
         if f.kind == "kill":
             log.warning(f"[faultinject] simulated kill at {where}")
             raise SimulatedKill(f"injected kill at {where}")

@@ -10,7 +10,11 @@ or an array is materialized on the host inside it.
     ``lowerings`` (jaxpr->MLIR) increments on every in-memory cache miss —
     including ones served by the persistent compilation cache, which skips
     only the backend compile — so it is the honest "did jit re-trace"
-    signal. ``backend_compiles`` counts actual XLA compiles.
+    signal. ``backend_compiles`` counts actual XLA compiles. Counts are
+    also keyed by the active ``compile_phase()`` (train step / predict
+    warmup / serving) in ``by_phase``, and a process-lifetime listener
+    (``install_global_compile_listener``) feeds the same attribution to
+    the obs/ metrics plane and the flight recorder.
 
 ``no_host_transfers``
     Patches the Python-level host-materialization funnels on
@@ -72,10 +76,55 @@ class HostTransferError(AssertionError):
     """An array was materialized on the host inside a guarded region."""
 
 
+#: thread-local compile-phase stack (jax compiles synchronously on the
+#: calling thread, so the phase at event time attributes the compile)
+_phase_local = threading.local()
+
+#: phase recorded when no compile_phase() scope is active
+DEFAULT_PHASE = "other"
+
+
+def current_compile_phase() -> str:
+    stack = getattr(_phase_local, "stack", None)
+    return stack[-1] if stack else DEFAULT_PHASE
+
+
+@contextlib.contextmanager
+def compile_phase(name: str) -> Iterator[None]:
+    """Attribute compile events inside the block to ``name``.
+
+    The phase key behind ``CompileCount.by_phase`` and the metrics
+    plane: ``train_step`` wraps boosting iterations, ``predict_warmup``
+    wraps the serving-ladder warm, ``serving`` wraps coalescer ticks —
+    so a BENCH row (or a flight dump) says WHERE a compile happened
+    instead of reporting one global count. Nests; the innermost wins."""
+    stack = getattr(_phase_local, "stack", None)
+    if stack is None:
+        stack = _phase_local.stack = []
+    stack.append(str(name))
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
 @dataclasses.dataclass
 class CompileCount:
     lowerings: int = 0
     backend_compiles: int = 0
+    #: phase -> {"lowerings": n, "backend_compiles": m} (see compile_phase)
+    by_phase: dict = dataclasses.field(default_factory=dict)
+
+    def bump(self, kind: str, phase: str) -> None:
+        setattr(self, kind, getattr(self, kind) + 1)
+        slot = self.by_phase.setdefault(
+            phase, {"lowerings": 0, "backend_compiles": 0})
+        slot[kind] += 1
+
+    def snapshot(self) -> dict:
+        return {"lowerings": self.lowerings,
+                "backend_compiles": self.backend_compiles,
+                "by_phase": {p: dict(v) for p, v in self.by_phase.items()}}
 
     def assert_no_compiles(self, what: str = "guarded region") -> None:
         if self.lowerings or self.backend_compiles:
@@ -83,7 +132,8 @@ class CompileCount:
                 f"{what}: expected zero recompilations, saw "
                 f"{self.lowerings} lowering(s) and "
                 f"{self.backend_compiles} backend compile(s) — a shape, "
-                "dtype, or static-arg value changed after warmup")
+                "dtype, or static-arg value changed after warmup "
+                f"(by phase: {self.by_phase})")
 
 
 @contextlib.contextmanager
@@ -127,9 +177,9 @@ def compile_counter() -> Iterator[CompileCount]:
 
     def _on_event(event: str, duration_secs: float = 0.0, **kw) -> None:
         if event == _LOWER_EVENT:
-            counts.lowerings += 1
+            counts.bump("lowerings", current_compile_phase())
         elif event == _BACKEND_EVENT:
-            counts.backend_compiles += 1
+            counts.bump("backend_compiles", current_compile_phase())
 
     with _monitoring_listener(
             _on_event, monitoring.register_event_duration_secs_listener,
@@ -202,6 +252,76 @@ def configure_compile_cache(cache_dir) -> bool:
         except Exception:
             pass
     return True
+
+
+# -- process-lifetime compile accounting (the obs/ metrics plane) ----------
+#: cumulative phase-keyed counts, fed by ONE permanently-registered
+#: listener (install_global_compile_listener); the metrics stream emits
+#: these as cumulative snapshots so any two records diff cleanly
+_global_compiles = CompileCount()
+_global_cache = CacheCount()
+_global_listener_installed = False
+_global_mu = threading.Lock()
+
+
+def install_global_compile_listener() -> None:
+    """Register the always-on compile/cache listeners (idempotent).
+
+    Unlike :func:`compile_counter` (a scoped guard), this feeds the
+    process-lifetime counters behind :func:`phase_compile_counts` and
+    records each compile into the flight recorder, phase-keyed — so a
+    post-mortem dump shows WHAT compiled right before a death, and the
+    metrics plane reports attribution without any guard being armed.
+    Cost: one python callback per compile event (compiles are rare by
+    contract — the whole repo is built around zero steady-state
+    compiles)."""
+    global _global_listener_installed
+    with _global_mu:
+        if _global_listener_installed:
+            return
+        _global_listener_installed = True
+
+    def _on_duration(event: str, duration_secs: float = 0.0, **kw) -> None:
+        kind = None
+        if event == _LOWER_EVENT:
+            kind = "lowerings"
+        elif event == _BACKEND_EVENT:
+            kind = "backend_compiles"
+        if kind is None:
+            return
+        phase = current_compile_phase()
+        with _global_mu:
+            _global_compiles.bump(kind, phase)
+        from ..obs import flight
+        flight.note("compile", kind=kind, phase=phase,
+                    seconds=round(float(duration_secs), 4))
+
+    def _on_event(event: str, **kw) -> None:
+        if event == _CACHE_REQUEST_EVENT:
+            if jax.config.jax_compilation_cache_dir:
+                with _global_mu:
+                    _global_cache.requests += 1
+        elif event == _CACHE_HIT_EVENT:
+            with _global_mu:
+                _global_cache.hits += 1
+
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    monitoring.register_event_listener(_on_event)
+
+
+def phase_compile_counts() -> dict:
+    """Cumulative process-lifetime compile counts, phase-keyed (zeros
+    until :func:`install_global_compile_listener` ran)."""
+    with _global_mu:
+        return _global_compiles.snapshot()
+
+
+def global_cache_counts() -> dict:
+    """Cumulative persistent-compile-cache counters (same caveat)."""
+    with _global_mu:
+        return {"requests": _global_cache.requests,
+                "hits": _global_cache.hits,
+                "misses": _global_cache.misses}
 
 
 #: shared device-enumeration probe state: a wedged backend pins exactly

@@ -9,12 +9,14 @@ from .r005_collectives import CollectiveAccountingRule
 from .r006_axis import AxisNameRule
 from .r007_api_race import ApiRaceRule
 from .r008_serving import ServingContractRule
+from .r009_timing import TimingRule
 
 ALL_RULES = (HostSyncRule, RecompileRule, DtypeDriftRule,
              PallasContractRule, CollectiveAccountingRule,
-             AxisNameRule, ApiRaceRule, ServingContractRule)
+             AxisNameRule, ApiRaceRule, ServingContractRule, TimingRule)
 
 __all__ = ["Finding", "ModuleInfo", "PackageInfo", "Rule", "ALL_RULES",
            "HostSyncRule", "RecompileRule", "DtypeDriftRule",
            "PallasContractRule", "CollectiveAccountingRule",
-           "AxisNameRule", "ApiRaceRule", "ServingContractRule"]
+           "AxisNameRule", "ApiRaceRule", "ServingContractRule",
+           "TimingRule"]

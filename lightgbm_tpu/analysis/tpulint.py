@@ -23,6 +23,10 @@ for the same reason — correctness tooling as a first-class layer):
         queues (maxsize/maxlen mandatory, SimpleQueue banned), no
         blocking get/result/wait/join without a timeout, no blocking
         put without block=False/timeout
+  R009  host-clock timing around async dispatch: time.time()/
+        perf_counter()/span-close in jit-reachable code is a finding,
+        and any clock-plus-dispatch function without block_until_ready
+        is pinned (declared tick sites carry allowlist anchors)
 
 Deliberate exceptions live in the checked-in allowlist
 (analysis/tpulint.allow), one entry per line:

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import copy
 import json
+import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Union
 from typing import Sequence as _Seq
 
@@ -600,12 +601,19 @@ class Booster:
         if train_set is not None:
             raise NotImplementedError(
                 "changing train_set on update is not supported")
+        from .analysis.guards import compile_phase
         fobj = fobj or self._custom_objective
-        if fobj is not None:
-            grad, hess = _call_custom_objective(fobj, self)
-            finished = self._gbdt.train_one_iter(grad, hess)
-        else:
-            finished = self._gbdt.train_one_iter()
+        t0 = time.perf_counter()
+        # every compile inside an update is attributed to the train_step
+        # phase (guards.compile_counter by_phase, the metrics plane, and
+        # the flight recorder all key on it)
+        with compile_phase("train_step"):
+            if fobj is not None:
+                grad, hess = _call_custom_objective(fobj, self)
+                finished = self._gbdt.train_one_iter(grad, hess)
+            else:
+                finished = self._gbdt.train_one_iter()
+        self._gbdt._obs_iteration_tick(time.perf_counter() - t0)
         # a stop detected by a mid-training flush (e.g. in reset_parameter)
         pending, self._pending_finish = self._pending_finish, False
         return finished or pending
@@ -1009,18 +1017,24 @@ class Booster:
             max_rows = int(cfg.get("tpu_serve_warm_max_rows", 0) or 0)
         ladder = parse_bucket_ladder(cfg.get("tpu_predict_buckets", "auto"))
         rungs = warmup_rungs(ladder, max_rows)
+        from .obs import flight
+        from .obs.spans import span
         n_feat = inner.train_set.num_total_features
         plan = active_plan(cfg)
         t0 = _time.time()
         with guards.compile_counter() as cc, \
-                guards.cache_counter() as cache:
+                guards.cache_counter() as cache, \
+                guards.compile_phase("predict_warmup"):
             for rung in rungs:
                 # ordinal-matched site (no iteration= kwarg): warmup=N
                 # means the Nth rung warmed this process
                 plan.fire("warmup", rung=rung)
                 dummy = np.zeros((rung, n_feat), np.float32)
-                self.predict_serving(dummy, start_iteration=start_iteration,
-                                     num_iteration=num_iteration)
+                with span("predict_warmup"):
+                    self.predict_serving(dummy,
+                                         start_iteration=start_iteration,
+                                         num_iteration=num_iteration)
+                flight.note("warmup_rung", rung=rung)
         return {"rungs": list(rungs), "seconds": round(_time.time() - t0, 3),
                 "lowerings": cc.lowerings,
                 "backend_compiles": cc.backend_compiles,
@@ -1034,7 +1048,9 @@ class Booster:
         bounded admission, per-request deadlines, and hot-swap-ready
         model registry. Keyword arguments override the ``tpu_serve_*``
         config knobs (``tick_ms``, ``queue_max``, ``deadline_ms``,
-        ``warm_max_rows``, ``warm``, ``version``)."""
+        ``warm_max_rows``, ``warm``, ``version``); ``metrics_port``
+        (or ``tpu_metrics_port``) exposes ``GET /metrics`` Prometheus
+        text + ``/healthz`` over stdlib HTTP (obs/metrics.py)."""
         from .serving import PredictionServer
         return PredictionServer(self, **kwargs)
 

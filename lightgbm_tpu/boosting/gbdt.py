@@ -51,13 +51,15 @@ def _bound_gradients(obj, k_total: int, scores, label, weight):
     """Objective gradients with label/weight rebound to the compact grower's
     current row order (the objective's stored arrays are in the original
     order; see Objective.row_elementwise)."""
+    from ..obs.spans import span
     old_l, old_w = obj.label, obj.weight
     obj.label, obj.weight = label, weight
     try:
-        if k_total == 1:
-            g, h = obj.get_gradients(scores[0])
-            return g[None, :], h[None, :]
-        return obj.get_gradients(scores)
+        with span("gradient"):
+            if k_total == 1:
+                g, h = obj.get_gradients(scores[0])
+                return g[None, :], h[None, :]
+            return obj.get_gradients(scores)
     finally:
         obj.label, obj.weight = old_l, old_w
 
@@ -585,6 +587,11 @@ class GBDT:
         if cache_dir:
             from ..analysis.guards import configure_compile_cache
             configure_compile_cache(cache_dir)
+        # telemetry plane (lightgbm_tpu/obs): flight-ring capacity, the
+        # global phase-keyed compile listener, and the per-iteration
+        # metrics stream when tpu_metrics_path is set
+        from .. import obs as _obs
+        self._metrics_stream = _obs.configure(config)
 
         if train_set is not None:
             self._setup_train(train_set)
@@ -1137,6 +1144,22 @@ class GBDT:
                 text = jitted.lower(*args, **kwargs).compile().as_text()
                 self._comm_hlo.setdefault(k, text)
                 self._comm_hlo_history.setdefault(k, []).append(text)
+                # flight-recorder accounting: the collectives XLA actually
+                # inserted into this program, in bytes per step — a dead
+                # run's dump carries its own comm inventory
+                try:
+                    from ..analysis.hlo import collective_bytes
+                    from ..obs import flight
+                    bts = collective_bytes(text)
+                    flight.note("collective_program", key=k,
+                                bytes={kk: v for kk, v in bts.items()
+                                       if kk not in ("total", "count")
+                                       and v},
+                                total=bts.get("total", 0),
+                                count=bts.get("count", 0),
+                                relowered=len(self._comm_hlo_history[k]) - 1)
+                except Exception:  # noqa: BLE001 - accounting best-effort
+                    pass
             return jitted(*args, **kwargs)
         return capture
 
@@ -1838,10 +1861,19 @@ class GBDT:
 
     def _gradients(self) -> Tuple[jax.Array, jax.Array]:
         """(reference: GBDT::Boosting, gbdt.cpp:220)"""
+        from ..obs.spans import span
         if self._grad_fn is None:
-            fn = self.objective.get_gradients
+            base = self.objective.get_gradients
+
+            def named(*a, **kw):
+                # span at trace time: the gradient program carries its
+                # phase name into the device trace
+                with span("gradient"):
+                    return base(*a, **kw)
+
+            fn = named
             if not getattr(self.objective, "is_stochastic", False):
-                fn = jax.jit(fn)
+                fn = jax.jit(named)
             self._grad_fn = fn
         score = self.train_score
         if self.num_tree_per_iteration == 1:
@@ -2142,6 +2174,25 @@ class GBDT:
         if len(self._dev_trees) >= k * self.stop_check_freq:
             return self._flush_trees()
         return False
+
+    def _obs_iteration_tick(self, seconds: float) -> None:
+        """Per-update telemetry tick (called from Booster.update): one
+        flight-ring event and, when ``tpu_metrics_path`` is armed, one
+        JSONL record carrying CUMULATIVE phase-keyed compile counts and
+        persistent-cache counters — host-only reads (python ints and the
+        wall clock), so the steady-state 0-d2h guard holds with telemetry
+        fully enabled. ``iteration`` is the count of completed updates
+        (absolute, so resumed runs line up)."""
+        from ..analysis import guards
+        from ..obs import flight
+        flight.note("iteration", iteration=self.iter_,
+                    seconds=round(seconds, 6))
+        stream = getattr(self, "_metrics_stream", None)
+        if stream is not None:
+            stream.emit("iteration", iteration=self.iter_,
+                        seconds=round(seconds, 6),
+                        compiles=guards.phase_compile_counts(),
+                        cache=guards.global_cache_counts())
 
     def _linear_tree_iter(self, tree, row_leaf, grad_k, hess_k, mask,
                           cur_tree_id: int, first_iter: bool) -> None:

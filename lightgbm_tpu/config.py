@@ -171,9 +171,27 @@ PARAMS: Dict[str, Tuple[Any, type, Tuple[str, ...]]] = {
     # per-leaf segments (O(N*depth)/tree), 'masked' streams all rows per
     # split (O(N*num_leaves)/tree); 'auto' picks compact for large data
     "tpu_grower": ("auto", str, ()),        # auto | compact | masked
-    # profiling: write a jax.profiler trace of the training loop here
-    # (reference aux analogue: USE_TIMETAG Common::Timer registry)
+    # observability (lightgbm_tpu/obs): phase-named device traces, the
+    # flight-recorder ring, and the metrics plane. tpu_trace_dir writes a
+    # jax.profiler trace of the run (Perfetto/TensorBoard) with every
+    # program carrying its span taxonomy name (obs/spans.py);
+    # tpu_trace_mode=annotations enables the span names + host phase
+    # table WITHOUT the full profiler trace
     "tpu_trace_dir": ("", str, ()),
+    "tpu_trace_mode": ("full", str, ("trace_mode",)),  # full | annotations
+    # per-iteration JSONL metrics stream (obs/metrics.py): one record per
+    # update with wall seconds + cumulative phase-keyed compile counts +
+    # compile-cache counters; bench.py derives its BENCH-row counters
+    # from it and scripts/obs prints the per-phase rollup
+    "tpu_metrics_path": ("", str, ("metrics_path",)),
+    # flight recorder (obs/flight.py): bounded in-memory ring of
+    # structured events dumped as JSONL on TrainingInterrupted / crash,
+    # on a blown hot-swap, and at checkpoint ticks; 0 disables
+    "tpu_flight_buffer": (512, int, ("flight_buffer",)),
+    # serving metrics endpoint (GET /metrics Prometheus text + /healthz):
+    # bound at PredictionServer start when > 0 (scripts/serve
+    # --metrics-port overrides)
+    "tpu_metrics_port": (0, int, ("metrics_port",)),
     "tpu_part_block": (2048, int, ()),      # compact partition stream block
     "tpu_hist_block": (16384, int, ()),     # compact histogram stream block
     # batched-M histogram depth: K row blocks per one-hot contraction fill

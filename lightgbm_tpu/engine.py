@@ -7,6 +7,7 @@ booster.update :309-345], cv :611, CVBooster :354, early-stop handling :342).
 from __future__ import annotations
 
 import collections
+import contextlib
 import copy
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Union
 
@@ -61,6 +62,66 @@ def train(
             num_boost_round = int(params.pop(key))
     params["num_iterations"] = num_boost_round
 
+    # one telemetry session around the WHOLE run — dataset construction
+    # included (binning is a span-taxonomy phase) — held as a context
+    # manager so the profiler trace closes on every error path
+    # (obs/spans.trace_session; tpu_trace_mode=annotations enables span
+    # names without a full profiler trace)
+    from . import obs
+    cfg0 = Config(params)
+    trace_dir = str(cfg0.get("tpu_trace_dir", "") or "")
+    trace_mode = obs.spans.resolve_trace_mode(cfg0.get("tpu_trace_mode"))
+    session = (obs.spans.trace_session(trace_dir, trace_mode)
+               if (trace_dir or cfg0.is_explicit("tpu_trace_mode"))
+               else contextlib.nullcontext())
+    # per-RUN summary baseline: the span phase-time table AND the
+    # seen-span set are process-cumulative, and a second train() in the
+    # same process (cv folds, sklearn refits, train-after-serve) must
+    # not re-report the first run's seconds or phases; taken BEFORE
+    # construction so construct-phase spans (binning) count
+    obs_baseline = {"phase": obs.spans.phase_times(),
+                    "seen": obs.spans.seen_counts()}
+    with session:
+        try:
+            return _train_impl(params, train_set, num_boost_round,
+                               valid_sets, valid_names, feval, init_model,
+                               callbacks, obs_baseline)
+        except BaseException as err:
+            # the flight recorder's "any crash escaping lgb.train" dump
+            # site — HERE, not around the boosting loop, so a death
+            # during dataset construction / multihost bootstrap /
+            # init_model load / checkpoint auto-resume still ships its
+            # post-mortem (the r05 gap). ALWAYS dump: a
+            # TrainingInterrupted from the boosting loop already dumped
+            # inside _train_impl, and re-dumping here only extends that
+            # record with the final-snapshot events — while one raised
+            # BEFORE the loop (bootstrap deadline, sync barrier) would
+            # otherwise leave nothing on disk.
+            from .obs import flight
+            from .parallel.multihost import TrainingInterrupted
+            interrupted = isinstance(err, TrainingInterrupted)
+            if not interrupted:
+                flight.note("crash", error=repr(err)[:300])
+            path = flight.dump(
+                "TrainingInterrupted" if interrupted
+                else f"crash: {type(err).__name__}",
+                extra={"error": repr(err)[:300]})
+            if path and not interrupted:
+                log.warning(f"flight recorder dumped to {path}")
+            raise
+
+
+def _train_impl(
+    params: Dict[str, Any],
+    train_set: Dataset,
+    num_boost_round: int,
+    valid_sets: Optional[Sequence[Dataset]],
+    valid_names: Optional[Sequence[str]],
+    feval: Optional[Union[Callable, Sequence[Callable]]],
+    init_model: Optional[Union[str, Booster]],
+    callbacks: Optional[Sequence[Callable]],
+    obs_baseline: Dict[str, Any],
+) -> Booster:
     # continue-training: the loaded model's trees stay value-space
     # (reference: engine.py init_model -> _InnerPredictor; gbdt.cpp:250-258);
     # its raw predictions seed all cached scores and its tree blocks are
@@ -179,14 +240,24 @@ def train(
                 log.warning(f"ignoring incompatible checkpoint in "
                             f"{ckpt_dir}: {err}")
 
-    # profiling (reference aux: USE_TIMETAG timers; here a jax.profiler
-    # trace of the device programs, viewable in TensorBoard/Perfetto)
-    trace_dir = str(params.get("tpu_trace_dir", "") or "")
-    trace_ctx = None
-    if trace_dir:
-        import jax
-        trace_ctx = jax.profiler.trace(trace_dir)
-        trace_ctx.__enter__()
+    # telemetry (lightgbm_tpu/obs): the trace session is already held by
+    # train() around this whole function; here the flight recorder and
+    # the metrics stream get their run-level hooks
+    from . import obs
+    from .obs import flight
+    mstream = booster._gbdt._metrics_stream
+    if mstream is not None:
+        mstream.emit("mark", name="train_begin",
+                     iteration=start_iteration,
+                     num_boost_round=num_boost_round)
+
+    def _flight_dump(reason: str, err: BaseException) -> None:
+        # the TrainingInterrupted dump site; other crashes dump from the
+        # train() wrapper, which covers construction/resume too
+        flight.note("training_interrupted", error=repr(err)[:300])
+        path = flight.dump(reason, extra={"error": repr(err)[:300]})
+        if path:
+            log.warning(f"flight recorder dumped to {path}")
 
     try:
         evaluation_result_list: List = []
@@ -234,10 +305,13 @@ def train(
                 finished = booster._gbdt._flush_trees() or finished
                 booster.save_model(f"{snapshot_out}.snapshot_iter_{i + 1}")
             # full-state checkpoint tick: the ONE planned device->host
-            # fetch outside stop checks (atomic write, keep-last-k)
+            # fetch outside stop checks (atomic write, keep-last-k). The
+            # flight ring rides along — a later SIGKILL leaves the events
+            # as of the last durable snapshot on disk
             if ckpt_dir and ckpt_freq > 0 and (i + 1) % ckpt_freq == 0:
                 finished = booster._gbdt._flush_trees() or finished
                 _write_checkpoint()
+                flight.dump(f"checkpoint tick @ iteration {i + 1}")
             if finished:
                 log.info("Finished training (no further splits possible)")
                 break
@@ -248,6 +322,8 @@ def train(
         # The snapshot itself runs under a deadline — when the hung step
         # still holds the booster lock or the device state is
         # unfetchable, resume falls back to the last periodic snapshot.
+        # The flight dump ships the post-mortem either way.
+        _flight_dump("TrainingInterrupted", err)
         if ckpt_dir:
             try:
                 run_with_deadline(_write_checkpoint,
@@ -260,8 +336,22 @@ def train(
                             f"snapshot failed: {snap_err}")
         raise
     finally:
-        if trace_ctx is not None:
-            trace_ctx.__exit__(None, None, None)
+        if mstream is not None:
+            from .analysis import guards
+            # spans_seen: sites newly ENTERED during this run — host
+            # spans plus programs traced this run. A program reused from
+            # the process jit cache (module-level grow_tree across
+            # boosters) was named at its original trace and does not
+            # re-enter; the cumulative registry is spans.seen_spans()
+            mstream.emit(
+                "summary",
+                iteration=booster._gbdt.iter_,
+                phase_times=obs.spans.phase_times_since(
+                    obs_baseline["phase"]),
+                spans_seen=sorted(obs.spans.seen_since(
+                    obs_baseline["seen"])),
+                compiles=guards.phase_compile_counts(),
+                cache=guards.global_cache_counts())
     # record final scores (reference: engine.py:346-352)
     if evaluation_result_list:
         best: Dict[str, Dict[str, float]] = collections.OrderedDict()

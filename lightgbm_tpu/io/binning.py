@@ -391,6 +391,14 @@ def bin_columns(mappers: Sequence[BinMapper], arr: np.ndarray,
     float32 input is never promoted to a float64 matrix (each comparison
     upcasts exactly), so results are bit-identical to the scalar path.
     """
+    from ..obs.spans import span
+    with span("binning"):
+        return _bin_columns(mappers, arr, dtype, row_chunk, workers)
+
+
+def _bin_columns(mappers: Sequence[BinMapper], arr: np.ndarray,
+                 dtype=np.uint8, row_chunk: int = 1 << 18,
+                 workers: Optional[int] = None) -> np.ndarray:
     arr = np.asarray(arr)
     if arr.dtype not in (np.float32, np.float64):
         arr = arr.astype(np.float64)

@@ -84,33 +84,38 @@ def write_snapshot(directory: str, iteration: int, state: Dict[str, Any],
     Returns the final path. Rotation (keep-last-``keep``) runs only after
     the new snapshot is durably in place; ``keep <= 0`` keeps everything.
     """
+    from ..obs import flight
+    from ..obs.spans import span
     os.makedirs(directory, exist_ok=True)
-    payload = pickle.dumps(state, protocol=4)
-    digest = hashlib.sha256(payload).digest()
-    final = snapshot_path(directory, iteration)
-    fd, tmp = tempfile.mkstemp(dir=directory, prefix=".snapshot_tmp_")
-    try:
-        with os.fdopen(fd, "wb") as fh:
-            fh.write(MAGIC)
-            fh.write(len(payload).to_bytes(8, "big"))
-            fh.write(digest)
-            fh.write(payload)
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, final)
-    except BaseException:
+    with span("checkpoint_write"):
+        payload = pickle.dumps(state, protocol=4)
+        digest = hashlib.sha256(payload).digest()
+        final = snapshot_path(directory, iteration)
+        fd, tmp = tempfile.mkstemp(dir=directory, prefix=".snapshot_tmp_")
         try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
-    _fsync_dir(directory)
+            with os.fdopen(fd, "wb") as fh:
+                fh.write(MAGIC)
+                fh.write(len(payload).to_bytes(8, "big"))
+                fh.write(digest)
+                fh.write(payload)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, final)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        _fsync_dir(directory)
     if keep > 0:
         for _, old in list_snapshots(directory)[:-keep]:
             try:
                 os.unlink(old)
             except OSError:  # pragma: no cover - already gone
                 pass
+    flight.note("snapshot", path=final, iteration=iteration,
+                bytes=len(payload))
     # chaos hook: corrupt@snapshot=N damages the file that just landed,
     # exercising the checksum fallback path deterministically
     from ..analysis.faultinject import active_plan
@@ -153,6 +158,8 @@ def load_latest(directory: str) -> Optional[Dict[str, Any]]:
             state = read_snapshot(path)
         except SnapshotCorrupt as err:
             log.warning(f"skipping corrupted snapshot: {err}")
+            from ..obs import flight
+            flight.note("snapshot_corrupt", path=path, error=str(err)[:200])
             continue
         state.setdefault("iteration", iteration)
         return state

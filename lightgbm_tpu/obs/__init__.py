@@ -1,0 +1,65 @@
+"""lightgbm_tpu.obs — unified telemetry: spans, flight recorder, metrics.
+
+One observability subsystem spanning training, collectives, and serving
+(the reproduction's answer to the reference's ``USE_TIMETAG``
+``Common::Timer`` registry plus the ops tooling it never had):
+
+* :mod:`.spans` — phase-named spans (``span("hist_build")``): zero-cost
+  when disabled, ``jax.named_scope`` under trace so DEVICE programs carry
+  the phase names into the ``tpu_trace_dir`` Perfetto/TensorBoard trace,
+  host timing + ``TraceAnnotation`` at the declared tick sites;
+  ``trace_session`` owns the ``tpu_trace_dir``/``tpu_trace_mode`` knobs.
+* :mod:`.flight` — bounded ring of structured events (iteration ticks,
+  phase-keyed compile events, collective byte accounting, fault fires,
+  deadline/retry outcomes), dumped as JSONL on ``TrainingInterrupted``,
+  on a blown hot-swap, and at checkpoint ticks (``tpu_flight_buffer``).
+* :mod:`.metrics` — per-iteration JSONL stream (``tpu_metrics_path``;
+  bench.py derives its BENCH-row counters from it) and a pull-based
+  Prometheus-text endpoint served from PredictionServer
+  (``--metrics-port`` on ``scripts/serve``). stdlib HTTP, no new deps.
+* :mod:`.summarize` — ``scripts/obs``: per-phase time share + compile /
+  collective totals from any of the above artifacts (the
+  ``Common::Timer::Print`` analogue), jax-free.
+
+This ``__init__`` stays jax-free too (``spans`` is the only jax-touching
+module and is imported lazily), so ``scripts/obs`` runs without a
+backend.
+"""
+from __future__ import annotations
+
+from . import flight, metrics, summarize  # noqa: F401  (jax-free)
+
+__all__ = ["flight", "metrics", "summarize", "spans", "configure"]
+
+
+def __getattr__(name):
+    # lazy: spans imports jax; offline consumers (scripts/obs) never pay.
+    # importlib (not `from . import`) — the from-form probes this very
+    # __getattr__ before importing, which recurses
+    if name == "spans":
+        import importlib
+        return importlib.import_module(".spans", __name__)
+    raise AttributeError(name)
+
+
+def configure(config) -> "metrics.MetricsStream | None":
+    """Arm the process-wide telemetry from a resolved config: flight-ring
+    capacity (``tpu_flight_buffer``), default dump dir
+    (``tpu_checkpoint_dir``), the global phase-keyed compile listener,
+    and the ``tpu_metrics_path`` stream (returned; None when unset).
+
+    Called from ``GBDT.__init__`` — one call per booster, idempotent."""
+    cap = config.get("tpu_flight_buffer", None)
+    dump_dir = str(config.get("tpu_checkpoint_dir", "") or "") or None
+    flight.configure(capacity=None if cap is None else int(cap),
+                     dump_dir=dump_dir)
+    from ..analysis import guards
+    guards.install_global_compile_listener()
+    # multihost: tpu_metrics_path is typically a shared filesystem (the
+    # same deployment contract as tpu_checkpoint_dir, where only process
+    # 0 writes) — every rank opening the one stream would truncate and
+    # interleave it. Rank 0 writes; the others run streamless.
+    import jax
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        return None
+    return metrics.stream_for(config.get("tpu_metrics_path", ""))

@@ -1,0 +1,236 @@
+"""Metrics plane: per-iteration JSONL stream + Prometheus-text exposition.
+
+Two consumers, one schema:
+
+* **Offline** — ``tpu_metrics_path`` arms a per-run JSONL stream. The
+  booster emits one ``iteration`` record per update (wall seconds +
+  CUMULATIVE phase-keyed compile counts + persistent-cache counters),
+  engine.train adds run-level marks and a final ``summary`` (host
+  phase-time table, span names seen). bench.py arms the same stream and
+  derives its BENCH-row counters (``warmup_seconds``/``compile_events``/
+  cache hit-miss) from it instead of re-deriving them inline, and
+  ``scripts/obs`` prints the ``Common::Timer::Print``-style rollup.
+* **Online** — :class:`MetricsServer` serves the same numbers as
+  Prometheus text exposition over stdlib HTTP (``GET /metrics``, plus
+  ``GET /healthz`` JSON) from a PredictionServer (``--metrics-port`` on
+  ``scripts/serve``). No new dependencies: ``http.server`` + a flat
+  gauge rendering.
+
+Stream records are self-describing dicts: ``{"t": <unix>, "kind": ...,
+...}``. Compile counters are cumulative so a consumer can diff any two
+records without having observed the events in between (the bench warmup
+window is exactly such a diff).
+"""
+from __future__ import annotations
+
+import http.server
+import json
+import numbers
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+#: default prefix for exposed metric names
+PROM_PREFIX = "lgbm_tpu_"
+
+
+class MetricsStream:
+    """Append-only JSONL metrics stream (one file per run)."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._mu = threading.Lock()
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        # truncate: the stream describes THIS run (resumed runs re-emit
+        # from their restored iteration; the consumer keys on the records,
+        # not on line position)
+        self._fh = open(self.path, "w", encoding="utf-8")
+
+    def emit(self, kind: str, **fields: Any) -> None:
+        """Write one record; flushed per record so a dying process leaves
+        everything it measured.
+
+        Best-effort by contract: telemetry must never kill the run it
+        observes. A write failure (ENOSPC, the stream's filesystem going
+        away mid-run) warns once, closes the stream, and drops further
+        records — it must NOT raise out of a training finally-block and
+        replace the in-flight exception."""
+        rec = {"t": round(time.time(), 6), "kind": kind}
+        rec.update(fields)
+        with self._mu:
+            if self._fh.closed:
+                return
+            try:
+                self._fh.write(json.dumps(rec, default=str) + "\n")
+                self._fh.flush()
+            except Exception as err:  # noqa: BLE001 - telemetry is best-effort
+                try:
+                    self._fh.close()
+                except Exception:
+                    pass
+                from ..utils import log
+                log.warning(f"metrics stream {self.path} failed "
+                            f"({err}); disabling for this run")
+
+    def close(self) -> None:
+        with self._mu:
+            if not self._fh.closed:
+                self._fh.close()
+
+
+#: per-path shared streams; None marks a path that failed to open (the
+#: failure is cached so it is not retried per booster)
+_streams: Dict[str, Optional[MetricsStream]] = {}
+_streams_mu = threading.Lock()
+
+
+def stream_for(path) -> Optional[MetricsStream]:
+    """The shared per-path stream (booster ticks and engine marks write
+    to ONE file); empty/unset paths return None.
+
+    A stream that CLOSED (emit failure, explicit close) is returned
+    as-is, not rebuilt: ``MetricsStream`` opens with truncating ``'w'``,
+    so resurrecting it would destroy every record the run already
+    flushed — a closed stream's ``emit`` is a safe no-op instead."""
+    p = str(path or "").strip()
+    if not p:
+        return None
+    key = os.path.abspath(p)
+    with _streams_mu:
+        if key in _streams:
+            return _streams[key]
+        try:
+            s = MetricsStream(p)
+        except OSError as err:
+            # telemetry must never kill the run it observes: an
+            # unwritable path (read-only checkout, full disk) warns once
+            # and the run proceeds streamless; the None is cached so the
+            # open() is not retried per booster
+            from ..utils import log
+            log.warning(f"cannot open metrics stream {p} ({err}); "
+                        "continuing without it")
+            s = None
+        _streams[key] = s
+        return s
+
+
+def read_stream(path: str) -> List[Dict[str, Any]]:
+    """Parse a JSONL telemetry artifact (metrics stream or flight dump —
+    same line shape), skipping blank/torn/non-record lines. The ONE
+    tolerant reader: flight.read_dump and summarize delegate here."""
+    out: List[Dict[str, Any]] = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+# -- Prometheus text exposition ---------------------------------------------
+def _flatten(prefix: str, value: Any, out: Dict[str, float]) -> None:
+    if isinstance(value, bool):
+        out[prefix] = 1.0 if value else 0.0
+    elif isinstance(value, numbers.Number):
+        out[prefix] = float(value)
+    elif isinstance(value, dict):
+        for k, v in value.items():
+            key = str(k).replace("-", "_").replace(" ", "_").replace(
+                ".", "_").replace("/", "_")
+            _flatten(f"{prefix}_{key}" if prefix else key, v, out)
+    elif isinstance(value, (list, tuple)):
+        out[f"{prefix}_count"] = float(len(value))
+    # strings/None: not a metric (they live in /healthz)
+
+
+def flatten_metrics(tree: Dict[str, Any]) -> Dict[str, float]:
+    """Flatten a nested dict into ``name_path -> float`` gauges; lists
+    become ``_count``, strings are dropped (they belong in /healthz)."""
+    out: Dict[str, float] = {}
+    _flatten("", tree, out)
+    return {k.lstrip("_"): v for k, v in out.items()}
+
+
+def render_prometheus(tree: Dict[str, Any],
+                      prefix: str = PROM_PREFIX) -> str:
+    """Prometheus text exposition (text/plain; version=0.0.4) of a nested
+    numeric dict. Everything is exposed as a gauge — counters here are
+    cumulative process-lifetime values, which Prometheus rate() handles
+    identically, and gauge is the type that is never a lie."""
+    lines: List[str] = []
+    for name, value in sorted(flatten_metrics(tree).items()):
+        full = f"{prefix}{name}"
+        lines.append(f"# TYPE {full} gauge")
+        lines.append(f"{full} {value:.17g}")
+    return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """Pull-based exposition endpoint: stdlib HTTP, two routes.
+
+    ``provider()`` returns the nested metrics dict; ``GET /metrics``
+    renders it as Prometheus text, ``GET /healthz`` (and ``/health``)
+    returns it as JSON. ``port=0`` binds an ephemeral port (tests);
+    ``.port`` reports the bound one. Serving runs on a daemon thread —
+    ``stop()`` (or the owning server's close) shuts it down."""
+
+    def __init__(self, provider: Callable[[], Dict[str, Any]],
+                 port: int = 0, host: str = "127.0.0.1",
+                 prefix: str = PROM_PREFIX):
+        self._provider = provider
+        self._prefix = prefix
+        outer = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - stdlib API
+                try:
+                    tree = outer._provider()
+                    if self.path.startswith("/metrics"):
+                        body = render_prometheus(
+                            tree, outer._prefix).encode()
+                        ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    elif self.path.startswith(("/healthz", "/health")):
+                        body = json.dumps(
+                            tree, default=str, indent=1).encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404)
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception as err:  # noqa: BLE001 - report, not die
+                    try:
+                        self.send_error(500, str(err)[:200])
+                    except Exception:
+                        pass
+
+            def log_message(self, *a):  # silence per-request stderr spam
+                return
+
+        self._httpd = http.server.ThreadingHTTPServer((host, int(port)),
+                                                      _Handler)
+        self._httpd.daemon_threads = True
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"lgbm-tpu-metrics:{self.port}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:  # noqa: BLE001 - idempotent shutdown
+            pass

@@ -1,0 +1,257 @@
+"""Summarize flight-recorder / metrics JSONL: the Common::Timer::Print.
+
+The reference prints a per-phase wall-time table at process exit when
+built with ``USE_TIMETAG`` (``Common::Timer::Print``,
+include/LightGBM/utils/log.h). Here the equivalent table is derived
+offline from the observability artifacts a run leaves behind — a
+``tpu_metrics_path`` stream, a flight-recorder dump, or both:
+
+    scripts/obs run_metrics.jsonl flight_1234.jsonl
+    scripts/obs --json run_metrics.jsonl
+
+prints per-phase host time share, phase-keyed compile totals, persistent-
+cache hit/miss, collective-program byte totals (when the run captured
+them via LGBM_TPU_COMM_ACCOUNTING), iteration throughput, and the tail
+of notable events (faults, deadlines, restarts, swaps) — the post-mortem
+read of a dead run, or the profile read of a healthy one.
+
+This module is intentionally jax-free (plain json/os), so ``scripts/obs``
+runs anywhere in milliseconds, including hosts without a backend.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence
+
+#: event kinds surfaced in the "notable events" tail
+NOTABLE = ("fault_fire", "deadline", "retry", "crash",
+           "training_interrupted", "swap_failed", "worker_restart",
+           "snapshot_corrupt")
+
+
+def _read_jsonl(path: str) -> List[Dict[str, Any]]:
+    from .metrics import read_stream
+    return read_stream(path)
+
+
+def _kind(rec: Dict[str, Any]) -> str:
+    return str(rec.get("kind") or rec.get("event") or "")
+
+
+def summarize(paths: Sequence[str]) -> Dict[str, Any]:
+    """Aggregate one or more JSONL artifacts into a summary dict."""
+    records: List[Dict[str, Any]] = []
+    for p in paths:
+        records.extend(_read_jsonl(p))
+
+    phase_times: Dict[str, Dict[str, float]] = {}
+    compiles: Optional[Dict[str, Any]] = None
+    cache: Optional[Dict[str, Any]] = None
+    collectives: Dict[str, Dict[str, Any]] = {}
+    iters = 0
+    iter_seconds = 0.0
+    notable: List[Dict[str, Any]] = []
+    spans_seen: List[str] = []
+    dump_header: Optional[Dict[str, Any]] = None
+
+    for rec in records:
+        k = _kind(rec)
+        if k == "iteration":
+            iters += 1
+            iter_seconds += float(rec.get("seconds", 0.0) or 0.0)
+            if isinstance(rec.get("compiles"), dict):
+                compiles = rec["compiles"]     # cumulative: keep the last
+            if isinstance(rec.get("cache"), dict):
+                cache = rec["cache"]
+        elif k in ("summary", "mark"):
+            if isinstance(rec.get("phase_times"), dict):
+                phase_times = rec["phase_times"]
+            if isinstance(rec.get("compiles"), dict):
+                compiles = rec["compiles"]
+            if isinstance(rec.get("cache"), dict):
+                cache = rec["cache"]
+            if isinstance(rec.get("spans_seen"), list):
+                spans_seen = sorted(set(spans_seen)
+                                    | set(rec["spans_seen"]))
+        elif k == "collective_program":
+            collectives[str(rec.get("key"))] = {
+                "bytes": rec.get("bytes"), "total": rec.get("total")}
+        elif k == "flight_dump":
+            dump_header = rec
+        if k in NOTABLE:
+            notable.append(rec)
+
+    total_phase_s = sum(float(v.get("seconds", 0.0) or 0.0)
+                        for v in phase_times.values()) or None
+    return {
+        "records": len(records),
+        "iterations": iters,
+        "iter_seconds_mean": (iter_seconds / iters) if iters else None,
+        "phase_times": phase_times,
+        "phase_total_seconds": total_phase_s,
+        "compiles": compiles,
+        "cache": cache,
+        "collectives": collectives,
+        "collective_bytes_total": sum(
+            int(v.get("total") or 0) for v in collectives.values()) or None,
+        "spans_seen": spans_seen,
+        "notable": notable[-20:],
+        "dump": dump_header,
+    }
+
+
+def _mark_index(records: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    """Last occurrence of each named ``mark`` record."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for rec in records:
+        if _kind(rec) == "mark" and rec.get("name"):
+            out[str(rec["name"])] = rec
+    return out
+
+
+def _diff_compiles(a: Optional[Dict], b: Optional[Dict]) -> Dict[str, Any]:
+    """b - a of two cumulative compile snapshots (phase-keyed)."""
+    a, b = a or {}, b or {}
+
+    def n(d, key):
+        return int((d or {}).get(key, 0) or 0)
+
+    phases = set((a.get("by_phase") or {})) | set((b.get("by_phase") or {}))
+    by_phase = {}
+    for p in sorted(phases):
+        pa = (a.get("by_phase") or {}).get(p) or {}
+        pb = (b.get("by_phase") or {}).get(p) or {}
+        d = {"lowerings": n(pb, "lowerings") - n(pa, "lowerings"),
+             "backend_compiles": (n(pb, "backend_compiles")
+                                  - n(pa, "backend_compiles"))}
+        if d["lowerings"] or d["backend_compiles"]:
+            by_phase[p] = d
+    return {"lowerings": n(b, "lowerings") - n(a, "lowerings"),
+            "backend_compiles": (n(b, "backend_compiles")
+                                 - n(a, "backend_compiles")),
+            "by_phase": by_phase}
+
+
+def bench_counters(path: str) -> Optional[Dict[str, Any]]:
+    """Derive the BENCH-row counters from a metrics stream.
+
+    Expects the bench marks ``warmup_start``/``warmup_end``/
+    ``steady_end`` (each carrying a cumulative ``compiles``/``cache``
+    snapshot). Returns None when the stream is missing or unmarked, so
+    bench.py can fall back to its inline counters instead of recording a
+    half-empty row."""
+    if not path or not os.path.exists(path):
+        return None
+    records = _read_jsonl(path)
+    marks = _mark_index(records)
+    if not all(m in marks for m in ("warmup_start", "warmup_end",
+                                    "steady_end")):
+        return None
+    w0, w1, s1 = (marks["warmup_start"], marks["warmup_end"],
+                  marks["steady_end"])
+    warm = _diff_compiles(w0.get("compiles"), w1.get("compiles"))
+    steady = _diff_compiles(w1.get("compiles"), s1.get("compiles"))
+
+    def cache_of(rec):
+        c = rec.get("cache") or {}
+        return {k: int(c.get(k, 0) or 0) for k in ("requests", "hits")}
+
+    # cache counters over the WARMUP window, matching compile_events and
+    # the inline warm_cache fallback — mixing windows would let a
+    # steady-state compile skew the warm-round hits==requests comparison
+    c0, c1 = cache_of(w0), cache_of(w1)
+    requests = c1["requests"] - c0["requests"]
+    hits = c1["hits"] - c0["hits"]
+    return {
+        "warmup_seconds": round(float(w1["t"]) - float(w0["t"]), 1),
+        "compile_events": warm["lowerings"],
+        "compile_events_by_phase": warm["by_phase"],
+        "compile_events_steady": steady["lowerings"],
+        "compile_cache": {"requests": requests, "hits": hits,
+                          "misses": requests - hits},
+    }
+
+
+def _fmt_table(summary: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    pt = summary["phase_times"]
+    total = summary["phase_total_seconds"]
+    lines.append(f"records: {summary['records']}  "
+                 f"iterations: {summary['iterations']}"
+                 + (f"  mean iter: {summary['iter_seconds_mean']:.4f}s"
+                    if summary["iter_seconds_mean"] else ""))
+    if pt:
+        lines.append("")
+        lines.append(f"{'phase':<20} {'seconds':>10} {'share':>7} "
+                     f"{'count':>8}")
+        for name, v in sorted(pt.items(),
+                              key=lambda kv: -float(
+                                  kv[1].get('seconds', 0) or 0)):
+            s = float(v.get("seconds", 0.0) or 0.0)
+            share = (s / total) if total else 0.0
+            lines.append(f"{name:<20} {s:>10.3f} {share:>6.1%} "
+                         f"{int(v.get('count', 0) or 0):>8}")
+    comp = summary["compiles"]
+    if comp:
+        lines.append("")
+        lines.append(f"compiles: {comp.get('lowerings', 0)} lowerings, "
+                     f"{comp.get('backend_compiles', 0)} backend")
+        for p, d in sorted((comp.get("by_phase") or {}).items()):
+            lines.append(f"  {p:<18} {d.get('lowerings', 0):>4} lowerings "
+                         f"{d.get('backend_compiles', 0):>4} backend")
+    cache = summary["cache"]
+    if cache:
+        lines.append(f"compile cache: {cache.get('hits', 0)}/"
+                     f"{cache.get('requests', 0)} hits")
+    if summary["collectives"]:
+        lines.append("")
+        lines.append(f"collective programs "
+                     f"({summary['collective_bytes_total']} bytes/step "
+                     f"total):")
+        for key, v in sorted(summary["collectives"].items()):
+            lines.append(f"  {key:<24} {v.get('total')} bytes "
+                         f"{json.dumps(v.get('bytes'), default=str)}")
+    if summary["spans_seen"]:
+        lines.append("")
+        lines.append("spans seen: " + ", ".join(summary["spans_seen"]))
+    if summary["dump"]:
+        d = summary["dump"]
+        lines.append("")
+        lines.append(f"flight dump: reason={d.get('reason')!r} "
+                     f"events={d.get('events')} dropped={d.get('dropped')}")
+    if summary["notable"]:
+        lines.append("")
+        lines.append("notable events (tail):")
+        for rec in summary["notable"]:
+            k = _kind(rec)
+            rest = {key: v for key, v in rec.items()
+                    if key not in ("kind", "event", "t", "seq")}
+            lines.append(f"  {k}: {json.dumps(rest, default=str)}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="obs", description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="metrics-stream / flight-dump JSONL files")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the summary as JSON instead of a table")
+    args = ap.parse_args(argv)
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(f"obs: no such file: {', '.join(missing)}", file=sys.stderr)
+        return 2
+    summary = summarize(args.paths)
+    if args.as_json:
+        print(json.dumps(summary, indent=1, default=str))
+    else:
+        print(_fmt_table(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

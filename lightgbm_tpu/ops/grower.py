@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..obs.spans import span
 from .histogram import histogram
 from .split import (SplitParams, SplitResult, best_split, child_output,
                     depth_gate, go_left_pred, leaf_output)
@@ -308,12 +309,13 @@ def _leaf_best_split(hist3, pg, ph, pc, feat_info, feat_mask, depth,
                      cmax=None, pout=0.0, cegb_pen=None, extra_key=None,
                      feature_contri=None, depth_budget=None):
     num_bins_arr, nan_bin_arr, has_nan_arr, is_cat_arr = feat_info
-    sp = best_split(
-        hist3, pg, ph, pc,
-        num_bins_arr, nan_bin_arr, has_nan_arr, is_cat_arr, feat_mask,
-        params.split_params(), mono_types, cmin, cmax, pout, depth, cegb_pen,
-        extra_key, feature_contri,
-    )
+    with span("split_scan"):
+        sp = best_split(
+            hist3, pg, ph, pc,
+            num_bins_arr, nan_bin_arr, has_nan_arr, is_cat_arr, feat_mask,
+            params.split_params(), mono_types, cmin, cmax, pout, depth,
+            cegb_pen, extra_key, feature_contri,
+        )
     return sp._replace(gain=depth_gate(sp.gain, depth, params.max_depth,
                                        depth_budget))
 
@@ -409,19 +411,22 @@ def grow_tree(
                    and min(2 * params.voting_k, f) < f)
 
     def hist3(mask):
-        chans = jnp.stack([grad * mask, hess * mask, cnt_weight * mask], axis=1)
-        if voting_live:
-            from ..parallel.voting import voting_histogram
-            return voting_histogram(binned, chans, B, params.voting_shards,
-                                    params.voting_k, params.split_params(),
-                                    impl=params.hist_impl,
-                                    mbatch=params.hist_mbatch,
-                                    layout=params.hist_layout,
-                                    overlap=params.hist_overlap)
-        return histogram(binned, chans, B, ax, impl=params.hist_impl,
-                         mbatch=params.hist_mbatch,
-                         layout=params.hist_layout,
-                         overlap=params.hist_overlap)
+        with span("hist_build"):
+            chans = jnp.stack(
+                [grad * mask, hess * mask, cnt_weight * mask], axis=1)
+            if voting_live:
+                from ..parallel.voting import voting_histogram
+                return voting_histogram(
+                    binned, chans, B, params.voting_shards,
+                    params.voting_k, params.split_params(),
+                    impl=params.hist_impl,
+                    mbatch=params.hist_mbatch,
+                    layout=params.hist_layout,
+                    overlap=params.hist_overlap)
+            return histogram(binned, chans, B, ax, impl=params.hist_impl,
+                             mbatch=params.hist_mbatch,
+                             layout=params.hist_layout,
+                             overlap=params.hist_overlap)
 
     if mono_types is None:
         mono_types = jnp.zeros((f,), jnp.int8)
@@ -453,9 +458,10 @@ def grow_tree(
     root_h = hess.sum()
     root_c = cnt_weight.sum()
     if ax is not None:
-        root_g = lax.psum(root_g, ax)
-        root_h = lax.psum(root_h, ax)
-        root_c = lax.psum(root_c, ax)
+        with span("collective_reduce"):
+            root_g = lax.psum(root_g, ax)
+            root_h = lax.psum(root_h, ax)
+            root_c = lax.psum(root_c, ax)
     root_hist = hist3(jnp.ones_like(cnt_weight))
     root_fm = node_feature_mask(
         feat_mask, jnp.zeros((f,), bool), inter_sets,
@@ -613,15 +619,18 @@ def grow_tree(
             jnp.where(applied, 1, leaf_parent_side[new_leaf]))
 
         # ---- partition rows (reference: CUDADataPartition::SplitInner) ----
-        fcol = lax.dynamic_slice_in_dim(binned_t, f_, 1, axis=0)[0].astype(i32)
-        nb = nan_bin_arr[f_]
-        iscat = is_cat_arr[f_]
-        go_left = go_left_pred(fcol, b_, dl, nb, iscat, bits)
-        row_leaf = jnp.where(
-            applied & (st.row_leaf == best_leaf) & jnp.logical_not(go_left),
-            new_leaf,
-            st.row_leaf,
-        )
+        with span("partition"):
+            fcol = lax.dynamic_slice_in_dim(
+                binned_t, f_, 1, axis=0)[0].astype(i32)
+            nb = nan_bin_arr[f_]
+            iscat = is_cat_arr[f_]
+            go_left = go_left_pred(fcol, b_, dl, nb, iscat, bits)
+            row_leaf = jnp.where(
+                applied & (st.row_leaf == best_leaf)
+                & jnp.logical_not(go_left),
+                new_leaf,
+                st.row_leaf,
+            )
 
         # ---- per-leaf aggregates for the two children ----
         lg, lh, lc = (st.bs_left_grad[best_leaf], st.bs_left_hess[best_leaf],

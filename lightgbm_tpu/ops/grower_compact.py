@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..obs.spans import span
 from .compact import (RowLayout, partition_segment, segment_histogram,
                       segments_to_leaf_vectors)
 from .fused_split import fused_split
@@ -252,12 +253,15 @@ def grow_tree_compact(
     def reduce_hist(local):
         """[F, B, 4] shard-local -> globally-summed histogram (full copy,
         or this shard's [F_loc, B, 4] feature slice under hist_scatter)."""
-        if scatter:
-            padded = jnp.pad(local, ((0, f_pad_sc), (0, 0), (0, 0))) \
-                if f_pad_sc else local
-            return lax.psum_scatter(padded, ax, scatter_dimension=0,
-                                    tiled=True)
-        return lax.psum(local, ax) if ax else local
+        if not ax:
+            return local
+        with span("collective_reduce"):
+            if scatter:
+                padded = jnp.pad(local, ((0, f_pad_sc), (0, 0), (0, 0))) \
+                    if f_pad_sc else local
+                return lax.psum_scatter(padded, ax, scatter_dimension=0,
+                                        tiled=True)
+            return lax.psum(local, ax)
 
     def sync_split(sp):
         """All-gather the per-shard best-split candidates and return the
@@ -270,6 +274,12 @@ def grow_tree_compact(
 
     def leaf_best(hist, pg, ph, pc, depth, fm, cmn, cmx, po, cegb_pen=None,
                   ek=None):
+        with span("split_scan"):
+            return _leaf_best(hist, pg, ph, pc, depth, fm, cmn, cmx, po,
+                              cegb_pen, ek)
+
+    def _leaf_best(hist, pg, ph, pc, depth, fm, cmn, cmx, po, cegb_pen,
+                   ek):
         if params.efb_virtual:
             # scan axis = stored columns + one virtual row per bundled
             # original feature (io/efb.py); exact in int32 when quantized
@@ -298,6 +308,10 @@ def grow_tree_compact(
                                            dbudget))
 
     def seg_hist(work, start, count, cols=None):
+        with span("hist_build"):
+            return _seg_hist(work, start, count, cols)
+
+    def _seg_hist(work, start, count, cols=None):
         # ``cols``: static stored-column subset of a hist_overlap feature
         # group; chunk_f pins the engines' row chunking to the full width
         # so the group build matches the ungrouped histogram bitwise
@@ -347,10 +361,11 @@ def grow_tree_compact(
         and not layout.packed4
 
     def _reduce_group(part):
-        if scatter:
-            return lax.psum_scatter(part, ax, scatter_dimension=0,
-                                    tiled=True)
-        return lax.psum(part, ax)
+        with span("collective_reduce"):
+            if scatter:
+                return lax.psum_scatter(part, ax, scatter_dimension=0,
+                                        tiled=True)
+            return lax.psum(part, ax)
 
     def _grouped_reduce(local):
         """reduce_hist with one collective per feature group (the
@@ -413,13 +428,14 @@ def grow_tree_compact(
     # ---- root ----
     if params.fused_block:
         # hist-only mode of the fused Mosaic kernel (ops/fused_split.py)
-        work, scratch, root_loc = fused_split(
-            work, scratch, jnp.asarray(1, i32), zero, jnp.asarray(n, i32),
-            zero, zero, zero, zero, zero, zero,
-            jnp.zeros((W,), jnp.uint32), layout, B, params.fused_block, W,
-            interpret=params.fused_interpret, dual=params.fused_dual,
-            hist_debug=params.fused_hist_debug, num_rows=n, quant=quant,
-            mbatch=params.hist_mbatch, hist_layout=params.hist_layout)
+        with span("hist_build"):
+            work, scratch, root_loc = fused_split(
+                work, scratch, jnp.asarray(1, i32), zero,
+                jnp.asarray(n, i32), zero, zero, zero, zero, zero, zero,
+                jnp.zeros((W,), jnp.uint32), layout, B, params.fused_block,
+                W, interpret=params.fused_interpret, dual=params.fused_dual,
+                hist_debug=params.fused_hist_debug, num_rows=n, quant=quant,
+                mbatch=params.hist_mbatch, hist_layout=params.hist_layout)
         root_hist = reduce_any(root_loc)
     else:
         # data-parallel: histograms reduce over the mesh axis (reference:
@@ -699,20 +715,23 @@ def grow_tree_compact(
             # in a single streamed walk (ops/fused_split.py); the left child
             # stays in the parent's residency array, the right child lands
             # in the other one (dual residency — no copy-back pass)
-            work, scratch, hist_small_fused = fused_split(
-                st.work, st.scratch, jnp.asarray(0, i32), s_, m_eff,
-                n_left_eff, f_col, b_, dl, nan_bin_arr[f_], f_cat,
-                bits, layout, B, params.fused_block, W,
-                interpret=params.fused_interpret,
-                smaller_left=left_smaller.astype(i32), side=side_p,
-                dual=params.fused_dual, hist_debug=params.fused_hist_debug,
-                num_rows=n, quant=quant, mbatch=params.hist_mbatch,
-                hist_layout=params.hist_layout)
+            with span("partition"), span("hist_build"):
+                work, scratch, hist_small_fused = fused_split(
+                    st.work, st.scratch, jnp.asarray(0, i32), s_, m_eff,
+                    n_left_eff, f_col, b_, dl, nan_bin_arr[f_], f_cat,
+                    bits, layout, B, params.fused_block, W,
+                    interpret=params.fused_interpret,
+                    smaller_left=left_smaller.astype(i32), side=side_p,
+                    dual=params.fused_dual,
+                    hist_debug=params.fused_hist_debug,
+                    num_rows=n, quant=quant, mbatch=params.hist_mbatch,
+                    hist_layout=params.hist_layout)
         else:
-            work, scratch = partition_segment(
-                st.work, st.scratch, s_, m_eff, n_left_eff, f_col, b_, dl,
-                nan_bin_arr[f_], f_cat, bits, params.part_block,
-                packed4=layout.packed4)
+            with span("partition"):
+                work, scratch = partition_segment(
+                    st.work, st.scratch, s_, m_eff, n_left_eff, f_col, b_,
+                    dl, nan_bin_arr[f_], f_cat, bits, params.part_block,
+                    packed4=layout.packed4)
         leaf_start = st.leaf_start.at[best_leaf].set(
             jnp.where(applied, s_, st.leaf_start[best_leaf]))
         leaf_start = leaf_start.at[new_leaf].set(

@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..obs.spans import span
+
 # target elements for the materialized one-hot per scan step
 _CHUNK_ELEMS = 1 << 23
 
@@ -361,7 +363,8 @@ def histogram(
                 quant_max=quant_max, chunk_f=f)
             # the reduce of group g is independent of group g+1's
             # contraction: XLA issues it async (-start/-done twins)
-            parts.append(lax.psum(part, axis_name))
+            with span("collective_reduce"):
+                parts.append(lax.psum(part, axis_name))
         return jnp.concatenate(parts, axis=0)
     hist = histogram_block(binned, channels, num_bins, impl=impl,
                            mbatch=mbatch, layout=layout, acc_bits=acc_bits,
@@ -371,5 +374,6 @@ def histogram(
         # distributed data-parallel: the reference reduce-scatters histograms over
         # its socket/MPI Network (src/treelearner/data_parallel_tree_learner.cpp:223-300);
         # on TPU the equivalent is a psum over the ICI mesh axis.
-        hist = lax.psum(hist, axis_name)
+        with span("collective_reduce"):
+            hist = lax.psum(hist, axis_name)
     return hist

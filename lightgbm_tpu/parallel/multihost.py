@@ -116,6 +116,9 @@ def run_with_deadline(fn: Callable, deadline_s: float, what: str, *,
                     name=f"lgbm-tpu-watchdog[{what}]")
                 worker.start()
                 if not done.wait(deadline_s):
+                    from ..obs import flight
+                    flight.note("deadline", what=what,
+                                deadline_s=deadline_s)
                     raise TrainingInterrupted(what, deadline_s)
                 if "error" in box:
                     raise box["error"]
@@ -130,6 +133,9 @@ def run_with_deadline(fn: Callable, deadline_s: float, what: str, *,
                 raise
             delay = backoff_s * (2 ** attempt)
             attempt += 1
+            from ..obs import flight
+            flight.note("retry", what=what, attempt=attempt,
+                        error=msg.splitlines()[0][:200])
             log.warning(
                 f"{what}: transient failure (attempt {attempt}/"
                 f"{retries}): {msg.splitlines()[0][:200]}; retrying in "

@@ -71,6 +71,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "warmup across restarts")
     ap.add_argument("--raw-score", action="store_true",
                     help="serve raw scores (skip objective conversion)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="expose GET /metrics (Prometheus text) and "
+                         "/healthz on this port (0 = ephemeral; printed "
+                         "to stderr at startup)")
     ap.add_argument("--probe", action="store_true",
                     help="print health JSON and exit 0 iff ready")
     args = ap.parse_args(argv)
@@ -95,8 +99,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     server = booster.serve(
         tick_ms=args.tick_ms, queue_max=args.queue_max,
         deadline_ms=args.deadline_ms, warm_max_rows=args.warm_max_rows,
-        raw_score=args.raw_score)
+        raw_score=args.raw_score, metrics_port=args.metrics_port)
     try:
+        if server.metrics_port is not None:
+            sys.stderr.write(f"[serve] metrics on "
+                             f"http://127.0.0.1:{server.metrics_port}"
+                             f"/metrics\n")
         health = server.health()
         if args.probe:
             print(json.dumps(health, indent=1, sort_keys=True, default=str))

@@ -36,6 +36,9 @@ import time
 from typing import Callable, List, Optional
 
 from ..analysis.faultinject import active_plan
+from ..analysis.guards import compile_phase
+from ..obs import flight
+from ..obs.spans import span
 from ..utils import log
 from .errors import (ServerClosed, ServerOverloaded, ServingError,
                      ServingTimeout)
@@ -304,13 +307,19 @@ class MicroBatchCoalescer:
                 # admission-side shedding, never into blocked submitters
                 active_plan(self._fault_config).fire(
                     "coalesce_tick", requests=len(batch))
-                self._serve_batch(batch)
+                # compiles in a tick are attributed to the serving phase
+                # (a steady-state serving compile is a bug the metrics
+                # plane must point at, not fold into a global count)
+                with compile_phase("serving"), span("serve_tick"):
+                    self._serve_batch(batch)
             except BaseException as err:  # noqa: BLE001 - classified below
                 with self._cv:
                     self.stats["ticks"] -= 1
                     self.stats["served_requests"] -= len(batch)
                     self.stats["served_rows"] -= rows
                     self.stats["errors"] += 1
+                flight.note("serve_tick_error", requests=len(batch),
+                            rows=rows, error=repr(err)[:200])
                 # one FRESH exception per future: concurrent result()
                 # raises would otherwise mutate a shared instance's
                 # __traceback__/__context__ across client threads
@@ -332,6 +341,7 @@ class MicroBatchCoalescer:
                 # structurally in _drain_loop; respawn so the queue keeps
                 # draining instead of wedging
                 log.warning(f"[serving] worker died ({err!r}); respawning")
+                flight.note("worker_restart", error=repr(err)[:200])
                 with self._cv:
                     self.stats["worker_restarts"] += 1
                     if self._closing:

@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..analysis.faultinject import active_plan
+from ..obs import flight
 from ..utils import log
 from ..utils.rwlock import RWLock
 from .errors import ServingError, SwapFailed
@@ -131,6 +132,8 @@ class ModelRegistry:
                 self._health_check(booster, version)
         except Exception as err:
             self.failed_swaps += 1
+            flight.note("swap_failed", version=version, stage="warmup",
+                        error=repr(err)[:300])
             raise SwapFailed(
                 f"candidate {version!r} failed pre-swap warmup/health "
                 f"check: {err}") from err
@@ -190,6 +193,12 @@ class ModelRegistry:
                 self.failed_swaps += 1
                 log.warning(f"[serving] swap to {version!r} rolled back: "
                             f"{err!r}")
+                # a blown swap is one of the three flight-dump sites: the
+                # ring at this moment names the fault/deadline that killed
+                # the commit (analysis/faultinject hang@swap included)
+                flight.note("swap_failed", version=version,
+                            error=repr(err)[:300])
+                flight.dump(f"swap to {version!r} failed")
                 if not isinstance(err, Exception):
                     raise               # injected kill: process-fatal
                 raise SwapFailed(
@@ -200,6 +209,8 @@ class ModelRegistry:
         finally:
             self._deploy_mu.release()
         self.swaps += 1
+        flight.note("swap_committed", version=version,
+                    rungs=len(warm_stats.get("rungs") or []))
         log.info(f"[serving] model {version!r} active "
                  f"(warmed rungs: {warm_stats.get('rungs')})")
         return warm_stats

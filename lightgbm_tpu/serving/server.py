@@ -42,7 +42,8 @@ class PredictionServer:
                  queue_max: Optional[int] = None,
                  deadline_ms: Optional[float] = None,
                  warm: bool = True, warm_max_rows: Optional[int] = None,
-                 raw_score: bool = False, swap_deadline_s: float = 30.0):
+                 raw_score: bool = False, swap_deadline_s: float = 30.0,
+                 metrics_port: Optional[int] = None):
         self._registry = registry if registry is not None else ModelRegistry()
         self._raw_score = bool(raw_score)
         self._swap_deadline_s = float(swap_deadline_s)
@@ -69,6 +70,25 @@ class PredictionServer:
             self._serve_batch, tick_ms=tick_ms, queue_max_rows=queue_max,
             max_batch_rows=self._resolve_max_batch(active),
             fault_config=cfg)
+        # metrics plane (obs/metrics.py): pull-based Prometheus text over
+        # stdlib HTTP. None = off; 0 = ephemeral port (.metrics_port tells)
+        self._metrics_server = None
+        if metrics_port is None:
+            port_cfg = int(cfg.get("tpu_metrics_port", 0) or 0)
+            metrics_port = port_cfg if port_cfg > 0 else None
+        if metrics_port is not None:
+            # a taken port must not take down SERVING: the coalescer
+            # worker is already running, and an __init__ raise here would
+            # orphan it with no handle to close() — serve without the
+            # endpoint instead (an explicit serve_metrics() call still
+            # raises, the caller asked for that port specifically)
+            try:
+                self.serve_metrics(metrics_port)
+            except OSError as err:
+                from ..utils import log
+                log.warning(f"[serving] metrics port {metrics_port} "
+                            f"unavailable ({err}); serving WITHOUT the "
+                            "metrics endpoint")
 
     # -- batch bound ---------------------------------------------------------
     def _resolve_max_batch(self, booster, version: Optional[str] = None
@@ -220,6 +240,49 @@ class PredictionServer:
         alive, not draining."""
         return self.health()["ready"]
 
+    # -- metrics plane -------------------------------------------------------
+    def metrics(self) -> Dict[str, Any]:
+        """The nested numeric view behind ``GET /metrics``: the health
+        snapshot plus process-lifetime phase-keyed compile counts and
+        persistent-cache counters — one schema with the training metrics
+        stream (same counter names, same attribution)."""
+        out = self.health()
+        out["compiles"] = guards.phase_compile_counts()
+        out["compile_cache"] = guards.global_cache_counts()
+        return out
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of :meth:`metrics`."""
+        from ..obs import metrics as obs_metrics
+        return obs_metrics.render_prometheus(self.metrics())
+
+    def serve_metrics(self, port: int = 0) -> int:
+        """Start the ``/metrics`` + ``/healthz`` HTTP endpoint; returns
+        the bound port (``--metrics-port`` on ``scripts/serve``; ``0``
+        binds an ephemeral port). Asking for a SPECIFIC port while the
+        endpoint is already bound elsewhere raises — silently returning
+        the old port would point the caller's scrape config at nothing."""
+        from ..obs import metrics as obs_metrics
+        with self._mu:          # check-then-create must not race: the
+            #                     losing endpoint would leak its bound
+            #                     port + thread with no handle to stop()
+            if self._metrics_server is not None:
+                bound = self._metrics_server.port
+                if port not in (0, bound):
+                    raise ValueError(
+                        f"metrics endpoint already bound on port {bound}; "
+                        f"cannot rebind to {port} (close() the server "
+                        "first)")
+                return bound
+            self._metrics_server = obs_metrics.MetricsServer(
+                self.metrics, port=port)
+            return self._metrics_server.port
+
+    @property
+    def metrics_port(self) -> Optional[int]:
+        return None if self._metrics_server is None \
+            else self._metrics_server.port
+
     @property
     def stats(self) -> Dict[str, int]:
         return dict(self._coalescer.stats)
@@ -228,9 +291,15 @@ class PredictionServer:
     def close(self, drain: bool = True,
               timeout_s: Optional[float] = None) -> None:
         """Graceful shutdown: stop admission, drain (or fail) the queue,
-        join the worker."""
+        join the worker, stop the metrics endpoint."""
         self._closed = True
         self._coalescer.close(drain=drain, timeout_s=timeout_s)
+        with self._mu:
+            # stop AND clear: a later serve_metrics() must bind fresh,
+            # not report the port of a dead endpoint as already-bound
+            ms, self._metrics_server = self._metrics_server, None
+        if ms is not None:
+            ms.stop()
 
     def __enter__(self) -> "PredictionServer":
         return self

@@ -1151,3 +1151,98 @@ def test_package_allowlist_staleness_clean():
     entries, errors = load_allowlist(DEFAULT_ALLOWLIST)
     assert not errors
     assert not check_allowlist_staleness(entries, [PKG_DIR])
+
+
+# ---------------------------------------------------------------- R009
+def test_r009_timing_in_jit_reachable_flagged(tmp_path):
+    """Host-clock reads under jit (alias-aware) are findings: the values
+    are trace-time constants at best, dispatch-time lies at worst."""
+    findings = lint_snippet(tmp_path, """
+        import time
+        import time as _time
+        from time import perf_counter
+        import jax
+
+        @jax.jit
+        def step(x):
+            t0 = time.time()
+            t1 = _time.monotonic()
+            t2 = perf_counter()
+            return x * (t1 - t0) * t2
+    """)
+    assert codes(findings).count("R009") >= 3
+
+
+def test_r009_manual_span_close_in_jit_flagged(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import jax
+        from lightgbm_tpu.obs.spans import span
+
+        @jax.jit
+        def step(x):
+            s = span("hist_build")
+            y = x + 1
+            s.close()
+            return y
+    """)
+    assert any(f.rule == "R009" and "span" in f.message for f in findings)
+
+
+def test_r009_clock_plus_dispatch_pinned(tmp_path):
+    """Tick-site pinning: timing around a dispatching call without
+    block_until_ready is a finding even OUTSIDE jit-reachable code."""
+    findings = lint_snippet(tmp_path, """
+        import time
+
+        def bench_loop(booster):
+            t0 = time.perf_counter()
+            booster.train_step()
+            return time.perf_counter() - t0
+    """)
+    r9 = [f for f in findings if f.rule == "R009"]
+    assert r9 and "block_until_ready" in r9[0].message
+
+
+def test_r009_block_until_ready_exempts(tmp_path):
+    """The honest-timing escape: materializing before reading the clock
+    again makes the measurement real — no finding."""
+    findings = lint_snippet(tmp_path, """
+        import time
+        import jax
+
+        def bench_loop(booster):
+            t0 = time.perf_counter()
+            out = booster.train_step()
+            jax.block_until_ready(out)
+            return time.perf_counter() - t0
+    """)
+    assert "R009" not in codes(findings)
+
+
+def test_r009_plain_host_timing_clean(tmp_path):
+    """A clock with no device dispatch in sight (queue bookkeeping, JSONL
+    timestamps) is none of R009's business."""
+    findings = lint_snippet(tmp_path, """
+        import time
+
+        def record(ring, fields):
+            rec = {"t": time.time()}
+            rec.update(fields)
+            ring.append(rec)
+    """)
+    assert "R009" not in codes(findings)
+
+
+def test_r009_with_span_under_jit_clean(tmp_path):
+    """The with-scoped span form is the SUPPORTED spelling in traced
+    code (named_scope at trace time) — not a finding."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+        from lightgbm_tpu.obs.spans import span
+
+        @jax.jit
+        def step(x):
+            with span("hist_build"):
+                return x + 1
+    """)
+    assert "R009" not in codes(findings)
