@@ -825,6 +825,48 @@ def run_ranking_bench():
     }))
 
 
+def _record_scaling_ledger(jax, trace_dir, shape, iters_per_sec,
+                           timed_iters):
+    """BENCH_LEDGER=1: parse the round's profiler trace and record the
+    scaling-efficiency block (obs/ledger.py) into COMM_ACCOUNTING.json
+    (+ BENCH_MULTICHIP_PATH when set). Best-effort — the ledger must
+    never sink a bench round that already measured its throughput."""
+    try:
+        from lightgbm_tpu.obs import ledger as obs_ledger
+        from lightgbm_tpu.obs import tracing as obs_tracing
+        analysis = obs_tracing.analyze_trace_dir(trace_dir)
+        if analysis is None:
+            sys.stderr.write(f"[bench] ledger: no trace artifact under "
+                             f"{trace_dir}\n")
+            return
+        n_chips = len(jax.devices())
+        contract_mode = os.environ.get(
+            "BENCH_LEDGER_CONTRACT",
+            "data_scatter" if n_chips > 1 else "serial_compact")
+        contract = obs_ledger.load_contract(contract_mode)
+        comm_path = os.environ.get(
+            "BENCH_COMM_ACCOUNTING",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "COMM_ACCOUNTING.json"))
+        block = obs_ledger.ledger_block(
+            shape, n_chips, iters_per_sec, analysis=analysis,
+            contract=contract, steps=timed_iters,
+            prior_rows=obs_ledger.prior_rows(comm_path, shape))
+        key = f"{shape}_x{n_chips}"
+        obs_ledger.record(comm_path, key, block)
+        mc_path = os.environ.get("BENCH_MULTICHIP_PATH", "")
+        if mc_path:
+            obs_ledger.record(mc_path, key, block)
+        mvm = block.get("measured_vs_model", {})
+        sys.stderr.write(
+            f"[bench] ledger[{key}]: efficiency="
+            f"{block['scaling'][-1].get('efficiency')} comm_fraction="
+            f"{mvm.get('measured', {}).get('comm_fraction')} -> "
+            f"{comm_path}\n")
+    except Exception as err:  # noqa: BLE001 - never sink the bench row
+        sys.stderr.write(f"[bench] ledger failed: {err}\n")
+
+
 def _bench_stage() -> str:
     """The ONE env-precedence chain both the dispatcher and the failure
     stub key on — a new bench mode added here is automatically labeled
@@ -996,16 +1038,35 @@ def _main(stage=None):
     warmup_s = time.time() - t0
     _mark("warmup_end")
 
+    # scaling-efficiency ledger (BENCH_LEDGER=1, obs/ledger.py): the
+    # timed loop runs under a full profiler trace_session so the
+    # device-time analytics can measure the collective durations the
+    # byte model only predicts — the measured_vs_model block lands in
+    # COMM_ACCOUNTING.json (and BENCH_MULTICHIP_PATH when set) with the
+    # round, attribution built in
+    import contextlib
+    ledger_on = os.environ.get("BENCH_LEDGER", "") == "1"
+    ledger_trace_dir = None
+    ledger_session = contextlib.nullcontext()
+    if ledger_on:
+        from lightgbm_tpu.obs import spans as obs_spans
+        ledger_trace_dir = os.environ.get(
+            "BENCH_TRACE_DIR",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".bench_trace"))
+        ledger_session = obs_spans.trace_session(ledger_trace_dir, "full")
     t0 = time.time()
     timed_from = bst.current_iteration()
-    with compile_counter() as steady_cc:
-        if ckpt_dir:
-            bst = _resumable_update_loop(bst, make_booster, WARMUP + ITERS,
-                                         ckpt_dir, ckpt_freq)
-        else:
-            for _ in range(ITERS):
-                bst.update()
-        bst._gbdt._flush_trees()  # materialize: all device work finishes
+    with ledger_session:
+        with compile_counter() as steady_cc:
+            if ckpt_dir:
+                bst = _resumable_update_loop(bst, make_booster,
+                                             WARMUP + ITERS,
+                                             ckpt_dir, ckpt_freq)
+            else:
+                for _ in range(ITERS):
+                    bst.update()
+            bst._gbdt._flush_trees()  # materialize: device work finishes
     train_s = time.time() - t0
     _mark("steady_end")
     # the unified-schema counters: derived from the metrics stream (the
@@ -1079,6 +1140,11 @@ def _main(stage=None):
         # low-bin runs (the reference's GPU learner defaults to 63 bins,
         # docs/GPU-Performance.rst:133) record under their own key
         shape = f"{shape}-b{MAX_BIN}"
+    if ledger_on and ledger_trace_dir:
+        # same shape key as BENCH_SHAPES so ledger rows and throughput
+        # rows join on it
+        _record_scaling_ledger(jax, ledger_trace_dir, shape,
+                               iters_per_sec, timed_iters)
     # every run also records its result in BENCH_SHAPES.json so the sparse
     # and ranking shape numbers live in files, not prose (run the other
     # shapes via BENCH_SPARSE=1 / BENCH_RANKING=1)
@@ -1109,6 +1175,11 @@ def _main(stage=None):
                               "hits": warm_cache.hits,
                               "misses": warm_cache.misses}),
         "metrics_stream": metrics_path if stream_row else None,
+        # BENCH_LEDGER rounds time the loop UNDER a full profiler
+        # session (the ledger needs the trace): per-op tracing overhead
+        # loads the number, so the row says so — comparing a ledgered
+        # round's it/s against untraced history would be a silent lie
+        **({"profiler_loaded": True} if ledger_on else {}),
     })
     print(json.dumps({
         "metric": f"synthetic-{shape}{ROWS // 1_000_000}M-"

@@ -459,6 +459,10 @@ class Booster:
         self._train_data_name = "training"
         self._custom_objective: Optional[Callable] = None
         self._pending_finish = False
+        # device-time trace analytics (obs/tracing.py): set by
+        # engine.train after a full trace session closes; None means no
+        # artifact was recorded/parseable for this booster's run
+        self._device_time_analysis = None
 
         if train_set is not None:
             if not isinstance(train_set, Dataset):
@@ -613,7 +617,24 @@ class Booster:
                 finished = self._gbdt.train_one_iter(grad, hess)
             else:
                 finished = self._gbdt.train_one_iter()
-        self._gbdt._obs_iteration_tick(time.perf_counter() - t0)
+        # sampled per-rank attribution (obs/ranks.py): at the
+        # tpu_rank_stats_every cadence ONLY, block on the step's device
+        # work so step_s is a real measurement (not dispatch), then let
+        # the rank-stats plane probe the collective and publish;
+        # off-sample iterations take neither the block nor the probe, so
+        # the steady-state 0-d2h guard holds between samples. The tick's
+        # seconds are captured BEFORE sample_step: the sampling overhead
+        # (barrier wait for a slow peer, the rank-0 KV gather) must not
+        # inflate the metrics stream's iteration wall
+        rank_stats = getattr(self._gbdt, "_rank_stats", None)
+        if rank_stats is not None and rank_stats.due(self._gbdt.iter_):
+            import jax
+            jax.block_until_ready(self._gbdt.train_score)
+            elapsed = time.perf_counter() - t0
+            rank_stats.sample_step(self._gbdt.iter_, elapsed)
+        else:
+            elapsed = time.perf_counter() - t0
+        self._gbdt._obs_iteration_tick(elapsed)
         # a stop detected by a mid-training flush (e.g. in reset_parameter)
         pending, self._pending_finish = self._pending_finish, False
         return finished or pending

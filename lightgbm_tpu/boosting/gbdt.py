@@ -638,6 +638,24 @@ class GBDT:
         self._pad = pad
         self.num_data = self._n_real + pad
 
+        # per-rank runtime attribution (obs/ranks.py): sampled step /
+        # collective-wait timers + rank-0 straggler aggregation over the
+        # coordination-service KV. Constructed HERE (not lazily) so the
+        # collective-arrival probe compiles outside the steady-state
+        # region; off-sample iterations touch none of it.
+        self._rank_stats = None
+        rs_every = int(cfg.get("tpu_rank_stats_every", 0) or 0)
+        if rs_every > 0:
+            from ..obs.ranks import RankStats
+            self._rank_stats = RankStats(
+                every=rs_every,
+                straggler_factor=float(
+                    cfg.get("tpu_straggler_factor", 3.0) or 3.0),
+                mesh=self.mesh,
+                deadline_s=float(
+                    cfg.get("tpu_collective_deadline_s", 0.0) or 0.0),
+                stream=self._metrics_stream)
+
         # EFB: configurations the bundle-space growers can't serve unbundle
         # HERE, before any device placement, so every learner's layout logic
         # below sees a plain dense matrix (bundling is lossless)
@@ -2193,6 +2211,25 @@ class GBDT:
                         seconds=round(seconds, 6),
                         compiles=guards.phase_compile_counts(),
                         cache=guards.global_cache_counts())
+
+    def train_metrics_tree(self) -> Dict[str, Any]:
+        """The live training-metrics tree the in-train Prometheus
+        endpoint (``tpu_metrics_port`` under ``lgb.train``) serves:
+        iteration progress, phase-keyed compile counters, persistent-
+        cache counters, and the latest rank-stats aggregate (median /
+        p99 / max over ranks, straggler flags) when sampling is armed.
+        Host-only reads — scraping must not touch the device."""
+        from ..analysis import guards
+        tree = {
+            "training": True,
+            "iteration": self.iter_,
+            "compiles": guards.phase_compile_counts(),
+            "cache": guards.global_cache_counts(),
+        }
+        rs = getattr(self, "_rank_stats", None)
+        if rs is not None:
+            tree["rank_stats"] = rs.latest_tree()
+        return tree
 
     def _linear_tree_iter(self, tree, row_leaf, grad_k, hess_k, mask,
                           cur_tree_id: int, first_iter: bool) -> None:

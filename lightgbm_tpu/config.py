@@ -188,10 +188,23 @@ PARAMS: Dict[str, Tuple[Any, type, Tuple[str, ...]]] = {
     # structured events dumped as JSONL on TrainingInterrupted / crash,
     # on a blown hot-swap, and at checkpoint ticks; 0 disables
     "tpu_flight_buffer": (512, int, ("flight_buffer",)),
-    # serving metrics endpoint (GET /metrics Prometheus text + /healthz):
-    # bound at PredictionServer start when > 0 (scripts/serve
-    # --metrics-port overrides)
+    # metrics endpoint (GET /metrics Prometheus text + /healthz): bound
+    # at PredictionServer start AND for the duration of lgb.train when
+    # > 0 (scripts/serve --metrics-port overrides) — a pod run is
+    # scrapeable while it trains (iteration progress, phase-keyed
+    # compile counters, rank-stats aggregate incl. straggler flags)
     "tpu_metrics_port": (0, int, ("metrics_port",)),
+    # per-rank runtime attribution (obs/ranks.py): every N iterations
+    # the booster blocks on the step (true step wall), times one
+    # collective-arrival probe, and publishes both through the
+    # coordination-service KV; rank 0 aggregates median/p99/max and
+    # flags stragglers into the flight recorder + metrics stream.
+    # 0 disables (default) — off-sample iterations are untouched, so
+    # the steady-state 0-d2h contract holds between samples
+    "tpu_rank_stats_every": (0, int, ("rank_stats_every",)),
+    # straggler threshold: a rank is flagged when its sampled iteration
+    # wall exceeds this factor x the rolling cross-rank median
+    "tpu_straggler_factor": (3.0, float, ("straggler_factor",)),
     "tpu_part_block": (2048, int, ()),      # compact partition stream block
     "tpu_hist_block": (16384, int, ()),     # compact histogram stream block
     # batched-M histogram depth: K row blocks per one-hot contraction fill

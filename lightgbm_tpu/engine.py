@@ -83,9 +83,9 @@ def train(
                     "seen": obs.spans.seen_counts()}
     with session:
         try:
-            return _train_impl(params, train_set, num_boost_round,
-                               valid_sets, valid_names, feval, init_model,
-                               callbacks, obs_baseline)
+            booster = _train_impl(params, train_set, num_boost_round,
+                                  valid_sets, valid_names, feval,
+                                  init_model, callbacks, obs_baseline)
         except BaseException as err:
             # the flight recorder's "any crash escaping lgb.train" dump
             # site — HERE, not around the boosting loop, so a death
@@ -109,6 +109,47 @@ def train(
             if path and not interrupted:
                 log.warning(f"flight recorder dumped to {path}")
             raise
+    # device-time trace analytics (obs/tracing.py): the profiler only
+    # writes its artifact when the session CLOSES, so the parse runs
+    # here — after the with-block, strictly off the training path — and
+    # emits the per-phase DEVICE-time table next to the host phase table
+    # the summary already carries (device_seconds vs host_seconds; a
+    # reader diffing the two sees host-dispatch skew instead of
+    # mistaking it for compute)
+    if trace_dir and trace_mode == "full":
+        _emit_device_time(booster, trace_dir, obs_baseline)
+    return booster
+
+
+def _emit_device_time(booster: Booster, trace_dir: str,
+                      obs_baseline: Dict[str, Any]) -> None:
+    """Parse the just-closed profiler artifact and emit the
+    ``device_time`` metrics record. Best-effort: analytics must never
+    fail a run that already trained."""
+    from . import obs
+    from .obs import flight, tracing
+    try:
+        analysis = tracing.analyze_trace_dir(trace_dir)
+    except Exception as err:  # noqa: BLE001 - telemetry is best-effort
+        log.warning(f"trace analytics failed for {trace_dir}: {err}")
+        return
+    if analysis is None:
+        log.warning(f"tpu_trace_dir={trace_dir} left no xplane artifact "
+                    "to analyze")
+        return
+    host_phases = obs.spans.phase_times_since(obs_baseline["phase"])
+    stream = booster._gbdt._metrics_stream
+    if stream is not None:
+        stream.emit("device_time", host_phase_times=host_phases,
+                    **analysis)
+    decomp = analysis.get("decomposition", {})
+    flight.note("device_time", source=analysis.get("source"),
+                phases={k: v.get("device_seconds")
+                        for k, v in analysis.get("phases", {}).items()},
+                **{k: decomp.get(k) for k in ("busy_seconds",
+                                              "comm_seconds",
+                                              "idle_seconds")})
+    booster._device_time_analysis = analysis
 
 
 def _train_impl(
@@ -251,6 +292,28 @@ def _train_impl(
                      iteration=start_iteration,
                      num_boost_round=num_boost_round)
 
+    # scrapeable while it TRAINS: tpu_metrics_port binds the same
+    # Prometheus-text endpoint the serving tier uses, serving the live
+    # training tree (iteration progress, phase-keyed compiles, cache
+    # counters, rank-stats aggregate incl. straggler flags) for the
+    # duration of the run. Rank 0 only — one scrape target per pod, the
+    # same single-writer contract as the metrics stream.
+    mserver = None
+    mport = int(cfg.get("tpu_metrics_port", 0) or 0)
+    if mport > 0:
+        import jax
+        if jax.process_index() == 0:
+            from .obs.metrics import MetricsServer
+            try:
+                mserver = MetricsServer(booster._gbdt.train_metrics_tree,
+                                        port=mport)
+                log.info(f"training metrics endpoint on "
+                         f":{mserver.port} (/metrics, /healthz)")
+            except OSError as err:
+                log.warning(
+                    f"cannot bind tpu_metrics_port={mport}: {err}; "
+                    "training continues unscrapeable")
+
     def _flight_dump(reason: str, err: BaseException) -> None:
         # the TrainingInterrupted dump site; other crashes dump from the
         # train() wrapper, which covers construction/resume too
@@ -336,6 +399,8 @@ def _train_impl(
                             f"snapshot failed: {snap_err}")
         raise
     finally:
+        if mserver is not None:
+            mserver.stop()
         if mstream is not None:
             from .analysis import guards
             # spans_seen: sites newly ENTERED during this run — host

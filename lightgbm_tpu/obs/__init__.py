@@ -19,26 +19,42 @@ One observability subsystem spanning training, collectives, and serving
   (``--metrics-port`` on ``scripts/serve``). stdlib HTTP, no new deps.
 * :mod:`.summarize` — ``scripts/obs``: per-phase time share + compile /
   collective totals from any of the above artifacts (the
-  ``Common::Timer::Print`` analogue), jax-free.
+  ``Common::Timer::Print`` analogue), jax-free; subcommands ``trace``
+  (device-time table from a profiler artifact) and ``merge``
+  (cross-rank flight-dump timeline).
+* :mod:`.tracing` — device-time trace analytics: parses the
+  ``tpu_trace_dir`` xplane artifact (jax-free protobuf wire reader) and
+  maps timed device events back to the span taxonomy — the per-phase
+  DEVICE-seconds table, per-collective durations, MXU/comm/idle
+  decomposition. Post-run only; tpulint R009c keeps it out of
+  jit-reachable modules.
+* :mod:`.ranks` — per-rank runtime attribution: sampled step /
+  collective-wait timers published over the coordination-service KV,
+  rank-0 median/p99/max aggregation + straggler flags
+  (``tpu_rank_stats_every`` / ``tpu_straggler_factor``).
+* :mod:`.ledger` — scaling-efficiency ledger: per-chip throughput
+  efficiency vs the 1-chip row + measured-vs-modeled comm accounting
+  recorded into MULTICHIP/COMM_ACCOUNTING.json (bench BENCH_LEDGER=1).
 
-This ``__init__`` stays jax-free too (``spans`` is the only jax-touching
-module and is imported lazily), so ``scripts/obs`` runs without a
-backend.
+This ``__init__`` stays jax-free too (``spans`` and ``ranks`` are the
+only jax-touching modules and are imported lazily), so ``scripts/obs``
+runs without a backend.
 """
 from __future__ import annotations
 
-from . import flight, metrics, summarize  # noqa: F401  (jax-free)
+from . import flight, ledger, metrics, summarize, tracing  # noqa: F401
 
-__all__ = ["flight", "metrics", "summarize", "spans", "configure"]
+__all__ = ["flight", "ledger", "metrics", "summarize", "tracing",
+           "spans", "ranks", "configure"]
 
 
 def __getattr__(name):
-    # lazy: spans imports jax; offline consumers (scripts/obs) never pay.
-    # importlib (not `from . import`) — the from-form probes this very
-    # __getattr__ before importing, which recurses
-    if name == "spans":
+    # lazy: spans/ranks import jax; offline consumers (scripts/obs)
+    # never pay. importlib (not `from . import`) — the from-form probes
+    # this very __getattr__ before importing, which recurses
+    if name in ("spans", "ranks"):
         import importlib
-        return importlib.import_module(".spans", __name__)
+        return importlib.import_module(f".{name}", __name__)
     raise AttributeError(name)
 
 

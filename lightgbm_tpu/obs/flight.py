@@ -41,6 +41,22 @@ from typing import Any, Dict, List, Optional
 #: default ring capacity when no config has been seen (tpu_flight_buffer)
 DEFAULT_CAPACITY = 512
 
+#: directory for the last-resort cwd fallback dump path. Empty = the
+#: working directory (production default); the test suite points it at
+#: a tmpdir so stray dumps can never pollute a checkout (conftest.py).
+_FALLBACK_DIR = ""
+
+
+def _process_rank() -> Optional[int]:
+    """This process's rank when running multi-process, else None."""
+    try:
+        import jax
+        if jax.process_count() > 1:
+            return int(jax.process_index())
+    except Exception:  # noqa: BLE001 - jax absent/uninitialized: single
+        pass
+    return None
+
 
 class FlightRecorder:
     """Thread-safe bounded event ring with JSONL dumps."""
@@ -97,18 +113,15 @@ class FlightRecorder:
     # -- dumping -------------------------------------------------------------
     @staticmethod
     def _rank_suffix() -> str:
-        """``_rN`` on multihost ranks > 0 — dump destinations are often
-        shared (env path identical on every rank, checkpoint dir on a
-        shared filesystem, pids colliding across containers), and ranks
-        must not clobber each other's post-mortems. Single-host paths
+        """``_rankN`` on EVERY multihost rank (rank 0 included) — dump
+        destinations are often shared (env path identical on every rank,
+        checkpoint dir on a shared filesystem, pids colliding across
+        containers), ranks must not clobber each other's post-mortems,
+        and ``scripts/obs merge`` interleaves the per-rank files back
+        into one cross-rank timeline by this tag. Single-host paths
         stay exactly as configured."""
-        try:
-            import jax
-            if jax.process_count() > 1:
-                return f"_r{jax.process_index()}"
-        except Exception:  # noqa: BLE001 - jax absent/uninitialized: rank 0
-            pass
-        return ""
+        rank = _process_rank()
+        return "" if rank is None else f"_rank{rank}"
 
     def _resolve_path(self, path: Optional[str]) -> str:
         rank = self._rank_suffix()
@@ -123,7 +136,8 @@ class FlightRecorder:
         if self._dump_dir:
             return os.path.join(self._dump_dir,
                                 f"flight{rank}_{os.getpid()}.jsonl")
-        return f"lgbm_tpu_flight{rank}_{os.getpid()}.jsonl"
+        return os.path.join(
+            _FALLBACK_DIR, f"lgbm_tpu_flight{rank}_{os.getpid()}.jsonl")
 
     def dump(self, reason: str, path: Optional[str] = None,
              extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
@@ -148,6 +162,7 @@ class FlightRecorder:
             with open(out, "w", encoding="utf-8") as fh:
                 header = {"event": "flight_dump", "reason": reason,
                           "t": round(time.time(), 6), "pid": os.getpid(),
+                          "rank": _process_rank(),
                           "capacity": self._capacity,
                           "events": len(events),
                           "dropped": max(0, seq - len(events))}

@@ -1,0 +1,213 @@
+"""Scaling-efficiency ledger: measured curves, not guesses, in the books.
+
+ROADMAP item 2 wants "honest scaling curves" and "per-chip scaling
+efficiency recorded in MULTICHIP/COMM_ACCOUNTING"; this module is the
+recorder. Three pure pieces (unit-testable, jax-free) plus one
+read-modify-write sink:
+
+* :func:`per_chip_efficiency` — throughput at N chips vs N x the 1-chip
+  row: the number a scaling curve is FOR (1.0 = perfect linear, the
+  reference's docs/Experiments.rst parallel-learning tables report the
+  same shape).
+* :func:`measured_vs_model` — the measured collective seconds from the
+  device-time trace analytics (obs/tracing.py) against the byte model
+  the HLO contracts already pin (``analysis/contracts/*.json``
+  ``measured.total`` bytes/step): comm fraction of device busy time,
+  modeled bytes moved, and the bandwidth the two numbers jointly imply.
+  A wild implied bandwidth means one of the two books is lying — which
+  is the point of keeping both.
+* :func:`ledger_block` — one bench round's ledger entry: the efficiency
+  row, the measured-vs-model block, and enough context (shape, chips,
+  throughput) to re-derive either.
+* :func:`record` — merge a block into a ledger JSON file
+  (COMM_ACCOUNTING.json / MULTICHIP_r0x.json) atomically
+  (write-temp-rename; bench rounds may be killed mid-write).
+
+bench.py arms this with ``BENCH_LEDGER=1``: the timed loop runs under a
+profiler ``trace_session``, the trace analytics produce the measured
+collective durations, and the round's ``measured_vs_model`` block lands
+in the books with attribution built in, not bolted on.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Dict, List, Optional
+
+#: ledger schema version (consumers key on it before trusting fields)
+LEDGER_VERSION = 1
+
+
+def per_chip_efficiency(rows: List[Dict[str, Any]]
+                        ) -> List[Dict[str, Any]]:
+    """Scaling efficiency per row vs the 1-chip row.
+
+    ``rows``: ``[{"n_chips": int, "iters_per_sec": float}, ...]`` where
+    ``iters_per_sec`` is the WHOLE-RUN throughput (not per-chip).
+    Returns the rows augmented with ``per_chip`` and ``efficiency``
+    (None when no 1-chip row exists to normalize against).
+    """
+    base = None
+    for r in rows:
+        if int(r.get("n_chips", 0)) == 1:
+            base = float(r["iters_per_sec"])
+            break
+    out = []
+    for r in rows:
+        n = max(1, int(r.get("n_chips", 1)))
+        ips = float(r.get("iters_per_sec", 0.0))
+        row = dict(r)
+        row["per_chip"] = round(ips / n, 6)
+        row["efficiency"] = (round(ips / (n * base), 4)
+                             if base else None)
+        out.append(row)
+    return out
+
+
+def comm_fraction(analysis: Dict[str, Any]) -> Optional[float]:
+    """Measured comm share of device busy time from a trace analysis
+    (obs/tracing.py output); None when nothing was busy."""
+    d = analysis.get("decomposition") or {}
+    busy = float(d.get("busy_seconds", 0.0) or 0.0)
+    if busy <= 0.0:
+        return None
+    return round(float(d.get("comm_seconds", 0.0) or 0.0) / busy, 6)
+
+
+def model_bytes_per_step(contract: Dict[str, Any]) -> Optional[int]:
+    """The byte model a checked-in HLO contract pins for one step
+    (``measured.total`` — the bytes the lowered collectives move)."""
+    measured = contract.get("measured") or {}
+    total = measured.get("total")
+    return None if total is None else int(total)
+
+
+def measured_vs_model(analysis: Dict[str, Any],
+                      contract: Optional[Dict[str, Any]],
+                      steps: Optional[int] = None) -> Dict[str, Any]:
+    """One comparison block: measured collective seconds (trace) vs the
+    contract's byte model, and the bandwidth they jointly imply."""
+    d = analysis.get("decomposition") or {}
+    comm_s = float(d.get("comm_seconds", 0.0) or 0.0)
+    block: Dict[str, Any] = {
+        "measured": {
+            "comm_seconds": round(comm_s, 9),
+            "busy_seconds": round(
+                float(d.get("busy_seconds", 0.0) or 0.0), 9),
+            "comm_fraction": comm_fraction(analysis),
+            "collectives": analysis.get("collectives", {}),
+            "source": analysis.get("source"),
+        },
+        "model": {},
+    }
+    bps = model_bytes_per_step(contract) if contract else None
+    if bps is not None:
+        block["model"] = {
+            "bytes_per_step": bps,
+            "mode": contract.get("mode"),
+            "num_devices": contract.get("num_devices"),
+        }
+        if steps:
+            total_bytes = bps * int(steps)
+            block["model"]["bytes_total"] = total_bytes
+            if comm_s > 0.0:
+                block["implied_gbps"] = round(
+                    total_bytes / comm_s / 1e9, 6)
+    return block
+
+
+def load_contract(mode: str) -> Optional[Dict[str, Any]]:
+    """A checked-in HLO contract by mode name (``data_scatter``,
+    ``serial_compact``, ...), or None."""
+    d = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "analysis", "contracts")
+    path = os.path.join(d, f"{mode}.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def ledger_block(shape: str, n_chips: int, iters_per_sec: float,
+                 analysis: Optional[Dict[str, Any]] = None,
+                 contract: Optional[Dict[str, Any]] = None,
+                 steps: Optional[int] = None,
+                 prior_rows: Optional[List[Dict[str, Any]]] = None
+                 ) -> Dict[str, Any]:
+    """One bench round's ledger entry. ``prior_rows`` (earlier rounds'
+    ``{n_chips, iters_per_sec}``) feed the efficiency normalization so a
+    multichip round records its efficiency against the recorded 1-chip
+    row."""
+    rows = list(prior_rows or [])
+    rows = [r for r in rows if int(r.get("n_chips", 0)) != int(n_chips)]
+    rows.append({"n_chips": int(n_chips),
+                 "iters_per_sec": float(iters_per_sec)})
+    rows.sort(key=lambda r: int(r["n_chips"]))
+    block: Dict[str, Any] = {
+        "version": LEDGER_VERSION,
+        "shape": shape,
+        "n_chips": int(n_chips),
+        "iters_per_sec": float(iters_per_sec),
+        "scaling": per_chip_efficiency(rows),
+    }
+    if analysis is not None:
+        block["measured_vs_model"] = measured_vs_model(
+            analysis, contract, steps=steps)
+    return block
+
+
+def prior_rows(path: str, shape: str) -> List[Dict[str, Any]]:
+    """Earlier recorded ``{n_chips, iters_per_sec}`` rows for ``shape``
+    from a ledger file — the normalization base a new round's
+    efficiency is computed against."""
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return []
+    out = []
+    for blk in (data.get("scaling_ledger") or {}).values():
+        if isinstance(blk, dict) and blk.get("shape") == shape \
+                and "n_chips" in blk and "iters_per_sec" in blk:
+            out.append({"n_chips": int(blk["n_chips"]),
+                        "iters_per_sec": float(blk["iters_per_sec"])})
+    return out
+
+
+def record(path: str, key: str, block: Dict[str, Any]) -> None:
+    """Merge ``block`` under ``ledger[key]`` of the JSON file at
+    ``path`` (created if absent), atomically. Existing unrelated keys
+    are preserved — COMM_ACCOUNTING.json carries the byte-model entries
+    next to this ledger."""
+    data: Dict[str, Any] = {}
+    if os.path.exists(path):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                data = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            data = {}
+    if not isinstance(data, dict):
+        data = {}
+    ledger = data.setdefault("scaling_ledger", {})
+    if not isinstance(ledger, dict):
+        ledger = data["scaling_ledger"] = {}
+    ledger[key] = block
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as fh:
+            json.dump(data, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise

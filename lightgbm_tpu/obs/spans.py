@@ -54,11 +54,10 @@ from typing import Dict, Iterator, Optional, Set
 import jax
 
 #: the complete phase-name taxonomy (tests assert a traced+served run
-#: touches every one of these)
-SPAN_TAXONOMY = (
-    "binning", "gradient", "hist_build", "collective_reduce", "split_scan",
-    "partition", "checkpoint_write", "predict_warmup", "serve_tick",
-)
+#: touches every one of these). Canonical copy lives in obs/tracing.py
+#: (jax-free, so scripts/obs can attribute trace phases with no backend);
+#: re-exported here because spans is the producer side of the same names.
+from .tracing import SPAN_TAXONOMY  # noqa: E402,F401
 
 _TRACE_MODES = ("full", "annotations")
 

@@ -29,7 +29,7 @@ from typing import Any, Dict, List, Optional, Sequence
 #: event kinds surfaced in the "notable events" tail
 NOTABLE = ("fault_fire", "deadline", "retry", "crash",
            "training_interrupted", "swap_failed", "worker_restart",
-           "snapshot_corrupt")
+           "snapshot_corrupt", "straggler", "rank_missing")
 
 
 def _read_jsonl(path: str) -> List[Dict[str, Any]]:
@@ -38,7 +38,11 @@ def _read_jsonl(path: str) -> List[Dict[str, Any]]:
 
 
 def _kind(rec: Dict[str, Any]) -> str:
-    return str(rec.get("kind") or rec.get("event") or "")
+    # flight records type themselves with "event" and may carry a
+    # PAYLOAD field named "kind" (fault_fire's fault kind); metrics
+    # records type with "kind" and never have "event" — so "event"
+    # must win the classification
+    return str(rec.get("event") or rec.get("kind") or "")
 
 
 def summarize(paths: Sequence[str]) -> Dict[str, Any]:
@@ -56,6 +60,9 @@ def summarize(paths: Sequence[str]) -> Dict[str, Any]:
     notable: List[Dict[str, Any]] = []
     spans_seen: List[str] = []
     dump_header: Optional[Dict[str, Any]] = None
+    device_time: Optional[Dict[str, Any]] = None
+    rank_stats: Optional[Dict[str, Any]] = None
+    stragglers: List[Dict[str, Any]] = []
 
     for rec in records:
         k = _kind(rec)
@@ -79,6 +86,15 @@ def summarize(paths: Sequence[str]) -> Dict[str, Any]:
         elif k == "collective_program":
             collectives[str(rec.get("key"))] = {
                 "bytes": rec.get("bytes"), "total": rec.get("total")}
+        elif k == "device_time":
+            device_time = rec                  # one per run: keep the last
+            if isinstance(rec.get("host_phase_times"), dict) \
+                    and not phase_times:
+                phase_times = rec["host_phase_times"]
+        elif k == "rank_stats":
+            rank_stats = rec                   # cumulative-ish: keep last
+        elif k == "straggler":
+            stragglers.append(rec)
         elif k == "flight_dump":
             dump_header = rec
         if k in NOTABLE:
@@ -92,6 +108,9 @@ def summarize(paths: Sequence[str]) -> Dict[str, Any]:
         "iter_seconds_mean": (iter_seconds / iters) if iters else None,
         "phase_times": phase_times,
         "phase_total_seconds": total_phase_s,
+        "device_time": device_time,
+        "rank_stats": rank_stats,
+        "stragglers": stragglers[-20:],
         "compiles": compiles,
         "cache": cache,
         "collectives": collectives,
@@ -179,21 +198,61 @@ def _fmt_table(summary: Dict[str, Any]) -> str:
     lines: List[str] = []
     pt = summary["phase_times"]
     total = summary["phase_total_seconds"]
+    dt = summary.get("device_time") or {}
+    dev_phases = dt.get("phases") or {}
     lines.append(f"records: {summary['records']}  "
                  f"iterations: {summary['iterations']}"
                  + (f"  mean iter: {summary['iter_seconds_mean']:.4f}s"
                     if summary["iter_seconds_mean"] else ""))
-    if pt:
+    if pt or dev_phases:
+        # host and device seconds SIDE BY SIDE: the host column is wall
+        # clock at the tick sites (dispatch included), the device column
+        # is profiler-measured op time — a large host/device gap on the
+        # same phase is dispatch skew, not compute
         lines.append("")
-        lines.append(f"{'phase':<20} {'seconds':>10} {'share':>7} "
-                     f"{'count':>8}")
-        for name, v in sorted(pt.items(),
-                              key=lambda kv: -float(
-                                  kv[1].get('seconds', 0) or 0)):
+        lines.append(f"{'phase':<20} {'host_s':>10} {'share':>7} "
+                     f"{'count':>8} {'device_s':>10}")
+        names = set(pt) | set(dev_phases)
+        for name in sorted(names, key=lambda n: -max(
+                float((pt.get(n) or {}).get("seconds", 0) or 0),
+                float((dev_phases.get(n) or {}).get(
+                    "device_seconds", 0) or 0))):
+            v = pt.get(name) or {}
             s = float(v.get("seconds", 0.0) or 0.0)
             share = (s / total) if total else 0.0
-            lines.append(f"{name:<20} {s:>10.3f} {share:>6.1%} "
-                         f"{int(v.get('count', 0) or 0):>8}")
+            host = f"{s:>10.3f}" if name in pt else f"{'-':>10}"
+            d = dev_phases.get(name) or {}
+            dev = (f"{float(d.get('device_seconds', 0.0)):>10.4f}"
+                   if name in dev_phases else f"{'-':>10}")
+            lines.append(f"{name:<20} {host} {share:>6.1%} "
+                         f"{int(v.get('count', 0) or 0):>8} {dev}")
+    if dt:
+        d = dt.get("decomposition") or {}
+        lines.append("")
+        lines.append(
+            f"device timeline ({dt.get('source')}): "
+            f"busy {d.get('busy_seconds', 0):.4f}s = "
+            f"mxu {d.get('mxu_seconds', 0):.4f}s + "
+            f"comm {d.get('comm_seconds', 0):.4f}s + other; "
+            f"idle {d.get('idle_seconds', 0):.4f}s")
+        for key, v in sorted((dt.get("collectives") or {}).items()):
+            lines.append(f"  collective {key:<22} "
+                         f"{v.get('seconds', 0):.6f}s x{v.get('count')}")
+    rs = summary.get("rank_stats")
+    if rs:
+        lines.append("")
+        lines.append(
+            f"ranks: {rs.get('ranks_reporting')}/{rs.get('world')} "
+            f"reporting  median {rs.get('median_s')}s  "
+            f"p99 {rs.get('p99_s')}s  max {rs.get('max_s')}s "
+            f"(rank {rs.get('max_rank')})  wait_max "
+            f"{rs.get('wait_max_s')}s")
+        if summary.get("stragglers"):
+            for rec in summary["stragglers"][-5:]:
+                lines.append(
+                    f"  straggler: rank {rec.get('rank')} @ iteration "
+                    f"{rec.get('iteration')} ({rec.get('slow_s')}s vs "
+                    f"median {rec.get('rolling_median_s')}s)")
     comp = summary["compiles"]
     if comp:
         lines.append("")
@@ -227,13 +286,154 @@ def _fmt_table(summary: Dict[str, Any]) -> str:
         lines.append("notable events (tail):")
         for rec in summary["notable"]:
             k = _kind(rec)
+            # drop only the field that typed the record: a flight
+            # event's PAYLOAD "kind" (fault_fire's kill/hang) stays
             rest = {key: v for key, v in rec.items()
-                    if key not in ("kind", "event", "t", "seq")}
+                    if key not in ("event", "t", "seq")
+                    and not (key == "kind" and rec.get("event") is None)}
             lines.append(f"  {k}: {json.dumps(rest, default=str)}")
     return "\n".join(lines)
 
 
+def _rank_of_dump(path: str, header: Optional[Dict[str, Any]]) -> int:
+    """Rank of a flight dump: the header's rank field, else the
+    ``_rank<k>`` filename tag, else 0."""
+    if header is not None and header.get("rank") is not None:
+        try:
+            return int(header["rank"])
+        except (TypeError, ValueError):
+            pass
+    import re
+    m = re.search(r"_rank(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else 0
+
+
+def merge_ranks(paths: Sequence[str]) -> List[Dict[str, Any]]:
+    """Interleave rank-tagged flight dumps into ONE cross-rank timeline
+    ordered by ``(time, source rank)`` — each record annotated with
+    ``src_rank``, the rank whose dump it came from. A separate key on
+    purpose: events like ``straggler``/``rank_missing`` carry a payload
+    ``rank`` (the rank they are ABOUT), which the annotation must not
+    clobber — rank 0's dump says rank 1 straggled. The post-mortem read
+    of a pod: rank 1's fault fire lines up against rank 0's straggler
+    flag and collective-deadline events in wall-clock order."""
+    merged: List[Dict[str, Any]] = []
+    for path in paths:
+        records = _read_jsonl(path)
+        header = records[0] if records \
+            and _kind(records[0]) == "flight_dump" else None
+        rank = _rank_of_dump(path, header)
+        for rec in records:
+            out = dict(rec)
+            out["src_rank"] = rank
+            merged.append(out)
+    merged.sort(key=lambda r: (float(r.get("t", 0.0) or 0.0),
+                               int(r.get("src_rank", 0)),
+                               int(r.get("seq", 0) or 0)))
+    return merged
+
+
+def _fmt_merge(merged: List[Dict[str, Any]]) -> str:
+    lines = []
+    for rec in merged:
+        k = _kind(rec)
+        rest = {key: v for key, v in rec.items()
+                if key not in ("kind", "event", "t", "seq", "src_rank")}
+        lines.append(f"{float(rec.get('t', 0.0) or 0.0):>17.6f} "
+                     f"r{rec.get('src_rank', 0)} {k:<22} "
+                     f"{json.dumps(rest, default=str)}")
+    return "\n".join(lines)
+
+
+def merge_main(argv: Sequence[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="obs merge",
+        description="interleave rank-tagged flight dumps into one "
+                    "cross-rank timeline ordered by (time, rank)")
+    ap.add_argument("paths", nargs="+", help="rank-tagged dump files")
+    ap.add_argument("--jsonl", action="store_true",
+                    help="emit merged records as JSONL instead of a table")
+    args = ap.parse_args(argv)
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(f"obs merge: no such file: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    merged = merge_ranks(args.paths)
+    if args.jsonl:
+        for rec in merged:
+            print(json.dumps(rec, default=str))
+    else:
+        print(_fmt_merge(merged))
+    return 0
+
+
+def _fmt_trace(analysis: Dict[str, Any]) -> str:
+    """The per-phase device-time table of one profiler artifact."""
+    lines = [f"trace: {analysis.get('trace_dir', '')} "
+             f"({', '.join(analysis.get('files', []))}) "
+             f"source={analysis.get('source')} "
+             f"lanes={analysis.get('lanes')}"]
+    phases = analysis.get("phases") or {}
+    if phases:
+        lines.append("")
+        lines.append(f"{'phase':<20} {'device_s':>12} {'events':>8}")
+        for name, v in sorted(phases.items(),
+                              key=lambda kv: -float(
+                                  kv[1].get("device_seconds", 0) or 0)):
+            lines.append(f"{name:<20} "
+                         f"{float(v.get('device_seconds', 0)):>12.6f} "
+                         f"{int(v.get('events', 0)):>8}")
+    un = float(analysis.get("unattributed_seconds", 0.0) or 0.0)
+    if un:
+        lines.append(f"{'(unattributed)':<20} {un:>12.6f}")
+    d = analysis.get("decomposition") or {}
+    lines.append("")
+    lines.append(f"timeline: total {d.get('total_seconds', 0):.6f}s  "
+                 f"busy {d.get('busy_seconds', 0):.6f}s  "
+                 f"mxu {d.get('mxu_seconds', 0):.6f}s  "
+                 f"comm {d.get('comm_seconds', 0):.6f}s  "
+                 f"idle {d.get('idle_seconds', 0):.6f}s")
+    for key, v in sorted((analysis.get("collectives") or {}).items()):
+        lines.append(f"  collective {key:<22} "
+                     f"{v.get('seconds', 0):.6f}s x{v.get('count')}")
+    if analysis.get("spans_lowered"):
+        lines.append("")
+        lines.append("spans lowered: "
+                     + ", ".join(analysis["spans_lowered"]))
+    return "\n".join(lines)
+
+
+def trace_main(argv: Sequence[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="obs trace",
+        description="per-phase DEVICE-time table from a tpu_trace_dir "
+                    "profiler artifact (jax-free xplane parse)")
+    ap.add_argument("trace_dir", help="the tpu_trace_dir a run wrote")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the analysis as JSON instead of a table")
+    args = ap.parse_args(argv)
+    from .tracing import analyze_trace_dir
+    analysis = analyze_trace_dir(args.trace_dir)
+    if analysis is None:
+        print(f"obs trace: no xplane artifact under {args.trace_dir}",
+              file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(analysis, indent=1, default=str))
+    else:
+        print(_fmt_trace(analysis))
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # subcommands ride in front of the legacy positional form
+    # (`scripts/obs <files>` keeps summarizing, unchanged)
+    if argv and argv[0] == "trace":
+        return trace_main(argv[1:])
+    if argv and argv[0] == "merge":
+        return merge_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="obs", description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="+",

@@ -31,6 +31,18 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _route_flight_dumps_to_tmp(tmp_path, monkeypatch):
+    """Flight dumps must never land in the checkout: a test that trips a
+    crash dump without LGBM_TPU_FLIGHT_PATH or a checkpoint dir used to
+    fall back to the CWD (a stray lgbm_tpu_flight_*.jsonl once sat at
+    the repo root). Point the recorder's last-resort fallback directory
+    at the test's tmpdir; explicit env/path/dump-dir routing (what the
+    flight tests assert) is untouched."""
+    from lightgbm_tpu.obs import flight
+    monkeypatch.setattr(flight, "_FALLBACK_DIR", str(tmp_path))
+
+
 @pytest.fixture
 def rng():
     return np.random.RandomState(42)

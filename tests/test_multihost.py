@@ -9,6 +9,7 @@ Each subprocess gets HALF the rows; bin mappers must come out identical on
 both ranks (sample pooling at construct), the global arrays are assembled
 from per-process shards, and the two ranks' model files must match.
 """
+import json
 import os
 import socket
 import subprocess
@@ -128,3 +129,97 @@ def test_two_process_training_identical_models(tmp_path):
     # identical binning (pooled sample == full data) and identical split
     # logic; differences are f32 reduction order only
     assert np.abs(p_ref - p_mh).max() < 1e-3
+
+
+# ---------------------------------------------------------------- ISSUE 11
+_STRAGGLER_WORKER = r"""
+import json, os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+port, outdir = sys.argv[1], sys.argv[2]
+rank = int(os.environ["LIGHTGBM_TPU_PROCESS_ID"])
+# the PR 7 KV harness: 2 coordination-service processes, no XLA
+# collectives (process_allgather is unimplemented on multiprocess CPU —
+# the rank-attribution plane deliberately needs only the KV)
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=2, process_id=rank)
+# rank-tagged flight dumps: one shared env path, per-rank suffixed
+os.environ["LGBM_TPU_FLIGHT_PATH"] = os.path.join(outdir, "flight.jsonl")
+from lightgbm_tpu.analysis import faultinject
+from lightgbm_tpu.obs import flight
+from lightgbm_tpu.obs.ranks import RankStats
+
+rs = RankStats(every=1, straggler_factor=3.0, deadline_s=60.0)
+assert rs.world == 2 and rs.rank == rank, (rs.rank, rs.world)
+spec = "hang@step=3:seconds=1.5" if rank == 1 else ""
+with faultinject.inject(spec):
+    plan = faultinject.active_plan()
+    for i in range(1, 7):
+        t0 = time.perf_counter()
+        plan.fire("step", iteration=i)      # rank 1 sleeps 1.5s at i=3
+        time.sleep(0.02)                    # the simulated step
+        rs.sample_step(i, time.perf_counter() - t0)
+dump = flight.dump("dryrun end")
+print("DUMP", dump)
+if rank == 0:
+    with open(os.path.join(outdir, "r0.json"), "w") as fh:
+        json.dump({"latest": rs.latest_tree(),
+                   "stragglers": [e for e in flight.recorder().events()
+                                  if e["event"] == "straggler"]}, fh)
+print("rank", rank, "done")
+"""
+
+
+@pytest.mark.slow
+def test_two_process_straggler_dryrun(tmp_path):
+    """ISSUE 11 acceptance: 2-process CPU dryrun over the
+    coordination-service KV — an injected hang@step on rank 1 produces
+    a straggler event on rank 0, rank-tagged flight dumps on BOTH
+    ranks, and a `scripts/obs merge` timeline ordered by (time, rank)."""
+    port = _free_port()
+    worker = tmp_path / "worker.py"
+    worker.write_text(_STRAGGLER_WORKER)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["LIGHTGBM_TPU_PROCESS_ID"] = str(rank)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker), str(port), str(tmp_path)],
+            env=env, cwd=repo, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+
+    # rank 0 flagged rank 1 at the injected iteration
+    r0 = json.loads((tmp_path / "r0.json").read_text())
+    st = r0["stragglers"]
+    assert st, "no straggler event on rank 0"
+    assert st[-1]["rank"] == 1 and st[-1]["iteration"] == 3
+    assert st[-1]["slow_s"] > 1.0
+    assert r0["latest"]["world"] == 2
+    # rank 0 also SAW the wait: its collective-wait probe blocked on
+    # rank 1's late barrier arrival at the hung iteration
+    assert r0["latest"]["per_rank"]["0"]["iteration"] == 6
+
+    # rank-tagged dumps on both ranks, merged into one timeline
+    d0 = tmp_path / "flight_rank0.jsonl"
+    d1 = tmp_path / "flight_rank1.jsonl"
+    assert d0.exists() and d1.exists(), list(tmp_path.iterdir())
+    from lightgbm_tpu.obs import summarize
+    merged = summarize.merge_ranks([str(d0), str(d1)])
+    assert {r["src_rank"] for r in merged} == {0, 1}
+    keys = [(float(r.get("t", 0) or 0), r["src_rank"]) for r in merged]
+    assert keys == sorted(keys)
+    kinds = {r.get("event") for r in merged}
+    assert "rank_sample" in kinds
+    # rank 0's flag, in context — still naming rank 1 as the straggler
+    st = [r for r in merged if r.get("event") == "straggler"]
+    assert st and st[-1]["src_rank"] == 0 and st[-1]["rank"] == 1
+    assert any(r.get("event") == "fault_fire" and r["src_rank"] == 1
+               for r in merged)            # rank 1's hang, same timeline

@@ -308,24 +308,58 @@ def test_taxonomy_trace_metrics_acceptance(tmp_path):
 
 @pytest.mark.slow
 def test_full_profiler_trace_artifacts(tmp_path):
-    """Full tpu_trace_dir mode: a 5-iteration compact run writes real
-    profiler artifacts (and the session closes them on the way out).
-    Slow lane: opening the FIRST jax profiler session in a process costs
-    a one-time ~10s init regardless of content."""
+    """Full tpu_trace_dir mode: a 5-iteration compact (data-parallel)
+    run writes real profiler artifacts, the session closes them on the
+    way out, and the DEVICE-time analytics round-trip (ISSUE 11
+    acceptance): the parsed artifact yields a per-phase device-time
+    table covering every taxonomy span that lowered, emitted alongside
+    host seconds in the metrics stream. Slow lane: opening the FIRST
+    jax profiler session in a process costs a one-time ~10s init
+    regardless of content."""
+    from lightgbm_tpu.obs import tracing
     spans.reset()
     X, y = _make_data(400, 6)
     trace_dir = tmp_path / "trace"
+    mpath = tmp_path / "metrics.jsonl"
     params = {
         "objective": "binary", "num_leaves": 7, "verbosity": -1,
-        "tpu_grower": "compact", "tpu_trace_dir": str(trace_dir),
+        "tpu_grower": "compact", "tree_learner": "data",
+        "tpu_trace_dir": str(trace_dir),
+        "tpu_metrics_path": str(mpath),
     }
-    lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
     trace_files = [os.path.join(r, f)
                    for r, _, fs in os.walk(trace_dir) for f in fs]
     assert trace_files, "tpu_trace_dir produced no profiler artifacts"
     assert {"binning", "gradient", "hist_build", "split_scan",
             "partition"} <= spans.seen_spans()
     assert not spans.annotations_enabled()
+
+    # the round-trip: engine parsed the artifact post-session and
+    # attached/emitted the device-time analysis
+    analysis = bst._device_time_analysis
+    assert analysis is not None
+    lowered = set(analysis["spans_lowered"])
+    assert {"gradient", "hist_build", "split_scan",
+            "partition", "collective_reduce"} <= lowered
+    # EVERY lowered taxonomy span has a device-time row with real time
+    for name in lowered:
+        row = analysis["phases"][name]
+        assert row["device_seconds"] > 0.0 and row["events"] > 0
+    # collective op durations measured (data-parallel: psums lowered)
+    assert analysis["collectives"], "no collective durations measured"
+    d = analysis["decomposition"]
+    assert d["busy_seconds"] > 0.0
+    assert d["comm_seconds"] > 0.0
+    assert d["busy_seconds"] <= d["total_seconds"] + 1e-9
+    # ... and the stream carries device_seconds next to host seconds
+    recs = metrics.read_stream(str(mpath))
+    dt = [r for r in recs if r["kind"] == "device_time"]
+    assert len(dt) == 1
+    assert dt[0]["phases"] == analysis["phases"]
+    assert "host_phase_times" in dt[0]
+    # scripts/obs renders the side-by-side table from the same stream
+    assert summarize.summarize([str(mpath)])["device_time"] is not None
 
 
 # ------------------------------------------- the acceptance criterion (B)
@@ -470,3 +504,106 @@ def test_prediction_server_metrics_endpoint(served_booster):
     # endpoint down after close
     with pytest.raises(Exception):
         urllib.request.urlopen(f"{base}/metrics", timeout=1)
+
+
+# ------------------------------- per-rank attribution (ISSUE 11, leg 2)
+@pytest.fixture(scope="module")
+def rank_stats_booster(tmp_path_factory):
+    """Same shape as telemetry_booster (programs already jit-cached by
+    the earlier test) with the sampled rank-stats timers armed."""
+    tmp = tmp_path_factory.mktemp("obs_ranks")
+    X, y = _make_data(1500, 10, seed=7)
+    params = {
+        "objective": "binary", "num_leaves": 15, "max_bin": 63,
+        "verbosity": -1, "tpu_grower": "compact",
+        "stop_check_freq": 10_000,
+        "tpu_metrics_path": str(tmp / "m.jsonl"),
+        "tpu_rank_stats_every": 2,
+        "tpu_straggler_factor": 3.0,
+    }
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.Booster(params, ds)
+    for _ in range(2):                    # warm: compiles + first sample
+        bst.update()
+    return bst
+
+
+def test_rank_stats_sampled_timers_keep_steady_state_guard(
+        rank_stats_booster):
+    """The acceptance contract for leg 2: with sampling armed
+    (tpu_rank_stats_every=2) the steady-state region still lowers
+    nothing and materializes nothing on the host — on-sample ticks take
+    only block_until_ready (not a transfer) plus the pre-compiled
+    probe, off-sample iterations take neither."""
+    bst = rank_stats_booster
+    assert bst._gbdt._rank_stats is not None
+    with spans.trace_session(None, "annotations"):
+        with guards.steady_state_guard("rank-stats steady state") as cc:
+            for _ in range(4):            # iters 3..6: samples at 4, 6
+                bst.update()
+    assert cc.lowerings == 0
+    assert cc.backend_compiles == 0
+    recs = metrics.read_stream(str(bst.config.get("tpu_metrics_path")))
+    rs = [r for r in recs if r["kind"] == "rank_stats"]
+    # samples at iterations 2, 4, 6
+    assert [r["iteration"] for r in rs] == [2, 4, 6]
+    assert all(r["world"] == 1 and r["ranks_reporting"] == 1
+               for r in rs)
+    assert all(r["max_s"] >= r["median_s"] >= 0 for r in rs)
+    samples = [e for e in flight.recorder().events()
+               if e["event"] == "rank_sample"]
+    assert samples and samples[-1]["iteration"] == 6
+
+
+def test_rank_stats_mesh_probe_does_not_recompile():
+    """The collective-arrival probe compiles at construction (outside
+    the steady-state region); sampled probes after that lower nothing."""
+    from lightgbm_tpu.obs.ranks import RankStats
+    from lightgbm_tpu.parallel.mesh import make_mesh
+    rs = RankStats(every=1, mesh=make_mesh(), rank=0, world=1)
+    assert rs._probe_fn is not None       # 8 virtual devices: live probe
+    rs.collective_wait(1)                 # settle any first-call cache
+    with guards.compile_counter() as cc:
+        w = rs.collective_wait(2)
+    assert w >= 0.0
+    assert cc.lowerings == 0
+
+
+def test_training_metrics_endpoint_scrapeable_while_training(tmp_path):
+    """Satellite: tpu_metrics_port under lgb.train — a scrape DURING the
+    run sees the live training tree (iteration progress, phase-keyed
+    compiles, rank-stats gauges), and the endpoint is gone when the run
+    ends."""
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    X, y = _make_data(400, 6)
+    params = {
+        "objective": "binary", "num_leaves": 7, "verbosity": -1,
+        "tpu_metrics_port": port,
+        "tpu_rank_stats_every": 1,
+    }
+    seen = {}
+
+    def scrape(env):
+        if env.iteration == 2 and not seen:
+            base = f"http://127.0.0.1:{port}"
+            seen["text"] = urllib.request.urlopen(
+                f"{base}/metrics", timeout=5).read().decode()
+            seen["health"] = json.loads(urllib.request.urlopen(
+                f"{base}/healthz", timeout=5).read())
+
+    lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=4,
+              callbacks=[scrape])
+    assert "lgbm_tpu_training 1" in seen["text"]
+    assert "lgbm_tpu_iteration" in seen["text"]
+    assert "lgbm_tpu_compiles_lowerings" in seen["text"]
+    assert "lgbm_tpu_rank_stats_median_s" in seen["text"]
+    assert seen["health"]["training"] is True
+    assert seen["health"]["rank_stats"]["world"] == 1
+    # endpoint is torn down with the run
+    with pytest.raises(Exception):
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                               timeout=1)

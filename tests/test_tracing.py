@@ -1,0 +1,376 @@
+"""Distributed performance observability (ISSUE 11): fast-lane units.
+
+Covers the three legs without a profiler session (the first jax
+profiler session costs a one-time ~10s init — tier-1's budget lives in
+the slow lane for that; these tests synthesize the xplane artifact with
+a tiny protobuf wire encoder instead):
+
+* obs/tracing.py — xplane parse, HLO scope resolution, per-phase device
+  time, collective durations, MXU/comm/idle decomposition;
+* obs/ranks.py — sampled publish/aggregate over an injected KV,
+  straggler flags, heartbeat-miss reporting;
+* obs/ledger.py — per-chip efficiency, measured-vs-model, atomic record;
+* scripts/obs — trace table, cross-rank merge ordered by (time, rank).
+"""
+import json
+import os
+
+import pytest
+
+from lightgbm_tpu.obs import flight, ledger, summarize, tracing
+
+# ---------------------------------------------------------------- encoder
+# minimal protobuf wire encoder: enough XSpace/HloProto to synthesize a
+# device trace (field numbers mirror obs/tracing.py's reader)
+
+
+def _v(n):
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out += bytes([b | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _vi(fn, val):
+    return _v((fn << 3) | 0) + _v(val)
+
+
+def _ld(fn, payload):
+    return _v((fn << 3) | 2) + _v(len(payload)) + payload
+
+
+def _s(fn, text):
+    return _ld(fn, text.encode())
+
+
+def _hlo_proto(instrs):
+    """instrs: [(name, opcode, scoped_op_name)] -> serialized HloProto."""
+    comp = b""
+    for name, opcode, scoped in instrs:
+        meta = _s(2, scoped)                      # OpMetadata.op_name
+        comp += _ld(2, _s(1, name) + _s(2, opcode) + _ld(7, meta))
+    module = _s(1, "m") + _ld(3, comp)            # HloModuleProto
+    return _ld(1, module)                         # HloProto.hlo_module
+
+
+def _event_meta(mid, name, hlo=None):
+    body = _vi(1, mid) + _s(2, name)
+    if hlo is not None:
+        stat = _vi(1, 1) + _ld(6, hlo)            # XStat.bytes_value
+        body += _ld(5, stat)                      # XEventMetadata.stats
+    return _ld(4, _vi(1, mid) + _ld(2, body))     # map entry in XPlane
+
+
+def _line(name, ts_ns, events):
+    body = _s(2, name) + _vi(3, ts_ns)
+    for mid, off_ps, dur_ps in events:
+        body += _ld(4, _vi(1, mid) + _vi(2, off_ps) + _vi(3, dur_ps))
+    return _ld(3, body)                           # XPlane.lines
+
+
+def _plane(name, parts):
+    return _ld(1, _s(2, name) + b"".join(parts))  # XSpace.planes
+
+
+_US = 1_000_000  # 1 microsecond in picoseconds
+
+
+def _device_space():
+    """One device plane: four scoped ops + one unscoped, one collective."""
+    instrs = [
+        ("fusion.1", "fusion", "jit(step)/jit(main)/hist_build/add"),
+        ("dot.2", "dot", "jit(step)/jit(main)/hist_build/dot_general"),
+        ("all-reduce.3", "all-reduce",
+         "jit(step)/jit(main)/collective_reduce/psum"),
+        ("reduce.4", "reduce", "jit(step)/jit(main)/split_scan/reduce"),
+        ("copy.5", "copy", "copy.5"),             # no scope: unattributed
+    ]
+    parts = [_event_meta(i + 1, n, _hlo_proto(instrs) if i == 0 else None)
+             for i, (n, _, _) in enumerate(instrs)]
+    # timeline (ts base 1000ns): events at 0..50us, durations in us
+    parts.append(_line("XLA Ops", 1000, [
+        (1, 0 * _US, 10 * _US),       # hist_build fusion: 10us
+        (2, 10 * _US, 5 * _US),       # hist_build dot:     5us (MXU)
+        (3, 15 * _US, 20 * _US),      # collective_reduce: 20us (comm)
+        (4, 35 * _US, 8 * _US),       # split_scan:         8us
+        (5, 43 * _US, 2 * _US),       # unattributed:       2us
+    ]))
+    return _plane("/device:TPU:0", parts)
+
+
+def test_xplane_parse_and_phase_table(tmp_path):
+    run = tmp_path / "plugins" / "profile" / "2026_08_04"
+    run.mkdir(parents=True)
+    (run / "host.xplane.pb").write_bytes(_device_space())
+    out = tracing.analyze_trace_dir(str(tmp_path))
+    assert out is not None and out["source"] == "device"
+    ph = out["phases"]
+    assert ph["hist_build"]["device_seconds"] == pytest.approx(15e-6)
+    assert ph["hist_build"]["events"] == 2
+    assert ph["collective_reduce"]["device_seconds"] == pytest.approx(
+        20e-6)
+    assert ph["split_scan"]["device_seconds"] == pytest.approx(8e-6)
+    assert out["unattributed_seconds"] == pytest.approx(2e-6)
+    # collective durations by op stem
+    assert out["collectives"]["all-reduce"]["count"] == 1
+    assert out["collectives"]["all-reduce"]["seconds"] == pytest.approx(
+        20e-6)
+    # decomposition: total spans first start to last end = 45us
+    d = out["decomposition"]
+    assert d["total_seconds"] == pytest.approx(45e-6)
+    assert d["busy_seconds"] == pytest.approx(45e-6)
+    assert d["mxu_seconds"] == pytest.approx(5e-6)
+    assert d["comm_seconds"] == pytest.approx(20e-6)
+    assert d["idle_seconds"] == pytest.approx(0.0)
+    assert out["spans_lowered"] == ["collective_reduce", "hist_build",
+                                    "split_scan"]
+
+
+def test_host_fallback_counts_only_resolved_ops():
+    """No device plane: host events count ONLY when they resolve through
+    the HLO instruction map — python frames are not device time."""
+    instrs = [("fusion.9", "fusion",
+               "jit(f)/jit(main)/partition/scatter")]
+    parts = [
+        _event_meta(1, "fusion.9", _hlo_proto(instrs)),
+        _event_meta(2, "$builtins isinstance"),
+        _line("tf_XLAEigen/1", 0, [(1, 0, 7 * _US), (2, 0, 500 * _US)]),
+    ]
+    out = tracing.analyze_planes(tracing.parse_xspace(
+        _plane("/host:CPU", parts)))
+    assert out["source"] == "host-xla"
+    assert out["phases"] == {"partition": {"device_seconds": 7e-6,
+                                           "events": 1}}
+    assert out["decomposition"]["busy_seconds"] == pytest.approx(7e-6)
+
+
+def test_phase_of_outermost_scope_wins():
+    assert tracing.phase_of(
+        "jit(s)/split_scan/jit(x)/partition/op") == "split_scan"
+    assert tracing.phase_of("no taxonomy here") is None
+
+
+def test_analyze_trace_dir_tolerates_torn_artifacts(tmp_path):
+    assert tracing.analyze_trace_dir(str(tmp_path)) is None
+    (tmp_path / "torn.xplane.pb").write_bytes(b"\x0a\xff\xff")  # truncated
+    assert tracing.analyze_trace_dir(str(tmp_path)) is None
+
+
+# ----------------------------------------------------------------- ledger
+def test_per_chip_efficiency_vs_one_chip_row():
+    rows = ledger.per_chip_efficiency([
+        {"n_chips": 1, "iters_per_sec": 2.0},
+        {"n_chips": 8, "iters_per_sec": 12.0},
+    ])
+    assert rows[0]["efficiency"] == 1.0
+    assert rows[1]["per_chip"] == 1.5
+    assert rows[1]["efficiency"] == 0.75
+    # no 1-chip row -> efficiency is honest None, never a guess
+    rows = ledger.per_chip_efficiency([{"n_chips": 4,
+                                        "iters_per_sec": 6.0}])
+    assert rows[0]["efficiency"] is None
+
+
+def test_measured_vs_model_block():
+    analysis = {"decomposition": {"busy_seconds": 2.0,
+                                  "comm_seconds": 0.5},
+                "collectives": {"all-reduce": {"seconds": 0.5,
+                                               "count": 10}},
+                "source": "device"}
+    contract = {"measured": {"total": 1440}, "mode": "data_scatter",
+                "num_devices": 8}
+    block = ledger.measured_vs_model(analysis, contract, steps=100)
+    assert block["measured"]["comm_fraction"] == 0.25
+    assert block["model"]["bytes_per_step"] == 1440
+    assert block["model"]["bytes_total"] == 144000
+    assert block["implied_gbps"] == pytest.approx(144000 / 0.5 / 1e9)
+
+
+def test_ledger_record_merges_atomically(tmp_path):
+    path = tmp_path / "COMM.json"
+    path.write_text(json.dumps({"existing": {"all-reduce": 24588}}))
+    block = ledger.ledger_block("higgs", 1, 2.0)
+    ledger.record(str(path), "higgs_x1", block)
+    block8 = ledger.ledger_block(
+        "higgs", 8, 12.0,
+        prior_rows=ledger.prior_rows(str(path), "higgs"))
+    ledger.record(str(path), "higgs_x8", block8)
+    data = json.loads(path.read_text())
+    assert data["existing"] == {"all-reduce": 24588}   # preserved
+    led = data["scaling_ledger"]
+    assert led["higgs_x1"]["scaling"][0]["efficiency"] == 1.0
+    assert led["higgs_x8"]["scaling"][-1]["efficiency"] == 0.75
+    assert led["higgs_x8"]["n_chips"] == 8
+
+
+def test_load_contract_known_modes():
+    c = ledger.load_contract("data_scatter")
+    assert c is not None and ledger.model_bytes_per_step(c) == 1440
+    assert ledger.load_contract("no_such_mode") is None
+
+
+# ------------------------------------------------------- rank attribution
+class _FakeKV:
+    """Dict-backed stand-in for the coordination-service client."""
+
+    def __init__(self):
+        self.store = {}
+        self.barriers = []
+
+    def key_value_set(self, k, v):
+        if k in self.store:
+            raise RuntimeError(f"key exists: {k}")
+        self.store[k] = v
+
+    def blocking_key_value_get(self, k, timeout_ms):
+        if k not in self.store:
+            raise TimeoutError(k)
+        return self.store[k]
+
+    def wait_at_barrier(self, name, timeout_ms):
+        self.barriers.append(name)
+
+
+def _pair(kv, every=1, factor=3.0):
+    from lightgbm_tpu.obs.ranks import RankStats
+    r1 = RankStats(every=every, straggler_factor=factor, kv=kv,
+                   rank=1, world=2)
+    r0 = RankStats(every=every, straggler_factor=factor, kv=kv,
+                   rank=0, world=2)
+    # the two instances must agree on the KV namespace (in production
+    # the run counter advances in program order on every rank)
+    r0._run = r1._run
+    return r0, r1
+
+
+def test_rank_stats_aggregate_and_straggler_flag():
+    kv = _FakeKV()
+    r0, r1 = _pair(kv)
+    flight.recorder().clear()
+    for i in (1, 2):                      # healthy baseline window
+        r1.sample_step(i, 0.01)
+        r0.sample_step(i, 0.01)
+    r1.sample_step(3, 2.0)                # rank 1 hangs at step 3
+    r0.sample_step(3, 0.01)
+    agg = r0.latest_tree()
+    assert agg["world"] == 2 and agg["ranks_reporting"] == 2
+    assert agg["stragglers"] == [1]
+    assert agg["max_rank"] == 1
+    assert r0.straggler_events == 1
+    events = flight.recorder().events()
+    st = [e for e in events if e["event"] == "straggler"]
+    assert st and st[-1]["rank"] == 1 and st[-1]["iteration"] == 3
+    # the arrival barrier was exercised on both ranks
+    assert kv.barriers
+
+
+def test_rank_stats_global_slowdown_is_not_a_straggler():
+    kv = _FakeKV()
+    r0, r1 = _pair(kv)
+    for i in (1, 2):
+        r1.sample_step(i, 0.01)
+        r0.sample_step(i, 0.01)
+    # BOTH ranks slow down 100x: rolling median protects against the
+    # false positive — nobody is a straggler relative to the pod
+    r1.sample_step(3, 1.0)
+    r0.sample_step(3, 1.0)
+    assert r0.latest_tree()["stragglers"] == []
+
+
+def test_rank_stats_missing_rank_reports_heartbeat():
+    kv = _FakeKV()
+    r0, _ = _pair(kv)
+    flight.recorder().clear()
+    r0.sample_step(1, 0.01)               # rank 1 never publishes
+    agg = r0.latest_tree()
+    assert agg["missing"] == [1]
+    assert agg["ranks_reporting"] == 1
+    misses = [e for e in flight.recorder().events()
+              if e["event"] == "rank_missing"]
+    assert misses and misses[-1]["rank"] == 1
+
+
+def test_rank_stats_sampling_cadence():
+    from lightgbm_tpu.obs.ranks import RankStats
+    rs = RankStats(every=4, kv=_FakeKV(), rank=0, world=1)
+    assert [i for i in range(1, 13) if rs.due(i)] == [4, 8, 12]
+
+
+# ------------------------------------------------------ cross-rank merge
+def test_obs_merge_orders_by_time_then_rank(tmp_path, capsys):
+    r0 = flight.FlightRecorder(capacity=16)
+    r1 = flight.FlightRecorder(capacity=16)
+    r0.record("rank_sample", rank=0, iteration=1)
+    r1.record("rank_sample", rank=1, iteration=1)
+    r1.record("fault_fire", site="step", kind="hang")
+    r0.record("straggler", rank=1, iteration=3)
+    p0 = r0.dump("end", path=str(tmp_path / "f_rank0.jsonl"))
+    p1 = r1.dump("end", path=str(tmp_path / "f_rank1.jsonl"))
+    merged = summarize.merge_ranks([p0, p1])
+    # every record source-annotated (from the filename tag here)
+    assert {r["src_rank"] for r in merged} == {0, 1}
+    ts = [(r.get("t", 0.0), r["src_rank"]) for r in merged]
+    assert ts == sorted(ts)
+    kinds = [summarize._kind(r) for r in merged]
+    assert "straggler" in kinds and "fault_fire" in kinds
+    # the annotation must NOT clobber a payload rank: rank 0's dump
+    # says rank 1 straggled, and the merged record still says so
+    st = next(r for r in merged if summarize._kind(r) == "straggler")
+    assert st["src_rank"] == 0 and st["rank"] == 1
+    # CLI form (jsonl): one parseable record per line
+    assert summarize.merge_main([p0, p1, "--jsonl"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == len(merged)
+    assert all(isinstance(json.loads(line), dict) for line in out)
+
+
+def test_obs_trace_cli_renders_table(tmp_path, capsys):
+    run = tmp_path / "plugins" / "profile" / "r1"
+    run.mkdir(parents=True)
+    (run / "host.xplane.pb").write_bytes(_device_space())
+    assert summarize.trace_main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "hist_build" in out and "collective_reduce" in out
+    assert "all-reduce" in out
+    assert "spans lowered:" in out
+    assert summarize.trace_main([str(tmp_path / "nope")]) == 2
+
+
+def test_summary_table_shows_device_next_to_host(tmp_path, capsys):
+    """The side-by-side contract: a stream with a summary (host
+    seconds) AND a device_time record renders one table with both
+    columns."""
+    from lightgbm_tpu.obs import metrics
+    p = tmp_path / "s.jsonl"
+    s = metrics.MetricsStream(str(p))
+    s.emit("summary", phase_times={"hist_build": {"seconds": 1.0,
+                                                  "count": 5}})
+    s.emit("device_time", source="device",
+           phases={"hist_build": {"device_seconds": 0.25, "events": 9},
+                   "split_scan": {"device_seconds": 0.1, "events": 3}},
+           decomposition={"total_seconds": 0.5, "busy_seconds": 0.4,
+                          "mxu_seconds": 0.2, "comm_seconds": 0.05,
+                          "idle_seconds": 0.1},
+           collectives={"all-reduce": {"seconds": 0.05, "count": 4}})
+    s.close()
+    summary = summarize.summarize([str(p)])
+    assert summary["device_time"]["phases"]["hist_build"][
+        "device_seconds"] == 0.25
+    assert summarize.main([str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "host_s" in out and "device_s" in out
+    assert "0.2500" in out            # device seconds rendered
+    assert "device timeline" in out
+    assert "collective all-reduce" in out
+
+
+def test_flight_dump_carries_rank_field(tmp_path):
+    rec = flight.FlightRecorder(capacity=4)
+    rec.record("tick")
+    out = rec.dump("unit", path=str(tmp_path / "f.jsonl"))
+    header = flight.read_dump(out)[0]
+    assert "rank" in header           # None single-process, int on pods
+    assert header["rank"] is None
