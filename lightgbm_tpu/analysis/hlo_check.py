@@ -134,6 +134,31 @@ MODE_TEMPLATES: Dict[str, dict] = {
         "require_integer_dot": True,
         "problem": {"n": 509, "f": 8, "seed": 0},
     },
+    # -- engine-registry entry contracts (engines/registry.py) ----------
+    # One contract per non-exempt registry entry, the entry id in the
+    # filename (registry_contract_findings enumerates the coverage):
+    # a new engine entry cannot land without either a contract here or
+    # a justified contract_exempt on the entry. xla_lane pins the
+    # registry's fully-concretized serial program — every engine knob
+    # explicit (no "auto" left for the trace-time dispatch), autotune
+    # off — so a drift in how the registry threads its resolution into
+    # GrowerParams shows up as contract drift, not just a perf change.
+    "xla_lane": {
+        "description": "engine-registry entry xla_lane: the chunked "
+                       "one-hot einsum engine with every knob "
+                       "concretized through registry.resolve "
+                       "(tpu_hist_impl=xla, lane layout, batched-M 8, "
+                       "tpu_autotune=off) on the serial compact step — "
+                       "no collectives, no host traffic",
+        "params": dict(_BASE, tpu_grower="compact", tpu_hist_impl="xla",
+                       tpu_hist_layout="lane", tpu_hist_mbatch=8,
+                       tpu_autotune="off"),
+        "num_devices": 1,
+        "program": "compact_step_k0",
+        "require": [],
+        "require_integer_dot": False,
+        "problem": {"n": 509, "f": 8, "seed": 0},
+    },
     # -- async histogram-collective overlap (tpu_hist_overlap) ----------
     # The overlap modes carry a ``baseline_params`` override: --update
     # captures the overlap=off program too and records its accounting as
@@ -350,6 +375,58 @@ def check_hlo(hlo_text: str, contract: dict) -> List[ContractFinding]:
             + check_int_dots(hlo_text, contract))
 
 
+def registry_contract_findings(entries=None) -> List[ContractFinding]:
+    """Per-registry-entry contract coverage (engines/registry.py).
+
+    Every engine entry must either name contracts — known modes with a
+    checked-in file, at least one filename carrying the entry id — or
+    carry a ``contract_exempt`` justification, which is only admissible
+    for TPU-only engines (``requires_tpu``): the CPU contract harness
+    cannot lower Mosaic kernels, everything else MUST be pinned. A new
+    engine cannot land without one or the other (tier-1 runs this via
+    scripts/verify_contracts.py and tests/test_hlo_check.py)."""
+    if entries is None:
+        from ..engines.registry import ENTRIES as entries
+    out: List[ContractFinding] = []
+    for entry in entries:
+        if entry.contract_exempt:
+            if not entry.requires_tpu:
+                out.append(ContractFinding(
+                    entry.id, "registry",
+                    "contract_exempt is only admissible for TPU-only "
+                    "engines (the CPU harness cannot lower Mosaic "
+                    "kernels); a CPU-lowerable engine must check in a "
+                    "contract (scripts/verify_contracts.py --update)"))
+            continue
+        if not entry.contracts:
+            out.append(ContractFinding(
+                entry.id, "registry",
+                "registry entry has neither an HLO contract nor a "
+                "contract_exempt justification — a new engine cannot "
+                "land unpinned; add a MODE_TEMPLATE + contract file "
+                "named after the entry id and regenerate "
+                "(scripts/verify_contracts.py --update)"))
+            continue
+        if not any(entry.id in mode for mode in entry.contracts):
+            out.append(ContractFinding(
+                entry.id, "registry",
+                f"none of its contracts {list(entry.contracts)} carry "
+                "the entry id in the filename — per-entry enumeration "
+                "needs the id visible in analysis/contracts/"))
+        for mode in entry.contracts:
+            if mode not in MODE_TEMPLATES:
+                out.append(ContractFinding(
+                    entry.id, "registry",
+                    f"contract mode '{mode}' has no MODE_TEMPLATE — "
+                    "the harness cannot regenerate or verify it"))
+            elif not os.path.exists(contract_path(mode)):
+                out.append(ContractFinding(
+                    entry.id, "registry",
+                    f"contract file {contract_path(mode)} is missing — "
+                    "run scripts/verify_contracts.py --update"))
+    return out
+
+
 # ---------------------------------------------------------------------------
 # harness half (imports jax + the package lazily)
 # ---------------------------------------------------------------------------
@@ -483,9 +560,10 @@ def build_contract(mode: str, captured: Optional[CapturedMode] = None
 
 def verify_contracts(modes: Sequence[str] = MODES, update: bool = False,
                      check_drift: bool = True) -> List[ContractFinding]:
-    """The full gate: every mode verified, and the regenerated measurement
-    diffed against the checked-in contract (silent comm-shape drift fails
-    tier-1; ``update=True`` rewrites the files instead)."""
+    """The full gate: every registry entry covered, every mode verified,
+    and the regenerated measurement diffed against the checked-in
+    contract (silent comm-shape drift fails tier-1; ``update=True``
+    rewrites the files instead)."""
     findings: List[ContractFinding] = []
     for mode in modes:
         captured = capture_mode(mode)
@@ -512,6 +590,9 @@ def verify_contracts(modes: Sequence[str] = MODES, update: bool = False,
                 f"in {drift} — comm/program shape drifted; if intended, "
                 "rerun scripts/verify_contracts.py --update and review "
                 "the diff"))
+    # per-registry-entry coverage AFTER the update loop, so --update can
+    # create a new entry's contract file in the same invocation
+    findings += registry_contract_findings()
     return findings
 
 

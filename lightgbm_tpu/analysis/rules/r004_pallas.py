@@ -47,6 +47,22 @@ the caller (ops/fused_split.py module docstring):
     neighbour feature's high nibble rides along and every downstream
     compare (one-hot, routing predicate) silently mismatches on half
     the rows (ops/fused_split.py bin_col is the canonical site).
+  * engine-registry ownership (round 12): histogram-engine selection
+    lives in ONE place, ``lightgbm_tpu/engines/`` — the registry that
+    the startup microbench autotuner feeds. Outside that package,
+    (a) a ``GrowerParams(...)`` / ``._replace(...)`` call setting an
+    engine knob (``hist_impl``/``hist_layout``/``hist_mbatch``/
+    ``fused_block``) from anything but a registry resolution (a value
+    mentioning ``resolved``/``resolution``/``registry``), (b) a
+    function choosing between engine-impl constants (assigning or
+    returning two or more of ``"xla"``/``"pallas"``/``"fused"``), and
+    (c) a histogram call pinning a constant ``impl=``/``layout=`` are
+    all findings — a hardcoded engine choice silently bypasses the
+    measured per-shape decision AND the user/env override order. The
+    one sanctioned escape hatch is ``ops/histogram.py::_resolve_impl``
+    (allowlist-anchored): the trace-time per-call-width dispatch that
+    still runs when the registry hands ``"auto"`` through
+    (``tpu_autotune=off`` / no cached decision).
 """
 from __future__ import annotations
 
@@ -59,6 +75,35 @@ from .base import (Finding, ModuleInfo, PackageInfo, Rule, call_name,
 _BLOCK_KWARGS = {"block_size", "bs", "fused_block"}
 _MBATCH_KWARGS = {"mbatch", "hist_mbatch"}
 _MBATCH_MAX = 16          # 8K <= 128 MXU rows
+
+# engine-registry ownership (sub-checks (a)-(c) in the docstring)
+_ENGINE_KWARGS = {"hist_impl", "hist_layout", "hist_mbatch", "fused_block"}
+_ENGINE_CONSTS = {"xla", "pallas", "fused"}
+_ENGINE_CALL_KWARGS = {"impl", "hist_impl", "layout", "hist_layout"}
+_REGISTRY_TOKENS = ("resolv", "registry")
+
+
+def _is_registry_module(module: ModuleInfo) -> bool:
+    """True for the engine-registry package itself (the one place
+    engine-selection policy may live)."""
+    path = module.path.replace("\\", "/")
+    return "/engines/" in path or path.startswith("engines/") \
+        or (module.dotted or "").startswith("lightgbm_tpu.engines")
+
+
+def _mentions_registry(node: ast.AST) -> bool:
+    """A value expression sourced from a registry resolution: it
+    references a name/attribute/call mentioning ``resolv*``/``registry``
+    (``resolved.hist_impl``, ``engine_registry.clamp_fused_block(...)``,
+    a local named ``resolved_bs``)."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and \
+                any(t in n.id.lower() for t in _REGISTRY_TOKENS):
+            return True
+        if isinstance(n, ast.Attribute) and \
+                any(t in n.attr.lower() for t in _REGISTRY_TOKENS):
+            return True
+    return False
 
 
 def _target_is_blocky(name: str) -> bool:
@@ -76,7 +121,7 @@ def _has_validation(node: ast.AST) -> bool:
     for n in ast.walk(node):
         if isinstance(n, ast.Call):
             name = (call_name(n) or "").rsplit(".", 1)[-1].lower()
-            if "valid" in name or "round" in name:
+            if "valid" in name or "round" in name or "clamp" in name:
                 return True
         if isinstance(n, ast.BinOp) and isinstance(n.op, ast.FloorDiv) \
                 and isinstance(n.right, ast.Constant) and n.right.value == 32:
@@ -95,13 +140,101 @@ class PallasContractRule(Rule):
         for node in ast.walk(module.tree):
             if isinstance(node, ast.Call):
                 out.extend(self._check_call(module, node, func_of))
+                if not _is_registry_module(module):
+                    out.extend(self._check_engine_kwargs(
+                        module, node, func_of))
+                    out.extend(self._check_engine_call_consts(
+                        module, node, func_of))
             elif isinstance(node, ast.Assign):
                 out.extend(self._check_env_assign(module, node, func_of))
         for fn in module.functions.values():
             out.extend(self._check_defaults(module, fn))
+            if not _is_registry_module(module):
+                out.extend(self._check_engine_chooser(module, fn))
         out.extend(self._check_ring_drain(module))
         out.extend(self._check_nibble_masks(module, func_of))
         return out
+
+    # -- engine-registry ownership (round 12) ---------------------------
+    def _check_engine_kwargs(self, module, node: ast.Call, func_of
+                             ) -> List[Finding]:
+        """(a) GrowerParams(hist_*=...) / ._replace(hist_*=...) outside
+        lightgbm_tpu/engines must source the value from a registry
+        resolution — anything else re-opens a second selection site."""
+        name = (call_name(node) or "").rsplit(".", 1)[-1]
+        if name not in ("GrowerParams", "_replace"):
+            return []
+        out: List[Finding] = []
+        for kw in node.keywords:
+            if kw.arg in _ENGINE_KWARGS and \
+                    not _mentions_registry(kw.value):
+                out.append(self.finding(
+                    module, kw.value, func_of(node),
+                    f"{name}({kw.arg}=...) outside lightgbm_tpu/engines "
+                    "selects a histogram engine knob away from the "
+                    "registry — populate it from a registry.resolve "
+                    "Resolution (user > env > autotune cache > default) "
+                    "so the measured per-shape decision and the "
+                    "override order cannot be bypassed"))
+        return out
+
+    def _check_engine_call_consts(self, module, node: ast.Call, func_of
+                                  ) -> List[Finding]:
+        """(c) a histogram DISPATCH call (histogram_block / histogram —
+        the funnels the registry's resolution threads through) pinning
+        ``impl=``/``layout=`` to a constant hardcodes an engine choice;
+        direct engine-callable calls (pallas_histogram) stay under the
+        existing block/sublane contracts."""
+        name = (call_name(node) or "").rsplit(".", 1)[-1]
+        if name not in ("histogram_block", "histogram"):
+            return []
+        out: List[Finding] = []
+        for kw in node.keywords:
+            if kw.arg in _ENGINE_CALL_KWARGS and \
+                    isinstance(kw.value, ast.Constant) and \
+                    isinstance(kw.value.value, str) and \
+                    kw.value.value != "auto":
+                out.append(self.finding(
+                    module, kw.value, func_of(node),
+                    f"{name}({kw.arg}={kw.value.value!r}): constant "
+                    "engine selection outside lightgbm_tpu/engines — "
+                    "thread the registry-resolved value (GrowerParams) "
+                    "through instead of pinning the engine at the "
+                    "callsite"))
+        return out
+
+    def _check_engine_chooser(self, module, fn) -> List[Finding]:
+        """(b) a function assigning/returning >= 2 distinct engine-impl
+        constants IS an engine-selection policy site; outside the
+        registry that policy is unowned (the ops/histogram.py
+        _resolve_impl trace-time escape hatch carries the one allowlist
+        anchor)."""
+        consts = set()
+        first = None
+        for n in fn.own_nodes():
+            vals = []
+            if isinstance(n, ast.Return) and n.value is not None:
+                vals = [n.value]
+            elif isinstance(n, ast.Assign):
+                vals = [n.value]
+            for v in vals:
+                if isinstance(v, ast.IfExp):
+                    vals.extend([v.body, v.orelse])
+                    continue
+                if isinstance(v, ast.Constant) and v.value in _ENGINE_CONSTS:
+                    consts.add(v.value)
+                    first = first or n
+        if len(consts) < 2:
+            return []
+        return [self.finding(
+            module, first or fn.node, fn.qualname,
+            f"function selects between engine impls {sorted(consts)} "
+            "outside lightgbm_tpu/engines — engine-selection policy "
+            "belongs to the registry (engines/registry.py), where the "
+            "autotune cache and the user/env override order apply; the "
+            "only sanctioned exception is the trace-time "
+            "tpu_autotune=off dispatch in ops/histogram.py "
+            "_resolve_impl (allowlisted)")]
 
     def _check_call(self, module, node: ast.Call, func_of) -> List[Finding]:
         name = (call_name(node) or "").rsplit(".", 1)[-1]

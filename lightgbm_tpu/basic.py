@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import copy
 import json
+import os
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Union
 from typing import Sequence as _Seq
@@ -653,23 +654,47 @@ class Booster:
         gbdt.learning_rate = float(self.config.learning_rate)
         gbdt.shrinkage_rate = gbdt.learning_rate
         old_gp = gbdt.grower_params
-        from .boosting.gbdt import (_pick_hist_overlap, _pick_step_buckets,
-                                    bucketed_tree_shape)
-        # re-resolve the ladder/overlap knobs from the JUST-updated config,
-        # not the _setup_train-era attributes — reset_parameter(
-        # {"tpu_step_buckets": "off"}) must actually take the exact-keyed
-        # escape hatch, and the hist-overlap on/off bench toggle must not
-        # be a silent no-op
-        gbdt._step_buckets = _pick_step_buckets(self.config)
+        from .boosting.gbdt import bucketed_tree_shape
+        from .engines import registry as engine_registry
+        # re-resolve EVERY engine knob through the registry from the
+        # JUST-updated config, not the _setup_train-era attributes —
+        # reset_parameter({"tpu_step_buckets": "off"}) must actually take
+        # the exact-keyed escape hatch and a hist-overlap/mbatch/layout
+        # toggle must not be a silent no-op. prior= reuses the run's
+        # IN-MEMORY autotune decision verbatim: no cache file I/O in the
+        # training loop (the stock learning-rate callback calls this
+        # every iteration), and the measured engine can neither vanish
+        # (unwritable cache) nor flip (cache rewritten by another
+        # process) under a live run
+        resolved = engine_registry.resolve(
+            self.config, shape=getattr(gbdt, "_engine_shape", None),
+            allow_sweep=False,
+            prior=getattr(gbdt, "_engine_resolution", None))
+        gbdt._engine_resolution = resolved
+        gbdt._step_buckets = resolved.step_buckets
         key_leaves, key_depth = bucketed_tree_shape(
             gbdt._step_buckets,
             int(self.config.num_leaves), int(self.config.max_depth))
         gbdt._max_depth_cfg = int(self.config.max_depth)
+        resolved_fb = resolved.fused_block
+        clamp_ctx = getattr(gbdt, "_fused_clamp_ctx", None)
+        if resolved_fb and clamp_ctx:
+            # the compact row layout is already built: re-run the SAME
+            # record-width scoped-VMEM clamp _setup_compact_state applied
+            resolved_fb = engine_registry.clamp_fused_block(
+                resolved_fb, clamp_ctx["num_cols"], resolved.hist_mbatch,
+                resolved.hist_layout, num_bins=clamp_ctx["num_bins"],
+                num_features=clamp_ctx["num_features"],
+                env_override=os.environ.get("LGBM_TPU_FUSED_BS", ""))
         gbdt.grower_params = gbdt.grower_params._replace(
             num_leaves=key_leaves,
             max_depth=key_depth,
             step_buckets=gbdt._step_buckets,
-            hist_overlap=_pick_hist_overlap(self.config),
+            hist_overlap=resolved.hist_overlap,
+            hist_impl=resolved.hist_impl,
+            hist_mbatch=resolved.hist_mbatch,
+            hist_layout=resolved.hist_layout,
+            fused_block=resolved_fb,
             lambda_l1=float(self.config.lambda_l1),
             lambda_l2=float(self.config.lambda_l2),
             min_data_in_leaf=float(self.config.min_data_in_leaf),

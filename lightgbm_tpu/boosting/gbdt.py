@@ -190,98 +190,41 @@ def _forced_split_schedule(path: str, mappers, num_leaves: int):
 
 
 def _pick_fused_block(cfg) -> int:
-    """Resolve ``tpu_fused``: the fused per-split Mosaic kernel
-    (ops/fused_split.py) replaces the XLA partition+histogram streams on the
-    compact path. auto = on whenever a real TPU backend is present."""
-    from ..ops.fused_split import fused_available
-    mode = str(cfg.get("tpu_fused", "auto")).lower()
-    if mode in ("off", "0", "false"):
-        return 0
-    if bool(cfg.get("tpu_fused_interpret", False)):
-        # CI-only: run the Mosaic kernel in Pallas interpret mode on CPU
-        bs = int(cfg.get("tpu_fused_block", 512))
-        return max(32, (bs // 32) * 32)
-    if mode == "on" and not fused_available():
-        log.warning("tpu_fused=on requires a TPU backend (Mosaic); "
-                    "falling back to the XLA compact path")
-        return 0
-    if mode == "on" or (mode == "auto" and fused_available()):
-        bs = int(cfg.get("tpu_fused_block", 512))
-        return max(32, (bs // 32) * 32)
-    return 0
+    """Thin delegate: ``tpu_fused`` resolution lives in the engine
+    registry (lightgbm_tpu/engines/registry.py, the ONE selection
+    owner); kept under the historical name for its callers/tests."""
+    from ..engines import registry as engine_registry
+    return engine_registry.resolve_fused_block(cfg)
 
 
 def _pick_hist_mbatch(cfg) -> int:
-    """Resolve the batched-M histogram depth (``tpu_hist_mbatch``): K row
-    blocks per one-hot contraction, M = 8K MXU rows (ops/fused_split.py
-    hist_flush). The LGBM_TPU_HIST_MBATCH env override exists for perf
-    experiments and is validated the same way the block-size override is
-    (R004): clamped to [1, 16] so 8K never exceeds the 128 MXU rows and
-    the pending ring's VMEM multiplier stays bounded."""
-    k = int(cfg.get("tpu_hist_mbatch", 8))
-    if os.environ.get("LGBM_TPU_HIST_MBATCH", ""):
-        k = _validated_mbatch_env(os.environ["LGBM_TPU_HIST_MBATCH"])
-    return max(1, min(k, 16))
+    """Thin delegate: ``tpu_hist_mbatch`` (user > LGBM_TPU_HIST_MBATCH
+    env > autotune > default 8) resolves in the engine registry."""
+    from ..engines import registry as engine_registry
+    return engine_registry.resolve_mbatch(cfg)
 
 
 def _pick_hist_layout(cfg, num_bins: int) -> str:
-    """Resolve ``tpu_hist_layout``: the Mosaic one-hot register layout.
-
-    "sublane" lays bins along sublanes (B <= 64 only — wider bin counts
-    leave no room to group features into the 128 MXU rows); "auto"
-    resolves to "lane" until the BENCH_HIST_MICRO layout sweep says
-    otherwise for a shape (the sweep records both layouts per
-    {u8, pack4} x {f32, int8, int16-narrowed} cell)."""
-    mode = str(cfg.get("tpu_hist_layout", "auto")).lower()
-    if mode in ("", "auto", "lane"):
-        return "lane"
-    if mode != "sublane":
-        log.warning(f"tpu_hist_layout={mode!r} is not one of "
-                    "auto|lane|sublane; using the lane layout")
-        return "lane"
-    if num_bins > 64:
-        log.warning(
-            f"tpu_hist_layout=sublane needs num_bins <= 64 (got "
-            f"{num_bins}): bins lie along sublanes and wider counts "
-            "cannot group features into the 128 MXU rows; using lane")
-        return "lane"
-    return "sublane"
+    """Thin delegate: ``tpu_hist_layout`` resolves in the engine
+    registry. Without an autotune-cache decision "auto" keeps the
+    conservative lane default (registry.resolve_layout makes it honest
+    where a measured sublane win exists for the shape-class)."""
+    from ..engines import registry as engine_registry
+    return engine_registry.resolve_layout(cfg, num_bins)
 
 
 def _validated_mbatch_env(value: str) -> int:
-    """Round and re-guard an ``LGBM_TPU_HIST_MBATCH`` override (1-16)."""
-    k = int(value)
-    if not 1 <= k <= 16:
-        clamped = max(1, min(k, 16))
-        log.warning(f"LGBM_TPU_HIST_MBATCH={value} outside [1, 16] "
-                    f"(8K must fit the 128 MXU rows); clamped to {clamped}")
-        k = clamped
-    return k
+    """Thin delegate (engines/registry.py validated_mbatch_env)."""
+    from ..engines import registry as engine_registry
+    return engine_registry.validated_mbatch_env(value)
 
 
 def _validated_fused_block_env(value: str, num_cols: int,
                                vmem_cap_bs: int) -> int:
-    """Round and re-guard an ``LGBM_TPU_FUSED_BS`` override.
-
-    The override exists for perf experiments, but it must not be able to
-    recreate the hazards the automatic derivation prevents: the kernel
-    requires a 32-multiple block size (Mosaic DMA alignment,
-    ops/fused_split.py), and its scoped-VMEM buffers scale with
-    ``block_size * num_cols`` — so the value is rounded down to a
-    32-multiple and clamped to the same scoped-VMEM-derived cap the
-    automatic path uses (``vmem_cap_bs``)."""
-    bs = max(32, (int(value) // 32) * 32)
-    if bs != int(value):
-        log.warning(f"LGBM_TPU_FUSED_BS={value} is not a 32-multiple; "
-                    f"rounded to {bs}")
-    if bs > vmem_cap_bs:
-        log.warning(
-            f"LGBM_TPU_FUSED_BS={value} exceeds the scoped-VMEM cap for "
-            f"{num_cols}-byte row records (max {vmem_cap_bs}); clamped — "
-            "an unchecked override would recreate the VMEM blowup the "
-            "guard prevents")
-        bs = vmem_cap_bs
-    return bs
+    """Thin delegate (engines/registry.py validated_fused_block_env)."""
+    from ..engines import registry as engine_registry
+    return engine_registry.validated_fused_block_env(
+        value, num_cols, vmem_cap_bs)
 
 
 def _clamp_block(block: int, n: int, floor: int = 128) -> int:
@@ -292,39 +235,18 @@ def _clamp_block(block: int, n: int, floor: int = 128) -> int:
 
 
 def _pick_step_buckets(cfg) -> bool:
-    """Resolve ``tpu_step_buckets``: the bucketed grower-step ladder.
-
-    On (the default), the step program's jit key carries the power-of-two
-    leaf RUNG and the {unlimited, bounded} depth bucket instead of the
-    exact (num_leaves, max_depth) pair — the actual budgets ride as traced
-    scalars, so every configuration in a rung shares one compiled program
-    (and one persistent-compile-cache entry). ``off`` is the exact-keyed
-    escape hatch for parity benching."""
-    mode = str(cfg.get("tpu_step_buckets", "auto")).lower()
-    if mode in ("off", "0", "false"):
-        return False
-    if mode not in ("", "auto", "on", "1", "true"):
-        log.warning(f"tpu_step_buckets={mode!r} is not one of "
-                    "auto|on|off; the ladder stays on")
-    return True
+    """Thin delegate: ``tpu_step_buckets`` (the bucketed grower-step
+    ladder; ``off`` = the exact-keyed parity escape hatch) resolves in
+    the engine registry."""
+    from ..engines import registry as engine_registry
+    return engine_registry.resolve_step_buckets(cfg)
 
 
 def _pick_hist_overlap(cfg) -> int:
-    """Resolve ``tpu_hist_overlap``: async histogram-collective overlap.
-
-    ``on`` builds each leaf histogram in 2 feature groups with one
-    psum_scatter/all-reduce per group, issued while the next group still
-    accumulates (double-buffered hist slots) — the collective hides under
-    the MXU contraction and total collective bytes are unchanged. Only
-    meaningful on the distributed learners; the serial program ignores
-    it. ``auto`` stays off until a real-TPU sweep says otherwise."""
-    mode = str(cfg.get("tpu_hist_overlap", "auto")).lower()
-    if mode in ("on", "1", "true"):
-        return 2
-    if mode not in ("", "auto", "off", "0", "false"):
-        log.warning(f"tpu_hist_overlap={mode!r} is not one of "
-                    "auto|on|off; overlap stays off")
-    return 0
+    """Thin delegate: ``tpu_hist_overlap`` (async histogram-collective
+    overlap) resolves in the engine registry."""
+    from ..engines import registry as engine_registry
+    return engine_registry.resolve_overlap(cfg)
 
 
 def bucketed_tree_shape(step_buckets: bool, num_leaves: int,
@@ -580,6 +502,13 @@ class GBDT:
         # load); _setup_train overwrites them from the config
         self._step_buckets = False
         self._max_depth_cfg = int(config.get("max_depth", -1))
+        # engine-registry context (engines/registry.py): the dataset
+        # shape class + resolution from _setup_train, and the compact
+        # record-width clamp context — reset_parameter re-resolves
+        # through these so a mid-run change never leaves a stale engine
+        self._engine_shape = None
+        self._engine_resolution = None
+        self._fused_clamp_ctx = None
         # persistent XLA compilation cache (tpu_compile_cache_dir): armed
         # before the first jit of this booster so training AND predict-only
         # programs can skip their backend compiles on a warm cache
@@ -856,10 +785,42 @@ class GBDT:
                 fpad(fcv, 1.0)) if self._f_pad else jnp.asarray(fcv)
         else:
             self._feature_contri = None
+        # THE engine-registry callsite (lightgbm_tpu/engines/registry.py):
+        # one resolve populates every engine knob of GrowerParams —
+        # {fused, pallas, xla} x layout x batched-M x ladder x overlap —
+        # user > env > autotune cache > heuristic default. With
+        # tpu_autotune armed the startup microbench times the eligible
+        # candidates on a strided sample of the REAL binned matrix
+        # (strictly before the steady-state window; compiles land in the
+        # "autotune" phase) and persists the per-shape-class winner.
+        from ..engines import registry as engine_registry
+        binned_host = train_set.binned
+        shape = engine_registry.DatasetShape(
+            rows=int(self._n_real),
+            # STORED columns (post-EFB): the width the histogram engines
+            # actually stream, and the width the microbench sample has
+            features=int(binned_host.shape[1]),
+            num_bins=int(train_set.max_num_bins),
+            mode=(self.tree_learner if self.mesh is not None
+                  or self._multiproc else "serial"),
+            quant=bool(cfg.get("use_quantized_grad", False)),
+            pack4=bool(cfg.get("tpu_bin_pack4", False)))
+
+        def _autotune_sample(n, _b=binned_host):
+            if len(_b) <= n:
+                return _b
+            stride = max(1, len(_b) // n)
+            return _b[::stride][:n]
+
+        self._engine_shape = shape
+        resolved = engine_registry.resolve(
+            cfg, shape=shape, sample_provider=_autotune_sample)
+        self._engine_resolution = resolved
+
         # bucketed step ladder (the compile-once training contract): the
         # jit key carries (leaf rung, depth bucket), the actual budgets
         # ride as traced scalars through _step_budget_args()
-        self._step_buckets = _pick_step_buckets(cfg)
+        self._step_buckets = resolved.step_buckets
         self._max_depth_cfg = int(cfg.get("max_depth", -1))
         key_leaves, key_depth = bucketed_tree_shape(
             self._step_buckets, self.max_leaves, self._max_depth_cfg)
@@ -867,7 +828,7 @@ class GBDT:
             num_leaves=key_leaves,
             max_depth=key_depth,
             step_buckets=self._step_buckets,
-            hist_overlap=_pick_hist_overlap(cfg),
+            hist_overlap=resolved.hist_overlap,
             num_bins=int(train_set.max_num_bins),
             lambda_l1=float(cfg.get("lambda_l1", 0.0)),
             lambda_l2=float(cfg.get("lambda_l2", 0.0)),
@@ -896,16 +857,15 @@ class GBDT:
             voting_shards=(len(self.mesh.devices.ravel())
                            if self.mesh is not None
                            and self.tree_learner == "voting" else 0),
-            hist_impl=str(cfg.get("tpu_hist_impl", "auto")),
+            hist_impl=resolved.hist_impl,
             part_block=_clamp_block(
                 int(cfg.get("tpu_part_block", 2048)), self._n_real),
             hist_block=_clamp_block(
                 int(cfg.get("tpu_hist_block", 16384)), self._n_real),
-            fused_block=_pick_fused_block(cfg),
+            fused_block=resolved.fused_block,
             fused_interpret=bool(cfg.get("tpu_fused_interpret", False)),
-            hist_mbatch=_pick_hist_mbatch(cfg),
-            hist_layout=_pick_hist_layout(cfg,
-                                          int(train_set.max_num_bins)),
+            hist_mbatch=resolved.hist_mbatch,
+            hist_layout=resolved.hist_layout,
         )
 
         # serial-learner row storage: the compact grower physically
@@ -1261,34 +1221,29 @@ class GBDT:
                      "kernel variant")
             gp = gp._replace(fused_dual=False)
             self.grower_params = gp
+        # record-width context for the registry's scoped-VMEM clamp:
+        # kept so reset_parameter can re-run the SAME clamp when a
+        # mid-run config change re-resolves the engine knobs
+        from ..engines import registry as engine_registry
+        self._fused_clamp_ctx = {
+            "num_cols": layout.num_cols,
+            "num_features": layout.num_features,
+            "num_bins": int(self.grower_params.num_bins),
+        }
         if gp.fused_block:
             # kernel scoped-VMEM buffers scale with block_size * num_cols,
-            # the batched-M pending ring with hist_mbatch * block_size
-            # (bins + transposed channels + the flush's one-hot and
-            # block-diagonal transients), and the histogram accumulator
-            # with num_cols * num_bins; scale the block down for wide
-            # records / deep rings and fall back to the XLA walk when the
+            # the batched-M pending ring with hist_mbatch * block_size,
+            # and the histogram accumulator with num_cols * num_bins; the
+            # registry-owned clamp scales the block down for wide records
+            # / deep rings and falls back to the XLA walk when the
             # histogram alone would blow the ~16MB scoped limit
-            from ..ops.fused_split import fused_block_cap
-            c_rec = layout.num_cols
-            vmem_cap_bs = fused_block_cap(c_rec, gp.hist_mbatch,
-                                          hist_layout=gp.hist_layout)
-            bs = min(gp.fused_block, vmem_cap_bs)
-            if os.environ.get("LGBM_TPU_FUSED_BS", ""):
-                # perf experiments; rounded + re-guarded, never trusted raw
-                bs = _validated_fused_block_env(
-                    os.environ["LGBM_TPU_FUSED_BS"], c_rec, vmem_cap_bs)
-            from ..ops.fused_split import _hist_packing
-            stride, f_pad, _ = _hist_packing(
-                layout.num_features, int(self.grower_params.num_bins))
-            f_hist_bytes = f_pad * stride * 32
-            if f_hist_bytes > 6 << 20:
-                log.warning("fused kernel disabled: histogram accumulator "
-                            f"needs {f_hist_bytes >> 20}MB VMEM; using the "
-                            "XLA compact walk")
-                bs = 0
-            if bs != gp.fused_block:
-                gp = gp._replace(fused_block=bs)
+            resolved_bs = engine_registry.clamp_fused_block(
+                gp.fused_block, layout.num_cols, gp.hist_mbatch,
+                gp.hist_layout, num_bins=int(self.grower_params.num_bins),
+                num_features=layout.num_features,
+                env_override=os.environ.get("LGBM_TPU_FUSED_BS", ""))
+            if resolved_bs != gp.fused_block:
+                gp = gp._replace(fused_block=resolved_bs)
                 self.grower_params = gp
         # the fused kernel's aligned block writes may overrun a segment end
         # by up to one block + one alignment tile

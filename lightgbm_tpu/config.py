@@ -229,6 +229,20 @@ PARAMS: Dict[str, Tuple[Any, type, Tuple[str, ...]]] = {
     # f32 channel each — half the contraction work, bit-identical
     # sums), 32 = always the int8 -> int32 engine
     "tpu_quant_hist_bits": (0, int, ("quant_hist_bits",)),
+    # startup microbench autotuner (lightgbm_tpu/engines/autotune.py):
+    # at _setup_train the eligible engine-registry candidates ({xla,
+    # pallas} x {lane, sublane} x batched-M) are timed on a strided
+    # sample of the real binned data and the per-shape-class winner is
+    # persisted to tpu_autotune_cache (atomic JSON; default
+    # ~/.cache/lightgbm_tpu/autotune.json) — repeat runs with the same
+    # shape-class resolve with ZERO microbenches. Resolve order:
+    # user > env > autotune cache > heuristic default. first_run (the
+    # default) arms implicitly on TPU backends for shapes >= 64k rows
+    # (or anywhere when set explicitly); always re-sweeps over a cache
+    # hit; off is the pure-heuristic escape hatch (bit-identical trees
+    # either way — engine choice changes speed only)
+    "tpu_autotune": ("first_run", str, ("autotune",)),  # off | first_run | always
+    "tpu_autotune_cache": ("", str, ("autotune_cache",)),
     # data-parallel histogram reduction: reduce-scatter over the feature
     # axis + best-split all-gather vs full-histogram all-reduce
     # (ops/grower_compact.py hist_scatter)

@@ -1,0 +1,14 @@
+"""Engine registry + startup microbench autotuner.
+
+ONE owner for histogram-engine selection (registry.py) and the
+measured per-shape decision plane on top of it (autotune.py) —
+ROADMAP item 1: the {fused, pallas, xla-einsum} x mbatch x block size
+x layout knob space collapses behind ``registry.resolve``, and the
+choices flip from heuristic guesses to startup measurements.
+
+Module level stays jax-free (like ``obs``): ``scripts/tpulint``'s
+stub-package trick and the offline ``scripts/autotune`` CLI both import
+pieces of this package before a backend exists; everything that needs
+jax imports it lazily inside the function that runs on-device work.
+"""
+from . import registry  # noqa: F401  (jax-free)

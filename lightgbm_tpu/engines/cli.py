@@ -1,0 +1,135 @@
+"""``scripts/autotune`` — run the engine microbench sweep offline.
+
+A thin operational wrapper over the autotuner (engines/autotune.py):
+bin a CSV (or build a synthetic shape proxy), run the SAME candidate
+sweep ``_setup_train`` would run, print the decision table, and —
+with ``--cache`` — persist the winner so later training runs (and
+multi-process pods, which never sweep locally) resolve their engines
+with zero startup microbenches.
+
+Examples::
+
+    scripts/autotune train.csv --label-col 0 --cache ~/.cache/lightgbm_tpu/autotune.json
+    scripts/autotune --rows 1e6 --features 28 --max-bin 255   # shape proxy
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from . import autotune, registry
+
+
+def _load_binned_csv(path: str, label_col: int, max_bin: int):
+    """Bin a CSV through the real Dataset pipeline — the sweep then
+    times the engines on the ACTUAL bin distribution, not a proxy."""
+    import numpy as np
+
+    from .. import basic
+    raw = np.genfromtxt(path, delimiter=",", dtype=np.float64)
+    if raw.ndim != 2:
+        raise SystemExit(f"{path}: expected a 2-D CSV matrix")
+    y = raw[:, label_col]
+    X = np.delete(raw, label_col, axis=1)
+    ds = basic.Dataset(X, label=y, params={"max_bin": max_bin})
+    ds.construct()
+    inner = ds._inner
+    return inner.binned, int(inner.max_num_bins)
+
+
+def _synthetic_binned(rows: int, features: int, max_bin: int, seed: int):
+    """Uniform-random bin codes of the requested shape — a proxy for
+    engine timing (the one-hot contraction's cost is shape-, not
+    value-, dependent), clearly labeled as such in the output."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    b = max_bin + 1
+    dt = np.uint8 if b <= 256 else np.int32
+    return rng.randint(0, b, (rows, features)).astype(dt), b
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="autotune", description=__doc__.splitlines()[0])
+    ap.add_argument("data", nargs="?", default=None,
+                    help="training CSV (binned through the real "
+                         "pipeline); omit to sweep a synthetic "
+                         "--rows x --features shape proxy")
+    ap.add_argument("--label-col", type=int, default=0,
+                    help="label column index in the CSV (default 0)")
+    ap.add_argument("--rows", type=float, default=1e5,
+                    help="synthetic rows (no CSV; default 1e5)")
+    ap.add_argument("--features", type=int, default=28,
+                    help="synthetic feature count (default 28)")
+    ap.add_argument("--max-bin", type=int, default=255,
+                    help="bin width (CSV binning AND synthetic codes)")
+    ap.add_argument("--mode", default="serial",
+                    choices=("serial", "data", "voting", "feature"),
+                    help="learner mode the decision is keyed under")
+    ap.add_argument("--reps", type=int, default=autotune.SWEEP_REPS,
+                    help="timed repetitions per candidate")
+    ap.add_argument("--sample-rows", type=int,
+                    default=autotune.SWEEP_SAMPLE_ROWS,
+                    help="rows sampled for the microbench")
+    ap.add_argument("--cache", default="",
+                    help="persist the decision to this autotune cache "
+                         "(the tpu_autotune_cache trainers read); "
+                         "print-only when omitted")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    if args.data:
+        binned, num_bins = _load_binned_csv(args.data, args.label_col,
+                                            args.max_bin)
+        source = args.data
+    else:
+        binned, num_bins = _synthetic_binned(
+            int(args.rows), args.features, args.max_bin, args.seed)
+        source = (f"synthetic proxy [{int(args.rows)} x "
+                  f"{args.features}] (shape-, not value-, dependent)")
+    rows, features = binned.shape
+    platform = registry.current_platform()
+    shape = registry.DatasetShape(rows=rows, features=features,
+                                  num_bins=num_bins, mode=args.mode)
+    sclass = registry.shape_class(shape)
+    candidates = registry.sweep_candidates(shape, platform)
+    if not candidates:
+        print(f"no sweepable engine candidates for {sclass} on "
+              f"{platform}", file=sys.stderr)
+        return 2
+    print(f"# source: {source}", file=sys.stderr)
+    print(f"# platform={platform} shape_class={sclass} "
+          f"candidates={len(candidates)}", file=sys.stderr)
+    n = min(rows, args.sample_rows)
+    stride = max(1, rows // n)
+    sample = binned[::stride][:n]
+    winner, table = autotune.run_sweep(sample, num_bins, candidates,
+                                       reps=args.reps)
+    width = max(len(r["candidate"]) for r in table)
+    for r in table:
+        if "ms" in r:
+            line = (f"{r['candidate']:<{width}}  {r['ms']:>10.4f} ms  "
+                    f"{r['rows_per_sec']:>12,} rows/s")
+        else:
+            line = f"{r['candidate']:<{width}}  ERROR: {r['error']}"
+        print(line)
+    if winner is None:
+        print("every candidate failed — no decision", file=sys.stderr)
+        return 1
+    print(f"winner: {json.dumps(winner)}")
+    if args.cache:
+        block = autotune.decision_block(winner, table, platform, sclass,
+                                        n, args.reps)
+        autotune.store_decision(args.cache,
+                                autotune.cache_key(platform, sclass),
+                                block)
+        print(f"decision persisted to {args.cache} "
+              f"[{autotune.cache_key(platform, sclass)}]",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via scripts/
+    sys.exit(main())

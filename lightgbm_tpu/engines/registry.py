@@ -1,0 +1,554 @@
+"""Engine registry: the ONE owner of histogram-engine selection.
+
+Through round 11 the engine knob space — {fused, pallas, xla-einsum} x
+batched-M depth x block size x {lane, sublane} layout x learner mode —
+was resolved by five ``_pick_*`` helpers spread through
+``boosting/gbdt.py``, plus env overrides (``LGBM_TPU_FUSED_BS``,
+``LGBM_TPU_HIST_MBATCH``) and per-op defaults. This module collapses
+all of it behind one table (:data:`ENTRIES`) and one callsite
+(:func:`resolve`), the way the reference resolves col-wise vs row-wise
+histogram dispatch from ONE decision point at ``InitTrain``
+(``dataset.h:727``) — and, like the reference, the decision can be
+*measured* instead of guessed: the startup microbench autotuner
+(``engines/autotune.py``, ``tpu_autotune``) times the eligible entries
+on a slice of the real binned data and records the winner per
+shape-class.
+
+Resolve order, per knob (the contract every test in
+tests/test_registry.py pins)::
+
+    user explicit > env override > autotune cache > heuristic default
+
+Registry entries carry their HLO-contract id: ``scripts/
+verify_contracts.py`` enumerates contracts per entry (the entry id is
+in the contract filename), so a new engine cannot land without either
+a checked-in contract or a justified ``contract_exempt`` (TPU-only
+Mosaic kernels, which the CPU contract harness cannot lower — their
+parity is pinned by the cross-engine bit-identity tests instead).
+
+tpulint R004 enforces the ownership: ``GrowerParams(hist_*=...)`` or a
+direct engine-callable choice outside this package is a finding; the
+one sanctioned escape hatch is ``ops/histogram.py::_resolve_impl``
+(allowlist-anchored), the trace-time dispatch that keeps the measured
+per-width heuristic when the registry hands ``"auto"`` through
+(``tpu_autotune=off`` / no cache).
+
+Module level is jax-free; functions that need a backend import jax
+lazily.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+from ..utils import log
+
+#: platforms with a real Mosaic/TPU backend (matches ops/fused_split.py
+#: fused_available and ops/pallas_histogram.py pallas_available)
+TPU_PLATFORMS = ("tpu", "axon")
+
+#: batched-M depths the autotuner sweeps (ops/fused_split.py hist_flush:
+#: M = 8K MXU rows, K <= 16). The default (8) leads so a tie resolves to
+#: today's behavior, not to an arbitrary cell.
+MBATCH_CANDIDATES = (8, 16, 1)
+
+
+class DatasetShape(NamedTuple):
+    """The static dataset facts engine selection keys on."""
+    rows: int
+    features: int
+    num_bins: int
+    mode: str = "serial"          # serial | data | voting | feature
+    quant: bool = False           # use_quantized_grad (int8 channels)
+    pack4: bool = False           # tpu_bin_pack4 (nibble-packed bins)
+
+
+class EngineEntry(NamedTuple):
+    """One histogram engine the registry can select.
+
+    ``contracts`` names the ``analysis/contracts/<mode>.json`` files
+    that pin this entry's steady-state step program (at least one file
+    name must contain the entry id); ``contract_exempt`` is the
+    mandatory justification when no CPU contract can exist (TPU-only
+    Mosaic kernels). ``sweepable`` entries are timed standalone by the
+    autotuner; the fused kernel is selected structurally (it replaces
+    the partition+histogram streams and its binding constraint is the
+    scoped-VMEM validator, :func:`clamp_fused_block`) but INHERITS the
+    winning layout/mbatch — those knobs thread into its ``hist_flush``.
+    """
+    id: str
+    impl: str                     # hist_impl fed to ops/histogram dispatch
+    layout: str                   # lane | sublane
+    fused: bool
+    description: str
+    contracts: Tuple[str, ...] = ()
+    contract_exempt: str = ""
+    max_bins: int = 256           # eligibility bound on the bin width
+    requires_tpu: bool = False
+    sweepable: bool = True
+
+
+ENTRIES: Tuple[EngineEntry, ...] = (
+    EngineEntry(
+        "xla_lane", "xla", "lane", False,
+        "chunked one-hot einsum (fp32-HIGHEST / int8 -> s32), lane "
+        "layout — runs on every backend",
+        contracts=("xla_lane",)),
+    EngineEntry(
+        "pallas_lane", "pallas", "lane", False,
+        "standalone Mosaic one-hot kernel, bins along lanes "
+        "(ops/pallas_histogram.py)",
+        contract_exempt="Mosaic kernels cannot lower on the CPU "
+                        "contract harness; cross-engine bit-identity "
+                        "is pinned by tests/test_ops.py and "
+                        "tests/test_hist_mbatch.py",
+        requires_tpu=True),
+    EngineEntry(
+        "pallas_sublane", "pallas", "sublane", False,
+        "standalone Mosaic kernel, bins along sublanes (B <= 64: the "
+        "one-hot compare fills the register tile)",
+        contract_exempt="Mosaic kernels cannot lower on the CPU "
+                        "contract harness; layout bit-identity is "
+                        "pinned by tests/test_pack4_train.py",
+        max_bins=64, requires_tpu=True),
+    EngineEntry(
+        "fused_lane", "auto", "lane", True,
+        "fused partition+histogram Mosaic kernel (ops/fused_split.py), "
+        "lane-layout hist_flush",
+        contract_exempt="Mosaic kernels cannot lower on the CPU "
+                        "contract harness; parity is pinned by "
+                        "tests/test_fused.py leaf-count identity",
+        requires_tpu=True, sweepable=False),
+    EngineEntry(
+        "fused_sublane", "auto", "sublane", True,
+        "fused Mosaic kernel with the bins-on-sublanes hist_flush "
+        "(B <= 64)",
+        contract_exempt="Mosaic kernels cannot lower on the CPU "
+                        "contract harness; layout bit-identity is "
+                        "pinned by tests/test_pack4_train.py",
+        max_bins=64, requires_tpu=True, sweepable=False),
+)
+
+
+class Candidate(NamedTuple):
+    """One autotune sweep cell: an engine entry at a batched-M depth."""
+    entry: EngineEntry
+    mbatch: int
+
+    @property
+    def key(self) -> str:
+        return f"{self.entry.id}-k{self.mbatch}"
+
+
+class Resolution(NamedTuple):
+    """The registry's answer: every engine knob, with provenance.
+
+    ``sources`` maps knob -> one of ``user`` / ``env`` / ``autotune`` /
+    ``default`` so logs and tests can see WHICH rung of the resolve
+    order produced each value.
+    """
+    entry_id: str
+    fused_block: int
+    hist_impl: str
+    hist_mbatch: int
+    hist_layout: str
+    hist_overlap: int
+    step_buckets: bool
+    sources: Dict[str, str]
+    shape_class: Optional[str] = None
+    autotuned: bool = False
+    # the raw autotune winner this resolution applied (None = none):
+    # reset_parameter re-resolves against THIS, not a cache re-read —
+    # the in-run engine choice must survive an unwritable cache and
+    # must never flip because the file changed under a live run
+    decision: Optional[Dict[str, Any]] = None
+
+
+# ---------------------------------------------------------------------------
+# shape classes
+# ---------------------------------------------------------------------------
+def _rung(x: int) -> int:
+    """Power-of-two rung (>= 1) — shape classes bucket like the step
+    ladder does, so near-identical datasets share one decision."""
+    return 1 << max(0, (max(1, int(x)) - 1).bit_length())
+
+
+def shape_class(shape: DatasetShape) -> str:
+    """Canonical shape-class key: learner mode + row/feature rungs +
+    exact bin width + dtype/layout markers. The autotune cache and
+    BENCH_SHAPES["autotune"] both key on it."""
+    tags = ""
+    if shape.quant:
+        tags += "-quant"
+    if shape.pack4:
+        tags += "-pack4"
+    return (f"{shape.mode}-r{_rung(shape.rows)}-f{_rung(shape.features)}"
+            f"-b{int(shape.num_bins)}{tags}")
+
+
+def current_platform() -> str:
+    """The active jax backend platform ("cpu" when no backend exists —
+    the jax-free CLI paths pass an explicit platform instead)."""
+    try:
+        import jax
+        return jax.devices()[0].platform
+    except Exception:  # pragma: no cover - backend-less host
+        return "cpu"
+
+
+def _entry_available(entry: EngineEntry, platform: str) -> bool:
+    if entry.requires_tpu and platform not in TPU_PLATFORMS:
+        return False
+    if entry.fused and platform in TPU_PLATFORMS:
+        from ..ops.fused_split import fused_available
+        return fused_available()
+    return True
+
+
+def eligible_entries(shape: DatasetShape, platform: str
+                     ) -> List[EngineEntry]:
+    """Entries that can serve ``shape`` on ``platform``."""
+    return [e for e in ENTRIES
+            if shape.num_bins <= e.max_bins
+            and _entry_available(e, platform)]
+
+
+def sweep_candidates(shape: DatasetShape, platform: str
+                     ) -> List[Candidate]:
+    """The autotune sweep grid: sweepable eligible entries x mbatch."""
+    out: List[Candidate] = []
+    for entry in eligible_entries(shape, platform):
+        if not entry.sweepable:
+            continue
+        for k in MBATCH_CANDIDATES:
+            out.append(Candidate(entry, k))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cfg access (Config objects AND plain dicts — the gbdt delegates and
+# their tests pass both)
+# ---------------------------------------------------------------------------
+def _get(cfg, name: str, default: Any = None) -> Any:
+    if hasattr(cfg, "get"):
+        v = cfg.get(name, default)
+        return default if v is None else v
+    return default
+
+
+def _explicit(cfg, name: str) -> bool:
+    """Did the USER set this knob (resolve-order rung 1)?"""
+    if hasattr(cfg, "is_explicit"):
+        return bool(cfg.is_explicit(name))
+    try:
+        return name in cfg
+    except TypeError:  # pragma: no cover - exotic cfg objects
+        return False
+
+
+# ---------------------------------------------------------------------------
+# per-knob resolvers (validation/warning behavior of the former gbdt
+# _pick_* helpers, now registry-owned; gbdt keeps thin delegates)
+# ---------------------------------------------------------------------------
+def validated_mbatch_env(value: str) -> int:
+    """Round and re-guard an ``LGBM_TPU_HIST_MBATCH`` override (1-16)."""
+    k = int(value)
+    if not 1 <= k <= 16:
+        clamped = max(1, min(k, 16))
+        log.warning(f"LGBM_TPU_HIST_MBATCH={value} outside [1, 16] "
+                    f"(8K must fit the 128 MXU rows); clamped to {clamped}")
+        k = clamped
+    return k
+
+
+def validated_fused_block_env(value: str, num_cols: int,
+                              vmem_cap_bs: int) -> int:
+    """Round and re-guard an ``LGBM_TPU_FUSED_BS`` override.
+
+    The override exists for perf experiments, but it must not be able
+    to recreate the hazards the automatic derivation prevents: the
+    kernel requires a 32-multiple block size (Mosaic DMA alignment,
+    ops/fused_split.py), and its scoped-VMEM buffers scale with
+    ``block_size * num_cols`` — so the value is rounded down to a
+    32-multiple and clamped to the same scoped-VMEM-derived cap the
+    automatic path uses (``vmem_cap_bs``)."""
+    bs = max(32, (int(value) // 32) * 32)
+    if bs != int(value):
+        log.warning(f"LGBM_TPU_FUSED_BS={value} is not a 32-multiple; "
+                    f"rounded to {bs}")
+    if bs > vmem_cap_bs:
+        log.warning(
+            f"LGBM_TPU_FUSED_BS={value} exceeds the scoped-VMEM cap for "
+            f"{num_cols}-byte row records (max {vmem_cap_bs}); clamped — "
+            "an unchecked override would recreate the VMEM blowup the "
+            "guard prevents")
+        bs = vmem_cap_bs
+    return bs
+
+
+def resolve_mbatch(cfg, decision: Optional[Dict[str, Any]] = None,
+                   sources: Optional[Dict[str, str]] = None) -> int:
+    """``tpu_hist_mbatch``: K row blocks per one-hot contraction,
+    M = 8K MXU rows. user > env (LGBM_TPU_HIST_MBATCH) > autotune >
+    default 8; always clamped to [1, 16]."""
+    src = "default"
+    k = int(_get(cfg, "tpu_hist_mbatch", 8) or 8)
+    if _explicit(cfg, "tpu_hist_mbatch"):
+        src = "user"
+    elif os.environ.get("LGBM_TPU_HIST_MBATCH", ""):
+        k = validated_mbatch_env(os.environ["LGBM_TPU_HIST_MBATCH"])
+        src = "env"
+    elif decision and decision.get("hist_mbatch"):
+        k = int(decision["hist_mbatch"])
+        src = "autotune"
+    if sources is not None:
+        sources["hist_mbatch"] = src
+    return max(1, min(k, 16))
+
+
+def resolve_layout(cfg, num_bins: int,
+                   decision: Optional[Dict[str, Any]] = None,
+                   platform: Optional[str] = None,
+                   sources: Optional[Dict[str, str]] = None) -> str:
+    """``tpu_hist_layout``: the Mosaic one-hot register layout.
+
+    "sublane" lays bins along sublanes (B <= 64 only — wider bin counts
+    leave no room to group features into the 128 MXU rows). ``auto``
+    is honest where a measurement exists: an autotune-cache winner for
+    this shape-class selects the layout it measured fastest (the PR 6
+    sweep showed sublane competitive at B <= 64); without a cache the
+    conservative lane default holds."""
+    mode = str(_get(cfg, "tpu_hist_layout", "auto") or "auto").lower()
+    src = "user" if mode not in ("", "auto") else "default"
+    if mode in ("", "auto"):
+        mode = "lane"
+        if decision and decision.get("hist_layout"):
+            cand = str(decision["hist_layout"])
+            if cand == "sublane" and (num_bins <= 0 or num_bins > 64):
+                pass      # stale cache vs a wider re-bin: keep lane
+            elif cand == "sublane" and (platform or current_platform()) \
+                    not in TPU_PLATFORMS:
+                pass      # Mosaic layout needs a TPU backend
+            elif cand in ("lane", "sublane"):
+                mode, src = cand, "autotune"
+    elif mode not in ("lane", "sublane"):
+        log.warning(f"tpu_hist_layout={mode!r} is not one of "
+                    "auto|lane|sublane; using the lane layout (auto "
+                    "stays on the conservative lane default until an "
+                    "autotune cache records a sublane win for this "
+                    "shape-class — tpu_autotune=first_run)")
+        if sources is not None:
+            sources["hist_layout"] = "default"
+        return "lane"
+    if mode == "sublane" and num_bins > 64:
+        # num_bins <= 0 means "width unknown" (no train-set context,
+        # e.g. reset_parameter on a loaded booster) — the bound is
+        # enforced where a real width exists, not against a guess
+        log.warning(
+            f"tpu_hist_layout=sublane needs num_bins <= 64 (got "
+            f"{num_bins}): bins lie along sublanes and wider counts "
+            "cannot group features into the 128 MXU rows; using lane")
+        if sources is not None:
+            sources["hist_layout"] = "default"
+        return "lane"
+    if sources is not None:
+        sources["hist_layout"] = src
+    return mode
+
+
+def resolve_impl(cfg, decision: Optional[Dict[str, Any]] = None,
+                 sources: Optional[Dict[str, str]] = None) -> str:
+    """``tpu_hist_impl``: the standalone histogram engine. user >
+    autotune > "auto" (the trace-time per-width heuristic in
+    ops/histogram.py _resolve_impl — the ``tpu_autotune=off`` escape
+    hatch)."""
+    src = "default"
+    impl = str(_get(cfg, "tpu_hist_impl", "auto") or "auto").lower()
+    if _explicit(cfg, "tpu_hist_impl") and impl != "auto":
+        if impl not in ("xla", "pallas"):
+            log.warning(f"tpu_hist_impl={impl!r} is not one of "
+                        "auto|xla|pallas; using auto")
+            impl = "auto"
+        else:
+            src = "user"
+    elif decision and decision.get("hist_impl") in ("xla", "pallas"):
+        impl, src = str(decision["hist_impl"]), "autotune"
+    else:
+        impl = "auto"
+    if sources is not None:
+        sources["hist_impl"] = src
+    return impl
+
+
+def resolve_fused_block(cfg, platform: Optional[str] = None,
+                        sources: Optional[Dict[str, str]] = None) -> int:
+    """``tpu_fused``: the fused per-split Mosaic kernel block size
+    (0 = off). auto = on whenever a real TPU backend is present; the
+    fused kernel is selected structurally, not by the microbench (see
+    EngineEntry.sweepable), but its hist_flush inherits the autotuned
+    layout/mbatch. The record-width scoped-VMEM clamp re-runs at
+    :func:`clamp_fused_block` once the row layout is known."""
+    from ..ops.fused_split import fused_available
+    mode = str(_get(cfg, "tpu_fused", "auto") or "auto").lower()
+    src = "user" if _explicit(cfg, "tpu_fused") else "default"
+    if sources is not None:
+        sources["fused_block"] = src
+    if mode in ("off", "0", "false"):
+        return 0
+    if bool(_get(cfg, "tpu_fused_interpret", False)):
+        # CI-only: run the Mosaic kernel in Pallas interpret mode on CPU
+        bs = int(_get(cfg, "tpu_fused_block", 512) or 512)
+        return max(32, (bs // 32) * 32)
+    available = (fused_available() if platform is None
+                 else platform in TPU_PLATFORMS and fused_available())
+    if mode == "on" and not available:
+        log.warning("tpu_fused=on requires a TPU backend (Mosaic); "
+                    "falling back to the XLA compact path")
+        if sources is not None:
+            sources["fused_block"] = "default"
+        return 0
+    if mode == "on" or (mode == "auto" and available):
+        bs = int(_get(cfg, "tpu_fused_block", 512) or 512)
+        return max(32, (bs // 32) * 32)
+    return 0
+
+
+def resolve_step_buckets(cfg,
+                         sources: Optional[Dict[str, str]] = None) -> bool:
+    """``tpu_step_buckets``: the bucketed grower-step ladder.
+
+    On (the default), the step program's jit key carries the
+    power-of-two leaf RUNG and the {unlimited, bounded} depth bucket
+    instead of the exact (num_leaves, max_depth) pair — the actual
+    budgets ride as traced scalars, so every configuration in a rung
+    shares one compiled program. ``off`` is the exact-keyed escape
+    hatch for parity benching."""
+    mode = str(_get(cfg, "tpu_step_buckets", "auto") or "auto").lower()
+    if sources is not None:
+        sources["step_buckets"] = \
+            "user" if _explicit(cfg, "tpu_step_buckets") else "default"
+    if mode in ("off", "0", "false"):
+        return False
+    if mode not in ("", "auto", "on", "1", "true"):
+        log.warning(f"tpu_step_buckets={mode!r} is not one of "
+                    "auto|on|off; the ladder stays on")
+    return True
+
+
+def resolve_overlap(cfg,
+                    sources: Optional[Dict[str, str]] = None) -> int:
+    """``tpu_hist_overlap``: async histogram-collective overlap.
+
+    ``on`` builds each leaf histogram in 2 feature groups with one
+    psum_scatter/all-reduce per group, issued while the next group
+    still accumulates — collective latency hides under the MXU
+    contraction at unchanged byte totals. Only meaningful on the
+    distributed learners. ``auto`` stays off until a real-TPU sweep
+    says otherwise (the autotuner does not sweep it: overlap needs live
+    collectives, which a single-chip microbench cannot time)."""
+    mode = str(_get(cfg, "tpu_hist_overlap", "auto") or "auto").lower()
+    if sources is not None:
+        sources["hist_overlap"] = \
+            "user" if _explicit(cfg, "tpu_hist_overlap") else "default"
+    if mode in ("on", "1", "true"):
+        return 2
+    if mode not in ("", "auto", "off", "0", "false"):
+        log.warning(f"tpu_hist_overlap={mode!r} is not one of "
+                    "auto|on|off; overlap stays off")
+    return 0
+
+
+def clamp_fused_block(block: int, num_cols: int, mbatch: int,
+                      hist_layout: str, num_bins: int, num_features: int,
+                      env_override: str = "") -> int:
+    """The record-width scoped-VMEM clamp (registry-owned since round
+    12; previously inlined in gbdt._setup_compact_state).
+
+    The kernel's streaming buffers scale with ``block_size * num_cols``
+    and the batched-M pending ring with ``mbatch * block_size`` (bins +
+    transposed channels + the flush's one-hot and block-diagonal
+    transients — both register layouts charged, ops/fused_split.py
+    fused_ring_bytes); the histogram accumulator needs
+    ``f_pad * stride * 32`` bytes regardless of block size, so a shape
+    whose accumulator alone blows the ~16MB scoped limit falls back to
+    the XLA walk (returns 0). ``env_override`` (LGBM_TPU_FUSED_BS) is
+    rounded + re-guarded, never trusted raw."""
+    if not block:
+        return 0
+    from ..ops.fused_split import _hist_packing, fused_block_cap
+    vmem_cap_bs = fused_block_cap(num_cols, mbatch,
+                                  hist_layout=hist_layout)
+    bs = min(block, vmem_cap_bs)
+    if env_override:
+        # perf experiments; rounded + re-guarded, never trusted raw
+        bs = validated_fused_block_env(env_override, num_cols, vmem_cap_bs)
+    stride, f_pad, _ = _hist_packing(num_features, num_bins)
+    f_hist_bytes = f_pad * stride * 32
+    if f_hist_bytes > 6 << 20:
+        log.warning("fused kernel disabled: histogram accumulator "
+                    f"needs {f_hist_bytes >> 20}MB VMEM; using the "
+                    "XLA compact walk")
+        return 0
+    return bs
+
+
+# ---------------------------------------------------------------------------
+# THE resolve callsite
+# ---------------------------------------------------------------------------
+def resolve(cfg, shape: Optional[DatasetShape] = None,
+            sample_provider=None, platform: Optional[str] = None,
+            allow_sweep: bool = True,
+            prior: Optional[Resolution] = None) -> Resolution:
+    """Resolve every engine knob for one training run.
+
+    ``shape`` keys the autotune cache (None = no shape context, e.g. a
+    booster constructed without a train set: heuristic defaults only).
+    ``sample_provider(n)`` returns up to ``n`` rows of the REAL binned
+    matrix for the microbench; ``allow_sweep=False`` never runs a new
+    sweep. ``prior`` (reset_parameter) is the run's previous
+    Resolution: its in-memory decision is reused VERBATIM — no cache
+    re-read, no file I/O in the training loop, and the engine a run
+    measured at startup can neither vanish (unwritable cache) nor flip
+    (cache rewritten underneath a live run) on a mid-run re-resolve.
+    """
+    platform = platform or current_platform()
+    sources: Dict[str, str] = {}
+    decision = None
+    swept = False
+    sclass = shape_class(shape) if shape is not None else None
+    if prior is not None:
+        decision = prior.decision
+    elif shape is not None:
+        from . import autotune
+        decision, swept = autotune.decision_for(
+            cfg, shape, platform, sample_provider=sample_provider,
+            allow_sweep=allow_sweep)
+    # 0 = bin width unknown (no train-set context): the sublane bound
+    # cannot be checked, so it is not enforced against a made-up width
+    num_bins = int(shape.num_bins) if shape is not None else 0
+    mbatch = resolve_mbatch(cfg, decision, sources)
+    layout = resolve_layout(cfg, num_bins, decision, platform, sources)
+    impl = resolve_impl(cfg, decision, sources)
+    fused_block = resolve_fused_block(cfg, platform, sources)
+    step_buckets = resolve_step_buckets(cfg, sources)
+    overlap = resolve_overlap(cfg, sources)
+    if fused_block:
+        entry_id = "fused_sublane" if layout == "sublane" else "fused_lane"
+    elif decision and decision.get("entry"):
+        entry_id = str(decision["entry"])
+    elif impl == "pallas":
+        entry_id = ("pallas_sublane" if layout == "sublane"
+                    else "pallas_lane")
+    else:
+        entry_id = "xla_lane"
+    res = Resolution(
+        entry_id=entry_id, fused_block=fused_block, hist_impl=impl,
+        hist_mbatch=mbatch, hist_layout=layout, hist_overlap=overlap,
+        step_buckets=step_buckets, sources=sources, shape_class=sclass,
+        autotuned=bool(decision), decision=decision)
+    if decision and prior is None:
+        log.info(
+            f"engine registry: shape-class {sclass} -> {entry_id} "
+            f"(layout={layout}, mbatch={mbatch}, impl={impl}; "
+            f"{'measured now' if swept else 'autotune cache'})")
+    return res

@@ -42,6 +42,8 @@ Span taxonomy (every name a device program or tick site carries):
 ``checkpoint_write``      io/checkpoint.write_snapshot atomic tick
 ``predict_warmup``        one serving-ladder rung warm (basic.py)
 ``serve_tick``            one coalescer micro-batch device dispatch
+``autotune``              the startup engine microbench sweep
+                          (engines/autotune.py — strictly pre-steady-state)
 ========================  ==================================================
 """
 from __future__ import annotations

@@ -254,13 +254,19 @@ def test_steady_state_guard_with_mbatch_set():
 
 
 def test_hist_mbatch_env_override_validated():
+    """Round-12 resolve order (engines/registry.py): an explicit user
+    knob beats the env override, the env override beats the default —
+    and out-of-range env values are still clamped to [1, 16]."""
     import os
     from lightgbm_tpu.boosting.gbdt import _pick_hist_mbatch
     assert _pick_hist_mbatch({"tpu_hist_mbatch": 12}) == 12
     os.environ["LGBM_TPU_HIST_MBATCH"] = "99"
     try:
-        assert _pick_hist_mbatch({"tpu_hist_mbatch": 8}) == 16
+        # explicit user knob wins over the env override
+        assert _pick_hist_mbatch({"tpu_hist_mbatch": 4}) == 4
+        # env override (validated: 99 clamps to 16) wins over the default
+        assert _pick_hist_mbatch({}) == 16
         os.environ["LGBM_TPU_HIST_MBATCH"] = "5"
-        assert _pick_hist_mbatch({"tpu_hist_mbatch": 8}) == 5
+        assert _pick_hist_mbatch({}) == 5
     finally:
         del os.environ["LGBM_TPU_HIST_MBATCH"]

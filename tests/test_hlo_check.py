@@ -204,3 +204,53 @@ def test_canonicalize_strips_naming_noise():
     assert hlo.fingerprint(a) == hlo.fingerprint(b)
     c = "%dot.9 = s8[8]{0} dot(s8[8]{0} %x.2)"
     assert hlo.fingerprint(a) != hlo.fingerprint(c)
+
+
+# -------------------------------------- per-registry-entry enumeration
+def test_registry_entries_all_covered():
+    """Every engine-registry entry (engines/registry.py) is pinned: a
+    checked-in contract whose filename carries the entry id, or a
+    justified TPU-only exemption — the shipped tree enumerates clean."""
+    findings = hlo_check.registry_contract_findings()
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_registry_entry_without_contract_fails():
+    """A new engine cannot land unpinned: an entry with neither a
+    contract nor a contract_exempt justification is a finding, and a
+    CPU-lowerable entry cannot hide behind an exemption."""
+    from lightgbm_tpu.engines.registry import EngineEntry
+    bare = EngineEntry("new_engine", "xla", "lane", False, "unpinned")
+    findings = hlo_check.registry_contract_findings([bare])
+    assert len(findings) == 1 and "neither" in findings[0].message
+    cheat = bare._replace(contract_exempt="trust me", requires_tpu=False)
+    findings = hlo_check.registry_contract_findings([cheat])
+    assert len(findings) == 1 and "TPU-only" in findings[0].message
+    # a TPU-only Mosaic engine MAY be exempt (the CPU harness cannot
+    # lower it) — that is the shipped fused/pallas entries' shape
+    exempt = bare._replace(contract_exempt="Mosaic; pinned by parity",
+                           requires_tpu=True)
+    assert not hlo_check.registry_contract_findings([exempt])
+
+
+def test_registry_entry_id_must_be_in_filename():
+    """Per-entry enumeration needs the entry id visible in
+    analysis/contracts/ — naming an unrelated (existing) contract does
+    not count as coverage."""
+    from lightgbm_tpu.engines.registry import EngineEntry
+    sneaky = EngineEntry("new_engine", "xla", "lane", False, "mislabeled",
+                         contracts=("serial_compact",))
+    findings = hlo_check.registry_contract_findings([sneaky])
+    assert len(findings) == 1 and "entry id" in findings[0].message
+
+
+def test_xla_lane_entry_contract_is_fully_concretized(captured):
+    """The xla_lane entry contract pins the registry-resolved program
+    with every engine knob explicit and autotune off; it lowers with no
+    collectives and no host ops like the serial baseline."""
+    contract = hlo_check.load_contract("xla_lane")
+    assert contract["params"]["tpu_hist_impl"] == "xla"
+    assert contract["params"]["tpu_autotune"] == "off"
+    findings = hlo_check.verify_mode("xla_lane", contract,
+                                     captured["xla_lane"])
+    assert not findings, "\n".join(f.render() for f in findings)

@@ -642,6 +642,100 @@ def test_r004_sublane_ring_budget_charged(tmp_path):
     assert len(r4) == 1, [f.render() for f in sub]
 
 
+def test_r004_engine_kwargs_outside_registry(tmp_path):
+    """Engine-registry ownership seed (round 12): GrowerParams/._replace
+    setting an engine knob outside lightgbm_tpu/engines from anything
+    but a registry resolution re-opens a second selection site."""
+    findings = lint_snippet(tmp_path, """
+        def setup(cfg):
+            return GrowerParams(num_leaves=31, hist_impl="pallas",
+                                hist_mbatch=16)
+    """)
+    r4 = [f for f in findings if f.rule == "R004"
+          and "registry" in f.message]
+    assert len(r4) == 2, [f.render() for f in findings]
+    clean = lint_snippet(tmp_path, """
+        def setup(cfg, resolved):
+            return GrowerParams(num_leaves=31,
+                                hist_impl=resolved.hist_impl,
+                                hist_mbatch=resolved.hist_mbatch,
+                                fused_block=resolved.fused_block)
+    """, name="clean_engine_kwargs.py")
+    assert not [f for f in clean if "registry" in f.message]
+    repl = lint_snippet(tmp_path, """
+        def reset(gp, k):
+            return gp._replace(hist_layout="sublane", hist_block=k)
+    """, name="replace_engine.py")
+    assert len([f for f in repl if f.rule == "R004"
+                and "registry" in f.message]) == 1
+
+
+def test_r004_engine_chooser_outside_registry(tmp_path):
+    """A function choosing between engine-impl constants is selection
+    POLICY — outside engines/ it is unowned (the ops/histogram.py
+    _resolve_impl trace-time escape hatch is the one allowlist anchor)."""
+    findings = lint_snippet(tmp_path, """
+        def pick_engine(num_bins):
+            if num_bins >= 128:
+                return "pallas"
+            return "xla"
+    """)
+    r4 = [f for f in findings if f.rule == "R004"]
+    assert len(r4) == 1 and "engine" in r4[0].message
+    # the same policy INSIDE the registry package is its home
+    pkg = tmp_path / "engines"
+    pkg.mkdir()
+    (pkg / "registry.py").write_text(textwrap.dedent("""
+        def pick_engine(num_bins):
+            if num_bins >= 128:
+                return "pallas"
+            return "xla"
+    """))
+    in_registry, errors = lint_paths([str(pkg / "registry.py")])
+    assert not errors
+    assert not [f for f in in_registry if f.rule == "R004"]
+
+
+def test_r004_constant_impl_callsite(tmp_path):
+    """A histogram call pinning impl=/layout= to a constant hardcodes
+    the engine at the callsite, bypassing the measured decision."""
+    findings = lint_snippet(tmp_path, """
+        def build(binned, ch, b):
+            return histogram_block(binned, ch, b, impl="pallas",
+                                   layout="sublane")
+    """)
+    r4 = [f for f in findings if f.rule == "R004"
+          and "engine selection" in f.message]
+    assert len(r4) == 2, [f.render() for f in findings]
+    clean = lint_snippet(tmp_path, """
+        def build(binned, ch, b, params):
+            return histogram_block(binned, ch, b, impl=params.hist_impl,
+                                   layout=params.hist_layout)
+    """, name="clean_impl_passthrough.py")
+    assert not clean
+    # "auto" is not a selection — it defers to the anchored dispatch
+    auto = lint_snippet(tmp_path, """
+        def build(binned, ch, b):
+            return histogram_block(binned, ch, b, impl="auto")
+    """, name="auto_impl.py")
+    assert not auto
+
+
+def test_r004_engine_ownership_package_anchor():
+    """The shipped tree's ONE engine-selection site outside engines/ is
+    ops/histogram.py::_resolve_impl, carried by its allowlist anchor —
+    with the allowlist applied the package is clean (the tier-1 test),
+    without it exactly that site surfaces."""
+    path = os.path.join(PKG_DIR, "ops", "histogram.py")
+    findings, errors = lint_paths([path])
+    assert not errors
+    r4 = [f for f in findings if f.rule == "R004"]
+    assert len(r4) == 1 and r4[0].func == "_resolve_impl", \
+        [f.render() for f in r4]
+    entries, _ = load_allowlist(DEFAULT_ALLOWLIST)
+    assert not apply_allowlist(r4, entries)
+
+
 def test_r004_pack4_nibble_mask_detector(tmp_path):
     """pack4 unpack sites must mask with & 0xF (round 6): the unmasked
     shift leaves the neighbour feature's nibble in the high bits."""
