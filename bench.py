@@ -681,11 +681,18 @@ def run_serving_bench(print_json=True):
     sizes = [int(s) for s in os.environ.get(
         "BENCH_SERVING_SIZES", "1,8,64,256").split(",")]
 
+    endpoints = [e.strip() for e in os.environ.get(
+        "BENCH_SERVING_ENDPOINTS", "predict,leaf,contrib").split(",")
+        if e.strip()]
+    featurize_mode = os.environ.get("BENCH_SERVING_FEATURIZE", "device")
+
     X, y = make_higgs_like(train_rows, feats)
     params = {
         "objective": "binary", "num_leaves": leaves, "max_bin": 63,
         "learning_rate": 0.1, "min_data_in_leaf": 20, "verbosity": -1,
         "stop_check_freq": 10_000, "tpu_predict_buckets": ladder,
+        "tpu_serve_endpoints": ",".join(endpoints),
+        "tpu_serve_featurize": featurize_mode,
     }
     t0 = time.time()
     bst = lgb.train(params, lgb.Dataset(X, label=y, params=params), rounds)
@@ -699,12 +706,45 @@ def run_serving_bench(print_json=True):
                      f"in {warm['seconds']}s ({warm['lowerings']} "
                      f"lowerings)\n")
 
+    # featurize attribution: host seconds vs device seconds for one
+    # top-rung batch — the hoist ISSUE 13 claims, as a recorded number.
+    # Host = the bin_columns sweep predict_serving used to run per tick;
+    # device = the jitted raw->binned program (ops/device_bin.py), timed
+    # blocked so it is device work, not dispatch.
+    import jax as _jax
     import threading as _threading
     rng = np.random.RandomState(5)
+    inner = bst._gbdt
+    top_rung = int(max(warm["rungs"]))
+    fprobe = rng.randn(top_rung, feats).astype(np.float32)
+    reps = int(os.environ.get("BENCH_SERVING_FEATURIZE_REPS", 20))
+    _jax.block_until_ready(inner.featurize_rung(fprobe))     # warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        inner.bin_matrix(fprobe)
+    host_s = (time.perf_counter() - t0) / reps
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        _jax.block_until_ready(inner.featurize_rung(fprobe))
+    dev_s = (time.perf_counter() - t0) / reps
+    featurize_row = {
+        "rows": top_rung, "mode": featurize_mode,
+        "featurize_host_seconds": round(host_s, 6),
+        "featurize_device_seconds": round(dev_s, 6),
+        "host_over_device": round(host_s / max(dev_s, 1e-9), 3),
+    }
+    sys.stderr.write(f"[bench-serving] featurize {top_rung} rows: "
+                     f"host {host_s*1e3:.2f}ms vs device program "
+                     f"{dev_s*1e3:.2f}ms\n")
+
     pool = rng.randn(max(sizes), feats).astype(np.float32)
     levels = {}
     with guards.compile_counter() as steady_cc:
-        for qps in qps_levels:
+        # per-endpoint levels: the same open-loop sweep drives each
+        # enabled endpoint (predict / leaf / contrib) through the shared
+        # coalescer ladder
+        for endpoint, qps in [(e, q) for e in endpoints
+                              for q in qps_levels]:
             futs, sheds, misc_errors = [], [0], [0]
             mu = _threading.Lock()
             t_end = time.monotonic() + duration_s
@@ -724,7 +764,7 @@ def run_serving_bench(print_json=True):
                     size = sizes[k % len(sizes)]
                     k += threads
                     try:
-                        f = server.submit(pool[:size])
+                        f = server.submit(pool[:size], kind=endpoint)
                         with mu:
                             futs.append(f)
                     except ServerOverloaded:
@@ -768,7 +808,10 @@ def run_serving_bench(print_json=True):
                 "timeout_rate": round(timeouts / max(offered, 1), 4),
                 "failed": failed + misc_errors[0],
             }
-            levels[str(qps)] = cell
+            cell["endpoint"] = endpoint
+            key = (str(qps) if endpoint == "predict"
+                   else f"{endpoint}@{qps}")   # predict keeps the legacy key
+            levels[key] = cell
             # same schema as the training rows: when BENCH_METRICS_PATH is
             # armed, each level also lands in the unified metrics stream
             # (shed-rate beside compile counts — scripts/obs reads both)
@@ -776,9 +819,10 @@ def run_serving_bench(print_json=True):
                 from lightgbm_tpu.obs import metrics as obs_metrics
                 s = obs_metrics.stream_for(os.environ["BENCH_METRICS_PATH"])
                 if s is not None:
-                    s.emit("serving_level", qps=qps, **cell)
+                    s.emit("serving_level", qps=qps, endpoint=endpoint,
+                           **cell)
             sys.stderr.write(
-                f"[bench-serving] qps={qps}: achieved="
+                f"[bench-serving] {endpoint} qps={qps}: achieved="
                 f"{cell['achieved_qps']} p50={cell['p50_ms']}ms "
                 f"p99={cell['p99_ms']}ms shed={cell['shed_rate']:.1%} "
                 f"timeout={cell['timeout_rate']:.1%}\n")
@@ -791,10 +835,12 @@ def run_serving_bench(print_json=True):
     _record_shape("serving", {
         "platform": dev.platform, "trees": rounds, "leaves": leaves,
         "features": feats, "ladder": warm["rungs"],
+        "endpoints": endpoints,
         "tick_ms": tick_ms, "deadline_ms": deadline_ms,
         "queue_max_rows": queue_max, "sizes": sizes,
         "duration_s": duration_s, "levels": levels,
         "warmup": warm,
+        "featurize": featurize_row,
         "compile_events_steady": steady_cc.lowerings,
         "coalescer": stats,
     })

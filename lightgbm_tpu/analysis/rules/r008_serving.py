@@ -17,7 +17,15 @@ contract silently:
     without ``block=False``/``timeout=``): one wedged device dispatch
     then wedges the caller — or a full bounded queue wedges every
     submitter — forever, instead of raising ``ServingTimeout``
-    (``tpu_serve_deadline_ms``) or shedding (``ServerOverloaded``).
+    (``tpu_serve_deadline_ms``) or shedding (``ServerOverloaded``);
+  * sub-check (c): HOST FEATURIZATION on the serving hot path — a
+    ``bin_columns`` / ``value_to_bin`` / ``np.searchsorted`` call in any
+    function reachable from a coalescer-tick/serve entry point re-opens
+    the per-tick O(rows * features) host sweep the device featurizer
+    (ops/device_bin.py, ``tpu_serve_featurize=device``) exists to
+    close. The ONE deliberate host binner — the
+    ``tpu_serve_featurize=host`` parity/escape hatch behind
+    ``GBDT.bin_matrix`` — carries an allowlist anchor.
 
 Scope: code is "serving-scoped" when its module lives under a
 ``serving`` package/path, its enclosing class matches ``Serv``/
@@ -59,6 +67,18 @@ _UNBOUNDABLE = {"SimpleQueue"}
 
 #: attribute calls that block forever without a timeout
 _BLOCKING_ATTRS = {"get", "result", "wait", "join"}
+
+#: host featurization primitives (sub-check (c)): the per-tick raw->bin
+#: host work the device featurizer replaces on serving paths
+_FEATURIZE_CALLS = {"bin_columns", "value_to_bin", "searchsorted"}
+#: function basenames that are serve/coalescer-tick entry points for the
+#: featurize reachability walk (the whole serving/ package seeds too)
+_SERVE_ENTRY_RE = re.compile(r"(^|_)serv", re.I)
+#: boundaries the featurize walk does NOT cross: training / dataset
+#: construction is boot-time work (scripts/serve trains-or-resumes before
+#: taking traffic), not per-tick request work — the construct-time binner
+#: behind them is legitimate
+_PHASE_STOP_RE = re.compile(r"^_?(train|construct|fit)", re.I)
 
 
 def _timeout_kw(node: ast.Call) -> Optional[ast.AST]:
@@ -171,6 +191,91 @@ class ServingContractRule(Rule):
                 walk(child, child_qual, child_scope)
 
         walk(module.tree, "<module>", module_scope)
+        out.extend(self._host_featurize_findings(module, package))
+        return out
+
+    # -- (c) host featurization reachable from serve entries ----------------
+    def _serve_closure(self, package: PackageInfo) -> set:
+        """Functions reachable from serving entry points, package-wide.
+
+        Seeds: every function in a ``serving`` module plus any function
+        whose basename says serve/serving (``predict_serving``,
+        ``_serve_batch``, the endpoint twins). The walk follows the
+        jit-reachability name-resolution edges PLUS a package-wide
+        basename resolution for method-style attribute calls
+        (``inner.bin_matrix(...)`` — serving hands work to Booster/GBDT
+        methods through object handles the import-based resolver cannot
+        see), and stops AT the featurize primitives — findings anchor at
+        their callers, not inside io/binning itself (which legitimately
+        owns the construct-time binner)."""
+        cached = getattr(package, "_r008_serve_closure", None)
+        if cached is not None:
+            return cached
+        by_basename: dict = {}
+        for m in package.modules:
+            for f in m.functions.values():
+                by_basename.setdefault(f.basename, []).append(f)
+        work, seen = [], set()
+        for m in package.modules:
+            mscope = _module_in_scope(m)
+            in_serving_class = set()
+            for cls in ast.walk(m.tree):
+                if isinstance(cls, ast.ClassDef) and _CLASS_RE.search(
+                        cls.name):
+                    for sub in ast.walk(cls):
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            in_serving_class.add(id(sub))
+            for f in m.functions.values():
+                if mscope or _SERVE_ENTRY_RE.search(f.basename) \
+                        or id(f.node) in in_serving_class:
+                    work.append(f)
+        def admit(fns):
+            # the walk stops at train/construct entries: boot-time phases
+            # own the construct-time binner legitimately
+            work.extend(f for f in fns
+                        if not _PHASE_STOP_RE.match(f.basename))
+
+        while work:
+            fn = work.pop()
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            for g in fn.module.functions.values():
+                if g.parent is fn:
+                    work.append(g)
+            for name in fn.refs:
+                if name.rsplit(".", 1)[-1] in _FEATURIZE_CALLS:
+                    continue
+                admit(package._callees(fn.module, name))
+            for alias, attr in fn.attr_refs:
+                if attr in _FEATURIZE_CALLS:
+                    continue
+                admit(package._resolve_attr(fn.module, alias, attr))
+                admit(by_basename.get(attr, ()))
+        package._r008_serve_closure = seen
+        return seen
+
+    def _host_featurize_findings(self, module: ModuleInfo,
+                                 package: PackageInfo) -> List[Finding]:
+        out: List[Finding] = []
+        closure = self._serve_closure(package)
+        for fn in module.functions.values():
+            if id(fn) not in closure:
+                continue
+            for node in fn.own_nodes():
+                if not isinstance(node, ast.Call):
+                    continue
+                base = (call_name(node) or "").rsplit(".", 1)[-1]
+                if base in _FEATURIZE_CALLS:
+                    out.append(self.finding(
+                        module, node, fn.qualname,
+                        f"host featurization ({base}) reachable from a "
+                        "serve/coalescer-tick entry — every tick pays an "
+                        "O(rows*features) host sweep; route through the "
+                        "device featurizer (ops/device_bin.py, "
+                        "tpu_serve_featurize=device) or anchor the "
+                        "deliberate host escape hatch"))
         return out
 
     def _check_call(self, module: ModuleInfo, node: ast.Call, qual: str,

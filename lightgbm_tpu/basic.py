@@ -836,20 +836,9 @@ class Booster:
         start_iteration, num_iteration = self._predict_window(
             start_iteration, num_iteration)
         arr = np.asarray(_maybe_series(data), dtype=np.float64)
-        pre = getattr(self, "_pre_model", None)
-        # global tree-window semantics across loaded + new trees (reference:
-        # models_ holds them in order and start/num address that sequence)
-        pre_iters = pre.current_iteration if pre is not None else 0
-        end = (start_iteration + num_iteration
-               if num_iteration is not None and num_iteration > 0 else None)
-        pre_start = min(start_iteration, pre_iters)
-        pre_cut = (max(min(end, pre_iters) - pre_start, 0)
-                   if end is not None else None)
-        own_start = max(start_iteration - pre_iters, 0)
-        own_cut = (max(end - pre_iters - own_start, 0)
-                   if end is not None else None)
-        pre_empty = pre is None or pre_start >= pre_iters or pre_cut == 0
-        own_empty = own_cut == 0
+        (pre, pre_start, pre_cut, own_start, own_cut, pre_empty,
+         own_empty) = self._global_tree_window(start_iteration,
+                                               num_iteration)
         if pred_leaf:
             own = (inner.predict_leaf_matrix(arr, own_cut, own_start)
                    if not own_empty else None)
@@ -859,10 +848,7 @@ class Booster:
                        else np.concatenate([pre_leaf, own], axis=1))
             return own
         if pred_contrib:
-            if start_iteration != 0:
-                raise NotImplementedError(
-                    "pred_contrib with start_iteration != 0 is not supported")
-            return self._predict_contrib(arr, num_iteration)
+            return self._predict_contrib(arr, num_iteration, start_iteration)
         early = self._predict_early_stop(kwargs)
         raw = (inner.predict_raw_matrix(arr, own_cut, own_start, early)
                if not own_empty else None)   # [K, N]
@@ -922,6 +908,29 @@ class Booster:
                                                start_iteration)
         return raw[0] if raw.shape[0] == 1 else raw.T
 
+    def _global_tree_window(self, start_iteration: int,
+                            num_iteration: Optional[int]):
+        """Split a (start, num) iteration window across the loaded base
+        model and this booster's own trees — global tree-window semantics
+        (reference: models_ holds loaded-then-new trees in order and
+        start/num address that sequence). THE one implementation behind
+        predict() and _predict_contrib(); returns ``(pre, pre_start,
+        pre_cut, own_start, own_cut, pre_empty, own_empty)`` with
+        ``None`` cuts meaning "to the end"."""
+        pre = getattr(self, "_pre_model", None)
+        pre_iters = pre.current_iteration if pre is not None else 0
+        end = (start_iteration + num_iteration
+               if num_iteration is not None and num_iteration > 0 else None)
+        pre_start = min(start_iteration, pre_iters)
+        pre_cut = (max(min(end, pre_iters) - pre_start, 0)
+                   if end is not None else None)
+        own_start = max(start_iteration - pre_iters, 0)
+        own_cut = (max(end - pre_iters - own_start, 0)
+                   if end is not None else None)
+        pre_empty = pre is None or pre_start >= pre_iters or pre_cut == 0
+        return (pre, pre_start, pre_cut, own_start, own_cut, pre_empty,
+                own_cut == 0)
+
     def _predict_window(self, start_iteration: int,
                         num_iteration: Optional[int]):
         """Params-level prediction-window resolution shared by every
@@ -977,6 +986,30 @@ class Booster:
                 "path); use predict()")
         return inner
 
+    def _serving_request(self, data, start_iteration: int,
+                         num_iteration: Optional[int]):
+        """``(inner, start_iteration, num_iteration, arr32, n)`` — the
+        request-normalization preamble shared by every serving endpoint
+        (predict/leaf/contrib): window resolution and the float32 cast
+        (the serving wire format) live HERE, once."""
+        inner = self._device_serving_inner()
+        start_iteration, num_iteration = self._predict_window(
+            start_iteration, num_iteration)
+        arr = np.asarray(_maybe_series(data), dtype=np.float32)
+        if arr.ndim == 1:
+            arr = arr.reshape(1, -1)
+        return inner, start_iteration, num_iteration, arr, arr.shape[0]
+
+    @staticmethod
+    def _serving_binned(inner, arr32: np.ndarray):
+        """Bins for one serving batch: the jitted device featurizer
+        (default — returns the rung-padded device matrix, pack4 layout
+        included) or the host ``bin_columns`` escape hatch
+        (``tpu_serve_featurize=host``; predict_raw_device pads it)."""
+        if inner._serve_featurize_mode() == "device":
+            return inner.featurize_rung(arr32)
+        return inner.bin_matrix(arr32)
+
     @read_locked
     def predict_serving(self, data: _ArrayLike, raw_score: bool = False,
                         start_iteration: int = 0,
@@ -992,28 +1025,32 @@ class Booster:
         contract: :meth:`predict_device`'s device-side ``[:, :n]`` slice
         would lower one trivial program per distinct request size.
 
-        Rows ``[:n_valid]`` of the result equal ``predict(data)``
-        bit-for-bit (row routing, score sums, and the elementwise output
-        conversion are all per-row independent, so padding rows change
-        nothing). Shape ``[rung]`` for binary/regression, ``[rung, K]``
-        for multiclass. The request must fit the bucket ladder.
+        Rows ``[:n_valid]`` of the result equal
+        ``predict(float32(data))`` bit-for-bit (row routing, score sums,
+        and the elementwise output conversion are all per-row
+        independent, so padding rows change nothing; float32 is the
+        serving wire format below). Shape ``[rung]`` for
+        binary/regression, ``[rung, K]`` for multiclass. The request
+        must fit the bucket ladder.
 
         Honors the same params-level controls predict() does — the
         start_iteration_predict / num_iteration_predict window and the
         pred_early_stop margin/freq approximation (both per-row
-        independent, so parity survives batching)."""
-        inner = self._device_serving_inner()
-        start_iteration, num_iteration = self._predict_window(
-            start_iteration, num_iteration)
+        independent, so parity survives batching).
+
+        The serving wire format is raw float32 (requests cast here, in
+        BOTH featurize modes, so flipping ``tpu_serve_featurize`` can
+        never change a response): with the default ``device`` mode the
+        request is ONE host->device copy of the padded raw f32 matrix —
+        binning runs as a jitted program (ops/device_bin.py), bit-
+        identical to the ``host`` escape hatch's ``bin_columns`` pass."""
+        inner, start_iteration, num_iteration, arr, n = \
+            self._serving_request(data, start_iteration, num_iteration)
         early = self._predict_early_stop()
-        arr = np.asarray(_maybe_series(data), dtype=np.float64)
-        if arr.ndim == 1:
-            arr = arr.reshape(1, -1)
-        n = arr.shape[0]
-        binned = inner.bin_matrix(arr)
         raw = np.asarray(inner.predict_raw_device(
-            binned, num_iteration, start_iteration,
-            early_stop=early))                            # [K, rung] host
+            self._serving_binned(inner, arr), num_iteration,
+            start_iteration, early_stop=early,
+            device_packed=inner._pred_pack4))             # [K, rung] host
         if inner.average_output:
             raw = raw / inner._average_divisor(num_iteration,
                                                start_iteration)
@@ -1025,6 +1062,57 @@ class Booster:
         # padded shape: one eager program per rung, warmed alongside the
         # predict program by warm_predict_ladder
         return np.asarray(inner.objective.convert_output(out)), n
+
+    @read_locked
+    def predict_leaf_serving(self, data: _ArrayLike,
+                             start_iteration: int = 0,
+                             num_iteration: Optional[int] = None):
+        """One coalesced ``pred_leaf`` batch: ``(padded leaves, n_valid)``.
+
+        The serving twin of ``predict(pred_leaf=True)`` (reference:
+        PredictLeafIndex): the depth walk's final node ids, returned
+        rung-padded ``[rung, T]`` so callers slice per-request rows on
+        the host. Rows ``[:n_valid]`` equal the reference routing
+        bit-for-bit — leaf-index embeddings for downstream rankers."""
+        inner, start_iteration, num_iteration, arr, n = \
+            self._serving_request(data, start_iteration, num_iteration)
+        out = inner.predict_leaf_padded(
+            self._serving_binned(inner, arr), num_iteration,
+            start_iteration, device_packed=inner._pred_pack4)
+        return out, n
+
+    @read_locked
+    def predict_contrib_serving(self, data: _ArrayLike,
+                                start_iteration: int = 0,
+                                num_iteration: Optional[int] = None):
+        """One coalesced ``pred_contrib`` batch:
+        ``(padded [rung, K*(F+1)] contributions, n_valid)``.
+
+        Exact TreeSHAP (Lundberg et al.; reference ``Tree::TreeSHAP``,
+        src/io/tree.cpp) served from the device engine
+        (ops/treeshap_device.py) through the same rung ladder as
+        predict — matches the numpy reference within f32 tolerance and
+        sums to the raw score per row."""
+        inner, start_iteration, num_iteration, arr, n = \
+            self._serving_request(data, start_iteration, num_iteration)
+        out = inner.predict_contrib_padded(
+            self._serving_binned(inner, arr), num_iteration,
+            start_iteration, device_packed=inner._pred_pack4)
+        return out, n
+
+    def _serve_endpoints(self) -> tuple:
+        """Resolved ``tpu_serve_endpoints``: which request kinds this
+        booster's servers warm and accept. ``predict`` is always on."""
+        cfg = self._gbdt.config
+        raw = str(cfg.get("tpu_serve_endpoints", "predict") or "predict")
+        eps = {e.strip().lower() for e in raw.split(",") if e.strip()}
+        unknown = eps - {"predict", "leaf", "contrib"}
+        if unknown:
+            log.warning(f"unknown tpu_serve_endpoints {sorted(unknown)}; "
+                        "valid: predict, leaf, contrib")
+            eps -= unknown
+        eps.add("predict")
+        return tuple(sorted(eps))
 
     @read_locked
     def warm_predict_ladder(self, max_rows: Optional[int] = None,
@@ -1043,11 +1131,18 @@ class Booster:
         compiles (the returned ``cache`` counters prove it: hits ==
         requests, misses == 0 on a warm cache).
 
-        Stats: ``rungs`` warmed, ``seconds``, ``lowerings`` /
-        ``backend_compiles`` spent, and the persistent-cache ``cache``
-        ``{requests, hits, misses}``. ``max_rows`` caps the rung
-        enumeration (``tpu_serve_warm_max_rows``); the scan escape-hatch
-        engine recompiles per shape by design and reports ``skipped``."""
+        Every endpoint in ``tpu_serve_endpoints`` warms per rung —
+        predict always, plus the ``pred_leaf`` walk and the device
+        TreeSHAP ``pred_contrib`` programs when enabled — so all three
+        request kinds serve mixed batch sizes with zero steady-state
+        compiles through the same ladder.
+
+        Stats: ``rungs`` warmed, ``endpoints``, ``seconds``,
+        ``lowerings`` / ``backend_compiles`` spent, and the
+        persistent-cache ``cache`` ``{requests, hits, misses}``.
+        ``max_rows`` caps the rung enumeration
+        (``tpu_serve_warm_max_rows``); the scan escape-hatch engine
+        recompiles per shape by design and reports ``skipped``."""
         import time as _time
 
         from .analysis import guards
@@ -1066,6 +1161,7 @@ class Booster:
         from .obs import flight
         from .obs.spans import span
         n_feat = inner.train_set.num_total_features
+        endpoints = self._serve_endpoints()
         plan = active_plan(cfg)
         t0 = _time.time()
         with guards.compile_counter() as cc, \
@@ -1080,8 +1176,17 @@ class Booster:
                     self.predict_serving(dummy,
                                          start_iteration=start_iteration,
                                          num_iteration=num_iteration)
+                    if "leaf" in endpoints:
+                        self.predict_leaf_serving(
+                            dummy, start_iteration=start_iteration,
+                            num_iteration=num_iteration)
+                    if "contrib" in endpoints:
+                        self.predict_contrib_serving(
+                            dummy, start_iteration=start_iteration,
+                            num_iteration=num_iteration)
                 flight.note("warmup_rung", rung=rung)
-        return {"rungs": list(rungs), "seconds": round(_time.time() - t0, 3),
+        return {"rungs": list(rungs), "endpoints": list(endpoints),
+                "seconds": round(_time.time() - t0, 3),
                 "lowerings": cc.lowerings,
                 "backend_compiles": cc.backend_compiles,
                 "cache": {"requests": cache.requests, "hits": cache.hits,
@@ -1100,7 +1205,7 @@ class Booster:
         from .serving import PredictionServer
         return PredictionServer(self, **kwargs)
 
-    def _predict_contrib(self, arr, num_iteration):
+    def _predict_contrib(self, arr, num_iteration, start_iteration: int = 0):
         """Exact TreeSHAP contributions [N, K*(F+1)] (reference:
         PredictContrib -> Tree::TreeSHAP, src/io/tree.cpp).
 
@@ -1108,22 +1213,29 @@ class Booster:
         loaded models and continue-training bases route on the model text's
         raw-value thresholds, like the reference's dataset-free path.
         Linear trees attribute their constant leaf outputs, matching the
-        reference (TreeSHAP reads leaf_value_, never leaf coefficients)."""
+        reference (TreeSHAP reads leaf_value_, never leaf coefficients).
+
+        The (start_iteration, num_iteration) window addresses the global
+        loaded+new tree sequence exactly like predict() — SHAP is
+        additive over trees, so windowing the model stack is the whole
+        story (the ``start_iteration != 0 is not supported`` restriction
+        is gone)."""
         from .ops.treeshap import booster_contrib, loaded_booster_contrib
         g = self._gbdt
         k = max(g.num_tree_per_iteration, 1)
         arr = np.atleast_2d(np.asarray(arr, np.float64))
         if not hasattr(g, "bin_matrix"):
             # model-only path (Booster(model_file=...))
-            models = g.models
+            models = g.models[start_iteration * k:]
             if num_iteration is not None and num_iteration > 0:
                 models = models[: num_iteration * k]
             return loaded_booster_contrib(models, arr, k,
                                           g.max_feature_idx + 1)
-        pre = getattr(self, "_pre_model", None)
-        pre_cut, own_cut = self._split_iteration_window(num_iteration, pre)
+        (pre, pre_start, pre_cut, own_start, own_cut, pre_empty,
+         own_empty) = self._global_tree_window(start_iteration,
+                                               num_iteration)
         g._flush_trees()
-        models = g.models
+        models = [] if own_empty else g.models[own_start * k:]
         if own_cut is not None:
             models = models[: own_cut * k]
         binned = np.asarray(g.bin_matrix(arr))
@@ -1137,15 +1249,17 @@ class Booster:
             nan_bin = np.asarray(g.nan_bin_arr)
             is_cat = np.asarray(g.is_cat_arr)
 
+        from .obs.spans import span
         from .ops.split import go_left_scalar_np
-        out = booster_contrib(models, binned, nan_bin, is_cat,
-                              go_left_scalar_np,
-                              g.num_tree_per_iteration,
-                              int(binned.shape[1]))
-        if pre is not None:
+        with span("contrib"):
+            out = booster_contrib(models, binned, nan_bin, is_cat,
+                                  go_left_scalar_np,
+                                  g.num_tree_per_iteration,
+                                  int(binned.shape[1]))
+        if not pre_empty:
             # continue-trained: SHAP is additive over trees, so the loaded
             # base model's contributions (raw-space routing) sum in
-            pre_models = pre.models
+            pre_models = pre.models[pre_start * k:]
             if pre_cut is not None:
                 pre_models = pre_models[: pre_cut * k]
             out = out + loaded_booster_contrib(

@@ -132,7 +132,7 @@ class DART(GBDT):
             if not self.uniform_drop:
                 self.sum_weight -= self.tree_weight[i] * (1.0 / denom)
                 self.tree_weight[i] *= factor
-        self._device_trees_cache = None
+        self._invalidate_device_trees()
 
         if not self.uniform_drop:
             self.tree_weight.append(self.shrinkage_rate)

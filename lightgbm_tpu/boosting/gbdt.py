@@ -2134,7 +2134,7 @@ class GBDT:
                     del self.models[-k:]
                     # removal, not append: a cached stack may hold the
                     # popped trees (append-pad cannot repair deletions)
-                    self._device_trees_cache = None
+                    self._invalidate_device_trees()
                 self.iter_ -= 1
                 log.warning("Stopped training because there are no more "
                             "leaves that meet the split requirements")
@@ -2271,7 +2271,7 @@ class GBDT:
             if len(models) > k:
                 models = models[:-k]
                 # removal: drop any cached stack holding the popped tail
-                self._device_trees_cache = None
+                self._invalidate_device_trees()
             self.iter_ -= 1
             log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")
@@ -2373,7 +2373,7 @@ class GBDT:
             host = self.models[len(self.models) - k + cur_tree_id]
             self.apply_tree_to_scores(host, cur_tree_id, -1.0)
         del self.models[len(self.models) - k:]
-        self._device_trees_cache = None
+        self._invalidate_device_trees()
         self.iter_ -= 1
 
     # -- checkpoint / resume (io/checkpoint.py; reference: the model-text
@@ -2470,7 +2470,7 @@ class GBDT:
         with self._trees_mu:
             self.models = list(state["models"])
             self._dev_trees = []
-            self._device_trees_cache = None
+            self._invalidate_device_trees()
         self.iter_ = int(state["iteration"])
         self.shrinkage_rate = float(state["shrinkage_rate"])
         self._init_scores = list(state["init_scores"])
@@ -2605,6 +2605,16 @@ class GBDT:
     #: holds one padded model copy on device; serving uses 1-2 slots)
     _DTC_SLOTS = 8
 
+    def _invalidate_device_trees(self) -> None:
+        """Drop BOTH device model caches — the padded tree stacks AND
+        the TreeSHAP path arrays. Every mutation that invalidates one
+        invalidates the other: a rollback/RF/DART leaf rescale changes
+        leaf values (and expected values) without necessarily changing
+        the tree count, so the paths' cached ``ev`` would silently
+        serve stale contributions if it outlived the stack."""
+        self._device_trees_cache = None
+        self._shap_paths_cache = None
+
     def _device_trees_batched(self, num_iteration: Optional[int] = None,
                               start_iteration: int = 0, tbatch: int = 16):
         """(StackedTrees padded to the tree-count bucket, t_real, depth).
@@ -2690,7 +2700,8 @@ class GBDT:
     def predict_raw_device(self, binned,
                            num_iteration: Optional[int] = None,
                            start_iteration: int = 0,
-                           early_stop=None) -> jax.Array:
+                           early_stop=None,
+                           device_packed: bool = False) -> jax.Array:
         """Raw UNAVERAGED score sums, left on device: [K, n_padded] with
         the first ``binned.shape[0]`` columns valid.
 
@@ -2732,13 +2743,14 @@ class GBDT:
             any_cat=self._pred_any_cat)
         kk = np.int32(k)
         if not isinstance(binned, np.ndarray):
-            # device-array input (internal/test path): pad eagerly when a
-            # rung fits; nibble packing applies to host requests only
+            # device-array input (the serving device-featurize path hands
+            # an already-rung-padded — possibly nibble-packed — matrix;
+            # internal/test callers may pass unpadded, which pads here)
             rung = bucket_rows(n, ladder)
             if rung is not None and rung != n:
                 binned = jnp.pad(binned, ((0, rung - n), (0, 0)))
             return predict_raw_batched(binned, st, nan_a, cat_a, kk,
-                                       packed=False, **kwargs)
+                                       packed=device_packed, **kwargs)
         packed = self._pred_pack4
         rung = bucket_rows(n, ladder)
         if rung is not None:
@@ -2833,6 +2845,174 @@ class GBDT:
                 f"input has {arr.shape[1]} features, model expects "
                 f"{ds.num_total_features}")
         return bin_columns(ds.mappers, arr, ds.binned.dtype)
+
+    # -- serving featurization (ISSUE 13: the one-copy hot path) -------------
+    def _serve_featurize_mode(self) -> str:
+        """Resolved ``tpu_serve_featurize`` for this model: ``device``
+        (default — a serving request is one host->device copy of raw
+        float32, binned by the jitted ops/device_bin.py program) or
+        ``host`` (the bin_columns parity/escape hatch). Demotes to host
+        with a one-time warning when the model cannot take the device
+        featurizer (scan engine, int32-overflowing categorical codes)."""
+        mode = str(self.config.get("tpu_serve_featurize", "device")).lower()
+        if mode not in ("device", "host"):
+            log.warning(f"unrecognized tpu_serve_featurize={mode!r}; "
+                        "using 'device'")
+            mode = "device"
+        if mode == "host":
+            return "host"
+        if self._predict_cfg()[2] == "scan":
+            return "host"        # scan path has no rung padding to key on
+        return "device" if self._featurize_state() is not None else "host"
+
+    def _featurize_state(self):
+        """Device-resident binning state (built once per model), or None
+        when the model is not device-featurizable (warned once)."""
+        cached = getattr(self, "_featurize_dev", None)
+        if cached is not None:
+            return cached if cached != "ineligible" else None
+        from ..io.binning import export_featurize_state
+        from ..ops.device_bin import device_bin_state
+        host_state = export_featurize_state(self.train_set.mappers)
+        if host_state.reason is not None:
+            log.warning(f"tpu_serve_featurize=device unavailable "
+                        f"({host_state.reason}); serving bins on host")
+            self._featurize_dev = "ineligible"
+            return None
+        self._featurize_dev = device_bin_state(host_state)
+        return self._featurize_dev
+
+    def featurize_rung(self, arr32: np.ndarray) -> jax.Array:
+        """Pad a raw float32 request to its bucket rung, upload it (THE
+        one host->device copy of a serving request) and bin it with the
+        jitted featurizer — device-resident bins in the exact layout the
+        host path would produce (pack4 included), ready for
+        predict_raw_device(device_packed=self._pred_pack4)."""
+        from ..ops.device_bin import bin_rows_device
+        ds = self.train_set
+        if arr32.shape[1] != ds.num_total_features:
+            raise ValueError(
+                f"input has {arr32.shape[1]} features, model expects "
+                f"{ds.num_total_features}")
+        n = arr32.shape[0]
+        rung = self._serving_rung(n)
+        if n != rung:
+            arr32 = np.pad(arr32, ((0, rung - n), (0, 0)))
+        state = self._featurize_state()
+        if state is None:
+            raise ValueError("model is not device-featurizable; use the "
+                             "host binner (tpu_serve_featurize=host)")
+        return bin_rows_device(jnp.asarray(arr32), state, np.int32(n),
+                               out_dtype=ds.binned.dtype.name,
+                               packed=self._pred_pack4)
+
+    # -- device TreeSHAP / leaf-index serving (ISSUE 13 endpoints) -----------
+    #: shap-path cache slots (per prediction window; serving uses 1-2)
+    _SHAP_SLOTS = 4
+
+    def _device_shap_state(self, num_iteration: Optional[int],
+                           start_iteration: int, tbatch: int):
+        """(StackedTrees, ShapPaths, t_real, depth) for a window.
+
+        The tree stack comes from the shared append-pad device cache
+        (_device_trees_batched); the per-leaf path arrays are extracted
+        once per (window, model length) and cached — the row-independent
+        half of TreeSHAP, the analogue of the reference computing each
+        tree's decision paths once per PredictContrib call."""
+        from ..ops.treeshap_device import build_shap_paths
+        st, t_real, depth = self._device_trees_batched(
+            num_iteration, start_iteration, tbatch)
+        with self._trees_mu:
+            # slice to the stacked length: a tree appended between the two
+            # mutex sections must not desync paths from the stack
+            models = self._model_window(num_iteration,
+                                        start_iteration)[:t_real]
+            key = (tbatch, start_iteration,
+                   num_iteration if num_iteration is not None
+                   and num_iteration > 0 else None)
+            cache = getattr(self, "_shap_paths_cache", None)
+            if cache is None:
+                cache = self._shap_paths_cache = {}
+            c = cache.get(key)
+            d_bkt = depth_bucket(depth)
+            if c is not None and c["t_real"] == t_real \
+                    and c["d_bkt"] == d_bkt:
+                return st, c["paths"], t_real, depth
+            paths = build_shap_paths(models, st.leaf_value.shape[1], d_bkt,
+                                     pad_to=st.num_trees)
+            cache[key] = {"paths": paths, "t_real": t_real, "d_bkt": d_bkt}
+            while len(cache) > self._SHAP_SLOTS:
+                cache.pop(next(k for k in cache if k != key))
+            return st, paths, t_real, depth
+
+    def _serving_rung(self, n: int) -> int:
+        """Bucket rung for one serving batch, or a structural error when
+        the request overflows the ladder — THE one bounds check shared
+        by the featurize and host-binned serving paths."""
+        _, ladder, _ = self._predict_cfg()
+        rung = bucket_rows(n, ladder)
+        if rung is None:
+            raise ValueError(
+                f"request of {n} rows overflows the serving ladder "
+                f"(max {ladder[-1]}); slice it or raise "
+                "tpu_predict_buckets")
+        return rung
+
+    def _serving_device_request(self, binned, device_packed: bool):
+        """(device matrix at a rung, packed?) for a serving batch that may
+        arrive host-binned (numpy) or device-featurized (jax.Array)."""
+        if not isinstance(binned, np.ndarray):
+            return binned, device_packed
+        rung = self._serving_rung(binned.shape[0])
+        return (self._pad_request_to_bucket(binned, rung, self._pred_pack4),
+                self._pred_pack4)
+
+    def predict_contrib_padded(self, binned,
+                               num_iteration: Optional[int] = None,
+                               start_iteration: int = 0,
+                               device_packed: bool = False) -> np.ndarray:
+        """Exact TreeSHAP contributions [rung, K*(F+1)] via the device
+        engine (ops/treeshap_device.py), rung-padded like
+        predict_serving — the ``pred_contrib`` serving endpoint's one
+        device dispatch. Matches ops/treeshap.py's numpy reference
+        within f32 tolerance and sums to the raw score per row."""
+        from ..ops.treeshap_device import shap_batched
+        k = self.num_tree_per_iteration
+        tb_cfg, _, _ = self._predict_cfg()
+        f = self.train_set.num_total_features
+        st, paths, t_real, depth = self._device_shap_state(
+            num_iteration, start_iteration, tb_cfg)
+        if t_real == 0:
+            return np.zeros((binned.shape[0], k * (f + 1)), np.float32)
+        dev, packed = self._serving_device_request(binned, device_packed)
+        nan_a, cat_a = self._pred_route_args()
+        out = shap_batched(dev, st, paths, nan_a, cat_a, np.int32(k),
+                           num_class=k, depth=depth_bucket(depth),
+                           tbatch=tb_cfg, any_cat=self._pred_any_cat,
+                           packed=packed, num_features=f)
+        arr = np.asarray(out)                     # [K, rung, F+1]
+        return arr.transpose(1, 0, 2).reshape(arr.shape[1], -1)
+
+    def predict_leaf_padded(self, binned,
+                            num_iteration: Optional[int] = None,
+                            start_iteration: int = 0,
+                            device_packed: bool = False) -> np.ndarray:
+        """Per-tree leaf indices [rung, t_real] via the depth walk —
+        the ``pred_leaf`` serving endpoint (reference: PredictLeafIndex).
+        The walk already computes the final node ids for every predict;
+        this returns them rung-padded so per-request slicing stays on
+        the host (the coalescer's zero-recompile contract)."""
+        tb, _, _ = self._predict_cfg()
+        st, t_real, depth = self._device_trees_batched(
+            num_iteration, start_iteration, tb)
+        if t_real == 0:
+            return np.zeros((binned.shape[0], 0), np.int32)
+        dev, packed = self._serving_device_request(binned, device_packed)
+        nan_a, cat_a = self._pred_route_args()
+        lv = predict_leaf_batched(
+            dev, st, nan_a, cat_a, depth=depth_bucket(depth), tbatch=tb,
+            any_cat=self._pred_any_cat, packed=packed)
+        return np.asarray(lv)[:t_real].T          # [rung, t_real]
 
     def predict_raw_matrix(self, arr: np.ndarray,
                            num_iteration: Optional[int] = None,

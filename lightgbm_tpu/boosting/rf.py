@@ -119,7 +119,7 @@ class RF(GBDT):
                         .at[cur_tree_id].add(const) \
                         .at[cur_tree_id].multiply(1.0 / (n_prev + 1.0))
             self.models.append(host)
-            self._device_trees_cache = None
+            self._invalidate_device_trees()
         self.iter_ += 1
         return False
 

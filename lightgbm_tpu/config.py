@@ -305,6 +305,17 @@ PARAMS: Dict[str, Tuple[Any, type, Tuple[str, ...]]] = {
     # batch larger than its largest warmed rung, so the post-warmup
     # serving steady state compiles nothing
     "tpu_serve_warm_max_rows": (16384, int, ("serve_warm_max_rows",)),
+    # serving featurization: "device" (default) bins a request with the
+    # jitted raw->binned program (ops/device_bin.py) so a serving batch
+    # is ONE host->device copy of raw float32; "host" keeps the
+    # bin_columns numpy path (bit-identical parity/escape hatch)
+    "tpu_serve_featurize": ("device", str, ("serve_featurize",)),
+    # endpoints a server warms and accepts through the coalescer ladder:
+    # comma list of predict / leaf / contrib. Warming compiles one
+    # program per (endpoint, rung), so the non-default endpoints are
+    # opt-in; submitting to an unlisted endpoint raises structurally
+    # (serving it cold would compile in the request path)
+    "tpu_serve_endpoints": ("predict", str, ("serve_endpoints",)),
     # fault tolerance (io/checkpoint.py, parallel/multihost.py watchdog,
     # analysis/faultinject.py): atomic full-state snapshots every
     # tpu_checkpoint_freq iterations into tpu_checkpoint_dir (keep-last-k

@@ -21,6 +21,7 @@ layout feeds the histogram matmul kernels (see ops/histogram.py).
 from __future__ import annotations
 
 import os
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -344,6 +345,109 @@ def _find_bin_with_forced(values, total_sample_cnt, max_bin, min_data_in_bin,
     return m
 
 
+# -- serving featurize state export (ops/device_bin.py consumes this) -------
+
+#: int32 sentinel that can never equal a served categorical code (the
+#: device lookup pads its key table with it)
+CAT_PAD = np.int32(np.iinfo(np.int32).min)
+
+
+def round_down_f32(bounds: np.ndarray) -> np.ndarray:
+    """Largest float32 <= each float64 bound.
+
+    The device featurizer compares float32 request values against
+    float32 thresholds; for a float32 value ``v`` and float64 bound
+    ``b``, ``v > b`` (exact, in float64) holds iff ``v > t`` where ``t``
+    is the largest float32 <= ``b`` — so binning float32 requests on
+    device is bit-identical to the host ``bin_columns`` path, which
+    upcasts each comparison to float64. (+/-inf map to themselves /
+    +/-float32-max correctly: a bound beyond float32 range keeps the
+    comparison outcome for every float32 value.)"""
+    b = np.asarray(bounds, np.float64)
+    t = b.astype(np.float32)
+    over = t.astype(np.float64) > b          # rounded UP past the bound
+    if over.any():
+        t = t.copy()
+        t[over] = np.nextafter(t[over], np.float32(-np.inf))
+    return t
+
+
+@dataclass
+class FeaturizeState:
+    """Per-feature binning state stacked into dense arrays, built once at
+    deploy/warm time so a serving tick's raw->binned featurization can
+    run as ONE device program (the reference caches exactly this state in
+    its single-row fast path — ``SingleRowPredictor`` + ``FastConfig``,
+    src/c_api.cpp:117). ``reason`` is non-None when the model cannot take
+    the device featurizer (callers fall back to host ``bin_columns``)."""
+
+    bounds32: np.ndarray        # [F, Kb] f32 round-down thresholds, +inf pad
+    nan_bins: np.ndarray        # [F] i32 (0 for trivial features)
+    is_cat: np.ndarray          # [F] bool
+    cat_keys: np.ndarray        # [F, Kc] i32 sorted, CAT_PAD padded
+    cat_vals: np.ndarray        # [F, Kc] i32, 0 padded
+    reason: Optional[str] = None
+
+
+def export_featurize_state(mappers: Sequence[BinMapper]) -> FeaturizeState:
+    """Stack fitted per-feature mappers for the device featurizer.
+
+    Numerical features keep their interior upper bounds (exactly the
+    array ``value_to_bin``/``bin_columns`` search) as round-down float32
+    thresholds padded to a common width with +inf (padding never counts:
+    no float32 value exceeds +inf). Categorical features keep their
+    sorted (code, bin) tables padded with a sentinel key. A model whose
+    categorical codes overflow int32 cannot be looked up on a
+    float32/int32 device path; the state then carries a ``reason`` and
+    serving stays on the host binner."""
+    f = len(mappers)
+    num_bounds = [_interior_bounds(m) if not (m.is_trivial or m.is_categorical)
+                  else np.empty(0) for m in mappers]
+    kb = max((len(b) for b in num_bounds), default=0)
+    bounds32 = np.full((f, max(kb, 1)), np.inf, np.float32)
+    for j, b in enumerate(num_bounds):
+        if len(b):
+            bounds32[j, : len(b)] = round_down_f32(b)
+    nan_bins = np.array([0 if m.is_trivial else m.nan_bin for m in mappers],
+                        np.int32)
+    is_cat = np.array([m.is_categorical and not m.is_trivial
+                       for m in mappers], bool)
+    cat_tables = []
+    reason = None
+    for j, m in enumerate(mappers):
+        if is_cat[j] and len(m.cat_to_bin):
+            keys, vals = m._cat_lookup()
+            if keys.size and (keys.max() > np.iinfo(np.int32).max
+                              or keys.min() < np.iinfo(np.int32).min + 1):
+                reason = (f"categorical feature {j} has codes outside "
+                          "int32; device featurization cannot represent "
+                          "its lookup keys")
+            cat_tables.append((j, keys, vals))
+    kc = max((len(k) for _, k, _ in cat_tables), default=0)
+    cat_keys = np.full((f, max(kc, 1)), CAT_PAD, np.int32)
+    cat_vals = np.zeros((f, max(kc, 1)), np.int32)
+    if reason is None:
+        for j, keys, vals in cat_tables:
+            cat_keys[j, : len(keys)] = keys.astype(np.int32)
+            cat_vals[j, : len(vals)] = vals.astype(np.int32)
+    return FeaturizeState(bounds32, nan_bins, is_cat, cat_keys, cat_vals,
+                          reason)
+
+
+# host featurize call counter: the serving steady-state guard asserts the
+# device-featurize path does NO per-tick host binning work (tests read
+# host_featurize_calls() around a traffic window). Locked: bin_columns is
+# callable from concurrent serving/construct threads and a torn
+# read-modify-write would let the guard under-count
+_HOST_CALLS = 0
+_HOST_CALLS_MU = threading.Lock()
+
+
+def host_featurize_calls() -> int:
+    with _HOST_CALLS_MU:
+        return _HOST_CALLS
+
+
 # row-chunk x column-chunk x bounds budget for the batched compare
 # (bool intermediates, ~4MB a piece — cache-resident)
 _BATCH_ELEMS = 1 << 22
@@ -391,6 +495,9 @@ def bin_columns(mappers: Sequence[BinMapper], arr: np.ndarray,
     float32 input is never promoted to a float64 matrix (each comparison
     upcasts exactly), so results are bit-identical to the scalar path.
     """
+    global _HOST_CALLS
+    with _HOST_CALLS_MU:
+        _HOST_CALLS += 1
     from ..obs.spans import span
     with span("binning"):
         return _bin_columns(mappers, arr, dtype, row_chunk, workers)

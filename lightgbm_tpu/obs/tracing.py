@@ -58,7 +58,7 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 SPAN_TAXONOMY = (
     "binning", "gradient", "hist_build", "collective_reduce", "split_scan",
     "partition", "checkpoint_write", "predict_warmup", "serve_tick",
-    "autotune",
+    "autotune", "featurize", "contrib",
 )
 
 #: HLO opcode/name fragments that mean "communication"

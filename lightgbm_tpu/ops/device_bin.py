@@ -1,0 +1,117 @@
+"""On-device featurization: raw float32 request rows -> bin codes.
+
+The serving hot path's missing half (ISSUE 13 / ROADMAP item 3): before
+this module every coalescer tick ran ``io/binning.bin_columns`` — a numpy
+searchsorted sweep — on the host, so a "one device dispatch per tick"
+server still paid O(rows * features) host work per tick. Here the
+per-feature binning state (interior upper bounds, NaN / MissingType-Zero
+handling, categorical code->bin lookup) is stacked once into
+device-resident arrays (io/binning.export_featurize_state — the analogue
+of the reference's cached single-row fast-path state, ``SingleRowPredictor``
++ ``FastConfig``, src/c_api.cpp:117) and a request becomes ONE host->device
+copy of raw float32 followed by one jitted program:
+
+  * numerical: ``sum(value > bounds)`` per feature — the broadcast
+    compare-and-sum that equals ``np.searchsorted(bounds, v, 'left')``
+    exactly, the same trick ``bin_columns`` uses on the host. Bounds are
+    round-down float32 thresholds (io/binning.round_down_f32), so for
+    float32 requests the device bins are bit-identical to the host path's
+    float64-upcast comparisons;
+  * NaN rows overwrite with the per-feature nan bin (which for
+    MissingType Zero IS the zero bin — the same fill ``bin_columns``
+    applies);
+  * categorical: equality-match against the per-feature sorted code
+    table (padded with a sentinel no request can produce); codes outside
+    int32 or non-finite values map to bin 0, like the host lookup;
+  * optional 4-bit nibble packing (``pack4_device``) so a pack4-serving
+    model's featurized matrix enters the predict walk in the SAME packed
+    layout the host path produces with io/dataset.pack4_matrix.
+
+The program is keyed on the (row rung, feature count, state widths)
+shapes only — all rung-padded by the caller — so a warmed serving ladder
+compiles one featurize program per rung and the coalescer tick lowers
+nothing new.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class DeviceBinState(NamedTuple):
+    """Device-resident twin of io/binning.FeaturizeState."""
+
+    bounds32: jax.Array      # [F, Kb] f32 round-down thresholds, +inf pad
+    nan_bins: jax.Array      # [F] i32
+    is_cat: jax.Array        # [F] bool
+    cat_keys: jax.Array      # [F, Kc] i32, CAT_PAD padded
+    cat_vals: jax.Array      # [F, Kc] i32, 0 padded
+
+
+def device_bin_state(state) -> DeviceBinState:
+    """Upload a host FeaturizeState once (deploy/warm time, not per tick)."""
+    if state.reason is not None:
+        raise ValueError(f"model is not device-featurizable: {state.reason}")
+    return DeviceBinState(
+        jnp.asarray(state.bounds32), jnp.asarray(state.nan_bins),
+        jnp.asarray(state.is_cat), jnp.asarray(state.cat_keys),
+        jnp.asarray(state.cat_vals))
+
+
+def pack4_device(bins: jax.Array) -> jax.Array:
+    """[N, F] u8 (< 16) -> [N, ceil(F/2)] u8, the io/dataset.pack4_matrix
+    layout (column 2j in the low nibble, 2j+1 in the high nibble) so the
+    predict walk's nibble gather (ops/packed.gather_bin) inverts it."""
+    if bins.shape[1] % 2:
+        bins = jnp.pad(bins, ((0, 0), (0, 1)))
+    return bins[:, 0::2] | (bins[:, 1::2] << 4)
+
+
+#: float32 values with |v| >= 2**31 cannot be categorical codes; the host
+#: lookup int64-casts them to values no table contains, the device path
+#: masks them to "no match" before its int32 cast
+_CAT_RANGE = 2.0 ** 31
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype", "packed"))
+def bin_rows_device(raw: jax.Array, state: DeviceBinState,
+                    n_valid: jax.Array,
+                    out_dtype: str = "uint8",
+                    packed: bool = False) -> jax.Array:
+    """Featurize rung-padded raw rows on device: [N, F] f32 -> bin codes.
+
+    Returns [N, F] ``out_dtype`` (or [N, ceil(F/2)] u8 when ``packed``),
+    bit-identical to ``bin_columns(mappers, raw_f32)`` on the host —
+    the device/host parity contract tests/test_device_serving.py pins
+    across NaN, MissingType-Zero, categorical, EFB-bundled and
+    pack4-stored models. ``n_valid`` (traced, so it never keys the jit
+    cache) zeroes the padding rows' bins, exactly what the host path's
+    pad-after-binning produces — device and host featurize are then
+    byte-identical on the FULL padded rung, tail included.
+    """
+    from ..obs.spans import span
+    with span("featurize"):
+        nan_mask = jnp.isnan(raw)
+        # numerical: sum(bounds < v) == searchsorted(bounds, v, 'left');
+        # the +inf padding never counts, so ragged bound lists batch
+        num = (raw[:, :, None] > state.bounds32[None, :, :]).sum(
+            axis=2, dtype=jnp.int32)
+        num = jnp.where(nan_mask, state.nan_bins[None, :], num)
+        # categorical: exact equality against the sorted code table
+        # (codes are unique per feature, so the masked sum IS the match)
+        in_range = jnp.isfinite(raw) & (jnp.abs(raw) < _CAT_RANGE)
+        iv = jnp.where(in_range, raw, 0.0).astype(jnp.int32)
+        hit = (state.cat_keys[None, :, :] == iv[:, :, None]) \
+            & in_range[:, :, None]
+        cat = jnp.sum(jnp.where(hit, state.cat_vals[None, :, :], 0),
+                      axis=2, dtype=jnp.int32)
+        bins = jnp.where(state.is_cat[None, :], cat, num)
+        live = jnp.arange(raw.shape[0], dtype=jnp.int32) < n_valid
+        bins = jnp.where(live[:, None], bins, 0)
+        bins = bins.astype(jnp.dtype(out_dtype))
+        if packed:
+            bins = pack4_device(bins)
+        return bins

@@ -57,9 +57,10 @@ class ServeFuture:
     _GRACE_S = 5.0
 
     def __init__(self, arr, deadline_s: Optional[float],
-                 deadline_ms: float):
+                 deadline_ms: float, kind: str = "predict"):
         self.arr = arr                      # [n, F] float request rows
         self.n = int(arr.shape[0])
+        self.kind = kind                    # predict | leaf | contrib
         self.deadline = (time.monotonic() + deadline_s
                          if deadline_s is not None else None)
         self.deadline_ms = deadline_ms
@@ -169,7 +170,7 @@ class MicroBatchCoalescer:
 
     # -- admission (any thread) ---------------------------------------------
     def submit(self, arr, deadline_s: Optional[float],
-               deadline_ms: float) -> ServeFuture:
+               deadline_ms: float, kind: str = "predict") -> ServeFuture:
         n = int(arr.shape[0])
         if n < 1:
             raise ValueError("empty request (0 rows)")
@@ -186,7 +187,7 @@ class MicroBatchCoalescer:
                 f"request of {n} rows exceeds the admission bound "
                 f"(tpu_serve_queue_max={self._queue_max_rows}); slice it "
                 "or raise the bound")
-        fut = ServeFuture(arr, deadline_s, deadline_ms)
+        fut = ServeFuture(arr, deadline_s, deadline_ms, kind)
         with self._cv:
             if self._closing or self._closed:
                 raise ServerClosed("server is draining/closed; "
@@ -278,6 +279,12 @@ class MicroBatchCoalescer:
                         f"({self._max_batch_rows}) after a model swap; "
                         "resubmit in smaller slices"))
                     continue
+                if batch and r.kind != batch[0].kind:
+                    # one endpoint per tick: a batch is ONE device
+                    # dispatch, and predict/leaf/contrib are distinct
+                    # programs — mixed traffic serves FIFO on
+                    # consecutive ticks instead of splitting a tick
+                    break
                 if batch and rows + r.n > self._max_batch_rows:
                     break                   # next tick's batch
                 self._q.popleft()

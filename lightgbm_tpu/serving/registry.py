@@ -210,9 +210,11 @@ class ModelRegistry:
             self._deploy_mu.release()
         self.swaps += 1
         flight.note("swap_committed", version=version,
-                    rungs=len(warm_stats.get("rungs") or []))
+                    rungs=len(warm_stats.get("rungs") or []),
+                    endpoints=",".join(warm_stats.get("endpoints") or ()))
         log.info(f"[serving] model {version!r} active "
-                 f"(warmed rungs: {warm_stats.get('rungs')})")
+                 f"(warmed rungs: {warm_stats.get('rungs')}, endpoints: "
+                 f"{warm_stats.get('endpoints')})")
         return warm_stats
 
     def _health_check(self, booster, version: str) -> None:

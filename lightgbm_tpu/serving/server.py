@@ -56,6 +56,7 @@ class PredictionServer:
         _, active = self._registry.active()     # requires a deployed model
         cfg = active._gbdt.config
         self._fault_config = cfg
+        self._endpoints = active._serve_endpoints()
         tick_ms = (float(cfg.get("tpu_serve_tick_ms", 5.0))
                    if tick_ms is None else float(tick_ms))
         queue_max = (int(cfg.get("tpu_serve_queue_max", 8192))
@@ -106,21 +107,36 @@ class PredictionServer:
         return int(max(warmup_rungs(ladder, self._warm_max_rows)))
 
     # -- request path --------------------------------------------------------
-    def submit(self, data, deadline_ms: Optional[float] = None
-               ) -> ServeFuture:
+    def submit(self, data, deadline_ms: Optional[float] = None,
+               kind: str = "predict") -> ServeFuture:
         """Enqueue one request; returns its :class:`ServeFuture`.
+
+        ``kind`` selects the endpoint: ``predict`` (scores), ``leaf``
+        (per-tree leaf indices, reference PredictLeafIndex) or
+        ``contrib`` (exact TreeSHAP contributions) — all through the
+        same coalescer/deadline/ladder machinery, one device dispatch
+        per tick. Endpoints are warmed per ``tpu_serve_endpoints``;
+        submitting to an unlisted one raises structurally (serving it
+        cold would compile in the request path).
 
         Raises structured errors at the admission edge:
         ``ServerOverloaded`` (bounded queue full), ``ServerClosed``
-        (draining), ``ValueError`` (shape/size). ``deadline_ms``
+        (draining), ``ValueError`` (shape/size/endpoint). ``deadline_ms``
         overrides ``tpu_serve_deadline_ms``; ``<= 0`` disables the
         deadline for this request (the future still bounds its own
         ``result()`` wait)."""
+        if kind not in self._endpoints:
+            raise ValueError(
+                f"endpoint {kind!r} is not enabled on the active model "
+                f"(tpu_serve_endpoints={','.join(self._endpoints)}); "
+                "serving it unwarmed would compile in the request path")
         active_plan(self._fault_config).fire("request")
         # snapshot the request: submit is async, and np.asarray aliases a
-        # caller-owned float64 buffer — a client reusing its buffer would
-        # otherwise have queued requests served with overwritten rows
-        arr = np.array(data, dtype=np.float64, copy=True)
+        # caller-owned buffer — a client reusing its buffer would
+        # otherwise have queued requests served with overwritten rows.
+        # float32 IS the serving wire format (predict_serving casts
+        # anyway; copying f32 here halves the queue's footprint)
+        arr = np.array(data, dtype=np.float32, copy=True)
         if arr.ndim == 1:
             arr = arr.reshape(1, -1)
         if arr.ndim != 2 or arr.shape[1] != self._n_features:
@@ -131,19 +147,45 @@ class PredictionServer:
             deadline_ms = self._deadline_ms
         deadline_s = (deadline_ms / 1000.0) if deadline_ms > 0 else None
         return self._coalescer.submit(
-            arr, deadline_s, deadline_ms if deadline_ms > 0 else 0.0)
+            arr, deadline_s, deadline_ms if deadline_ms > 0 else 0.0, kind)
+
+    def submit_leaf(self, data, deadline_ms: Optional[float] = None
+                    ) -> ServeFuture:
+        """Enqueue one ``pred_leaf`` request (leaf-index embeddings)."""
+        return self.submit(data, deadline_ms, kind="leaf")
+
+    def submit_contrib(self, data, deadline_ms: Optional[float] = None
+                       ) -> ServeFuture:
+        """Enqueue one ``pred_contrib`` request (exact TreeSHAP)."""
+        return self.submit(data, deadline_ms, kind="contrib")
 
     def predict(self, data, deadline_ms: Optional[float] = None,
                 timeout: Optional[float] = None):
         """Synchronous convenience: ``submit(...).result(...)`` —
         micro-batched with every other in-flight request, equal to the
-        active booster's ``predict(data)``."""
+        active booster's ``predict(float32(data))`` (float32 is the
+        serving wire format; ``submit`` casts there)."""
         return self.submit(data, deadline_ms).result(timeout=timeout)
+
+    def predict_leaf(self, data, deadline_ms: Optional[float] = None,
+                     timeout: Optional[float] = None):
+        """Synchronous ``pred_leaf``: equals the active booster's
+        ``predict(float32(data), pred_leaf=True)``."""
+        return self.submit_leaf(data, deadline_ms).result(timeout=timeout)
+
+    def predict_contrib(self, data, deadline_ms: Optional[float] = None,
+                        timeout: Optional[float] = None):
+        """Synchronous ``pred_contrib``: the device TreeSHAP twin of the
+        active booster's ``predict(float32(data), pred_contrib=True)``
+        (matches within documented f32 tolerance)."""
+        return self.submit_contrib(data, deadline_ms).result(timeout=timeout)
 
     def _serve_batch(self, batch) -> None:
         """One tick: pin ONE model snapshot, run the concatenated batch
         through the device engine at a warmed rung, slice per-request
-        rows on the host. A request is never split across models."""
+        rows on the host. A request is never split across models; the
+        coalescer pops homogeneous-kind batches, so one tick is one
+        endpoint's single device dispatch."""
         version, booster = self._registry.active()
         rows = sum(r.n for r in batch)
         if rows > self._resolve_max_batch(booster, version):
@@ -157,11 +199,27 @@ class PredictionServer:
                 f"batch of {rows} rows exceeds model {version!r}'s "
                 "largest warmed rung (hot-swap landed mid-tick); "
                 "resubmit")
+        kind = batch[0].kind
+        if kind not in booster._serve_endpoints():
+            # admitted under the PREVIOUS model's endpoint set and a swap
+            # landed before this pin: the new model never warmed this
+            # kind's programs, so serving it would compile in the request
+            # path — fail structurally, like the oversized-rows case
+            from .errors import ServingError
+            raise ServingError(
+                f"endpoint {kind!r} is not enabled on model {version!r} "
+                "(hot-swap landed mid-queue); resubmit against the new "
+                "model's tpu_serve_endpoints")
         if len(batch) == 1:
             x = batch[0].arr
         else:
             x = np.concatenate([r.arr for r in batch], axis=0)
-        out, _ = booster.predict_serving(x, raw_score=self._raw_score)
+        if kind == "leaf":
+            out, _ = booster.predict_leaf_serving(x)
+        elif kind == "contrib":
+            out, _ = booster.predict_contrib_serving(x)
+        else:
+            out, _ = booster.predict_serving(x, raw_score=self._raw_score)
         off = 0
         for r in batch:
             # copy: the padded rung buffer must not stay pinned by views
@@ -200,6 +258,7 @@ class PredictionServer:
         with self._mu:
             self._n_features = active._gbdt.train_set.num_total_features
             self._fault_config = active._gbdt.config
+            self._endpoints = active._serve_endpoints()
             self._coalescer.set_fault_config(active._gbdt.config)
             self._coalescer.set_max_batch_rows(
                 self._resolve_max_batch(active))
@@ -227,6 +286,7 @@ class PredictionServer:
             "active_version": active,
             "versions": self._registry.versions(),
             "warm_rungs": list(warm.get("rungs") or []),
+            "endpoints": list(self._endpoints),
             "queue_depth_rows": self._coalescer.queue_depth_rows(),
             "max_batch_rows": self._coalescer.max_batch_rows,
             "worker_alive": self._coalescer.worker_alive(),

@@ -1,0 +1,373 @@
+"""Device-resident serving hot path (ISSUE 13).
+
+Acceptance surface: (1) the device featurizer (ops/device_bin.py) is
+bit-identical to the host ``bin_columns`` path across NaN /
+MissingType-Zero / categorical / EFB-bundled / pack4-stored models and
+non-rung row counts — so a serving request is ONE host->device copy of
+raw float32; (2) the device TreeSHAP engine (ops/treeshap_device.py)
+matches the numpy reference (ops/treeshap.py) within f32 tolerance and
+sums to the raw score, multiclass and windowed models included; (3) the
+``pred_leaf`` endpoint equals reference routing bit-for-bit; (4) the
+steady state serves mixed batch sizes and a mid-stream hot-swap on all
+three endpoints with 0 recompiles and 0 host featurize calls.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.analysis import guards
+from lightgbm_tpu.io import binning
+
+from utils import FAST_PARAMS, binary_data, multiclass_data
+
+#: tiny two-rung ladder: warmup compiles two programs per endpoint
+LADDER = "32,128"
+
+
+def _params(**kw):
+    return dict(FAST_PARAMS, objective="binary", verbosity=-1,
+                tpu_predict_buckets=LADDER, **kw)
+
+
+def _featurize_both(bst, x32):
+    """(host bins [n, F], device bins [rung, ...]) for one f32 request."""
+    g = bst._gbdt
+    return g.bin_matrix(x32), np.asarray(g.featurize_rung(x32))
+
+
+@pytest.fixture(scope="module")
+def nan_booster():
+    X, y = binary_data()
+    X = X.copy()
+    X[::7, 3] = np.nan                       # MissingType NaN on col 3
+    bst = lgb.train(_params(), lgb.Dataset(X, label=y), 8)
+    return bst, X
+
+
+# ---------------------------------------------------- featurize bit-parity
+def test_featurize_parity_nan(nan_booster):
+    bst, X = nan_booster
+    x = X[:50].astype(np.float32)
+    host, dev = _featurize_both(bst, x)
+    assert dev.shape[0] == 128               # padded to the rung
+    np.testing.assert_array_equal(dev[:50], host)
+    assert not dev[50:].any()                # pad rows bin to 0, like host
+
+
+def test_featurize_parity_missing_zero():
+    X, y = binary_data()
+    X = X.copy()
+    X[::5, 2] = np.nan
+    p = _params(zero_as_missing=True)
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p), 5)
+    ms = bst._gbdt.train_set.mappers
+    assert any(m.missing_type == binning.MISSING_ZERO for m in ms)
+    x = X[:30].astype(np.float32)
+    host, dev = _featurize_both(bst, x)
+    np.testing.assert_array_equal(dev[:30], host)
+
+
+def test_featurize_parity_categorical_edge_values():
+    rng = np.random.RandomState(3)
+    X, y = binary_data()
+    Xc = X.copy()
+    Xc[:, 5] = rng.randint(0, 8, len(X))
+    p = _params()
+    bst = lgb.train(p, lgb.Dataset(Xc, label=y, params=p,
+                                   categorical_feature=[5]), 6)
+    assert bst._gbdt.train_set.mappers[5].is_categorical
+    q = Xc[:40].copy()
+    q[0, 5] = 999.0                          # unseen category -> bin 0
+    q[1, 5] = -3.0                           # negative code -> bin 0
+    q[2, 5] = np.inf                         # non-finite -> bin 0
+    q[3, 5] = np.nan
+    q[4, 5] = 3.7                            # truncates toward zero
+    q[5, 5] = 4.0e9                          # outside int32 -> no match
+    host, dev = _featurize_both(bst, q.astype(np.float32))
+    np.testing.assert_array_equal(dev[:40], host)
+
+
+def test_featurize_parity_efb_bundled():
+    """EFB-bundled TRAINING matrix; prediction inputs bin per ORIGINAL
+    feature, and the device featurizer must match that layout."""
+    rng = np.random.RandomState(2)
+    n, groups, card = 600, 50, 6             # 300 one-hot cols (EFB >= 256)
+    X = np.zeros((n, groups * card), np.float64)
+    for g in range(groups):
+        X[np.arange(n), g * card + rng.randint(0, card, n)] = 1.0
+    y = (X[:, ::card].sum(1) + 0.3 * rng.randn(n) > 0.5).astype(np.float64)
+    p = _params(enable_bundle=True)
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p), 6)
+    assert bst._gbdt._efb is not None, "test did not exercise EFB"
+    x = X[:25].astype(np.float32)
+    host, dev = _featurize_both(bst, x)
+    np.testing.assert_array_equal(dev[:25], host)
+    out, nv = bst.predict_serving(X[:25])
+    np.testing.assert_array_equal(out[:nv], bst.predict(x))
+
+
+def test_featurize_parity_pack4_packed_layout():
+    X, y = binary_data()
+    p = _params(max_bin=15, tpu_bin_pack4=True)
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p), 6)
+    assert bst._gbdt._pred_pack4
+    from lightgbm_tpu.io.dataset import pack4_matrix
+    x = X[:40].astype(np.float32)
+    host, dev = _featurize_both(bst, x)
+    padded = np.zeros((128, host.shape[1]), host.dtype)
+    padded[:40] = host
+    np.testing.assert_array_equal(dev, pack4_matrix(padded))
+    out, nv = bst.predict_serving(X[:40])
+    np.testing.assert_array_equal(out[:nv], bst.predict(x))
+
+
+def test_featurize_non_rung_row_counts(nan_booster):
+    bst, X = nan_booster
+    for n in (1, 31, 32, 33, 100):
+        x = X[:n].astype(np.float32)
+        host, dev = _featurize_both(bst, x)
+        np.testing.assert_array_equal(dev[:n], host)
+        out, nv = bst.predict_serving(X[:n])
+        assert nv == n
+        np.testing.assert_array_equal(out[:n], bst.predict(x))
+
+
+def test_featurize_host_escape_hatch_byte_identical(nan_booster):
+    """tpu_serve_featurize=host is a PARITY hatch: flipping it changes
+    nothing, padding rows included."""
+    bst, X = nan_booster
+    g = bst._gbdt
+    out_d, _ = bst.predict_serving(X[:40])
+    g.config.set({"tpu_serve_featurize": "host"})
+    try:
+        out_h, _ = bst.predict_serving(X[:40])
+    finally:
+        g.config.set({"tpu_serve_featurize": "device"})
+    np.testing.assert_array_equal(out_d, out_h)
+
+
+def test_featurize_ineligible_categorical_falls_back_to_host():
+    """Categorical codes outside int32 cannot be looked up on device;
+    serving demotes to the host binner and still answers correctly."""
+    rng = np.random.RandomState(4)
+    X, y = binary_data()
+    Xc = X.copy()
+    Xc[:, 0] = rng.choice([3.0e9, 4.0e9, 5.0e9], len(X))
+    p = _params()
+    bst = lgb.train(p, lgb.Dataset(Xc, label=y, params=p,
+                                   categorical_feature=[0]), 4)
+    g = bst._gbdt
+    assert g.train_set.mappers[0].is_categorical
+    assert g._serve_featurize_mode() == "host"
+    with pytest.raises(ValueError, match="not device-featurizable"):
+        g.featurize_rung(Xc[:4].astype(np.float32))
+    out, nv = bst.predict_serving(Xc[:10])
+    np.testing.assert_array_equal(out[:nv],
+                                  bst.predict(Xc[:10].astype(np.float32)))
+
+
+# ------------------------------------------------------- device TreeSHAP
+def test_device_treeshap_matches_numpy_reference(nan_booster):
+    bst, X = nan_booster
+    x = X[:40].astype(np.float32)
+    contrib, nv = bst.predict_contrib_serving(x)
+    ref = bst.predict(x, pred_contrib=True)
+    np.testing.assert_allclose(contrib[:nv], ref, rtol=2e-5, atol=2e-5)
+    raw = bst.predict(x, raw_score=True)
+    np.testing.assert_allclose(contrib[:nv].sum(axis=1), raw,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_device_treeshap_categorical():
+    rng = np.random.RandomState(5)
+    X, _ = binary_data()
+    Xc = X.copy()
+    Xc[:, 4] = rng.randint(0, 6, len(X))
+    # category drives the label so the trees actually split on it
+    y = (np.isin(Xc[:, 4], (1, 3, 5)).astype(float)
+         + 0.3 * X[:, 1] > 0.6).astype(np.float64)
+    p = _params()
+    bst = lgb.train(p, lgb.Dataset(Xc, label=y, params=p,
+                                   categorical_feature=[4]), 8)
+    assert any(np.any(m.cat_bitset) for m in bst._gbdt.models), \
+        "test did not exercise categorical splits"
+    x = Xc[:30].astype(np.float32)
+    contrib, nv = bst.predict_contrib_serving(x)
+    ref = bst.predict(x, pred_contrib=True)
+    np.testing.assert_allclose(contrib[:nv], ref, rtol=2e-5, atol=2e-5)
+
+
+def test_device_treeshap_multiclass_and_sum():
+    X, y = multiclass_data()
+    p = dict(FAST_PARAMS, objective="multiclass", num_class=3,
+             tpu_predict_buckets=LADDER, verbosity=-1)
+    bst = lgb.train(p, lgb.Dataset(X, label=y), 4)
+    x = X[:20].astype(np.float32)
+    contrib, nv = bst.predict_contrib_serving(x)
+    ref = bst.predict(x, pred_contrib=True)
+    np.testing.assert_allclose(contrib[:nv], ref, rtol=2e-5, atol=2e-5)
+    raw = bst.predict(x, raw_score=True)                 # [n, K]
+    sums = contrib[:nv].reshape(nv, 3, -1).sum(axis=2)
+    np.testing.assert_allclose(sums, raw, rtol=1e-5, atol=1e-5)
+
+
+def test_device_treeshap_windowed_model(nan_booster):
+    bst, X = nan_booster
+    x = X[:25].astype(np.float32)
+    for kw in ({"num_iteration": 3}, {"start_iteration": 2},
+               {"start_iteration": 2, "num_iteration": 3}):
+        dev, nv = bst.predict_contrib_serving(x, **kw)
+        ref = bst.predict(x, pred_contrib=True, **kw)
+        np.testing.assert_allclose(dev[:nv], ref, rtol=2e-5, atol=2e-5)
+
+
+# ------------------------------------- pred_contrib start_iteration lift
+def test_pred_contrib_start_iteration_additivity(nan_booster):
+    """SHAP is additive over trees: the window pieces sum EXACTLY (f64
+    host path) to the full model's contributions."""
+    bst, X = nan_booster
+    x = X[:20]
+    full = bst.predict(x, pred_contrib=True)
+    head = bst.predict(x, pred_contrib=True, num_iteration=3)
+    tail = bst.predict(x, pred_contrib=True, start_iteration=3)
+    np.testing.assert_allclose(head + tail, full, rtol=1e-12, atol=1e-12)
+    mid = bst.predict(x, pred_contrib=True, start_iteration=3,
+                      num_iteration=2)
+    tail2 = bst.predict(x, pred_contrib=True, start_iteration=5)
+    np.testing.assert_allclose(head + mid + tail2, full,
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_pred_contrib_start_iteration_loaded_model(nan_booster):
+    """The model-only (loaded-from-text) contrib path windows the same
+    way — raw-value routing, same additivity."""
+    bst, X = nan_booster
+    loaded = lgb.Booster(model_str=bst.model_to_string())
+    x = X[:15]
+    full = loaded.predict(x, pred_contrib=True)
+    head = loaded.predict(x, pred_contrib=True, num_iteration=3)
+    tail = loaded.predict(x, pred_contrib=True, start_iteration=3)
+    np.testing.assert_allclose(head + tail, full, rtol=1e-12, atol=1e-12)
+
+
+# ----------------------------------------------------- pred_leaf endpoint
+def test_pred_leaf_serving_parity(nan_booster):
+    bst, X = nan_booster
+    x = X[:40].astype(np.float32)
+    leaves, nv = bst.predict_leaf_serving(x)
+    assert leaves.shape == (128, bst.num_trees())
+    np.testing.assert_array_equal(leaves[:nv],
+                                  bst.predict(x, pred_leaf=True))
+    # windowed
+    lw, nv = bst.predict_leaf_serving(x, start_iteration=2,
+                                      num_iteration=3)
+    np.testing.assert_array_equal(
+        lw[:nv], bst.predict(x, pred_leaf=True, start_iteration=2,
+                             num_iteration=3))
+
+
+# --------------------------------------------- endpoints through the server
+@pytest.fixture(scope="module")
+def endpoint_boosters():
+    X, y = binary_data()
+    p = _params(tpu_serve_endpoints="predict,leaf,contrib")
+    b1 = lgb.train(p, lgb.Dataset(X, label=y, params=p), 8)
+    b2 = lgb.train(p, lgb.Dataset(X, label=y, params=p), 8)
+    return b1, b2, X
+
+
+def test_endpoints_served_through_coalescer(endpoint_boosters):
+    b1, _, X = endpoint_boosters
+    srv = b1.serve(tick_ms=1.0, deadline_ms=5000.0)
+    try:
+        assert sorted(srv.health()["endpoints"]) == \
+            ["contrib", "leaf", "predict"]
+        x32 = X[:20].astype(np.float32)
+        np.testing.assert_array_equal(srv.predict(X[:20]),
+                                      b1.predict(x32))
+        np.testing.assert_array_equal(srv.predict_leaf(X[:20]),
+                                      b1.predict(x32, pred_leaf=True))
+        np.testing.assert_allclose(srv.predict_contrib(X[:20]),
+                                   b1.predict(x32, pred_contrib=True),
+                                   rtol=2e-5, atol=2e-5)
+        warm = srv.registry.warm_stats()
+        assert sorted(warm["endpoints"]) == ["contrib", "leaf", "predict"]
+    finally:
+        srv.close(drain=True)
+
+
+def test_unlisted_endpoint_rejected_structurally():
+    X, y = binary_data()
+    bst = lgb.train(_params(), lgb.Dataset(X, label=y), 3)
+    srv = bst.serve(tick_ms=1.0)
+    try:
+        with pytest.raises(ValueError, match="tpu_serve_endpoints"):
+            srv.predict_contrib(X[:3])
+        with pytest.raises(ValueError, match="tpu_serve_endpoints"):
+            srv.submit_leaf(X[:3])
+    finally:
+        srv.close(drain=False, timeout_s=5.0)
+
+
+def test_queued_kind_unserved_by_swapped_model_fails_structurally(
+        endpoint_boosters):
+    """A contrib request admitted under model A must not be served COLD
+    by a swapped-in model whose endpoints exclude contrib (compiling in
+    the request path); it fails structurally like the oversized-rows
+    case."""
+    from lightgbm_tpu.serving import ServingError
+    from lightgbm_tpu.serving.coalescer import ServeFuture
+    b1, _, X = endpoint_boosters
+    p = _params()                              # default: predict only
+    bp = lgb.train(p, lgb.Dataset(X, label=(X[:, 0] > 0).astype(float),
+                                  params=p), 3)
+    srv = b1.serve(tick_ms=1.0)
+    try:
+        srv.deploy("v2", bp)
+        # a future that was queued BEFORE the swap (kind now unserved)
+        fut = ServeFuture(X[:3].astype(np.float32), 5.0, 5000.0,
+                          kind="contrib")
+        with pytest.raises(ServingError, match="tpu_serve_endpoints"):
+            srv._serve_batch([fut])
+        # and fresh submits are rejected at the admission edge
+        with pytest.raises(ValueError, match="tpu_serve_endpoints"):
+            srv.submit_contrib(X[:3])
+    finally:
+        srv.close(drain=False, timeout_s=5.0)
+
+
+def test_steady_state_guard_all_endpoints_with_hot_swap(endpoint_boosters):
+    """THE acceptance guard: after warmup, mixed batch sizes on all
+    three endpoints — across a mid-stream hot-swap — compile NOTHING
+    and do NO host featurization work."""
+    b1, b2, X = endpoint_boosters
+    srv = b1.serve(tick_ms=1.0, deadline_ms=5000.0)
+    try:
+        # prime every (endpoint, rung) program once
+        for s in (3, 40):
+            srv.predict(X[:s]); srv.predict_leaf(X[:s])
+            srv.predict_contrib(X[:s])
+        host0 = binning.host_featurize_calls()
+        with guards.compile_counter() as cc:
+            futs = []
+            for s in (1, 17, 32, 100):
+                futs += [srv.submit(X[:s]), srv.submit_leaf(X[:s]),
+                         srv.submit_contrib(X[:s])]
+            for f in futs:
+                f.result()
+            srv.deploy("v2", b2)            # mid-stream hot-swap
+            futs = []
+            for s in (5, 64):
+                futs += [srv.submit(X[:s]), srv.submit_leaf(X[:s]),
+                         srv.submit_contrib(X[:s])]
+            versions = {f.result() is not None and f.version
+                        for f in futs}
+        assert cc.lowerings == 0, \
+            f"steady serving lowered {cc.lowerings} programs"
+        assert binning.host_featurize_calls() == host0, \
+            "steady serving did host featurization work"
+        assert versions == {"v2"}
+        x32 = X[:5].astype(np.float32)
+        np.testing.assert_array_equal(srv.predict(X[:5]), b2.predict(x32))
+    finally:
+        srv.close(drain=True)

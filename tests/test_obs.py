@@ -264,12 +264,15 @@ def test_taxonomy_trace_metrics_acceptance(tmp_path, monkeypatch):
     }
     bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
     # serving side of the taxonomy: warm the ladder + one coalesced tick
+    # (the default device featurizer traces the `featurize` span) + one
+    # pred_contrib call for the `contrib` span
     with spans.trace_session(None, "annotations"):
         server = bst.serve(warm_max_rows=256, tick_ms=1.0)
         try:
             out = server.predict(X[:16])
         finally:
             server.close(drain=True)
+        bst.predict(X[:4], pred_contrib=True)
     np.testing.assert_allclose(np.asarray(out),
                                bst.predict(X[:16]), rtol=0, atol=0)
 

@@ -1140,6 +1140,83 @@ def test_r008_shipped_serving_layer_needs_only_the_drain_anchor():
     assert not apply_allowlist(r8, entries)
 
 
+# ------------------------------------------------- R008 (c): featurize
+def test_r008_host_featurize_in_tick_flagged(tmp_path):
+    """Seed: a coalescer tick binning on the host — every tick pays the
+    O(rows*features) numpy sweep the device featurizer replaces."""
+    findings = lint_snippet(tmp_path, """
+        from binning import bin_columns
+
+        class MicroBatchCoalescer:
+            def _tick(self, batch, mappers):
+                return bin_columns(mappers, batch)
+    """)
+    r8 = [f for f in findings if "featurization" in f.message]
+    assert len(r8) == 1 and "bin_columns" in r8[0].message
+
+
+def test_r008_host_featurize_reachable_from_serve_entry_flagged(tmp_path):
+    """Seed: the searchsorted sweep hides one call deep behind a serve
+    entry — the reachability walk still pins it (at the helper)."""
+    findings = lint_snippet(tmp_path, """
+        import numpy as np
+
+        def _bin_request(mappers, arr):
+            return np.searchsorted(mappers, arr)
+
+        def predict_serving(self, data):
+            return _bin_request(self.mappers, data)
+    """)
+    r8 = [f for f in findings if "featurization" in f.message]
+    assert len(r8) == 1 and "searchsorted" in r8[0].message
+    assert r8[0].func.endswith("_bin_request")
+
+
+def test_r008_host_featurize_outside_serving_clean(tmp_path):
+    """The same calls outside serving scope (dataset construction, model
+    export) are not findings — construct-time binning is the design."""
+    findings = lint_snippet(tmp_path, """
+        import numpy as np
+
+        def fit_mappers(values, bounds):
+            return np.searchsorted(bounds, values)
+
+        def export_model(mapper, thr):
+            return mapper.value_to_bin(thr)
+    """)
+    assert not [f for f in findings if "featurization" in f.message]
+
+
+def test_r008_host_featurize_behind_train_boundary_clean(tmp_path):
+    """The walk stops at train/construct entries: scripts/serve trains
+    before taking traffic, and that boot-time bin pass is legitimate."""
+    findings = lint_snippet(tmp_path, """
+        from binning import bin_columns
+
+        def train(data, mappers):
+            return bin_columns(mappers, data)
+
+        def serve_main(data, mappers):
+            model = train(data, mappers)
+            return model
+    """)
+    assert not [f for f in findings if "featurization" in f.message]
+
+
+def test_r008_shipped_host_featurize_hatch_is_anchored():
+    """The one shipped host-featurize site on a serving path is the
+    tpu_serve_featurize=host escape hatch (GBDT.bin_matrix), and it is
+    allowlist-anchored."""
+    findings, errors = lint_paths([PKG_DIR])
+    assert not errors
+    feat = [f for f in findings if f.rule == "R008"
+            and "featurization" in f.message]
+    assert len(feat) == 1 and feat[0].func.endswith("bin_matrix"), \
+        [f.render() for f in feat]
+    entries, _ = load_allowlist(DEFAULT_ALLOWLIST)
+    assert not apply_allowlist(feat, entries)
+
+
 # ------------------------------------------------------------ allowlist
 def test_allowlist_suppresses_and_tracks_usage(tmp_path):
     snippet = tmp_path / "mod.py"
