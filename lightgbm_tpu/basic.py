@@ -1013,7 +1013,8 @@ class Booster:
     @read_locked
     def predict_serving(self, data: _ArrayLike, raw_score: bool = False,
                         start_iteration: int = 0,
-                        num_iteration: Optional[int] = None):
+                        num_iteration: Optional[int] = None,
+                        observe=None):
         """One coalesced serving batch: ``(padded host scores, n_valid)``.
 
         The serving twin of :meth:`predict`: bins the request, routes it
@@ -1047,10 +1048,18 @@ class Booster:
         inner, start_iteration, num_iteration, arr, n = \
             self._serving_request(data, start_iteration, num_iteration)
         early = self._predict_early_stop()
-        raw = np.asarray(inner.predict_raw_device(
-            self._serving_binned(inner, arr), num_iteration,
-            start_iteration, early_stop=early,
-            device_packed=inner._pred_pack4))             # [K, rung] host
+        binned = self._serving_binned(inner, arr)
+        raw_dev = inner.predict_raw_device(
+            binned, num_iteration, start_iteration, early_stop=early,
+            device_packed=inner._pred_pack4)              # [K, rung] device
+        raw = np.asarray(raw_dev)                         # [K, rung] host
+        if observe is not None:
+            # drift window (obs/drift.py): pure on-device adds of the
+            # tick's bins + raw margins, enqueued AFTER the response
+            # materialized so the accumulates overlap the host-side
+            # slice/complete work instead of sitting on the latency path
+            observe.observe_binned(binned, n)
+            observe.observe_scores(raw_dev, n)
         if inner.average_output:
             raw = raw / inner._average_divisor(num_iteration,
                                                start_iteration)
@@ -1066,7 +1075,8 @@ class Booster:
     @read_locked
     def predict_leaf_serving(self, data: _ArrayLike,
                              start_iteration: int = 0,
-                             num_iteration: Optional[int] = None):
+                             num_iteration: Optional[int] = None,
+                             observe=None):
         """One coalesced ``pred_leaf`` batch: ``(padded leaves, n_valid)``.
 
         The serving twin of ``predict(pred_leaf=True)`` (reference:
@@ -1076,15 +1086,19 @@ class Booster:
         bit-for-bit — leaf-index embeddings for downstream rankers."""
         inner, start_iteration, num_iteration, arr, n = \
             self._serving_request(data, start_iteration, num_iteration)
+        binned = self._serving_binned(inner, arr)
         out = inner.predict_leaf_padded(
-            self._serving_binned(inner, arr), num_iteration,
-            start_iteration, device_packed=inner._pred_pack4)
+            binned, num_iteration, start_iteration,
+            device_packed=inner._pred_pack4)
+        if observe is not None:
+            observe.observe_binned(binned, n)
         return out, n
 
     @read_locked
     def predict_contrib_serving(self, data: _ArrayLike,
                                 start_iteration: int = 0,
-                                num_iteration: Optional[int] = None):
+                                num_iteration: Optional[int] = None,
+                                observe=None):
         """One coalesced ``pred_contrib`` batch:
         ``(padded [rung, K*(F+1)] contributions, n_valid)``.
 
@@ -1095,9 +1109,12 @@ class Booster:
         sums to the raw score per row."""
         inner, start_iteration, num_iteration, arr, n = \
             self._serving_request(data, start_iteration, num_iteration)
+        binned = self._serving_binned(inner, arr)
         out = inner.predict_contrib_padded(
-            self._serving_binned(inner, arr), num_iteration,
-            start_iteration, device_packed=inner._pred_pack4)
+            binned, num_iteration, start_iteration,
+            device_packed=inner._pred_pack4)
+        if observe is not None:
+            observe.observe_binned(binned, n)
         return out, n
 
     def _serve_endpoints(self) -> tuple:

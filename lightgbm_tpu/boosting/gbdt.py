@@ -2611,9 +2611,12 @@ class GBDT:
         invalidates the other: a rollback/RF/DART leaf rescale changes
         leaf values (and expected values) without necessarily changing
         the tree count, so the paths' cached ``ev`` would silently
-        serve stale contributions if it outlived the stack."""
+        serve stale contributions if it outlived the stack. The cached
+        host score baseline (drift_reference) rides along: those same
+        mutations change train_score at an unchanged tree count."""
         self._device_trees_cache = None
         self._shap_paths_cache = None
+        self._drift_score_host = None
 
     def _device_trees_batched(self, num_iteration: Optional[int] = None,
                               start_iteration: int = 0, tbatch: int = 16):
@@ -2881,6 +2884,29 @@ class GBDT:
             return None
         self._featurize_dev = device_bin_state(host_state)
         return self._featurize_dev
+
+    def drift_reference(self):
+        """Serving drift-monitor reference (ISSUE 14): ``(bin-occupancy
+        probs [F, B], per-feature bin counts [F], training raw margins
+        [K, N] device array or None)``.
+
+        The occupancy is the training data's normalized per-feature bin
+        distribution (cached on the dataset — the serving registry
+        materializes it during the deploy warm phase so it ships WITH
+        the model); the margins seed the fixed-edge score-distribution
+        baseline and are returned as a CACHED host copy, so the [K, N]
+        d2h also happens once, in the warm phase, not at the post-swap
+        monitor attach. Live serving windows are compared against both
+        (PSI / KL) by obs/drift.DriftMonitor."""
+        probs, nbins = self.train_set.reference_bin_distribution()
+        self._flush_trees()
+        key = len(self.models)          # continued training MUST refresh
+        cached = getattr(self, "_drift_score_host", None)
+        if cached is None or cached[0] != key:
+            ts = getattr(self, "train_score", None)
+            cached = (key, False if ts is None else np.asarray(ts))
+            self._drift_score_host = cached
+        return probs, nbins, (None if cached[1] is False else cached[1])
 
     def featurize_rung(self, arr32: np.ndarray) -> jax.Array:
         """Pad a raw float32 request to its bucket rung, upload it (THE

@@ -316,6 +316,43 @@ PARAMS: Dict[str, Tuple[Any, type, Tuple[str, ...]]] = {
     # opt-in; submitting to an unlisted endpoint raises structurally
     # (serving it cold would compile in the request path)
     "tpu_serve_endpoints": ("predict", str, ("serve_endpoints",)),
+    # serving drift monitors (obs/drift.py): every served batch's binned
+    # matrix folds into a device-resident [F, B] bin-occupancy
+    # accumulator (plus a fixed-edge histogram of raw margins) with pure
+    # on-device adds; every N serving ticks the window flushes to host
+    # (the ONE declared d2h), PSI/KL per feature and score drift are
+    # computed against the training-data reference distribution, and
+    # hysteresis-gated drift_detected events land in the flight recorder
+    # + Prometheus gauges. 0 disables (default) — the machine-readable
+    # "model went stale / traffic shifted" refit trigger of ROADMAP 4
+    "tpu_drift_flush_every": (0, int, ("drift_flush_every",)),
+    # PSI above this marks a feature (or the score distribution) drifted
+    # (drift_detected event); it un-marks (drift_cleared) only below
+    # half the threshold — the hysteresis band that stops flapping.
+    # 0.2 is the conventional "significant shift" PSI cut
+    "tpu_drift_psi_threshold": (0.2, float, ("drift_psi_threshold",)),
+    # fixed-edge bin count of the raw-margin (score) histogram; edges
+    # come from the training-score reference range at attach time
+    "tpu_drift_score_bins": (32, int, ("drift_score_bins",)),
+    # PSI compares ~equal-reference-mass GROUPS of adjacent bins, not
+    # the raw mapper bins (a finite window leaves most of a 255-bin
+    # quantile mapper empty and unshifted traffic would read as
+    # drifted); 10-20 is the conventional PSI bucket count
+    "tpu_drift_bins": (16, int, ("drift_bins",)),
+    # minimum rows a flush window needs before drift EVENTS fire (PSI
+    # sampling noise has expectation ~(G-1)/rows, so a low-traffic
+    # window would cry wolf on unshifted traffic); gauges/records still
+    # update every flush. 0 = auto: 20 x tpu_drift_bins
+    "tpu_drift_min_rows": (0, int, ("drift_min_rows",)),
+    # serving SLO tracker (obs/drift.py): a served request is "good"
+    # when it completes within tpu_serve_slo_ms; rolling good/bad counts
+    # feed multi-window (5 m / 1 h) error-budget burn rates exposed as
+    # gauges, with slo_burn flight events on sustained burn > 1.
+    # 0 disables (default)
+    "tpu_serve_slo_ms": (0.0, float, ("serve_slo_ms",)),
+    # target good fraction of the SLO (burn rate 1.0 == exactly spending
+    # the 1 - target error budget)
+    "tpu_serve_slo_target": (0.99, float, ("serve_slo_target",)),
     # fault tolerance (io/checkpoint.py, parallel/multihost.py watchdog,
     # analysis/faultinject.py): atomic full-state snapshots every
     # tpu_checkpoint_freq iterations into tpu_checkpoint_dir (keep-last-k

@@ -448,6 +448,46 @@ def host_featurize_calls() -> int:
         return _HOST_CALLS
 
 
+def bin_occupancy(binned: np.ndarray, mappers: Sequence[BinMapper],
+                  bundle_info=None) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-ORIGINAL-feature bin-occupancy counts of a binned matrix:
+    ``(counts [F, B] float64, num_bins [F] int32)`` with ``B`` the widest
+    feature's bin count (padded tail stays zero).
+
+    The serving drift monitor's reference distribution (ISSUE 14): live
+    traffic is binned in original feature space, so the training-data
+    occupancy must be too. For an EFB-bundled matrix each member feature
+    reads its reserved ``[offset+1, offset+num_bins]`` range back out of
+    its bundle column's histogram — the encode stores ``offset + 1 + b``
+    for every non-default bin and 0 for all-defaults (io/efb.py), so the
+    member's default-bin count is ``N - sum(non-default)``. Exact for
+    conflict-free bundles; under bounded-conflict bundling a conflicting
+    row counts at the losing member's default bin, the same information
+    loss ``efb.unbundle`` accepts (bounded by ``max_conflict_rate``)."""
+    n, f = binned.shape[0], len(mappers)
+    nb = np.array([m.num_bins for m in mappers], np.int32)
+    width = int(nb.max(initial=1))
+    counts = np.zeros((f, width), np.float64)
+    colhist = [np.bincount(binned[:, c].astype(np.int64, copy=False))
+               for c in range(binned.shape[1])]
+    for j, m in enumerate(mappers):
+        w = int(nb[j])
+        if bundle_info is None:
+            c, off = j, -1
+        else:
+            c, off = int(bundle_info.col_of[j]), int(bundle_info.offset_of[j])
+        h = colhist[c]
+        if off < 0:
+            seg = h[:w]
+            counts[j, :len(seg)] = seg
+        else:
+            seg = h[off + 1: off + 1 + w]
+            counts[j, :len(seg)] = seg
+            d = int(m.default_bin)
+            counts[j, d] = max(n - counts[j].sum(), 0.0)
+    return counts, nb
+
+
 # row-chunk x column-chunk x bounds budget for the batched compare
 # (bool intermediates, ~4MB a piece — cache-resident)
 _BATCH_ELEMS = 1 << 22

@@ -147,6 +147,9 @@ class BinnedDataset:
         self.used_features: List[int] = []         # non-trivial feature indices
         self.categorical_features: List[int] = []
         self.raw_data: Optional[np.ndarray] = None  # kept only if needed (linear trees)
+        # cached reference bin-occupancy (serving drift monitors); built
+        # lazily so construct pays nothing when drift is off
+        self._ref_dist: Optional[tuple] = None
 
     # -- binary serialization (reference: Dataset::SaveBinaryFile,
     # src/io/dataset.cpp / DatasetLoader::LoadFromBinFile :417) -------------
@@ -506,6 +509,32 @@ class BinnedDataset:
         return ds
 
     # -- views for the tree learner ----------------------------------------
+    def reference_bin_distribution(self):
+        """Normalized per-ORIGINAL-feature bin occupancy of this
+        dataset's rows: ``(probs [F, B] float32, num_bins [F] int32)``.
+
+        The drift monitor's reference (ISSUE 14): live serving traffic
+        is binned in original feature space with these exact mappers, so
+        the per-feature occupancy of the training data is the
+        distribution a served window's occupancy is compared against
+        (PSI/KL). Computed from the stored bin matrix — EFB bundle
+        columns decode through their reserved offset ranges
+        (io/binning.bin_occupancy) — and cached: the registry
+        materializes it during the deploy warm phase so the monitor
+        ships WITH the model and the swap flip never stalls on a data
+        pass."""
+        if self._ref_dist is not None:
+            return self._ref_dist
+        if self.binned is None:
+            raise ValueError("dataset is not constructed")
+        from .binning import bin_occupancy
+        counts, nb = bin_occupancy(self.binned, self.mappers,
+                                   self.bundle_info)
+        probs = (counts / np.maximum(counts.sum(axis=1, keepdims=True), 1)
+                 ).astype(np.float32)
+        self._ref_dist = (probs, nb)
+        return self._ref_dist
+
     @property
     def num_features(self) -> int:
         return self.num_total_features

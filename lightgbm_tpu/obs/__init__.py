@@ -35,6 +35,13 @@ One observability subsystem spanning training, collectives, and serving
 * :mod:`.ledger` — scaling-efficiency ledger: per-chip throughput
   efficiency vs the 1-chip row + measured-vs-modeled comm accounting
   recorded into MULTICHIP/COMM_ACCOUNTING.json (bench BENCH_LEDGER=1).
+* :mod:`.drift` — serving-quality observability (ROADMAP 4's "observe"
+  pillar): on-device per-feature bin-occupancy + raw-margin drift
+  monitors flushed on a cadence (``tpu_drift_flush_every``) with
+  hysteresis-gated PSI ``drift_detected`` events, per-request latency
+  attribution histograms, and the multi-window SLO burn-rate tracker
+  (``tpu_serve_slo_ms`` / ``tpu_serve_slo_target``). Module level is
+  numpy-only; jax loads lazily inside the device accumulate builders.
 
 This ``__init__`` stays jax-free too (``spans`` and ``ranks`` are the
 only jax-touching modules and are imported lazily), so ``scripts/obs``
@@ -42,9 +49,9 @@ runs without a backend.
 """
 from __future__ import annotations
 
-from . import flight, ledger, metrics, summarize, tracing  # noqa: F401
+from . import drift, flight, ledger, metrics, summarize, tracing  # noqa: F401
 
-__all__ = ["flight", "ledger", "metrics", "summarize", "tracing",
+__all__ = ["drift", "flight", "ledger", "metrics", "summarize", "tracing",
            "spans", "ranks", "configure"]
 
 

@@ -1,0 +1,868 @@
+"""Serving-quality observability: drift monitors, latency attribution,
+SLO burn-rate tracking (ROADMAP 4's "observe" pillar).
+
+The continuous-learning loop (train -> deploy -> observe -> refit ->
+hot-swap) needs a machine-readable signal that a deployed model has gone
+stale or its traffic has shifted. The reference ships that feedback
+surface for training (``src/metric/``, ``GBDT::ValidOneIter``); this
+module is its serving-side analogue, built on the device-resident
+featurization of the serving hot path: every served request is already
+binned ON DEVICE, so per-feature bin-occupancy — the raw material of
+covariate-drift detection — accumulates with pure on-device adds inside
+the existing ``serve_tick`` span, at zero extra host transfers.
+
+Three planes, one owner (:class:`ServingObserver`, held by a
+PredictionServer):
+
+* **Drift** (:class:`DriftMonitor`) — at attach time the model ships its
+  reference distributions: the training data's normalized per-feature
+  bin occupancy (``BinnedDataset.reference_bin_distribution``) and a
+  fixed-edge histogram of the training raw margins. Each served batch's
+  binned matrix folds into a device ``[F*B]`` occupancy accumulator and
+  each predict batch's raw margins into a ``[K, SB]`` score accumulator
+  (one jitted scatter-add per warmed rung, pre-lowered by
+  :meth:`DriftMonitor.warm` so an armed monitor adds ZERO steady-state
+  compiles). Every ``tpu_drift_flush_every`` serving ticks the window
+  flushes to host — the ONE declared d2h (``host_syncs`` counts it;
+  guard-tested) — and PSI / KL per feature plus score drift are computed
+  against the reference. Events are hysteresis-gated: ``drift_detected``
+  fires when PSI crosses ``tpu_drift_psi_threshold`` (within one flush
+  of a real shift), ``drift_cleared`` only below HALF the threshold, and
+  a feature that stays drifted re-fires nothing — no flapping.
+* **Latency attribution** — every ServeFuture is stamped with its phase
+  times (queue-wait / featurize+dispatch / slice-return) and completed
+  requests land in fixed-bucket latency histograms keyed by
+  (endpoint kind, model version), exposed as real Prometheus histogram
+  series (``lgbm_tpu_serve_latency_ms_bucket{kind=,version=,le=}``).
+* **SLO** (:class:`SloTracker`) — a request is "good" when it completes
+  within ``tpu_serve_slo_ms``; rolling good/bad counts in 10 s buckets
+  feed multi-window (5 m / 1 h) error-budget burn rates
+  (``bad_fraction / (1 - tpu_serve_slo_target)``), exposed as gauges
+  with ``slo_burn`` flight events on sustained burn > 1 over both
+  windows.
+
+Flush records (``drift_flush`` / ``slo``) go to the ``tpu_metrics_path``
+stream and compact twins into the flight recorder — ``scripts/obs
+drift`` renders the latest flush's PSI table, top drifted features, and
+the SLO burn tail jax-free.
+
+The module level is numpy-only (obs/__init__ stays importable without a
+backend); jax loads lazily inside the device accumulate builders.
+"""
+from __future__ import annotations
+
+import bisect
+import collections
+import functools
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import log
+from . import flight
+from . import metrics as obs_metrics
+
+#: probability floor for PSI/KL terms: an empty bin must contribute a
+#: large-but-finite term, not an infinity (the conventional PSI floor)
+PSI_EPS = 1e-4
+
+#: the score-distribution "feature" name used in drift events/gauges
+SCORE_FEATURE = "__score__"
+
+#: fixed latency-histogram bucket upper bounds, milliseconds (Prometheus
+#: ``le`` labels; +Inf is implicit)
+LATENCY_BUCKETS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                      500.0, 1000.0, 2500.0, 5000.0)
+
+#: cap on per-feature PSI maps embedded in flush records/gauges for very
+#: wide datasets (drifted features are always included regardless)
+PSI_MAP_CAP = 64
+PSI_MAP_FULL_MAX = 256
+
+
+# -- divergence math (host side, numpy; shared with tests/CLI) --------------
+def equal_mass_groups(ref_probs: np.ndarray, n_groups: int) -> np.ndarray:
+    """Merge adjacent bins into ~equal reference-mass groups: ``[..., B]``
+    probability rows -> ``[..., B]`` int group ids in ``[0, n_groups)``,
+    monotone along the bin axis.
+
+    PSI over the raw mapper bins is biased upward: a 255-bin quantile
+    mapper holds ~0.4% reference mass per bin, and any finite serving
+    window leaves most bins empty, so every empty bin pays the epsilon
+    floor penalty and UNSHIFTED traffic reads as drifted. The standard
+    construction compares ~10-20 equal-population buckets; grouping by
+    cumulative reference mass recovers exactly that from the mapper's
+    quantile bins (a feature with fewer bins than groups keeps its bins
+    1:1). Bins empty in BOTH distributions then share the floor and
+    contribute nothing."""
+    p = np.asarray(ref_probs, np.float64)
+    cum_before = np.cumsum(p, axis=-1) - p
+    return np.minimum((cum_before * n_groups).astype(np.int64),
+                      n_groups - 1)
+
+
+def group_counts(counts: np.ndarray, gid: np.ndarray,
+                 n_groups: int) -> np.ndarray:
+    """Sum ``[F, B]`` per-bin counts into ``[F, G]`` per-group counts."""
+    counts = np.asarray(counts, np.float64)
+    f = counts.shape[0]
+    flat = gid + np.arange(f, dtype=np.int64)[:, None] * n_groups
+    return np.bincount(flat.ravel(), weights=counts.ravel(),
+                       minlength=f * n_groups).reshape(f, n_groups)
+
+
+def psi_rows(ref: np.ndarray, cur: np.ndarray,
+             valid: Optional[np.ndarray] = None) -> np.ndarray:
+    """Population Stability Index per row: ``sum_b (q-p) * ln(q/p)``.
+
+    ``ref``/``cur`` are ``[..., B]`` probability rows; ``valid`` masks
+    the padded bin tail of features with fewer than B bins."""
+    p = np.maximum(np.asarray(ref, np.float64), PSI_EPS)
+    q = np.maximum(np.asarray(cur, np.float64), PSI_EPS)
+    t = (q - p) * np.log(q / p)
+    if valid is not None:
+        t = np.where(valid, t, 0.0)
+    return t.sum(axis=-1)
+
+
+def kl_rows(ref: np.ndarray, cur: np.ndarray,
+            valid: Optional[np.ndarray] = None) -> np.ndarray:
+    """``KL(cur || ref)`` per row, with the same floor/mask as PSI."""
+    p = np.maximum(np.asarray(ref, np.float64), PSI_EPS)
+    q = np.maximum(np.asarray(cur, np.float64), PSI_EPS)
+    t = q * np.log(q / p)
+    if valid is not None:
+        t = np.where(valid, t, 0.0)
+    return t.sum(axis=-1)
+
+
+# -- device accumulate programs (lazy jax; one per (layout, rung)) ----------
+@functools.lru_cache(maxsize=None)
+def _bin_accum_fn(packed: bool, num_features: int, bin_width: int):
+    """Jitted ``occ[F*B] += onehot(bins)`` over the valid row prefix.
+
+    ``bins`` is the serving binned matrix exactly as the featurizer
+    produced it (``[rung, F]`` u8/u16, or ``[rung, ceil(F/2)]`` nibble-
+    packed under pack4 — unpacked in-program); ``n_valid`` rides as a
+    traced scalar so the program is keyed on the rung alone. A pure
+    on-device scatter-add: nothing here reads back to the host."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.packed import unpack4
+
+    def accum(occ, bins, n_valid):
+        full = (unpack4(bins, num_features) if packed
+                else bins).astype(jnp.int32)
+        idx = full + jnp.arange(num_features,
+                                dtype=jnp.int32)[None, :] * bin_width
+        mask = (jnp.arange(bins.shape[0]) < n_valid).astype(occ.dtype)
+        return occ.at[idx].add(jnp.broadcast_to(mask[:, None], idx.shape))
+
+    return jax.jit(accum)
+
+
+@functools.lru_cache(maxsize=None)
+def _score_accum_fn(num_class: int, score_bins: int):
+    """Jitted fixed-edge margin histogram add: ``hist[K, SB] +=
+    bincount(clip(floor((raw - lo)/width)))`` over the valid column
+    prefix of a ``[K, rung]`` raw-score matrix.
+
+    ``lo``/``width`` ride as TRACED scalars (like ``n_valid``), not
+    cache keys: they differ per model version, and keying the jit cache
+    on them would retain one compiled program per hot-swapped model
+    forever in a long-lived refit loop."""
+    import jax
+    import jax.numpy as jnp
+
+    def accum(hist, raw, n_valid, lo, width):
+        idx = jnp.clip(jnp.floor((raw - lo) / width), 0,
+                       score_bins - 1).astype(jnp.int32)
+        k = jnp.arange(num_class, dtype=jnp.int32)[:, None] * score_bins
+        mask = (jnp.arange(raw.shape[1]) < n_valid).astype(hist.dtype)
+        flat = hist.reshape(-1).at[idx + k].add(
+            jnp.broadcast_to(mask[None, :], idx.shape))
+        return flat.reshape(num_class, score_bins)
+
+    return jax.jit(accum)
+
+
+def _host_bin_counts(bins: np.ndarray, n: int, num_features: int,
+                     bin_width: int) -> np.ndarray:
+    """Host twin of the device accumulate (``tpu_serve_featurize=host``)."""
+    b = np.asarray(bins[:n], np.int64)
+    idx = b + np.arange(num_features, dtype=np.int64)[None, :] * bin_width
+    return np.bincount(idx.ravel(), minlength=num_features * bin_width)
+
+
+def _score_bincount(scores: np.ndarray, lo: float, width: float,
+                    score_bins: int) -> np.ndarray:
+    """``[K, SB]`` fixed-edge histogram with the device program's exact
+    clamp semantics (under/overflow lands in the edge bins)."""
+    s = np.asarray(scores, np.float64)
+    idx = np.clip(np.floor((s - lo) / width), 0,
+                  score_bins - 1).astype(np.int64)
+    k = np.arange(idx.shape[0], dtype=np.int64)[:, None] * score_bins
+    return np.bincount((idx + k).ravel(),
+                       minlength=idx.shape[0] * score_bins
+                       ).reshape(idx.shape[0], score_bins)
+
+
+class DriftMonitor:
+    """Per-model drift state: reference distributions, device window
+    accumulators, and hysteresis-gated PSI events.
+
+    Built at model attach (server start / hot-swap commit) from the
+    booster's ``drift_reference()`` — the training data's bin occupancy
+    and raw-margin histogram, which the registry materializes during the
+    warm phase so the swap flip never stalls on a data pass."""
+
+    def __init__(self, version: str, booster, *, flush_every: int,
+                 psi_threshold: float, score_bins: int,
+                 drift_bins: int = 16, min_rows: int = 0,
+                 stream_path: str = ""):
+        inner = booster._gbdt
+        ds = inner.train_set
+        probs, nbins, ref_scores = inner.drift_reference()
+        self.version = str(version)
+        self.flush_every = int(flush_every)
+        self.threshold = float(psi_threshold)
+        #: hysteresis band: cleared only below HALF the enter threshold
+        self.exit_threshold = 0.5 * self.threshold
+        self._stream_path = str(stream_path or "")
+        self.feature_names = list(ds.feature_names)
+        self._ref = np.asarray(probs, np.float64)
+        self._nbins = np.asarray(nbins, np.int64)
+        self._F, self._B = self._ref.shape
+        # PSI compares ~equal-reference-mass GROUPS of adjacent bins
+        # (tpu_drift_bins), not the raw mapper bins — see
+        # equal_mass_groups for why fine bins would cry wolf
+        self._G = max(2, min(int(drift_bins), self._B))
+        self._gid = equal_mass_groups(self._ref, self._G)
+        rg = group_counts(self._ref, self._gid, self._G)
+        self._ref_g = rg / np.maximum(rg.sum(axis=1, keepdims=True), 1e-12)
+        # event gate: PSI sampling noise has expectation ~(G-1)/rows, so
+        # a window below ~20G rows would fire spurious events on
+        # unshifted low-traffic services; gauges/records still update,
+        # only the hysteresis TRANSITIONS wait for a big-enough window
+        self.min_rows = int(min_rows) if int(min_rows) > 0 \
+            else 20 * self._G
+        self._packed = bool(getattr(inner, "_pred_pack4", False))
+        self._bins_dtype = ds.binned.dtype
+        self._K = int(inner.num_tree_per_iteration)
+        self._SB = max(int(score_bins), 2)
+        self._SG = max(2, min(self._G, self._SB))
+        if ref_scores is not None:
+            rs = np.asarray(ref_scores, np.float64).reshape(self._K, -1)
+            lo, hi = float(rs.min()), float(rs.max())
+            pad = 0.05 * (hi - lo) or 0.5
+            self._lo, self._hi = lo - pad, hi + pad
+            self._width = (self._hi - self._lo) / self._SB
+            h = _score_bincount(rs, self._lo, self._width, self._SB)
+            self._set_score_ref(h)
+        else:
+            # no training margins (unusual): the first flushed window
+            # becomes the score baseline (that flush reports 0 drift)
+            self._score_ref = None
+            self._score_gid = None
+            self._lo, self._hi = -10.0, 10.0
+            self._width = (self._hi - self._lo) / self._SB
+        # window accumulators. Device arrays take pure on-device adds in
+        # the serve tick; the host twins absorb the
+        # tpu_serve_featurize=host escape hatch. Both zero at flush.
+        self._occ_dev = None
+        self._shist_dev = None
+        self._occ_host = np.zeros(self._F * self._B, np.int64)
+        self._shist_host = np.zeros((self._K, self._SB), np.int64)
+        self.window_rows = 0
+        self.score_rows = 0
+        self.flushes = 0
+        #: device->host syncs — exactly one per flush, nothing per tick
+        #: (the steady-state guard tests read this)
+        self.host_syncs = 0
+        self.events_total = 0
+        self._drifted = np.zeros(self._F, bool)
+        self._score_drifted = False
+        self._last_psi = np.zeros(self._F)
+        self._last_kl = np.zeros(self._F)
+        self._last_score_psi: Optional[float] = None
+        self._gauges: Dict[str, Any] = {}
+        self._gmu = threading.Lock()
+
+    def _set_score_ref(self, hist: np.ndarray) -> None:
+        """Baseline the score distribution: fixed-edge bin histogram ->
+        equal-mass groups (same cry-wolf fix as the feature bins)."""
+        p = np.asarray(hist, np.float64)
+        p = p / np.maximum(p.sum(axis=1, keepdims=True), 1)
+        self._score_gid = equal_mass_groups(p, self._SG)
+        g = group_counts(p, self._score_gid, self._SG)
+        self._score_ref = g / np.maximum(g.sum(axis=1, keepdims=True),
+                                         1e-12)
+
+    # -- accumulate (serving worker thread, inside the serve tick) ----------
+    def _reset_device(self):
+        import jax.numpy as jnp
+        # int32 counts, not f32: a float accumulator silently saturates
+        # at 2^24 rows per bin (x + 1 == x), under-counting dominant
+        # bins on long flush cadences at high QPS
+        self._occ_dev = jnp.zeros(self._F * self._B, jnp.int32)
+        self._shist_dev = jnp.zeros((self._K, self._SB), jnp.int32)
+        return self._occ_dev
+
+    def observe_binned(self, binned, n: int) -> None:
+        """Fold one served batch's binned matrix into the occupancy
+        window: a device scatter-add for device-featurized batches, a
+        host bincount for the host-binned escape hatch."""
+        if isinstance(binned, np.ndarray):
+            self._occ_host += _host_bin_counts(binned, int(n), self._F,
+                                               self._B)
+        else:
+            if self._occ_dev is None:
+                self._reset_device()
+            fn = _bin_accum_fn(self._packed, self._F, self._B)
+            self._occ_dev = fn(self._occ_dev, binned, np.int32(n))
+        self.window_rows += int(n)
+
+    def observe_scores(self, raw, n: int) -> None:
+        """Fold one predict batch's raw margins ``[K, rung]`` into the
+        fixed-edge score histogram window."""
+        if isinstance(raw, np.ndarray):
+            self._shist_host += _score_bincount(
+                raw[:, :int(n)], self._lo, self._width, self._SB)
+        else:
+            if self._shist_dev is None:
+                self._reset_device()
+            fn = _score_accum_fn(self._K, self._SB)
+            self._shist_dev = fn(self._shist_dev, raw, np.int32(n),
+                                 np.float32(self._lo),
+                                 np.float32(self._width))
+        self.score_rows += int(n)
+
+    def warm(self, rungs: Sequence[int]) -> None:
+        """Pre-lower the accumulate programs for every warmed serving
+        rung (one program per rung, exactly like the predict ladder) and
+        the reset constants, so an armed monitor compiles NOTHING in
+        steady state; the dummy window is discarded."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..analysis.guards import compile_phase
+        cols = (self._F + 1) // 2 if self._packed else self._F
+        with compile_phase("predict_warmup"):
+            for rung in rungs:
+                self.observe_binned(
+                    jnp.zeros((int(rung), cols), self._bins_dtype), 0)
+                self.observe_scores(
+                    jnp.zeros((self._K, int(rung)), jnp.float32), 0)
+            jax.block_until_ready(self._reset_device())
+        self._occ_host[:] = 0
+        self._shist_host[:] = 0
+        self.window_rows = 0
+        self.score_rows = 0
+
+    # -- flush (the declared d2h tick) --------------------------------------
+    def _psi_keep(self, psi: np.ndarray) -> List[int]:
+        """Feature indices embedded in records/gauges: all of them for
+        ordinary widths, the top :data:`PSI_MAP_CAP` plus every drifted
+        feature for very wide datasets."""
+        if self._F <= PSI_MAP_FULL_MAX:
+            return list(range(self._F))
+        top = np.argsort(psi)[::-1][:PSI_MAP_CAP]
+        return sorted(set(top.tolist())
+                      | set(np.nonzero(self._drifted)[0].tolist()))
+
+    def flush(self, stream=None) -> Dict[str, Any]:
+        """Close the window: ONE device->host sync of the accumulators,
+        PSI/KL vs the reference, hysteresis-gated events into the flight
+        recorder, gauges for the Prometheus endpoint, and a
+        ``drift_flush`` record into the metrics stream (when armed)."""
+        occ = np.asarray(self._occ_host, np.float64).copy()
+        shist = np.asarray(self._shist_host, np.float64).copy()
+        if self._occ_dev is not None:
+            self.host_syncs += 1
+            occ += np.asarray(self._occ_dev, np.float64)
+            shist += np.asarray(self._shist_dev, np.float64)
+            self._reset_device()
+        self._occ_host[:] = 0
+        self._shist_host[:] = 0
+        rows, srows = self.window_rows, self.score_rows
+        self.window_rows = 0
+        self.score_rows = 0
+        # NOTE: self.flushes advances at the END of this method — it is
+        # the completion signal clients poll (tests, operators), so the
+        # events/gauges/records must already be visible when it moves
+        flush_no = self.flushes + 1
+        occ = occ.reshape(self._F, self._B)
+        events: List[Tuple[str, str, float]] = []
+        low_traffic = rows < self.min_rows
+        if rows > 0:
+            occ_g = group_counts(occ, self._gid, self._G)
+            cur = occ_g / max(rows, 1)
+            psi = psi_rows(self._ref_g, cur)
+            klv = kl_rows(self._ref_g, cur)
+            # single-bin features cannot drift in bin space
+            psi = np.where(self._nbins > 1, psi, 0.0)
+            klv = np.where(self._nbins > 1, klv, 0.0)
+            if not low_traffic:
+                entered = (psi >= self.threshold) & ~self._drifted
+                cleared = (psi < self.exit_threshold) & self._drifted
+                for j in np.nonzero(entered)[0]:
+                    events.append(("drift_detected",
+                                   self.feature_names[j], float(psi[j])))
+                for j in np.nonzero(cleared)[0]:
+                    events.append(("drift_cleared",
+                                   self.feature_names[j], float(psi[j])))
+                self._drifted |= entered
+                self._drifted &= ~cleared
+            self._last_psi, self._last_kl = psi, klv
+        else:
+            psi, klv = self._last_psi, self._last_kl
+        score_psi = None
+        if srows > 0:
+            if self._score_ref is None:
+                self._set_score_ref(shist)
+            sg = group_counts(shist, self._score_gid, self._SG)
+            curs = sg / np.maximum(sg.sum(axis=1, keepdims=True), 1)
+            score_psi = float(psi_rows(self._score_ref, curs).max())
+            if srows >= self.min_rows:
+                if score_psi >= self.threshold \
+                        and not self._score_drifted:
+                    self._score_drifted = True
+                    events.append(("drift_detected", SCORE_FEATURE,
+                                   score_psi))
+                elif score_psi < self.exit_threshold \
+                        and self._score_drifted:
+                    self._score_drifted = False
+                    events.append(("drift_cleared", SCORE_FEATURE,
+                                   score_psi))
+            self._last_score_psi = score_psi
+        self.events_total += len(events)
+        jmax = int(np.argmax(psi)) if self._F else 0
+        drifted = [self.feature_names[j]
+                   for j in np.nonzero(self._drifted)[0]]
+        keep = self._psi_keep(psi)
+        record = {
+            "version": self.version, "flush": flush_no,
+            "window_rows": rows, "score_rows": srows,
+            "threshold": self.threshold,
+            "psi": {self.feature_names[j]: round(float(psi[j]), 6)
+                    for j in keep},
+            "kl": {self.feature_names[j]: round(float(klv[j]), 6)
+                   for j in keep},
+            "max_psi": round(float(psi[jmax]), 6) if self._F else 0.0,
+            "max_feature": self.feature_names[jmax] if self._F else None,
+            "score_psi": (round(score_psi, 6)
+                          if score_psi is not None else None),
+            "score_drifted": self._score_drifted,
+            "low_traffic": low_traffic,
+            "min_rows": self.min_rows,
+            "drifted": drifted,
+            "events": [{"event": e, "feature": f, "psi": round(p, 6)}
+                       for e, f, p in events],
+        }
+        flight.note("drift_flush", version=self.version,
+                    flush=flush_no, window_rows=rows,
+                    max_psi=record["max_psi"],
+                    max_feature=record["max_feature"],
+                    score_psi=record["score_psi"],
+                    drifted=len(drifted))
+        for e, f, p in events:
+            flight.note(e, feature=f, psi=round(p, 6),
+                        version=self.version, flush=flush_no)
+        if stream is None and self._stream_path:
+            stream = obs_metrics.stream_for(self._stream_path)
+        if stream is not None:
+            stream.emit("drift_flush", **record)
+        with self._gmu:
+            self._gauges = {
+                "psi": record["psi"],
+                "score_psi": record["score_psi"],
+                "max_psi": record["max_psi"],
+                "max_feature": record["max_feature"],
+                "drifted": drifted,
+                "score_drifted": self._score_drifted,
+                "flushes": flush_no,
+                "window_rows": rows,
+                "events_total": self.events_total,
+            }
+        self.flushes = flush_no     # LAST: the poll-visible completion
+        return record
+
+    def gauges(self) -> Dict[str, Any]:
+        with self._gmu:
+            return dict(self._gauges)
+
+
+class LatencyHistogram:
+    """Fixed-bucket latency histogram (Prometheus ``le`` semantics)."""
+
+    __slots__ = ("counts", "sum_ms", "count")
+
+    def __init__(self):
+        self.counts = [0] * (len(LATENCY_BUCKETS_MS) + 1)  # + overflow
+        self.sum_ms = 0.0
+        self.count = 0
+
+    def observe(self, ms: float) -> None:
+        self.counts[bisect.bisect_left(LATENCY_BUCKETS_MS, ms)] += 1
+        self.sum_ms += float(ms)
+        self.count += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"counts": list(self.counts), "sum_ms": self.sum_ms,
+                "count": self.count}
+
+
+class SloTracker:
+    """Rolling good/bad counts + multi-window error-budget burn rates.
+
+    10 s buckets over a 1 h horizon; ``burn_rate(w)`` is the window's
+    bad fraction over the allowed ``1 - target`` budget — 1.0 means
+    spending the error budget exactly as fast as the SLO allows."""
+
+    BUCKET_S = 10.0
+    HORIZON_S = 3600.0
+    WINDOWS_S = (("5m", 300.0), ("1h", 3600.0))
+
+    def __init__(self, slo_ms: float, target: float):
+        self.slo_ms = float(slo_ms)
+        self.target = min(max(float(target), 0.0), 1.0 - 1e-9)
+        self._n = int(self.HORIZON_S / self.BUCKET_S)
+        self._good = np.zeros(self._n, np.int64)
+        self._bad = np.zeros(self._n, np.int64)
+        self._ids = np.full(self._n, -1, np.int64)
+        self.good_total = 0
+        self.bad_total = 0
+        self.alerting = False
+
+    def _slot(self, now: float) -> int:
+        bid = int(now / self.BUCKET_S)
+        s = bid % self._n
+        if self._ids[s] != bid:       # lazily retire the stale horizon
+            self._good[s] = 0
+            self._bad[s] = 0
+            self._ids[s] = bid
+        return s
+
+    def record(self, good: bool, now: Optional[float] = None) -> None:
+        s = self._slot(time.monotonic() if now is None else now)
+        if good:
+            self._good[s] += 1
+            self.good_total += 1
+        else:
+            self._bad[s] += 1
+            self.bad_total += 1
+
+    def window_counts(self, window_s: float,
+                      now: Optional[float] = None) -> Tuple[int, int]:
+        now = time.monotonic() if now is None else now
+        bid = int(now / self.BUCKET_S)
+        k = min(int(math.ceil(window_s / self.BUCKET_S)), self._n)
+        ids = np.arange(bid - k + 1, bid + 1, dtype=np.int64)
+        slots = ids % self._n
+        live = self._ids[slots] == ids
+        return (int(self._good[slots][live].sum()),
+                int(self._bad[slots][live].sum()))
+
+    def burn_rate(self, window_s: float,
+                  now: Optional[float] = None) -> float:
+        g, b = self.window_counts(window_s, now)
+        t = g + b
+        if t == 0:
+            return 0.0
+        return (b / t) / max(1.0 - self.target, 1e-9)
+
+    def snapshot(self, now: Optional[float] = None) -> Dict[str, Any]:
+        out = {"slo_ms": self.slo_ms, "target": self.target,
+               "good_total": self.good_total, "bad_total": self.bad_total,
+               "alerting": self.alerting}
+        for name, w in self.WINDOWS_S:
+            out[f"burn_{name}"] = round(self.burn_rate(w, now), 4)
+        return out
+
+
+class ServingObserver:
+    """The serving tier's quality plane, owned by one PredictionServer.
+
+    The coalescer notifies it of every completed/failed future
+    (``on_future_done``) and of every served tick (``on_tick_served`` —
+    the drift flush cadence); the server passes its active
+    :class:`DriftMonitor` (``drift_for``) into the serving prediction
+    calls so bins and margins accumulate on device inside the tick.
+    Latency histograms and the SLO tracker are always on (host-side
+    counters, a few ns per request); drift arms via
+    ``tpu_drift_flush_every > 0``, SLO via ``tpu_serve_slo_ms > 0``."""
+
+    def __init__(self, cfg, *, slo_ms=None, slo_target=None,
+                 drift_flush_every=None, drift_psi_threshold=None):
+        def get(key, default):
+            try:
+                return cfg.get(key, default)
+            except Exception:  # noqa: BLE001 - config-less construction
+                return default
+        self.flush_every = int(
+            drift_flush_every if drift_flush_every is not None
+            else get("tpu_drift_flush_every", 0) or 0)
+        self.psi_threshold = float(
+            drift_psi_threshold if drift_psi_threshold is not None
+            else get("tpu_drift_psi_threshold", 0.2) or 0.2)
+        self.score_bins = int(get("tpu_drift_score_bins", 32) or 32)
+        self.drift_bins = int(get("tpu_drift_bins", 16) or 16)
+        self.min_rows = int(get("tpu_drift_min_rows", 0) or 0)
+        slo_ms = float(slo_ms if slo_ms is not None
+                       else get("tpu_serve_slo_ms", 0.0) or 0.0)
+        target = float(slo_target if slo_target is not None
+                       else get("tpu_serve_slo_target", 0.99) or 0.99)
+        self.slo = SloTracker(slo_ms, target) if slo_ms > 0 else None
+        #: burn-rate alert evaluation is throttled to ~1/s: transitions
+        #: move at bucket granularity, and the full window scan must not
+        #: run per request on the admission/completion hot paths
+        self._next_alert_check = 0.0
+        self._stream_path = str(get("tpu_metrics_path", "") or "")
+        #: slo stream-record cadence when drift flushing is off
+        self._slo_emit_every = (self.flush_every
+                                if self.flush_every > 0 else 256)
+        self._mu = threading.Lock()
+        self._hists: Dict[Tuple[str, str], LatencyHistogram] = {}
+        self._phases: Dict[str, Dict[str, float]] = {}
+        #: recently-attached model versions — histogram series for
+        #: versions outside this window are pruned at attach (a refit
+        #: loop must not grow /metrics cardinality per swap, forever)
+        self._recent_versions: collections.deque = collections.deque(
+            maxlen=4)
+        self._drift: Optional[DriftMonitor] = None
+        self._ticks = 0
+
+    # -- model attach (deploy / rollback / warm) ----------------------------
+    def attach_model(self, version: str, booster,
+                     rungs: Sequence[int]) -> None:
+        """(Re)build the drift monitor for the now-active model — fresh
+        reference distributions, fresh window, warmed accumulate
+        programs. A hot-swap resets the drift window by design: the
+        reference is per model. Latency-histogram series for versions
+        long since swapped out are pruned here — unbounded per-version
+        time-series cardinality is the classic Prometheus anti-pattern,
+        and a continuous-refit server swaps forever."""
+        version = str(version)
+        with self._mu:
+            if version in self._recent_versions:
+                self._recent_versions.remove(version)
+            self._recent_versions.append(version)
+            keep = set(self._recent_versions)
+            self._hists = {k: h for k, h in self._hists.items()
+                           if k[1] in keep}
+        if self.flush_every <= 0:
+            return
+        mon = DriftMonitor(version, booster,
+                           flush_every=self.flush_every,
+                           psi_threshold=self.psi_threshold,
+                           score_bins=self.score_bins,
+                           drift_bins=self.drift_bins,
+                           min_rows=self.min_rows,
+                           stream_path=self._stream_path)
+        mon.warm(rungs or ())
+        with self._mu:
+            self._drift = mon
+        flight.note("drift_attach", version=str(version),
+                    features=mon._F, bins=mon._B,
+                    score_bins=mon._SB)
+
+    def drift_for(self, version) -> Optional[DriftMonitor]:
+        """The active drift monitor iff it matches the tick's pinned
+        model version (a swap landing mid-queue must not fold one
+        model's bins into another's window)."""
+        d = self._drift
+        if d is not None and d.version == str(version):
+            return d
+        return None
+
+    @property
+    def drift(self) -> Optional[DriftMonitor]:
+        return self._drift
+
+    def on_shed(self, kind: str) -> None:
+        """A request shed at the admission edge never becomes a future,
+        but it IS a failed request from the client's side — an SLO that
+        cannot see sheds reports burn rate 0 during the exact overload
+        it exists to page on."""
+        if self.slo is None:
+            return
+        with self._mu:
+            self.slo.record(False)
+        self._check_slo_alert()
+
+    # -- per-future / per-tick hooks (coalescer worker thread) --------------
+    def on_future_done(self, fut) -> None:
+        err = fut._error
+        ok = err is None
+        lat = fut.latency_s
+        ph = fut.phase_times()
+        with self._mu:
+            if ok and lat is not None:
+                key = (fut.kind, str(fut.version))
+                h = self._hists.get(key)
+                if h is None:
+                    h = self._hists[key] = LatencyHistogram()
+                h.observe(lat * 1e3)
+            if ph:
+                d = self._phases.get(fut.kind)
+                if d is None:
+                    d = self._phases[fut.kind] = {
+                        "queue_wait_s": 0.0, "serve_s": 0.0,
+                        "complete_s": 0.0, "count": 0}
+                for k, v in ph.items():
+                    d[k] += v
+                d["count"] += 1
+            if self.slo is not None:
+                good = (ok and lat is not None
+                        and lat * 1e3 <= self.slo.slo_ms)
+                self.slo.record(good)
+        if self.slo is not None:
+            # alert transitions evaluate on EVERY outcome, not just on
+            # served ticks: a total outage (every tick failing, every
+            # request shed) produces no on_tick_served calls — exactly
+            # when the burn alert must fire
+            self._check_slo_alert()
+
+    def on_tick_served(self, kind: str) -> None:
+        """One served tick: advance the flush cadence, flush the drift
+        window when due (the declared d2h tick), emit SLO records, and
+        evaluate burn-rate alert transitions."""
+        with self._mu:
+            self._ticks += 1
+            t = self._ticks
+        stream = (obs_metrics.stream_for(self._stream_path)
+                  if self._stream_path else None)
+        d = self._drift
+        flushed = (d is not None and self.flush_every > 0
+                   and t % self.flush_every == 0)
+        if flushed:
+            d.flush(stream)
+        if self.slo is not None:
+            if stream is not None and (flushed
+                                       or t % self._slo_emit_every == 0):
+                with self._mu:      # a concurrent shed must not tear
+                    #                 the emitted totals vs burn rates
+                    snap = self.slo.snapshot()
+                stream.emit("slo", **snap)
+            self._check_slo_alert(force=True)
+
+    def _check_slo_alert(self, force: bool = False) -> None:
+        s = self.slo
+        now = time.monotonic()
+        with self._mu:      # one transition wins: concurrent sheds
+            #                 (client threads) race the worker here
+            if not force and now < self._next_alert_check:
+                return      # throttle: the window scans must not run
+                #             per request on the hot paths
+            self._next_alert_check = now + 1.0
+            # burn over every exposed window (THE one window constant —
+            # the alert gate and the gauges must never diverge)
+            burns = {name: s.burn_rate(w, now)
+                     for name, w in s.WINDOWS_S}
+            short = s.WINDOWS_S[0][0]
+            tags = {f"burn_{k}": round(v, 3) for k, v in burns.items()}
+            if not s.alerting and all(v > 1.0 for v in burns.values()):
+                # multi-window gate: a blip the long window has already
+                # absorbed does not page; sustained burn on all does
+                s.alerting = True
+                flight.note("slo_burn", slo_ms=s.slo_ms,
+                            good=s.good_total, bad=s.bad_total, **tags)
+            elif s.alerting and burns[short] <= 1.0:
+                s.alerting = False
+                flight.note("slo_burn_cleared", **tags)
+
+    def final_flush(self) -> None:
+        """Flush a pending partial window at server close so short-lived
+        servers still leave their last drift numbers behind."""
+        d = self._drift
+        try:
+            if d is not None and (d.window_rows or d.score_rows):
+                d.flush(obs_metrics.stream_for(self._stream_path)
+                        if self._stream_path else None)
+        except Exception as err:  # noqa: BLE001 - telemetry on shutdown
+            log.warning(f"[serving] final drift flush failed: {err!r}")
+
+    # -- exposition ----------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """Scalar summary for the nested metrics tree (/healthz JSON and
+        the flattened gauges); the labeled per-feature series live in
+        :meth:`prometheus_text`."""
+        with self._mu:
+            ticks = self._ticks
+        out: Dict[str, Any] = {"ticks": ticks}
+        d = self._drift
+        if d is not None:
+            g = d.gauges()
+            out["drift"] = {
+                "flushes": d.flushes, "host_syncs": d.host_syncs,
+                "window_rows": d.window_rows,
+                "events_total": d.events_total,
+                "features_drifted": len(g.get("drifted") or ()),
+                "max_psi": g.get("max_psi") or 0.0,
+                "score_psi": g.get("score_psi") or 0.0,
+                "score_drifted": bool(g.get("score_drifted")),
+            }
+        if self.slo is not None:
+            with self._mu:
+                out["slo"] = self.slo.snapshot()
+        return out
+
+    def prometheus_text(self) -> str:
+        """The labeled series the flat gauge tree cannot carry: latency
+        histograms per (kind, version), per-phase seconds per kind, and
+        per-feature drift PSI — label values escaped per the Prometheus
+        text exposition."""
+        lines: List[str] = []
+        with self._mu:
+            hists = {k: h.snapshot() for k, h in self._hists.items()}
+            phases = {k: dict(v) for k, v in self._phases.items()}
+        for (kind, version), h in sorted(hists.items()):
+            lines += obs_metrics.render_histogram(
+                "lgbm_tpu_serve_latency_ms",
+                {"kind": kind, "version": version},
+                LATENCY_BUCKETS_MS, h["counts"], h["sum_ms"], h["count"])
+        phase_series = []
+        count_series = []
+        for kind, d in sorted(phases.items()):
+            for phase in ("queue_wait_s", "serve_s", "complete_s"):
+                phase_series.append(({"kind": kind,
+                                      "phase": phase[:-2]}, d[phase]))
+            count_series.append(({"kind": kind}, d["count"]))
+        if phase_series:
+            lines += obs_metrics.render_gauges(
+                "lgbm_tpu_serve_phase_seconds_total", phase_series)
+            lines += obs_metrics.render_gauges(
+                "lgbm_tpu_serve_requests_observed_total", count_series)
+        d = self._drift
+        if d is not None:
+            g = d.gauges()
+            psi_map = g.get("psi") or {}
+            drifted = set(g.get("drifted") or ())
+            if psi_map:
+                lines += obs_metrics.render_gauges(
+                    "lgbm_tpu_drift_psi",
+                    [({"feature": f, "version": d.version}, v)
+                     for f, v in sorted(psi_map.items())])
+                lines += obs_metrics.render_gauges(
+                    "lgbm_tpu_drift_detected",
+                    [({"feature": f, "version": d.version},
+                      1.0 if f in drifted else 0.0)
+                     for f in sorted(psi_map)])
+            if g.get("score_psi") is not None:
+                lines += obs_metrics.render_gauges(
+                    "lgbm_tpu_drift_score_psi",
+                    [({"version": d.version}, float(g["score_psi"]))])
+        if self.slo is not None:
+            with self._mu:
+                s = self.slo.snapshot()
+            for key in ("good_total", "bad_total", "burn_5m", "burn_1h"):
+                lines += obs_metrics.render_gauges(
+                    f"lgbm_tpu_serve_slo_{key}", [({}, float(s[key]))])
+            lines += obs_metrics.render_gauges(
+                "lgbm_tpu_serve_slo_alerting",
+                [({}, 1.0 if s["alerting"] else 0.0)])
+        return "\n".join(lines) + ("\n" if lines else "")

@@ -18,7 +18,10 @@ Events recorded by the shipped hooks: iteration ticks, compile events
 collective-program byte accounting (analysis/hlo.py, when
 LGBM_TPU_COMM_ACCOUNTING=1), fault-injection fires, collective deadline /
 transient-retry outcomes, checkpoint writes, serving swaps and worker
-restarts.
+restarts, and the serving-quality plane (obs/drift.py): drift_flush
+summaries, hysteresis-gated drift_detected / drift_cleared — the
+machine-readable refit trigger of ROADMAP 4 — and slo_burn /
+slo_burn_cleared transitions.
 
 Dump location, first match wins: explicit ``path=``, the
 ``LGBM_TPU_FLIGHT_PATH`` env var, ``<dump_dir>/flight_<pid>.jsonl`` when

@@ -137,6 +137,65 @@ def read_stream(path: str) -> List[Dict[str, Any]]:
 
 
 # -- Prometheus text exposition ---------------------------------------------
+def escape_label_value(value: Any) -> str:
+    """Escape one label VALUE per the Prometheus text exposition format:
+    backslash, double-quote and newline must be escaped (in that order —
+    escaping the backslash last would re-break the other two). Label
+    values are arbitrary UTF-8 (feature names, model versions come from
+    user data), so this is mandatory hygiene, not polish."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _label_name(name: str) -> str:
+    """Sanitize a label NAME to the [a-zA-Z_][a-zA-Z0-9_]* charset (label
+    names, unlike values, have no escape syntax)."""
+    out = "".join(c if c.isalnum() or c == "_" else "_" for c in str(name))
+    if not out or not (out[0].isalpha() or out[0] == "_"):
+        out = "_" + out
+    return out
+
+
+def render_labels(labels: Dict[str, Any]) -> str:
+    """``{k="v",...}`` with escaped values; empty dict renders nothing."""
+    if not labels:
+        return ""
+    inner = ",".join(f'{_label_name(k)}="{escape_label_value(v)}"'
+                     for k, v in labels.items())
+    return "{" + inner + "}"
+
+
+def render_gauges(name: str,
+                  series: List[tuple]) -> List[str]:
+    """One gauge family: a TYPE line plus one sample per
+    ``(labels_dict, value)`` entry."""
+    lines = [f"# TYPE {name} gauge"]
+    for labels, value in series:
+        lines.append(f"{name}{render_labels(labels)} {float(value):.17g}")
+    return lines
+
+
+def render_histogram(name: str, labels: Dict[str, Any],
+                     bucket_bounds, counts, total_sum: float,
+                     total_count: int) -> List[str]:
+    """One Prometheus histogram: per-bucket (NON-cumulative) ``counts``
+    — one per bound plus a final overflow cell — rendered as the
+    cumulative ``_bucket{le=}`` series the format requires, with
+    ``+Inf``, ``_sum`` and ``_count``."""
+    lines = [f"# TYPE {name} histogram"]
+    cum = 0
+    for bound, c in zip(bucket_bounds, counts):
+        cum += int(c)
+        lab = render_labels({**labels, "le": format(float(bound), "g")})
+        lines.append(f"{name}_bucket{lab} {cum}")
+    lab = render_labels({**labels, "le": "+Inf"})
+    lines.append(f"{name}_bucket{lab} {int(total_count)}")
+    base = render_labels(labels)
+    lines.append(f"{name}_sum{base} {float(total_sum):.17g}")
+    lines.append(f"{name}_count{base} {int(total_count)}")
+    return lines
+
+
 def _flatten(prefix: str, value: Any, out: Dict[str, float]) -> None:
     if isinstance(value, bool):
         out[prefix] = 1.0 if value else 0.0
@@ -179,15 +238,20 @@ class MetricsServer:
 
     ``provider()`` returns the nested metrics dict; ``GET /metrics``
     renders it as Prometheus text, ``GET /healthz`` (and ``/health``)
-    returns it as JSON. ``port=0`` binds an ephemeral port (tests);
-    ``.port`` reports the bound one. Serving runs on a daemon thread —
-    ``stop()`` (or the owning server's close) shuts it down."""
+    returns it as JSON. ``text_extra`` (optional) returns pre-rendered
+    exposition lines appended to ``/metrics`` — the labeled series
+    (latency histograms, per-feature drift PSI) the flat gauge tree
+    cannot carry. ``port=0`` binds an ephemeral port (tests); ``.port``
+    reports the bound one. Serving runs on a daemon thread — ``stop()``
+    (or the owning server's close) shuts it down."""
 
     def __init__(self, provider: Callable[[], Dict[str, Any]],
                  port: int = 0, host: str = "127.0.0.1",
-                 prefix: str = PROM_PREFIX):
+                 prefix: str = PROM_PREFIX,
+                 text_extra: Optional[Callable[[], str]] = None):
         self._provider = provider
         self._prefix = prefix
+        self._text_extra = text_extra
         outer = self
 
         class _Handler(http.server.BaseHTTPRequestHandler):
@@ -195,8 +259,10 @@ class MetricsServer:
                 try:
                     tree = outer._provider()
                     if self.path.startswith("/metrics"):
-                        body = render_prometheus(
-                            tree, outer._prefix).encode()
+                        text = render_prometheus(tree, outer._prefix)
+                        if outer._text_extra is not None:
+                            text += outer._text_extra()
+                        body = text.encode()
                         ctype = "text/plain; version=0.0.4; charset=utf-8"
                     elif self.path.startswith(("/healthz", "/health")):
                         body = json.dumps(

@@ -8,6 +8,9 @@ offline from the observability artifacts a run leaves behind — a
 
     scripts/obs run_metrics.jsonl flight_1234.jsonl
     scripts/obs --json run_metrics.jsonl
+    scripts/obs drift serve_metrics.jsonl     # serving-quality view:
+                                              # latest PSI flush + SLO
+                                              # burn tail (obs/drift.py)
 
 prints per-phase host time share, phase-keyed compile totals, persistent-
 cache hit/miss, collective-program byte totals (when the run captured
@@ -29,7 +32,9 @@ from typing import Any, Dict, List, Optional, Sequence
 #: event kinds surfaced in the "notable events" tail
 NOTABLE = ("fault_fire", "deadline", "retry", "crash",
            "training_interrupted", "swap_failed", "worker_restart",
-           "snapshot_corrupt", "straggler", "rank_missing")
+           "snapshot_corrupt", "straggler", "rank_missing",
+           "drift_detected", "drift_cleared", "slo_burn",
+           "slo_burn_cleared")
 
 
 def _read_jsonl(path: str) -> List[Dict[str, Any]]:
@@ -404,6 +409,132 @@ def _fmt_trace(analysis: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def drift_summary(paths: Sequence[str], top: int = 10) -> Dict[str, Any]:
+    """Aggregate serving-quality records (``drift_flush`` / ``slo`` plus
+    drift/SLO events) from metrics streams / flight dumps into one
+    summary dict — the latest flush's PSI table, top-k drifted features,
+    score drift, and the SLO burn-rate tail."""
+    records: List[Dict[str, Any]] = []
+    for p in paths:
+        records.extend(_read_jsonl(p))
+    # the same flush appears TWICE when given both the metrics stream
+    # and a flight dump (the ring carries a summary twin of every
+    # drift_flush): dedup by (version, flush), preferring the record
+    # with the full psi map (the stream one) over the compact twin
+    seen: Dict[tuple, Dict[str, Any]] = {}
+    order: List[tuple] = []
+    for rec in records:
+        if _kind(rec) != "drift_flush":
+            continue
+        key = (rec.get("version"), rec.get("flush"))
+        cur = seen.get(key)
+        if cur is None:
+            seen[key] = rec
+            order.append(key)
+        elif isinstance(rec.get("psi"), dict) \
+                and not isinstance(cur.get("psi"), dict):
+            seen[key] = rec
+    flushes = [seen[k] for k in order]
+    slo = [r for r in records if _kind(r) == "slo"]
+    events = [r for r in records
+              if _kind(r) in ("drift_detected", "drift_cleared",
+                              "slo_burn", "slo_burn_cleared")]
+    latest = flushes[-1] if flushes else None
+    table: List[Dict[str, Any]] = []
+    for rec in reversed(flushes):
+        psi = rec.get("psi")
+        if isinstance(psi, dict) and psi:
+            klm = rec.get("kl") if isinstance(rec.get("kl"), dict) else {}
+            drifted = set(rec.get("drifted") or ())
+            table = [{"feature": k, "psi": float(v),
+                      "kl": klm.get(k), "drifted": k in drifted}
+                     for k, v in sorted(psi.items(),
+                                        key=lambda kv: -float(kv[1]))]
+            break
+    return {
+        "flushes": len(flushes),
+        "latest": latest,
+        "psi_table": table[:max(int(top), 1)],
+        "drift_events": events[-20:],
+        "slo_tail": slo[-8:],
+    }
+
+
+def _fmt_drift(s: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    latest = s.get("latest")
+    if latest is None:
+        lines.append("no drift_flush records found (is "
+                     "tpu_drift_flush_every armed and the stream/flight "
+                     "dump from a serving run?)")
+    else:
+        lines.append(
+            f"drift flushes: {s['flushes']}  latest: flush "
+            f"#{latest.get('flush')} version={latest.get('version')!r} "
+            f"window_rows={latest.get('window_rows')} "
+            f"threshold={latest.get('threshold')}")
+        sp = latest.get("score_psi")
+        lines.append(f"score drift: psi="
+                     f"{sp if sp is not None else '-'}"
+                     + (" [DRIFTED]" if latest.get("score_drifted")
+                        else ""))
+        if s["psi_table"]:
+            lines.append("")
+            lines.append(f"{'feature':<24} {'psi':>10} {'kl':>10}  state")
+            for row in s["psi_table"]:
+                kl = row.get("kl")
+                kls = f"{kl:>10.4f}" if kl is not None else f"{'-':>10}"
+                lines.append(
+                    f"{str(row['feature'])[:24]:<24} "
+                    f"{row['psi']:>10.4f} {kls}"
+                    f"  {'DRIFTED' if row['drifted'] else 'ok'}")
+    if s["drift_events"]:
+        lines.append("")
+        lines.append("drift/SLO events (tail):")
+        for rec in s["drift_events"]:
+            rest = {k: v for k, v in rec.items()
+                    if k not in ("event", "kind", "t", "seq")}
+            lines.append(f"  {_kind(rec)}: {json.dumps(rest, default=str)}")
+    if s["slo_tail"]:
+        lines.append("")
+        lines.append(f"{'good':>10} {'bad':>8} {'burn_5m':>9} "
+                     f"{'burn_1h':>9}  alerting")
+        for rec in s["slo_tail"]:
+            lines.append(
+                f"{int(rec.get('good_total', 0) or 0):>10} "
+                f"{int(rec.get('bad_total', 0) or 0):>8} "
+                f"{float(rec.get('burn_5m', 0) or 0):>9.3f} "
+                f"{float(rec.get('burn_1h', 0) or 0):>9.3f}  "
+                f"{bool(rec.get('alerting'))}")
+    return "\n".join(lines)
+
+
+def drift_main(argv: Sequence[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="obs drift",
+        description="latest serving drift flush: per-feature PSI table, "
+                    "top drifted features, score drift, SLO burn-rate "
+                    "tail (from tpu_metrics_path streams / flight dumps)")
+    ap.add_argument("paths", nargs="+",
+                    help="metrics-stream / flight-dump JSONL files")
+    ap.add_argument("--top", type=int, default=10,
+                    help="PSI table rows (default 10)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the summary as JSON instead of a table")
+    args = ap.parse_args(argv)
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(f"obs drift: no such file: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    s = drift_summary(args.paths, top=args.top)
+    if args.as_json:
+        print(json.dumps(s, indent=1, default=str))
+    else:
+        print(_fmt_drift(s))
+    return 0
+
+
 def trace_main(argv: Sequence[str]) -> int:
     ap = argparse.ArgumentParser(
         prog="obs trace",
@@ -434,6 +565,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return trace_main(argv[1:])
     if argv and argv[0] == "merge":
         return merge_main(argv[1:])
+    if argv and argv[0] == "drift":
+        return drift_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="obs", description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="+",

@@ -66,6 +66,12 @@ class ServeFuture:
         self.deadline_ms = deadline_ms
         self.version = None                 # model version that answered
         self.created_at = time.monotonic()
+        # latency-attribution stamps (obs/drift.ServingObserver):
+        # popped_at when the tick cuts this request out of the queue,
+        # served_at when the device response is host-materialized —
+        # queue-wait / featurize+dispatch / slice-return fall out
+        self.popped_at: Optional[float] = None
+        self.served_at: Optional[float] = None
         self.completed_at: Optional[float] = None
         self._value = None
         self._error: Optional[BaseException] = None
@@ -108,6 +114,28 @@ class ServeFuture:
             return None
         return self.completed_at - self.created_at
 
+    def phase_times(self) -> Optional[dict]:
+        """Per-request latency attribution: ``queue_wait_s`` (submit ->
+        popped into a tick), ``serve_s`` (featurize + device dispatch +
+        host materialization), ``complete_s`` (per-request slice/copy +
+        completion). None until the request reached a tick (sheds and
+        queue-expired timeouts never did).
+
+        Stamps are clamped into ``created <= popped <= served <=
+        completed`` order: a client-side result() timeout can complete
+        the future BEFORE the worker stamps served_at (the completion
+        CAS), and un-clamped that would feed negative phase seconds into
+        the cumulative gauges."""
+        if self.completed_at is None or self.popped_at is None:
+            return None
+        done = self.completed_at
+        popped = min(self.popped_at, done)
+        served = done if self.served_at is None \
+            else min(max(self.served_at, popped), done)
+        return {"queue_wait_s": popped - self.created_at,
+                "serve_s": served - popped,
+                "complete_s": done - served}
+
     def result(self, timeout: Optional[float] = None):
         if timeout is None:
             if self.deadline is not None:
@@ -140,7 +168,7 @@ class MicroBatchCoalescer:
     def __init__(self, serve_batch: Callable[[List[ServeFuture]], None],
                  *, tick_ms: float, queue_max_rows: int,
                  max_batch_rows: int, fault_config=None,
-                 name: str = "serve"):
+                 name: str = "serve", observer=None):
         if queue_max_rows < 1:
             raise ValueError("tpu_serve_queue_max must be >= 1 row")
         if max_batch_rows < 1:
@@ -150,6 +178,12 @@ class MicroBatchCoalescer:
         self._queue_max_rows = int(queue_max_rows)
         self._max_batch_rows = int(max_batch_rows)
         self._fault_config = fault_config
+        # quality-plane hook (obs/drift.ServingObserver): on_future_done
+        # per completed/failed future, on_tick_served per served tick
+        # (the drift-flush cadence). Best-effort: observer failures must
+        # never fail serving (_notify swallows + warns once)
+        self._observer = observer
+        self._observer_warned = False
         self._cv = threading.Condition()
         # each request holds >= 1 row and admission rejects past the row
         # bound first, so maxlen (a hard REQUEST cap) is never the
@@ -163,6 +197,10 @@ class MicroBatchCoalescer:
             "submitted": 0, "served_requests": 0, "served_rows": 0,
             "ticks": 0, "shed": 0, "timeouts": 0, "errors": 0,
             "worker_restarts": 0, "max_queue_rows": 0,
+            # per-endpoint-kind breakdown (ticks pop homogeneous-kind
+            # batches, so every counter keys cleanly); the flat keys
+            # above stay the aggregates for compatibility
+            "kinds": {},
         }
         self._thread = threading.Thread(
             target=self._run, daemon=True, name=f"lgbm-tpu-{name}-coalescer")
@@ -193,8 +231,10 @@ class MicroBatchCoalescer:
                 raise ServerClosed("server is draining/closed; "
                                    "request rejected")
             self.stats["submitted"] += 1
+            self._kstats(kind)["submitted"] += 1
             if self._rows + n > self._queue_max_rows:
                 self.stats["shed"] += 1
+                self._kstats(kind)["shed"] += 1
                 raise ServerOverloaded(self._rows, self._queue_max_rows)
             self._q.append(fut)
             self._rows += n
@@ -202,6 +242,38 @@ class MicroBatchCoalescer:
                 self.stats["max_queue_rows"], self._rows)
             self._cv.notify_all()
         return fut
+
+    def _kstats(self, kind: str) -> dict:
+        """Per-endpoint-kind counter block (created on first use); must
+        be called under ``self._cv``."""
+        ks = self.stats["kinds"].get(kind)
+        if ks is None:
+            ks = self.stats["kinds"][kind] = {
+                "submitted": 0, "served_requests": 0, "served_rows": 0,
+                "shed": 0, "timeouts": 0, "errors": 0}
+        return ks
+
+    def stats_snapshot(self) -> dict:
+        """Consistent deep copy of the counters (the nested per-kind
+        blocks must not alias the live dicts a tick mutates)."""
+        with self._cv:
+            out = {k: v for k, v in self.stats.items() if k != "kinds"}
+            out["kinds"] = {k: dict(v)
+                            for k, v in self.stats["kinds"].items()}
+            return out
+
+    def _notify(self, fut: ServeFuture) -> None:
+        """Hand one completed/failed future to the quality-plane
+        observer; never from under ``self._cv``, never raising."""
+        if self._observer is None:
+            return
+        try:
+            self._observer.on_future_done(fut)
+        except Exception as err:  # noqa: BLE001 - telemetry is best-effort
+            if not self._observer_warned:
+                self._observer_warned = True
+                log.warning(f"[serving] observer failed ({err!r}); "
+                            "further failures suppressed")
 
     def queue_depth_rows(self) -> int:
         with self._cv:
@@ -234,6 +306,14 @@ class MicroBatchCoalescer:
         """Next batch (possibly empty after a deadline sweep), or None to
         exit. Blocks in SHORT bounded waits so close() is always
         responsive."""
+        swept: List[ServeFuture] = []
+        batch = self._pop_batch_locked(swept)
+        for r in swept:                 # observer runs OUTSIDE the lock
+            self._notify(r)
+        return batch
+
+    def _pop_batch_locked(self, swept: List[ServeFuture]
+                          ) -> Optional[List[ServeFuture]]:
         with self._cv:
             while not self._q:
                 if self._closing:
@@ -263,8 +343,10 @@ class MicroBatchCoalescer:
                     self._q.popleft()
                     self._rows -= r.n
                     self.stats["timeouts"] += 1
+                    self._kstats(r.kind)["timeouts"] += 1
                     r._fail(ServingTimeout("request expired in queue",
                                            r.deadline_ms))
+                    swept.append(r)
                     continue
                 if r.n > self._max_batch_rows:
                     # admitted before a hot-swap shrank the warmed-rung
@@ -273,11 +355,13 @@ class MicroBatchCoalescer:
                     self._q.popleft()
                     self._rows -= r.n
                     self.stats["errors"] += 1
+                    self._kstats(r.kind)["errors"] += 1
                     r._fail(ServingError(
                         f"request of {r.n} rows exceeds the active "
                         f"model's largest warmed rung "
                         f"({self._max_batch_rows}) after a model swap; "
                         "resubmit in smaller slices"))
+                    swept.append(r)
                     continue
                 if batch and r.kind != batch[0].kind:
                     # one endpoint per tick: a batch is ONE device
@@ -289,6 +373,7 @@ class MicroBatchCoalescer:
                     break                   # next tick's batch
                 self._q.popleft()
                 self._rows -= r.n
+                r.popped_at = now
                 batch.append(r)
                 rows += r.n
             return batch
@@ -301,6 +386,7 @@ class MicroBatchCoalescer:
             if not batch:
                 continue
             rows = sum(r.n for r in batch)
+            kind = batch[0].kind            # ticks are kind-homogeneous
             # count BEFORE the futures complete: clients synchronize on
             # result(), so a stats read right after it must already see
             # this batch (rolled back below if the tick fails)
@@ -308,6 +394,9 @@ class MicroBatchCoalescer:
                 self.stats["ticks"] += 1
                 self.stats["served_requests"] += len(batch)
                 self.stats["served_rows"] += rows
+                ks = self._kstats(kind)
+                ks["served_requests"] += len(batch)
+                ks["served_rows"] += rows
             try:
                 # the slow-tick / worker-kill injection point: fired
                 # OUTSIDE the queue lock, so a hanging tick converts into
@@ -325,6 +414,10 @@ class MicroBatchCoalescer:
                     self.stats["served_requests"] -= len(batch)
                     self.stats["served_rows"] -= rows
                     self.stats["errors"] += 1
+                    ks = self._kstats(kind)
+                    ks["served_requests"] -= len(batch)
+                    ks["served_rows"] -= rows
+                    ks["errors"] += 1
                 flight.note("serve_tick_error", requests=len(batch),
                             rows=rows, error=repr(err)[:200])
                 # one FRESH exception per future: concurrent result()
@@ -334,8 +427,24 @@ class MicroBatchCoalescer:
                        else f"serving tick failed: {err!r}")
                 for r in batch:
                     r._fail(ServingError(msg))
+                    self._notify(r)
                 if not isinstance(err, Exception):
                     raise           # a worker kill: respawn boundary below
+                continue
+            # success: futures first (their latency/SLO outcomes), then
+            # the tick boundary — the drift-flush cadence sees this
+            # tick's window fully accumulated
+            for r in batch:
+                self._notify(r)
+            if self._observer is not None:
+                try:
+                    self._observer.on_tick_served(kind)
+                except Exception as err:  # noqa: BLE001 - best-effort
+                    if not self._observer_warned:
+                        self._observer_warned = True
+                        log.warning(f"[serving] observer tick hook "
+                                    f"failed ({err!r}); further failures "
+                                    "suppressed")
 
     def _run(self) -> None:
         while True:

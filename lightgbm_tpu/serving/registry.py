@@ -89,7 +89,8 @@ class ModelRegistry:
     def deploy(self, version: str, booster, *, warm: bool = True,
                warm_max_rows: Optional[int] = None,
                health_check: bool = True,
-               deadline_s: float = 30.0) -> Dict:
+               deadline_s: float = 30.0,
+               prepare_drift: Optional[bool] = None) -> Dict:
         """Register ``booster`` as ``version`` and atomically make it
         active. Returns the candidate's warmup stats.
 
@@ -128,6 +129,19 @@ class ModelRegistry:
             if warm:
                 warm_stats = booster.warm_predict_ladder(
                     max_rows=warm_max_rows)
+            drift_armed = (prepare_drift if prepare_drift is not None
+                           else int(inner.config.get(
+                               "tpu_drift_flush_every", 0) or 0) > 0)
+            if drift_armed:
+                # the drift reference SHIPS with the model: materialize
+                # the training-data bin-occupancy baseline AND the host
+                # copy of the training margins here in the warm phase
+                # (both cache), so the post-swap monitor attach — and
+                # therefore the commit flip — never stalls on a
+                # full-data occupancy pass. ``prepare_drift`` carries
+                # the server's arming decision (per-server overrides
+                # the config knob alone would miss)
+                inner.drift_reference()
             if health_check:
                 self._health_check(booster, version)
         except Exception as err:

@@ -22,19 +22,28 @@ Throughput/latency numbers live in BENCH_SHAPES.json["serving"]
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, Optional
 
 import numpy as np
 
 from ..analysis import guards
 from ..analysis.faultinject import active_plan
+from ..obs.drift import ServingObserver
 from ..ops.predict import parse_bucket_ladder, warmup_rungs
 from .coalescer import MicroBatchCoalescer, ServeFuture
+from .errors import ServerOverloaded, ServingError
 from .registry import ModelRegistry
 
 
 class PredictionServer:
-    """Micro-batching, deadline-aware, hot-swappable serving front."""
+    """Micro-batching, deadline-aware, hot-swappable serving front.
+
+    The serving-quality plane (obs/drift.ServingObserver) rides along:
+    per-request latency attribution histograms always; the on-device
+    drift monitor when ``tpu_drift_flush_every > 0`` (or
+    ``drift_flush_every=``), the SLO burn-rate tracker when
+    ``tpu_serve_slo_ms > 0`` (or ``slo_ms=``)."""
 
     def __init__(self, booster=None, *, registry: Optional[ModelRegistry]
                  = None, version: str = "v0",
@@ -43,16 +52,23 @@ class PredictionServer:
                  deadline_ms: Optional[float] = None,
                  warm: bool = True, warm_max_rows: Optional[int] = None,
                  raw_score: bool = False, swap_deadline_s: float = 30.0,
-                 metrics_port: Optional[int] = None):
+                 metrics_port: Optional[int] = None,
+                 slo_ms: Optional[float] = None,
+                 slo_target: Optional[float] = None,
+                 drift_flush_every: Optional[int] = None,
+                 drift_psi_threshold: Optional[float] = None):
         self._registry = registry if registry is not None else ModelRegistry()
         self._raw_score = bool(raw_score)
         self._swap_deadline_s = float(swap_deadline_s)
         self._closed = False
         self._mu = threading.Lock()
         if booster is not None:
-            self._registry.deploy(version, booster, warm=warm,
-                                  warm_max_rows=warm_max_rows,
-                                  deadline_s=self._swap_deadline_s)
+            self._registry.deploy(
+                version, booster, warm=warm,
+                warm_max_rows=warm_max_rows,
+                deadline_s=self._swap_deadline_s,
+                prepare_drift=(drift_flush_every > 0
+                               if drift_flush_every is not None else None))
         _, active = self._registry.active()     # requires a deployed model
         cfg = active._gbdt.config
         self._fault_config = cfg
@@ -67,10 +83,17 @@ class PredictionServer:
             warm_max_rows = int(cfg.get("tpu_serve_warm_max_rows", 0) or 0)
         self._warm_max_rows = warm_max_rows
         self._n_features = active._gbdt.train_set.num_total_features
+        # the serving-quality plane: built BEFORE the coalescer (whose
+        # worker notifies it) and attached to the active model after
+        self._obs = ServingObserver(
+            cfg, slo_ms=slo_ms, slo_target=slo_target,
+            drift_flush_every=drift_flush_every,
+            drift_psi_threshold=drift_psi_threshold)
         self._coalescer = MicroBatchCoalescer(
             self._serve_batch, tick_ms=tick_ms, queue_max_rows=queue_max,
             max_batch_rows=self._resolve_max_batch(active),
-            fault_config=cfg)
+            fault_config=cfg, observer=self._obs)
+        self._attach_obs_model()
         # metrics plane (obs/metrics.py): pull-based Prometheus text over
         # stdlib HTTP. None = off; 0 = ephemeral port (.metrics_port tells)
         self._metrics_server = None
@@ -146,8 +169,20 @@ class PredictionServer:
         if deadline_ms is None:
             deadline_ms = self._deadline_ms
         deadline_s = (deadline_ms / 1000.0) if deadline_ms > 0 else None
-        return self._coalescer.submit(
-            arr, deadline_s, deadline_ms if deadline_ms > 0 else 0.0, kind)
+        try:
+            return self._coalescer.submit(
+                arr, deadline_s, deadline_ms if deadline_ms > 0 else 0.0,
+                kind)
+        except ServerOverloaded:
+            # a shed IS a failed request from the client's side: it must
+            # burn the SLO error budget even though no future exists.
+            # Guarded like every observer hook — a telemetry failure
+            # must not replace the structured error clients catch
+            try:
+                self._obs.on_shed(kind)
+            except Exception:  # noqa: BLE001 - telemetry is best-effort
+                pass
+            raise
 
     def submit_leaf(self, data, deadline_ms: Optional[float] = None
                     ) -> ServeFuture:
@@ -194,7 +229,6 @@ class PredictionServer:
             # compile in the request path (or overflow the new ladder) —
             # raise, and the coalescer fails every request structurally
             # (and counts the tick as an error, not as served)
-            from .errors import ServingError
             raise ServingError(
                 f"batch of {rows} rows exceeds model {version!r}'s "
                 "largest warmed rung (hot-swap landed mid-tick); "
@@ -205,7 +239,6 @@ class PredictionServer:
             # landed before this pin: the new model never warmed this
             # kind's programs, so serving it would compile in the request
             # path — fail structurally, like the oversized-rows case
-            from .errors import ServingError
             raise ServingError(
                 f"endpoint {kind!r} is not enabled on model {version!r} "
                 "(hot-swap landed mid-queue); resubmit against the new "
@@ -214,14 +247,25 @@ class PredictionServer:
             x = batch[0].arr
         else:
             x = np.concatenate([r.arr for r in batch], axis=0)
+        # drift window: the tick's binned matrix (and, for predict, the
+        # raw margins) fold into the active monitor's device accumulators
+        # — only when the monitor matches this tick's pinned version (a
+        # swap landing mid-queue must not mix models' windows)
+        drift = self._obs.drift_for(version)
         if kind == "leaf":
-            out, _ = booster.predict_leaf_serving(x)
+            out, _ = booster.predict_leaf_serving(x, observe=drift)
         elif kind == "contrib":
-            out, _ = booster.predict_contrib_serving(x)
+            out, _ = booster.predict_contrib_serving(x, observe=drift)
         else:
-            out, _ = booster.predict_serving(x, raw_score=self._raw_score)
+            out, _ = booster.predict_serving(x, raw_score=self._raw_score,
+                                             observe=drift)
+        # latency attribution: `out` is host-materialized above (the
+        # serving calls return numpy), so this stamp brackets completed
+        # device work — R009 allowlist anchor, not an async-dispatch lie
+        served_at = time.monotonic()
         off = 0
         for r in batch:
+            r.served_at = served_at
             # copy: the padded rung buffer must not stay pinned by views
             r._complete(version, np.array(out[off:off + r.n]))
             off += r.n
@@ -236,7 +280,12 @@ class PredictionServer:
         stats = self._registry.deploy(
             version, booster, warm=warm, warm_max_rows=self._warm_max_rows,
             deadline_s=self._swap_deadline_s if deadline_s is None
-            else float(deadline_s))
+            else float(deadline_s),
+            # this server's drift arming (per-server override included)
+            # decides whether the candidate's reference distributions
+            # must materialize in the warm phase — the config knob alone
+            # would miss booster.serve(drift_flush_every=...) servers
+            prepare_drift=self._obs.flush_every > 0)
         self._after_model_change()
         return stats
 
@@ -262,6 +311,14 @@ class PredictionServer:
             self._coalescer.set_fault_config(active._gbdt.config)
             self._coalescer.set_max_batch_rows(
                 self._resolve_max_batch(active))
+        self._attach_obs_model()
+
+    def _attach_obs_model(self) -> None:
+        """(Re)point the quality plane at the active model: fresh drift
+        reference + warmed accumulate programs per warmed rung."""
+        version, active = self._registry.active()
+        warm = self._registry.warm_stats(version) or {}
+        self._obs.attach_model(version, active, warm.get("rungs") or [])
 
     @property
     def registry(self) -> ModelRegistry:
@@ -275,7 +332,7 @@ class PredictionServer:
         device = guards.device_healthcheck()
         active = self._registry.active_version()
         warm = self._registry.warm_stats(active) or {}
-        stats = dict(self._coalescer.stats)
+        stats = self._coalescer.stats_snapshot()
         ready = bool(device["ok"] and active is not None
                      and warm.get("rungs") and not self._closed
                      and self._coalescer.worker_alive())
@@ -303,18 +360,25 @@ class PredictionServer:
     # -- metrics plane -------------------------------------------------------
     def metrics(self) -> Dict[str, Any]:
         """The nested numeric view behind ``GET /metrics``: the health
-        snapshot plus process-lifetime phase-keyed compile counts and
-        persistent-cache counters — one schema with the training metrics
-        stream (same counter names, same attribution)."""
+        snapshot plus process-lifetime phase-keyed compile counts,
+        persistent-cache counters, and the serving-quality scalars
+        (drift flush/score summary, SLO burn rates) — one schema with
+        the training metrics stream (same counter names, same
+        attribution). The labeled series (per-feature PSI, latency
+        histograms) ride the exposition text, not this tree."""
         out = self.health()
         out["compiles"] = guards.phase_compile_counts()
         out["compile_cache"] = guards.global_cache_counts()
+        out["serving_obs"] = self._obs.snapshot()
         return out
 
     def metrics_text(self) -> str:
-        """Prometheus text exposition of :meth:`metrics`."""
+        """Prometheus text exposition of :meth:`metrics` plus the
+        labeled serving-quality series (latency histograms per
+        endpoint/version, per-feature drift PSI, SLO gauges)."""
         from ..obs import metrics as obs_metrics
-        return obs_metrics.render_prometheus(self.metrics())
+        return (obs_metrics.render_prometheus(self.metrics())
+                + self._obs.prometheus_text())
 
     def serve_metrics(self, port: int = 0) -> int:
         """Start the ``/metrics`` + ``/healthz`` HTTP endpoint; returns
@@ -335,7 +399,8 @@ class PredictionServer:
                         "first)")
                 return bound
             self._metrics_server = obs_metrics.MetricsServer(
-                self.metrics, port=port)
+                self.metrics, port=port,
+                text_extra=self._obs.prometheus_text)
             return self._metrics_server.port
 
     @property
@@ -344,16 +409,28 @@ class PredictionServer:
             else self._metrics_server.port
 
     @property
-    def stats(self) -> Dict[str, int]:
-        return dict(self._coalescer.stats)
+    def stats(self) -> Dict[str, Any]:
+        return self._coalescer.stats_snapshot()
+
+    @property
+    def observer(self) -> ServingObserver:
+        """The serving-quality plane: latency histograms, drift monitor
+        (``observer.drift``), SLO tracker (``observer.slo``)."""
+        return self._obs
 
     # -- lifecycle -----------------------------------------------------------
     def close(self, drain: bool = True,
               timeout_s: Optional[float] = None) -> None:
         """Graceful shutdown: stop admission, drain (or fail) the queue,
-        join the worker, stop the metrics endpoint."""
+        join the worker, flush any pending drift window, stop the
+        metrics endpoint."""
         self._closed = True
         self._coalescer.close(drain=drain, timeout_s=timeout_s)
+        if not self._coalescer.worker_alive():
+            # only after the worker actually exited: a timed-out join
+            # (hung tick) leaves it running, and a concurrent final
+            # flush would race its unsynchronized window accumulation
+            self._obs.final_flush()
         with self._mu:
             # stop AND clear: a later serve_metrics() must bind fresh,
             # not report the port of a dead endpoint as already-bound

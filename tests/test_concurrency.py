@@ -355,3 +355,70 @@ def test_sanitizer_ignores_same_thread_nesting():
         bst.update()
         assert len(s) > 0
     assert san.races == []
+
+
+# ------------------------------------------------- metrics scrape (ISSUE 14)
+def test_metrics_scrape_mid_traffic_under_sanitizer():
+    """ISSUE 14: 16 threads split between serving traffic and /metrics +
+    /healthz scrapes while drift + SLO monitors are armed. Every scrape
+    must return a parseable body (Prometheus text with escaped labels /
+    JSON), the rwlock discipline stays race-free under the sanitizer,
+    and the scrapes themselves compile nothing."""
+    import json as _json
+    import urllib.request
+
+    bst, X = _train(5, tpu_predict_buckets="32,256")
+    bst.warm_predict_ladder()
+    srv = bst.serve(tick_ms=1.0, queue_max=4096, deadline_ms=5000.0,
+                    drift_flush_every=3, slo_ms=5000.0, metrics_port=0)
+    port = srv.metrics_port
+    assert port
+    errors = []
+    bodies = []
+    started = threading.Barrier(N_THREADS + 1)
+
+    def client(i):
+        try:
+            started.wait()
+            if i % 2 == 0:                   # traffic half
+                for j in range(6):
+                    srv.submit(X[: 1 + (i + j) % 64]).result()
+            else:                            # scrape half
+                for j in range(6):
+                    path = "/metrics" if j % 2 == 0 else "/healthz"
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}{path}",
+                            timeout=10) as resp:
+                        bodies.append((path, resp.read().decode()))
+        except Exception as err:  # pragma: no cover - the failure path
+            errors.append(err)
+
+    try:
+        # prime every rung once so the guarded window is steady-state
+        for s in (1, 64, 200):
+            srv.predict(X[:s])
+        with guards.api_race_sanitizer() as san, \
+                guards.compile_counter() as cc:
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(N_THREADS)]
+            for t in threads:
+                t.start()
+            started.wait()
+            for t in threads:
+                t.join()
+        assert not errors, errors[:3]
+        assert len(bodies) == (N_THREADS // 2) * 6
+        for path, body in bodies:
+            if path == "/metrics":
+                assert "lgbm_tpu_ready" in body
+                # every sample line parses as `name[{labels}] value`
+                for ln in body.splitlines():
+                    if not ln or ln.startswith("#"):
+                        continue
+                    float(ln.rsplit(" ", 1)[1])
+            else:
+                assert _json.loads(body)["active_version"] == "v0"
+        san.assert_no_races("16-thread traffic + /metrics scrapes")
+        cc.assert_no_compiles("metrics scrape mid-traffic")
+    finally:
+        srv.close(drain=False, timeout_s=5.0)

@@ -1,0 +1,672 @@
+"""Serving-quality observability (ISSUE 14).
+
+Acceptance surface: (1) drift monitors + SLO tracker ENABLED add 0
+steady-state recompiles and 0 per-tick host transfers — the window
+accumulators are pure on-device adds, d2h happens only at the declared
+flush cadence (``host_syncs`` == flushes); (2) injected covariate shift
+on a served feature raises a ``drift_detected`` flight event naming that
+feature (and a nonzero PSI gauge) within ONE flush, while unshifted
+traffic stays quiet across >= 3 flushes; (3) events are hysteresis-gated
+(no re-fire while drifted, cleared only below half the threshold);
+(4) per-request latency attribution phases + per-(kind, version)
+histograms; (5) SLO burn rates + ``slo_burn`` events; (6) Prometheus
+label escaping survives hostile feature names; (7) per-endpoint-kind
+coalescer stats; (8) the jax-free ``scripts/obs drift`` summary.
+"""
+import json
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.analysis import guards
+from lightgbm_tpu.io import binning
+from lightgbm_tpu.obs import drift as drift_mod
+from lightgbm_tpu.obs import flight
+from lightgbm_tpu.obs import metrics as obs_metrics
+from lightgbm_tpu.obs import summarize
+from lightgbm_tpu.obs.drift import (DriftMonitor, LatencyHistogram,
+                                    SloTracker, equal_mass_groups,
+                                    group_counts, kl_rows, psi_rows)
+
+from utils import FAST_PARAMS
+
+LADDER = "64,256"
+
+
+def _params(**kw):
+    return dict(FAST_PARAMS, objective="binary", verbosity=-1,
+                tpu_predict_buckets=LADDER, **kw)
+
+
+def _data(n=600, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 2] > 0).astype(np.float64)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def drift_booster():
+    X, y = _data()
+    bst = lgb.train(_params(), lgb.Dataset(X, label=y), 5)
+    return bst, X
+
+
+def _wait_flushes(mon, n, timeout_s=10.0):
+    """The flush runs on the serving worker AFTER the futures complete;
+    a client must poll, not assert immediately."""
+    end = time.monotonic() + timeout_s
+    while mon.flushes < n:
+        if time.monotonic() >= end:
+            raise AssertionError(
+                f"flushes stuck at {mon.flushes}, wanted {n}")
+        time.sleep(0.005)
+
+
+def _events_since(seq0, names):
+    return [e for e in flight.recorder().events()
+            if e["seq"] > seq0 and e["event"] in names]
+
+
+# ----------------------------------------------------------- divergence math
+def test_psi_zero_on_identical():
+    p = np.array([[0.5, 0.3, 0.2], [0.1, 0.6, 0.3]])
+    np.testing.assert_allclose(psi_rows(p, p), 0.0, atol=1e-12)
+    np.testing.assert_allclose(kl_rows(p, p), 0.0, atol=1e-12)
+
+
+def test_psi_positive_on_shift():
+    p = np.array([[0.5, 0.3, 0.2]])
+    q = np.array([[0.1, 0.2, 0.7]])
+    assert psi_rows(p, q)[0] > 0.2
+    assert kl_rows(p, q)[0] > 0.0
+    # PSI is symmetric in (p, q) exchange; KL is not
+    np.testing.assert_allclose(psi_rows(p, q), psi_rows(q, p))
+
+
+def test_equal_mass_groups_monotone_and_balanced():
+    rng = np.random.RandomState(1)
+    p = rng.dirichlet(np.ones(100), size=3)
+    gid = equal_mass_groups(p, 10)
+    assert gid.shape == p.shape
+    assert (np.diff(gid, axis=1) >= 0).all()          # monotone
+    assert gid.min() == 0 and gid.max() == 9
+    g = group_counts(p, gid, 10)
+    # ~equal mass per group (each group holds >= ~half its fair share)
+    assert (g > 0.04).all() and (g < 0.25).all()
+
+
+def test_equal_mass_groups_few_bins_identity():
+    p = np.array([[0.7, 0.3]])
+    gid = equal_mass_groups(p, 16)
+    # 2 bins cannot fill 16 groups; bins stay separated
+    assert gid[0, 0] != gid[0, 1]
+
+
+# ------------------------------------------------- reference distribution
+def test_reference_distribution_matches_bincount(drift_booster):
+    bst, X = drift_booster
+    ds = bst._gbdt.train_set
+    probs, nb = ds.reference_bin_distribution()
+    assert probs.shape[0] == ds.num_total_features
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+    # ground truth: histogram the per-feature binned matrix directly
+    raw = binning.bin_columns(ds.mappers, X, ds.binned.dtype)
+    for j in range(ds.num_total_features):
+        h = np.bincount(raw[:, j], minlength=probs.shape[1])
+        np.testing.assert_allclose(
+            probs[j], h[:probs.shape[1]] / len(X), atol=1e-6)
+    # and it is cached (ships with the model through the registry)
+    assert ds.reference_bin_distribution() is ds.reference_bin_distribution()
+
+
+def test_bin_occupancy_efb_bundle_decode():
+    """EFB-bundled matrices decode member features through their bundle
+    offset ranges — occupancy must match the UNBUNDLED per-feature
+    histogram exactly on a conflict-free one-hot block (bundling at
+    construct needs >= 256 features; build the plan by hand)."""
+    from lightgbm_tpu.io import efb
+    rng = np.random.RandomState(3)
+    n = 400
+    hot = rng.randint(0, 4, n)
+    X = np.zeros((n, 6))
+    for k in range(4):                       # mutually exclusive block
+        X[:, k] = (hot == k).astype(float)
+    X[:, 4] = rng.randn(n)
+    X[:, 5] = rng.randn(n)
+    ds = lgb.Dataset(X, label=(X[:, 4] > 0).astype(float),
+                     params=dict(FAST_PARAMS)).construct()
+    inner = ds._inner
+    assert inner.bundle_info is None         # too few features for EFB
+    binned = inner.binned
+    nb = inner.feature_num_bins()
+    dflt = np.array([m.default_bin for m in inner.mappers], np.int32)
+    info = efb.build_bundle_info([[0, 1, 2, 3]], nb, 6)
+    bundled, conflicts = efb.bundle_chunk(binned, info, dflt)
+    assert conflicts == 0
+    counts, nb2 = binning.bin_occupancy(bundled, inner.mappers, info)
+    truth, _ = binning.bin_occupancy(binned, inner.mappers, None)
+    np.testing.assert_allclose(counts, truth, atol=1e-9)
+
+
+# ------------------------------------------------------- monitor mechanics
+def test_monitor_device_accumulate_no_host_transfers(drift_booster):
+    """THE per-tick transfer guard: device-binned observes are pure
+    on-device adds — nothing materializes on the host until flush, and
+    flush is exactly one sync."""
+    import jax.numpy as jnp
+    bst, X = drift_booster
+    mon = DriftMonitor("vg", bst, flush_every=8, psi_threshold=0.2,
+                       score_bins=16)
+    mon.warm([64])
+    g = bst._gbdt
+    dev_bins = g.featurize_rung(X[:50].astype(np.float32))
+    dev_scores = jnp.zeros((1, 64), jnp.float32)
+    with guards.compile_counter() as cc:
+        with guards.no_host_transfers():
+            for _ in range(5):
+                mon.observe_binned(dev_bins, 50)
+                mon.observe_scores(dev_scores, 50)
+    assert cc.lowerings == 0, "observe lowered a program post-warm"
+    assert mon.host_syncs == 0
+    rec = mon.flush()
+    assert mon.host_syncs == 1               # the ONE declared d2h
+    assert rec["window_rows"] == 250
+
+
+def test_monitor_host_hatch_accumulate(drift_booster):
+    """tpu_serve_featurize=host bins land in the host twin accumulator
+    and flush identically (no device arrays involved)."""
+    bst, X = drift_booster
+    mon = DriftMonitor("vh", bst, flush_every=4, psi_threshold=0.2,
+                       score_bins=16)
+    host_bins = bst._gbdt.bin_matrix(X.astype(np.float32))
+    mon.observe_binned(host_bins, len(X))
+    rec = mon.flush()
+    assert rec["window_rows"] == len(X)
+    assert mon.host_syncs == 0               # nothing ever hit a device
+    assert rec["max_psi"] < 0.2              # training rows: no drift
+
+
+def test_monitor_hysteresis_band(drift_booster):
+    """drift_detected fires ONCE on crossing; a PSI inside the
+    (exit, enter) band keeps the drifted state without re-firing; only
+    below HALF the threshold does drift_cleared fire."""
+    bst, X = drift_booster
+    mon = DriftMonitor("vband", bst, flush_every=4, psi_threshold=0.2,
+                       score_bins=16)
+    shifted = X.copy()
+    shifted[:, 2] += 3.0
+    bins = bst._gbdt.bin_matrix(shifted.astype(np.float32))
+    name = mon.feature_names[2]
+
+    mon.observe_binned(bins, len(bins))
+    r1 = mon.flush()
+    psi = r1["psi"][name]
+    assert psi >= 0.2
+    assert {(e["event"], e["feature"]) for e in r1["events"]} >= {
+        ("drift_detected", name)}
+    # same shifted window again: still drifted, NO second event
+    mon.observe_binned(bins, len(bins))
+    r2 = mon.flush()
+    assert not [e for e in r2["events"] if e["feature"] == name]
+    assert name in r2["drifted"]
+    # in-band (exit < psi < enter): state holds, no event either way
+    mon.threshold, mon.exit_threshold = psi * 2.0, psi * 0.5
+    mon.observe_binned(bins, len(bins))
+    r3 = mon.flush()
+    assert not [e for e in r3["events"] if e["feature"] == name]
+    assert name in r3["drifted"]
+    # below the exit band: cleared exactly once
+    mon.exit_threshold = psi * 2.0
+    mon.observe_binned(bins, len(bins))
+    r4 = mon.flush()
+    assert [e for e in r4["events"]
+            if e["feature"] == name and e["event"] == "drift_cleared"]
+    assert name not in r4["drifted"]
+
+
+def test_monitor_low_traffic_window_fires_no_events(drift_booster):
+    """PSI sampling noise ~ (G-1)/rows: a window below min_rows must
+    update gauges but NOT fire events — a low-traffic service does not
+    cry wolf. A big-enough shifted window then fires normally."""
+    bst, X = drift_booster
+    mon = DriftMonitor("vlow", bst, flush_every=4, psi_threshold=0.2,
+                       score_bins=16)
+    assert mon.min_rows == 20 * mon._G       # auto default
+    shifted = X.copy()
+    shifted[:, 2] += 3.0
+    bins = bst._gbdt.bin_matrix(shifted.astype(np.float32))
+    mon.observe_binned(bins[:40], 40)        # well under min_rows
+    rec = mon.flush()
+    assert rec["low_traffic"] is True
+    assert rec["max_psi"] > 0                # gauges still update
+    assert not rec["events"] and not rec["drifted"]
+    mon.observe_binned(bins, len(bins))      # 600 rows: gate open
+    rec2 = mon.flush()
+    assert rec2["low_traffic"] is False
+    assert [e for e in rec2["events"] if e["event"] == "drift_detected"]
+
+
+# -------------------------------------------------- serving integration
+def test_injected_shift_detected_within_one_flush(drift_booster):
+    """Train on one distribution, serve a shifted one: the right feature
+    raises drift_detected within ONE flush; unshifted traffic first
+    stays quiet across >= 3 flushes."""
+    bst, X = drift_booster
+    seq0 = flight.recorder().events()[-1]["seq"] \
+        if flight.recorder().events() else 0
+    srv = bst.serve(tick_ms=1.0, deadline_ms=10_000.0,
+                    drift_flush_every=2)
+    try:
+        mon = srv.observer.drift
+        assert mon is not None and mon.version == "v0"
+        # unshifted: 3 full flush windows of diverse training rows
+        i = 0
+        while mon.flushes < 3:
+            a = (i * 200) % 400
+            srv.predict(X[a:a + 200])
+            i += 1
+        _wait_flushes(mon, 3)
+        assert not _events_since(seq0, ("drift_detected",)), \
+            "unshifted traffic raised drift"
+        g = mon.gauges()
+        assert g["max_psi"] < mon.threshold and not g["drifted"]
+
+        # covariate shift on feature 2: detected within ONE flush
+        shifted = X.copy()
+        shifted[:, 2] += 3.0
+        f0 = mon.flushes
+        i = 0
+        while mon.flushes < f0 + 1:
+            a = (i * 200) % 400
+            srv.predict(shifted[a:a + 200])
+            i += 1
+        _wait_flushes(mon, f0 + 1)
+        evs = _events_since(seq0, ("drift_detected",))
+        names = {e["feature"] for e in evs}
+        assert mon.feature_names[2] in names, f"wrong features: {names}"
+        g = mon.gauges()
+        assert g["psi"][mon.feature_names[2]] >= mon.threshold
+        # the Prometheus gauge is nonzero for the drifted feature
+        text = srv.metrics_text()
+        line = [ln for ln in text.splitlines()
+                if ln.startswith("lgbm_tpu_drift_psi{")
+                and f'feature="{mon.feature_names[2]}"' in ln]
+        assert line and float(line[0].rsplit(" ", 1)[1]) >= mon.threshold
+    finally:
+        srv.close(drain=True)
+
+
+def test_steady_state_guard_with_monitors_on(drift_booster):
+    """Acceptance: drift + SLO enabled add 0 steady-state recompiles,
+    and d2h syncs happen ONLY at the flush cadence."""
+    bst, X = drift_booster
+    srv = bst.serve(tick_ms=1.0, deadline_ms=10_000.0,
+                    drift_flush_every=4, slo_ms=5_000.0)
+    try:
+        mon = srv.observer.drift
+        # prime each rung once through the full observe path
+        srv.predict(X[:20])
+        srv.predict(X[:200])
+        _wait_flushes(mon, 0)                 # no flush yet (2 ticks)
+        with guards.compile_counter() as cc:
+            for i in range(10):               # 12 ticks total -> 3 flushes
+                srv.predict(X[(i * 37) % 300:(i * 37) % 300 + 40])
+        _wait_flushes(mon, 3)
+        assert cc.lowerings == 0, \
+            f"monitors lowered {cc.lowerings} programs in steady state"
+        assert mon.flushes == 3
+        assert mon.host_syncs == mon.flushes, \
+            "d2h outside the declared flush ticks"
+        assert srv.observer.slo is not None
+        assert srv.observer.slo.good_total >= 12
+    finally:
+        srv.close(drain=True)
+
+
+def test_hot_swap_resets_drift_window(drift_booster):
+    """A deploy re-attaches the monitor to the new model; ticks pinned
+    to the OLD version must not feed the new monitor."""
+    bst, X = drift_booster
+    X2, y2 = _data(seed=7)
+    b2 = lgb.train(_params(), lgb.Dataset(X2, label=y2), 3)
+    srv = bst.serve(tick_ms=1.0, drift_flush_every=2)
+    try:
+        m1 = srv.observer.drift
+        srv.predict(X[:50])
+        srv.deploy("v2", b2)
+        m2 = srv.observer.drift
+        assert m2 is not m1 and m2.version == "v2"
+        assert srv.observer.drift_for("v0") is None
+        assert srv.observer.drift_for("v2") is m2
+        # the candidate's reference materialized during the WARM phase
+        # even though ITS config never armed drift (the server's
+        # override decides): the cached baselines already exist
+        assert b2._gbdt.train_set._ref_dist is not None
+        assert getattr(b2._gbdt, "_drift_score_host", None) is not None
+        srv.predict(X2[:50])
+        srv.predict(X2[:50])
+        _wait_flushes(m2, 1)
+    finally:
+        srv.close(drain=True)
+
+
+# ---------------------------------------------------- latency attribution
+def test_phase_times_and_histograms(drift_booster):
+    bst, X = drift_booster
+    srv = bst.serve(tick_ms=1.0, deadline_ms=10_000.0)
+    try:
+        fut = srv.submit(X[:8])
+        fut.result()
+        ph = fut.phase_times()
+        assert set(ph) == {"queue_wait_s", "serve_s", "complete_s"}
+        assert all(v >= 0 for v in ph.values())
+        assert abs(sum(ph.values()) - fut.latency_s) < 1e-6
+        # completed requests land in the (kind, version) histogram
+        end = time.monotonic() + 5.0          # observer runs post-complete
+        while ("predict", "v0") not in srv.observer._hists:
+            assert time.monotonic() < end
+            time.sleep(0.005)
+        h = srv.observer._hists[("predict", "v0")]
+        assert h.count >= 1
+        assert sum(h.counts) == h.count
+        assert h.sum_ms > 0
+        text = srv.observer.prometheus_text()
+        assert 'lgbm_tpu_serve_latency_ms_bucket{kind="predict"' in text
+        assert 'le="+Inf"' in text
+        assert "lgbm_tpu_serve_phase_seconds_total" in text
+    finally:
+        srv.close(drain=True)
+
+
+def test_latency_histogram_buckets():
+    h = LatencyHistogram()
+    for ms in (0.5, 1.0, 3.0, 9000.0):
+        h.observe(ms)
+    assert h.count == 4
+    # le=1.0 bucket holds 0.5 AND the exact 1.0 (le semantics)
+    assert h.counts[0] == 2
+    assert h.counts[-1] == 1                 # overflow past 5000ms
+    lines = obs_metrics.render_histogram(
+        "m", {"k": "v"}, drift_mod.LATENCY_BUCKETS_MS, h.counts,
+        h.sum_ms, h.count)
+    inf = [ln for ln in lines if 'le="+Inf"' in ln]
+    assert inf and inf[0].endswith(" 4")
+    assert any(ln.startswith('m_bucket{k="v",le="1"} 2') for ln in lines)
+
+
+# ----------------------------------------------------------------- SLO
+def test_slo_tracker_windows_and_burn():
+    t = SloTracker(slo_ms=100.0, target=0.9)   # budget: 10% bad
+    now = 10_000.0
+    for _ in range(90):
+        t.record(True, now)
+    for _ in range(10):
+        t.record(False, now)
+    # exactly at budget: burn rate 1.0
+    assert abs(t.burn_rate(300.0, now) - 1.0) < 1e-9
+    assert t.good_total == 90 and t.bad_total == 10
+    # all-bad second bucket pushes the short window over budget
+    for _ in range(50):
+        t.record(False, now + 10.0)
+    assert t.burn_rate(300.0, now + 10.0) > 1.0
+    # outside the window the counts retire
+    g, b = t.window_counts(300.0, now + 10_000.0)
+    assert (g, b) == (0, 0)
+    assert t.burn_rate(300.0, now + 10_000.0) == 0.0
+    # ring wrap: a slot reused an hour later forgets the old counts
+    t2 = SloTracker(100.0, 0.99)
+    t2.record(False, 0.0)
+    t2.record(True, SloTracker.HORIZON_S)    # same slot, new id
+    assert t2.window_counts(3600.0, SloTracker.HORIZON_S) == (1, 0)
+
+
+def test_slo_counts_sheds_as_bad_and_alerts_without_ticks():
+    """Requests shed at the admission edge never become futures and a
+    total outage serves no ticks — the SLO must burn AND page anyway
+    (overload is exactly what it exists for)."""
+    from lightgbm_tpu.obs.drift import ServingObserver
+    seq0 = flight.recorder().events()[-1]["seq"] \
+        if flight.recorder().events() else 0
+    obs = ServingObserver({}, slo_ms=100.0, slo_target=0.9)
+    obs.on_shed("predict")
+    obs.on_shed("leaf")
+    assert obs.slo.bad_total == 2 and obs.slo.good_total == 0
+    assert obs.slo.burn_rate(300.0) > 1.0
+    # the alert fired from the shed path itself — no on_tick_served ran
+    assert obs.slo.alerting
+    assert len(_events_since(seq0, ("slo_burn",))) == 1
+
+
+def test_phase_times_clamped_on_client_timeout_race():
+    """A client-side result() timeout can complete the future BEFORE the
+    worker stamps served_at; phases must clamp non-negative and still
+    sum to the latency."""
+    from lightgbm_tpu.serving.coalescer import ServeFuture
+    fut = ServeFuture(np.zeros((2, 3), np.float32), None, 0.0)
+    fut.popped_at = fut.created_at + 0.010
+    fut._fail(RuntimeError("client timeout"))      # stamps completed_at
+    fut.served_at = fut.completed_at + 0.050       # worker, later
+    ph = fut.phase_times()
+    assert all(v >= 0 for v in ph.values()), ph
+    assert abs(sum(ph.values()) - fut.latency_s) < 1e-9
+    # and a future completed while still queued (popped after) clamps too
+    fut2 = ServeFuture(np.zeros((1, 3), np.float32), None, 0.0)
+    fut2._fail(RuntimeError("expired"))
+    fut2.popped_at = fut2.completed_at + 0.020
+    ph2 = fut2.phase_times()
+    assert all(v >= 0 for v in ph2.values()), ph2
+
+
+def test_drift_reference_refreshes_after_continued_training(
+        drift_booster):
+    """A booster that keeps training after a drift-armed deploy must not
+    ship the stale score baseline on redeploy."""
+    X, y = _data(seed=11)
+    p = _params()
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p), 3)
+    g = bst._gbdt
+    _, _, s1 = g.drift_reference()
+    bst.update()                                   # continue training
+    _, _, s2 = g.drift_reference()
+    assert s2.shape != s1.shape or not np.array_equal(s1, s2)
+
+
+def test_latency_histograms_pruned_across_swaps(drift_booster):
+    """A continuous-refit server swaps forever; /metrics cardinality
+    must not grow one histogram family per retired version."""
+    bst, X = drift_booster
+    from lightgbm_tpu.obs.drift import LatencyHistogram, ServingObserver
+    obs = ServingObserver({})
+    for v in ("v0", "v1", "v2", "v3", "v4", "v5"):
+        obs._hists[("predict", v)] = LatencyHistogram()
+        obs.attach_model(v, bst, [])               # drift off: prune only
+    keys = {k[1] for k in obs._hists}
+    assert keys == {"v2", "v3", "v4", "v5"}        # last 4 attaches kept
+
+
+def test_slo_burn_alert_fires(drift_booster):
+    """An unmeetable SLO (1 microsecond) burns both windows -> one
+    slo_burn flight event + the alerting gauge."""
+    bst, X = drift_booster
+    seq0 = flight.recorder().events()[-1]["seq"] \
+        if flight.recorder().events() else 0
+    srv = bst.serve(tick_ms=1.0, deadline_ms=10_000.0, slo_ms=0.001)
+    try:
+        for _ in range(5):
+            srv.predict(X[:8])
+        time.sleep(0.05)
+        s = srv.observer.slo
+        assert s.bad_total >= 5 and s.good_total == 0
+        assert s.alerting
+        evs = _events_since(seq0, ("slo_burn",))
+        assert len(evs) == 1                  # transition-gated, no spam
+        assert "lgbm_tpu_serve_slo_alerting 1" in srv.metrics_text()
+        snap = srv.observer.snapshot()
+        assert snap["slo"]["burn_5m"] > 1.0
+    finally:
+        srv.close(drain=True)
+
+
+# ------------------------------------------------- per-kind stats (coalescer)
+def test_per_kind_stats_breakdown(drift_booster):
+    bst, X = drift_booster
+    p = _params(tpu_serve_endpoints="predict,leaf")
+    X2, y2 = _data(seed=1)
+    b = lgb.train(p, lgb.Dataset(X2, label=y2, params=p), 3)
+    srv = b.serve(tick_ms=1.0, deadline_ms=10_000.0)
+    try:
+        srv.predict(X2[:10])
+        srv.predict_leaf(X2[:10])
+        srv.predict(X2[:5])
+        st = srv.stats
+        assert st["kinds"]["predict"]["served_requests"] == 2
+        assert st["kinds"]["predict"]["served_rows"] == 15
+        assert st["kinds"]["leaf"]["served_requests"] == 1
+        assert st["kinds"]["leaf"]["served_rows"] == 10
+        # aggregates stay the compatible flat keys
+        assert st["served_requests"] == 3
+        assert st["served_rows"] == 25
+        # the snapshot must not alias live dicts
+        st["kinds"]["predict"]["served_requests"] = 999
+        assert srv.stats["kinds"]["predict"]["served_requests"] == 2
+        # nested kinds flatten into /metrics gauges
+        flat = obs_metrics.flatten_metrics(srv.health())
+        assert flat["stats_kinds_leaf_served_rows"] == 10.0
+    finally:
+        srv.close(drain=True)
+
+
+def test_per_kind_timeout_counter(drift_booster):
+    bst, X = drift_booster
+    srv = bst.serve(tick_ms=40.0)
+    try:
+        fut = srv.submit(X[:4], deadline_ms=1.0)
+        with pytest.raises(Exception):
+            fut.result()
+        end = time.monotonic() + 5.0
+        while time.monotonic() < end:
+            if srv.stats["kinds"].get("predict", {}).get("timeouts"):
+                break
+            time.sleep(0.01)
+        st = srv.stats
+        assert st["kinds"]["predict"]["timeouts"] >= 1
+        assert st["timeouts"] >= 1
+    finally:
+        srv.close(drain=False, timeout_s=5.0)
+
+
+# --------------------------------------------------- label escaping hygiene
+def test_escape_label_value_hostile():
+    assert obs_metrics.escape_label_value('a"b') == 'a\\"b'
+    assert obs_metrics.escape_label_value("a\\b") == "a\\\\b"
+    assert obs_metrics.escape_label_value("a\nb") == "a\\nb"
+    # order matters: the backslash introduced by the quote escape must
+    # not be re-escaped
+    assert obs_metrics.escape_label_value('\\"') == '\\\\\\"'
+    lab = obs_metrics.render_labels({"f": 'x"y\nz\\w', "bad name!": "v"})
+    assert lab == '{f="x\\"y\\nz\\\\w",bad_name_="v"}'
+
+
+def test_prometheus_hostile_feature_names():
+    """Feature names with quotes/backslashes/newlines come straight from
+    user data; the exposition must stay parseable."""
+    rng = np.random.RandomState(5)
+    X = rng.randn(300, 3)
+    y = (X[:, 0] > 0).astype(np.float64)
+    names = ['fe"at', 'ba\\ck', 'new\nline']
+    p = _params()
+    bst = lgb.train(p, lgb.Dataset(X, label=y, feature_name=names,
+                                   params=p), 3)
+    srv = bst.serve(tick_ms=1.0, drift_flush_every=1)
+    try:
+        srv.predict(X[:100])
+        _wait_flushes(srv.observer.drift, 1)
+        text = srv.metrics_text()
+        psi_lines = [ln for ln in text.splitlines()
+                     if ln.startswith("lgbm_tpu_drift_psi{")]
+        assert len(psi_lines) == 3
+        joined = "\n".join(psi_lines)
+        assert 'feature="fe\\"at"' in joined
+        assert 'feature="ba\\\\ck"' in joined
+        assert 'feature="new\\nline"' in joined
+        # every sample line still parses as name{labels} value
+        for ln in psi_lines:
+            assert ln.count("{") == 1 and ln.rsplit(" ", 1)[1]
+            float(ln.rsplit(" ", 1)[1])
+    finally:
+        srv.close(drain=True)
+
+
+# --------------------------------------------------------- scripts/obs drift
+def test_obs_drift_cli(tmp_path, capsys):
+    path = tmp_path / "stream.jsonl"
+    recs = [
+        {"t": 1.0, "kind": "drift_flush", "version": "v0", "flush": 1,
+         "window_rows": 256, "threshold": 0.2,
+         "psi": {"f0": 0.01, "f2": 0.91, "f1": 0.05},
+         "kl": {"f0": 0.005, "f2": 0.6, "f1": 0.02},
+         "max_psi": 0.91, "max_feature": "f2", "score_psi": 0.4,
+         "score_drifted": True, "drifted": ["f2"]},
+        {"t": 1.1, "event": "drift_detected", "feature": "f2",
+         "psi": 0.91, "version": "v0", "flush": 1},
+        {"t": 1.2, "kind": "slo", "slo_ms": 50.0, "target": 0.99,
+         "good_total": 90, "bad_total": 30, "burn_5m": 25.0,
+         "burn_1h": 25.0, "alerting": True},
+    ]
+    path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    assert summarize.drift_main([str(path), "--top", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "f2" in out and "DRIFTED" in out
+    assert "0.91" in out
+    assert "score drift" in out
+    assert "25.0" in out                      # burn tail rendered
+    assert "f0" not in out.split("drift/SLO events")[0]  # top-2 cut
+    # --json emits the machine-readable summary
+    assert summarize.drift_main([str(path), "--json"]) == 0
+    js = json.loads(capsys.readouterr().out)
+    assert js["psi_table"][0]["feature"] == "f2"
+    assert js["slo_tail"][0]["burn_5m"] == 25.0
+    # missing file is a structured failure
+    assert summarize.drift_main([str(tmp_path / "nope.jsonl")]) == 2
+
+
+def test_obs_drift_summary_dedups_stream_and_flight_twin(tmp_path):
+    """The same flush appears in BOTH the stream (full psi map) and the
+    flight dump (compact twin); given both files the summary must count
+    it once and prefer the psi-bearing record."""
+    stream = tmp_path / "s.jsonl"
+    dump = tmp_path / "f.jsonl"
+    stream.write_text(json.dumps(
+        {"t": 1.0, "kind": "drift_flush", "version": "v0", "flush": 1,
+         "window_rows": 500, "threshold": 0.2, "psi": {"a": 0.5},
+         "kl": {"a": 0.3}, "max_psi": 0.5, "max_feature": "a",
+         "drifted": ["a"]}) + "\n")
+    dump.write_text(json.dumps(
+        {"t": 1.0, "seq": 9, "event": "drift_flush", "version": "v0",
+         "flush": 1, "window_rows": 500, "max_psi": 0.5,
+         "max_feature": "a", "drifted": 1}) + "\n")
+    s = summarize.drift_summary([str(dump), str(stream)])
+    assert s["flushes"] == 1
+    assert s["latest"]["threshold"] == 0.2   # the stream record won
+    assert s["psi_table"][0]["feature"] == "a"
+
+
+def test_obs_drift_cli_reads_flight_dump(tmp_path, drift_booster,
+                                         capsys):
+    """The flight-ring twins (summary fields only) render the header
+    even without a psi map."""
+    bst, X = drift_booster
+    srv = bst.serve(tick_ms=1.0, drift_flush_every=1)
+    try:
+        srv.predict(X[:50])
+        _wait_flushes(srv.observer.drift, 1)
+    finally:
+        srv.close(drain=True)
+    dump = flight.dump("test", path=str(tmp_path / "f.jsonl"))
+    assert summarize.drift_main([dump]) == 0
+    out = capsys.readouterr().out
+    assert "drift flushes:" in out

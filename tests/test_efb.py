@@ -32,12 +32,19 @@ PARAMS = {"objective": "binary", "num_leaves": 31, "verbose": -1,
 
 class TestEFB:
     def test_lossless_vs_dense(self):
+        # the DENSE twin trains all 324 one-hot columns through the
+        # compact grower — the suite's single most expensive call. The
+        # 5-round models are a tree PREFIX of the original 8-round pair
+        # (round count changes no split decision), so losslessness is
+        # proven identically at 5/8 of the tier-1 cost. Rows stay 6000:
+        # the prediction tolerance is tuned to this seed's near-tie
+        # structure (a 4000-row slice flips one early near-tie split)
         X, y = _onehot_data()
         b_off = lgb.train(dict(PARAMS),
                           lgb.Dataset(X, label=y,
-                                      params={"enable_bundle": False}), 8)
+                                      params={"enable_bundle": False}), 5)
         ds = lgb.Dataset(X, label=y)
-        b_on = lgb.train(dict(PARAMS), ds, 8)
+        b_on = lgb.train(dict(PARAMS), ds, 5)
         info = ds._inner.bundle_info
         assert info is not None and info.n_columns < X.shape[1] // 4
         # bundling is exact in exact arithmetic; gains cumsum over

@@ -57,9 +57,13 @@ class TestSequenceConstruction:
         bst = lgb.train(dict(params), ds, 5, valid_sets=[dv])
         assert np.isfinite(bst.predict(dense[:50])).all()
 
+    @pytest.mark.slow
     def test_streaming_memory_bound(self):
         # peak RSS growth during construct stays under ~2x the packed bin
-        # matrix (the raw [N, F] float64 would be 16x it)
+        # matrix (the raw [N, F] float64 would be 16x it). Slow lane: a
+        # 200k-row resource-profiling measurement (~35s, the suite's #2
+        # cost) — the streaming-construction CORRECTNESS tests in this
+        # file stay tier-1
         import resource
         n, f = 200_000, 40
         seq = _GenSeq(n, f, 11)
