@@ -59,6 +59,9 @@ _SHAPE_RE = re.compile(r"([a-z]+[0-9]*)\[([0-9,]*)\]")
 _INSTR_RE = re.compile(
     r"^(?:ROOT\s+)?%?([\w.\-]+)\s+=\s+(.*?)\s*([a-z][a-z0-9\-]*)\((.*)$")
 
+# operand references inside the operand region: `f32[8]{0} %add.5, ...`
+_OPERAND_NAME_RE = re.compile(r"%([\w.\-]+)")
+
 
 def tensor_bytes(dtype: str, dims: str) -> int:
     n = 1
@@ -77,10 +80,19 @@ class Instruction:
     operand_shapes: List[Tuple[str, str]]
     line: int                              # 1-based within the module text
     raw: str
+    operand_names: List[str] = dataclasses.field(default_factory=list)
 
     @property
     def result_bytes(self) -> int:
         return sum(tensor_bytes(d, dims) for d, dims in self.result_shapes)
+
+    @property
+    def result_dims(self) -> List[int]:
+        """Every result dimension, flattened across tuple elements."""
+        out: List[int] = []
+        for _, dims in self.result_shapes:
+            out.extend(int(d) for d in dims.split(",") if d)
+        return out
 
 
 def _split_operands(rest: str) -> str:
@@ -118,7 +130,8 @@ def parse_instructions(hlo_text: str) -> List[Instruction]:
             name=name, opcode=opcode,
             result_shapes=_SHAPE_RE.findall(head),
             operand_shapes=_SHAPE_RE.findall(operands),
-            line=lineno, raw=s))
+            line=lineno, raw=s,
+            operand_names=_OPERAND_NAME_RE.findall(operands)))
     return out
 
 
@@ -184,3 +197,152 @@ def canonicalize(hlo_text: str) -> str:
 def fingerprint(hlo_text: str) -> str:
     """Stable short hash of a compiled program (see ``canonicalize``)."""
     return hashlib.sha256(canonicalize(hlo_text).encode()).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# computation structure + SPMD attributes (analysis/memory.py and
+# analysis/spmd_check.py build on these; still plain text, no jax)
+# ---------------------------------------------------------------------------
+# `%comp.1 (p: f32[8]) -> f32[8] {` and `ENTRY %main.4 (...) -> ... {`
+_COMP_HEAD_RE = re.compile(
+    r"^(ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->\s*.*\{\s*$")
+
+_REPLICA_GROUPS_LIST_RE = re.compile(r"replica_groups=\{(\{[0-9,{} ]*\})\}")
+_REPLICA_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[([0-9,]+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?")
+_NUM_PARTITIONS_RE = re.compile(r"num_partitions=(\d+)")
+_ALIAS_RE = re.compile(r"\{([0-9, ]*)\}:\s*\((\d+)")
+
+
+@dataclasses.dataclass
+class Computation:
+    """One HLO computation block: its instructions in program order."""
+    name: str
+    is_entry: bool
+    instructions: List[Instruction]
+
+    @property
+    def root(self) -> Optional[Instruction]:
+        for instr in reversed(self.instructions):
+            if instr.raw.startswith("ROOT "):
+                return instr
+        return self.instructions[-1] if self.instructions else None
+
+
+def parse_computations(hlo_text: str) -> List[Computation]:
+    """Split module text into computations, instructions kept in order.
+
+    The brace structure of post-optimization HLO text is flat — one
+    ``name (params) -> result {`` header per computation, instructions
+    until the closing ``}`` on its own line — so a line scan suffices;
+    attribute braces (``sharding={...}``) never start a line.
+    """
+    out: List[Computation] = []
+    current: Optional[Computation] = None
+    for lineno, line in enumerate(hlo_text.splitlines(), 1):
+        s = line.strip()
+        if current is None:
+            m = _COMP_HEAD_RE.match(s)
+            if m is not None and " = " not in s:
+                current = Computation(m.group(2), bool(m.group(1)), [])
+            continue
+        if s.startswith("}"):
+            out.append(current)
+            current = None
+            continue
+        if " = " not in s:
+            continue
+        m = _INSTR_RE.match(s)
+        if m is None:
+            continue
+        name, head, opcode, rest = m.groups()
+        operands = _split_operands(rest)
+        current.instructions.append(Instruction(
+            name=name, opcode=opcode,
+            result_shapes=_SHAPE_RE.findall(head),
+            operand_shapes=_SHAPE_RE.findall(operands),
+            line=lineno, raw=s,
+            operand_names=_OPERAND_NAME_RE.findall(operands)))
+    if current is not None:       # unterminated block (fixture tolerance)
+        out.append(current)
+    return out
+
+
+def entry_computation(hlo_text: str) -> Optional[Computation]:
+    for comp in parse_computations(hlo_text):
+        if comp.is_entry:
+            return comp
+    return None
+
+
+def num_partitions(hlo_text: str) -> int:
+    """SPMD partition count the module was compiled for (1 if absent)."""
+    m = _NUM_PARTITIONS_RE.search(hlo_text)
+    return int(m.group(1)) if m else 1
+
+
+def input_output_aliases(hlo_text: str) -> Dict[Tuple[int, ...], int]:
+    """Donation map {output tuple index: parameter number} from the
+    module header's ``input_output_alias={ {0}: (0, {}, may-alias) }``."""
+    _, sep, rest = hlo_text.partition("input_output_alias={")
+    if not sep:
+        return {}
+    # the alias map is a flat `{out_idx}: (param, {param_idx}[, kind])`
+    # sequence; the pair pattern (brace-list followed by a colon and an
+    # opening paren) occurs nowhere else in the header line
+    out: Dict[Tuple[int, ...], int] = {}
+    for om, pm in _ALIAS_RE.findall(rest.split("\n", 1)[0]):
+        idx = tuple(int(x) for x in om.replace(" ", "").split(",") if x)
+        out[idx] = int(pm)
+    return out
+
+
+def replica_groups_of(instr: Instruction) -> Optional[List[List[int]]]:
+    """Partition groups of a collective instruction, resolved to explicit
+    id lists. Handles both the literal form ``{{0,1},{2,3}}`` and the
+    iota form ``[2,2]<=[4]`` (optionally transposed, ``<=[2,2]T(1,0)``).
+    Returns None when the instruction carries no replica_groups attr;
+    ``[]`` (one implicit all-ranks group) is returned as ``[]``.
+    """
+    m = _REPLICA_GROUPS_LIST_RE.search(instr.raw)
+    if m is not None:
+        groups = []
+        for grp in re.findall(r"\{([0-9, ]*)\}", m.group(1)):
+            ids = [int(x) for x in grp.replace(" ", "").split(",") if x]
+            if ids:
+                groups.append(ids)
+        return groups
+    m = _REPLICA_GROUPS_IOTA_RE.search(instr.raw)
+    if m is not None:
+        dims = [int(x) for x in m.group(1).split(",")]
+        reshape = [int(x) for x in m.group(2).split(",")]
+        total = 1
+        for d in reshape:
+            total *= d
+        ids = list(range(total))
+        if m.group(3):
+            # iota over `reshape`, transposed by T(perm), flattened
+            perm = [int(x) for x in m.group(3).split(",")]
+            strides = [0] * len(reshape)
+            acc = 1
+            for i in range(len(reshape) - 1, -1, -1):
+                strides[i] = acc
+                acc *= reshape[i]
+            tdims = [reshape[p] for p in perm]
+            tstrides = [strides[p] for p in perm]
+            ids = []
+            idx = [0] * len(tdims)
+            for _ in range(total):
+                ids.append(sum(i * s for i, s in zip(idx, tstrides)))
+                for ax in range(len(tdims) - 1, -1, -1):
+                    idx[ax] += 1
+                    if idx[ax] < tdims[ax]:
+                        break
+                    idx[ax] = 0
+        rows, cols = dims[0], 1
+        for d in dims[1:]:
+            cols *= d
+        return [ids[r * cols:(r + 1) * cols] for r in range(rows)]
+    if "replica_groups" in instr.raw:
+        return []
+    return None

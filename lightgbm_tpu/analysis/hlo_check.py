@@ -35,10 +35,17 @@ Contract schema (one JSON object per mode)::
       "stable_fingerprint": true,
       "measured": {...},         # collective_bytes() at generation time —
                                  #   scripts/verify_contracts.py diffs this
-      "measured_baseline": {...} # overlap modes only: the overlap=off
-    }                            #   lowering's accounting — every kind's
+      "measured_baseline": {...},# overlap modes only: the overlap=off
+                                 #   lowering's accounting — every kind's
                                  #   bytes must MATCH "measured" (overlap
                                  #   hides latency, never adds traffic)
+      "memory": {                # per-mesh static per-chip HBM budget
+        "8": {"budget_bytes": ..., "estimate_bytes": ...,   # (ISSUE 15;
+              "headroom_bytes": ..., ...}},                 # memory.py walk)
+      "spmd": {                  # per-mesh collective inventory+schedule
+        "4": {"collectives": [...],          # recorded by scripts/tpulint
+              "schedule": [[kind, B], ...]}} # spmd --update (spmd_check.py)
+    }
 
 The harness half (``capture_mode``) trains a tiny Booster with
 ``LGBM_TPU_COMM_ACCOUNTING=1`` so ``boosting/gbdt.py`` records the
@@ -57,6 +64,7 @@ import os
 import re
 from typing import Dict, List, Optional, Sequence
 
+from . import memory
 from .hlo import (HOST_CUSTOM_CALL_MARKERS, HOST_OPS, INT_NARROW,
                   collective_bytes, fingerprint, parse_instructions)
 
@@ -368,11 +376,39 @@ def check_fingerprint(history: Sequence[str],
         "program)")]
 
 
+def check_memory(hlo_text: str, contract: dict) -> List[ContractFinding]:
+    """Native-mesh memory budget: the contract's ``memory`` block (ISSUE
+    15) records a per-chip peak-HBM budget + estimate per mesh key; the
+    mode's own lowering is checked against its native mesh here (the
+    flight meshes are spmd_check's job). An estimate above budget is a
+    memory regression; budgets only move by deliberate edit."""
+    name = contract["mode"]
+    key = str(contract.get("num_devices", 1))
+    block = contract.get("memory", {}).get(key)
+    if not block:
+        return []
+    est = memory.estimate(hlo_text)
+    budget = int(block["budget_bytes"])
+    if est.peak_bytes <= budget:
+        return []
+    top = ", ".join(f"{n}={memory.render_bytes(b)}"
+                    for n, b in est.largest[:3])
+    return [ContractFinding(
+        name, "memory",
+        f"mesh {key}: static per-chip peak "
+        f"{memory.render_bytes(est.peak_bytes)} exceeds the recorded "
+        f"{memory.render_bytes(budget)} budget (largest buffers: {top}) "
+        "— the step program's resident footprint regressed; shrink it "
+        "or raise budget_bytes deliberately (scripts/tpulint spmd "
+        "--update keeps budgets sticky)")]
+
+
 def check_hlo(hlo_text: str, contract: dict) -> List[ContractFinding]:
     """All single-program checks against one contract."""
     return (check_collectives(hlo_text, contract)
             + check_host_ops(hlo_text, contract)
-            + check_int_dots(hlo_text, contract))
+            + check_int_dots(hlo_text, contract)
+            + check_memory(hlo_text, contract))
 
 
 def registry_contract_findings(entries=None) -> List[ContractFinding]:
@@ -424,6 +460,21 @@ def registry_contract_findings(entries=None) -> List[ContractFinding]:
                     entry.id, "registry",
                     f"contract file {contract_path(mode)} is missing — "
                     "run scripts/verify_contracts.py --update"))
+            else:
+                # per-entry mesh enumeration (ISSUE 15): each contract
+                # must carry a verified memory block for every mesh the
+                # entry declares
+                have = set(load_contract(mode).get("memory", {}))
+                for mesh in getattr(entry, "meshes", ()):
+                    if mesh not in have:
+                        out.append(ContractFinding(
+                            entry.id, "registry",
+                            f"contract '{mode}' has no memory block "
+                            f"for declared mesh '{mesh}' (have "
+                            f"{sorted(have) or 'none'}) — regenerate "
+                            "(scripts/verify_contracts.py --update, or "
+                            "scripts/tpulint spmd --update for flight "
+                            "meshes)"))
     return out
 
 
@@ -437,6 +488,9 @@ class CapturedMode:
     hlo_text: str
     history: List[str]
     all_programs: Dict[str, str]
+    #: the trained GBDT — spmd_check's AOT-relowering hooks
+    #: (aot_lower_program / flight_row_dims) hang off it
+    gbdt: object = None
 
 
 def _tiny_problem(n: int, f: int, seed: int):
@@ -496,7 +550,7 @@ def capture_mode(mode: str, template: Optional[dict] = None,
             "different step path than the contract expects")
     return CapturedMode(mode, key, g._comm_hlo[key],
                         list(g._comm_hlo_history.get(key, [])),
-                        dict(g._comm_hlo))
+                        dict(g._comm_hlo), gbdt=g)
 
 
 def verify_mode(mode: str, contract: Optional[dict] = None,
@@ -555,6 +609,21 @@ def build_contract(mode: str, captured: Optional[CapturedMode] = None
         contract["measured_baseline"] = {
             k: v for k, v in sorted(collective_bytes(
                 base_cap.hlo_text).items())}
+    # memory block (ISSUE 15): the native-mesh per-chip budget+estimate,
+    # with any previously recorded budget kept STICKY and any additional
+    # mesh keys (the spmd flight matrix) and spmd schedule blocks
+    # preserved verbatim — those are re-recorded by scripts/tpulint
+    # spmd --update, not here
+    prior: dict = {}
+    if os.path.exists(contract_path(mode)):
+        prior = load_contract(mode)
+    native = str(t["num_devices"])
+    mem = dict(prior.get("memory", {}))
+    mem[native] = memory.contract_block(
+        captured.hlo_text, prior=prior.get("memory", {}).get(native))
+    contract["memory"] = mem
+    if "spmd" in prior:
+        contract["spmd"] = prior["spmd"]
     return contract
 
 

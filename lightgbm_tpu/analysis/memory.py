@@ -1,0 +1,334 @@
+"""memory — static peak-HBM estimation from compiled HLO text.
+
+The pod go/no-go question ("does the full 13.2M x 4228 Allstate step fit
+16 GiB per chip on 8 chips?") is answerable WITHOUT hardware: after SPMD
+partitioning the compiled module's shapes are already per-shard, so a
+buffer-liveness walk over the entry computation bounds the per-chip HBM
+the program needs. The reference budgets the same way by hand — its
+docs/Experiments.rst trains the full Allstate in ~1 GB RAM per rank
+because the bin matrix is the only O(rows) resident — here the walk is
+mechanical and runs in tier-1 on the CPU lowering of the SAME program
+(shapes, shardings and donation are backend-independent facts of the
+partitioned module; only the scheduler's transient packing differs).
+
+Model (deliberately simple, exact on the fixtures in
+tests/test_spmd_check.py, conservative on real programs):
+
+* every entry-computation instruction allocates its result bytes at its
+  program position, EXCEPT the view ops (tuple / get-tuple-element /
+  bitcast), ``while`` and ``conditional`` — a while's carried tuple is
+  updated in place by XLA, so its result aliases its operand's buffers
+  rather than doubling them (the dominant correction for the train
+  step, whose tree loop carries the multi-GiB work/scratch pair), and a
+  conditional's result aliases its branch operands' buffers the same
+  way (at most one branch runs; XLA emits an explicit ``copy`` — which
+  we count — whenever it cannot alias);
+* a buffer is live from its defining instruction through its last use;
+  parameters are live for the entire program (their buffers belong to
+  the caller and cannot be reused without donation);
+* donated parameters (``input_output_alias``) stay live to the end —
+  their buffer IS the output — and the aliased output instruction
+  allocates nothing (XLA writes it in place);
+* the ROOT's buffers are live through the end (they are the result);
+* called computations (``while`` bodies, ``call``/``conditional``
+  targets) add their own internal peak at the call site — parameters
+  excluded, those alias the caller's operand buffers, and an in-place
+  update of a parameter slot (same byte count, e.g. the
+  dynamic-update-slice a branch applies to the carried work array)
+  reuses that slot's caller buffer rather than allocating. Fusion
+  computations are NOT descended into: a fusion's intermediates live in
+  registers/scratch by construction, its output is the fusion
+  instruction's own result buffer.
+
+Peak = max over program positions of the live-byte sum. This
+over-estimates real HBM when XLA's buffer assignment reuses a dead
+buffer's allocation for a same-sized new one mid-program (we free at
+last use too, but do not model cross-buffer slot reuse beyond that) and
+under-estimates nothing structural — which is the right polarity for a
+go/no-go gate.
+
+Dependency-light like the rest of analysis/: plain text, no jax, so
+``scripts/tpulint spmd`` runs it anywhere.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from .hlo import (Computation, Instruction, input_output_aliases,
+                  parse_computations)
+
+#: result-is-a-view opcodes: no fresh allocation. ``while`` belongs
+#: here because XLA updates the carried tuple in place (operand and
+#: result shapes are required to match); its result aliases its operand.
+#: ``conditional`` aliases its branch operands: one branch runs, its
+#: result shares the operand buffers (an explicit ``copy`` appears in
+#: the HLO wherever XLA cannot alias, and copies ARE counted).
+_NO_ALLOC = ("tuple", "get-tuple-element", "bitcast", "after-all",
+             "add-dependency", "while", "conditional")
+
+#: single-operand view ops whose result IS the operand's buffer(s)
+_VIEW_OF_FIRST = ("get-tuple-element", "bitcast", "add-dependency",
+                  "while")
+
+#: opcodes whose attrs name computations that execute at the call site
+_CALL_ATTRS = ("to_apply=", "body=", "condition=", "branch_computations=")
+
+
+@dataclasses.dataclass
+class MemoryEstimate:
+    """Static per-chip memory picture of one compiled program."""
+    peak_bytes: int                  # max live bytes at any program point
+    argument_bytes: int              # entry parameter buffers
+    output_bytes: int                # ROOT buffers (donated bytes excluded)
+    largest: List[Tuple[str, int]]   # top buffers by size, for attribution
+
+    def to_json(self) -> dict:
+        return {"peak_bytes": self.peak_bytes,
+                "argument_bytes": self.argument_bytes,
+                "output_bytes": self.output_bytes,
+                "largest": [list(kv) for kv in self.largest]}
+
+
+def _called_names(instr: Instruction) -> List[str]:
+    """Computation names an instruction executes (while/call/conditional)."""
+    out: List[str] = []
+    for attr in _CALL_ATTRS:
+        at = instr.raw.find(attr)
+        if at < 0:
+            continue
+        rest = instr.raw[at + len(attr):]
+        if rest.startswith("{"):
+            rest = rest[1:rest.find("}")]
+        else:
+            rest = rest.split(",", 1)[0]
+        for tok in rest.split(","):
+            tok = tok.strip().lstrip("%")
+            if tok:
+                out.append(tok)
+    return out
+
+
+def _alias_roots(comp: Computation, inplace: Dict[str, int],
+                 carry_body: bool) -> Dict[str, Tuple[str, ...]]:
+    """Each name -> the allocation-root buffer name(s) it views.
+
+    View ops (get-tuple-element / bitcast / while) resolve to their
+    operand's roots; ``tuple`` aggregates every operand's roots.
+    ``inplace`` maps update-in-place roots (donated parameters, while
+    carry slots) to their byte size: an instruction consuming such a
+    root and producing the SAME byte count is an in-place update — its
+    result IS that buffer (XLA's donation/while-carry aliasing; when it
+    cannot alias, it inserts a copy and the live set still holds one
+    version, which is what this models). Under ``carry_body`` (walking a
+    computation a ``while`` executes), each get-tuple-element of the
+    carry parameter becomes its own in-place root — the per-slot caller
+    buffers the body updates.
+    """
+    mapping: Dict[str, Tuple[str, ...]] = {}
+    params = {i.name for i in comp.instructions if i.opcode == "parameter"}
+
+    def of(name: str) -> Tuple[str, ...]:
+        return mapping.get(name, (name,))
+
+    for instr in comp.instructions:
+        if carry_body and instr.opcode == "get-tuple-element" \
+                and instr.operand_names \
+                and instr.operand_names[0] in params:
+            mapping[instr.name] = (instr.name,)
+            inplace[instr.name] = instr.result_bytes
+            continue
+        if instr.opcode in _VIEW_OF_FIRST and instr.operand_names:
+            mapping[instr.name] = of(instr.operand_names[0])
+            continue
+        if instr.opcode in ("tuple", "conditional"):
+            # tuple: aggregate view of every operand. conditional: the
+            # result aliases whichever branch operand ran — union both
+            # (liveness merges; at most one version exists at runtime).
+            roots: List[str] = []
+            for op in instr.operand_names:
+                roots.extend(of(op))
+            mapping[instr.name] = tuple(dict.fromkeys(roots))
+            continue
+        tgt = None
+        for op in instr.operand_names:
+            for r in of(op):
+                if inplace.get(r) == instr.result_bytes:
+                    tgt = r
+                    break
+            if tgt:
+                break
+        mapping[instr.name] = (tgt,) if tgt else (instr.name,)
+    return mapping
+
+
+def _walk(comp: Computation, by_name: Dict[str, Computation],
+          cache: Dict[Tuple[str, bool], int], *,
+          zero_alloc: Set[str] = frozenset(),
+          pinned: Set[str] = frozenset(),
+          inplace: Optional[Dict[str, int]] = None,
+          carry_body: bool = False, initial_live: int = 0,
+          stack: Tuple[str, ...] = ()
+          ) -> Tuple[int, Dict[str, int]]:
+    """Liveness walk over one computation.
+
+    Returns ``(peak_bytes, effective_size_by_name)``. ``zero_alloc``
+    names allocate nothing (donation-aliased outputs); ``pinned`` names
+    are never freed (donated parameters). ROOT buffers are never freed.
+    Liveness is tracked on allocation ROOTS, so a buffer viewed through
+    tuple/get-tuple-element/while chains stays live as long as any view
+    of it is still used, and in-place updates of donated/carried
+    buffers (see :func:`_alias_roots`) allocate nothing.
+    """
+    roots = _alias_roots(comp, dict(inplace or {}), carry_body)
+    eff: Dict[str, int] = {}
+    for instr in comp.instructions:
+        if instr.opcode == "parameter" or instr.opcode in _NO_ALLOC \
+                or instr.name in zero_alloc \
+                or roots.get(instr.name) != (instr.name,):
+            eff[instr.name] = 0
+        else:
+            eff[instr.name] = instr.result_bytes
+    # last use per ROOT: any reference to any view of the root counts
+    ends: Dict[str, int] = {}
+    for idx, instr in enumerate(comp.instructions):
+        for r in roots.get(instr.name, (instr.name,)):
+            ends[r] = idx
+        for op in instr.operand_names:
+            for r in roots.get(op, (op,)):
+                ends[r] = idx
+    root = comp.root
+    immortal = set(pinned)
+    if root is not None:
+        immortal.update(roots.get(root.name, (root.name,)))
+    freed_at: Dict[int, int] = {}
+    for instr in comp.instructions:
+        if instr.name in immortal or not eff[instr.name]:
+            continue
+        end = ends.get(instr.name)
+        if end is not None:
+            freed_at[end] = freed_at.get(end, 0) + eff[instr.name]
+    live = initial_live
+    peak = live
+    for idx, instr in enumerate(comp.instructions):
+        called = 0
+        if instr.opcode != "fusion":
+            for name in _called_names(instr):
+                sub = by_name.get(name)
+                if sub is not None:
+                    # every called computation's parameters alias the
+                    # caller's operand buffers, so same-size updates of
+                    # a parameter slot are in-place there (carry_body)
+                    # — while bodies, conditional branches and call
+                    # targets alike
+                    called = max(called, _transient(
+                        sub, by_name, cache, stack + (comp.name,),
+                        carry_body=True))
+        live += eff[instr.name]
+        peak = max(peak, live + called)
+        live -= freed_at.get(idx, 0)
+    return peak, eff
+
+
+def _transient(comp: Computation, by_name: Dict[str, Computation],
+               cache: Dict[Tuple[str, bool], int],
+               stack: Tuple[str, ...] = (), carry_body: bool = False
+               ) -> int:
+    """Internal peak of a called computation (its parameters alias the
+    caller's operand buffers, so they count nothing here)."""
+    key = (comp.name, carry_body)
+    if key in cache:
+        return cache[key]
+    if comp.name in stack:      # defensive: HLO computations cannot recurse
+        return 0
+    peak, _ = _walk(comp, by_name, cache, stack=stack,
+                    carry_body=carry_body)
+    cache[key] = peak
+    return peak
+
+
+def estimate(hlo_text: str, top: int = 8) -> MemoryEstimate:
+    """Peak-HBM estimate of a compiled module's entry computation."""
+    comps = parse_computations(hlo_text)
+    by_name = {c.name: c for c in comps}
+    entry = next((c for c in comps if c.is_entry), None)
+    if entry is None or not entry.instructions:
+        return MemoryEstimate(0, 0, 0, [])
+    aliases = input_output_aliases(hlo_text)
+    root = entry.root
+    params: Dict[int, Instruction] = {}
+    for instr in entry.instructions:
+        if instr.opcode == "parameter":
+            num = instr.raw.rsplit("parameter(", 1)[-1].split(")", 1)[0]
+            try:
+                params[int(num)] = instr
+            except ValueError:
+                pass
+    donated = {params[p].name for p in aliases.values() if p in params}
+    # output instructions whose buffer reuses a donated input: the root
+    # itself for a non-tuple alias ({}), else the root's n-th operand
+    aliased_out: Set[str] = set()
+    if root is not None:
+        for out_idx in aliases:
+            if not out_idx:
+                aliased_out.add(root.name)
+            elif root.opcode == "tuple" and out_idx[0] < len(
+                    root.operand_names):
+                aliased_out.add(root.operand_names[out_idx[0]])
+
+    arg_bytes = sum(p.result_bytes for p in params.values())
+    cache: Dict[Tuple[str, bool], int] = {}
+    inplace = {params[p].name: params[p].result_bytes
+               for p in aliases.values() if p in params}
+    peak, eff = _walk(entry, by_name, cache, zero_alloc=aliased_out,
+                      pinned=donated, inplace=inplace,
+                      initial_live=arg_bytes)
+    sizes = {p.name: p.result_bytes for p in params.values()}
+    sizes.update({n: b for n, b in eff.items() if b})
+    out_bytes = 0
+    if root is not None:
+        if root.opcode == "tuple":
+            out_bytes = sum(
+                sizes.get(op, 0) for op in root.operand_names
+                if op not in donated)
+        elif root.name not in aliased_out:
+            out_bytes = root.result_bytes
+    largest = sorted(sizes.items(), key=lambda kv: (-kv[1], kv[0]))[:top]
+    return MemoryEstimate(peak, arg_bytes, out_bytes, largest)
+
+
+def render_bytes(n: int) -> str:
+    for unit, div in (("GiB", 1 << 30), ("MiB", 1 << 20), ("KiB", 1 << 10)):
+        if n >= div:
+            return f"{n / div:.2f} {unit}"
+    return f"{n} B"
+
+
+#: default headroom over a fresh estimate when no budget was recorded
+BUDGET_SLACK = 1.25
+_BUDGET_QUANTUM = 4096
+
+
+def default_budget(peak_bytes: int) -> int:
+    raw = int(peak_bytes * BUDGET_SLACK)
+    return -(-raw // _BUDGET_QUANTUM) * _BUDGET_QUANTUM
+
+
+def contract_block(hlo_text: str, budget_bytes: Optional[int] = None,
+                   prior: Optional[dict] = None) -> dict:
+    """One contract ``memory[mesh]`` block (hlo_check/spmd_check schema).
+
+    Budgets are STICKY: an existing recorded budget is kept verbatim —
+    an estimate growing past it fails ``check`` until a human raises it
+    deliberately — else ``budget_bytes`` (the go/no-go gates' hard
+    caps), else the fresh estimate plus default slack."""
+    est = estimate(hlo_text)
+    budget = int((prior or {}).get("budget_bytes")
+                 or budget_bytes or default_budget(est.peak_bytes))
+    return {
+        "budget_bytes": budget,
+        "estimate_bytes": est.peak_bytes,
+        "headroom_bytes": budget - est.peak_bytes,
+        "argument_bytes": est.argument_bytes,
+        "output_bytes": est.output_bytes,
+    }

@@ -10,13 +10,15 @@ from .r006_axis import AxisNameRule
 from .r007_api_race import ApiRaceRule
 from .r008_serving import ServingContractRule
 from .r009_timing import TimingRule
+from .r010_divergence import CollectiveDivergenceRule
 
 ALL_RULES = (HostSyncRule, RecompileRule, DtypeDriftRule,
              PallasContractRule, CollectiveAccountingRule,
-             AxisNameRule, ApiRaceRule, ServingContractRule, TimingRule)
+             AxisNameRule, ApiRaceRule, ServingContractRule, TimingRule,
+             CollectiveDivergenceRule)
 
 __all__ = ["Finding", "ModuleInfo", "PackageInfo", "Rule", "ALL_RULES",
            "HostSyncRule", "RecompileRule", "DtypeDriftRule",
            "PallasContractRule", "CollectiveAccountingRule",
            "AxisNameRule", "ApiRaceRule", "ServingContractRule",
-           "TimingRule"]
+           "TimingRule", "CollectiveDivergenceRule"]

@@ -1,0 +1,299 @@
+"""R010 — rank-divergent control flow reaching a collective.
+
+The pod deadlock nobody can debug from a stack trace: collectives are
+rendezvous points, so every rank must execute the SAME collective
+sequence (kind, order, count). The reference enforces this by design —
+one fixed per-rank schedule built at InitTrain (``src/network/``) and no
+rank-conditional Network calls anywhere in the training loop. In our
+world the hazard is Python-level: a branch or loop bound fed by a
+*rank-dependent read* (``jax.process_index()``, an env rank variable
+like ``LIGHTGBM_TPU_PROCESS_ID``, ``infer_process_id``) that guards a
+collective call means rank 0 arrives at a rendezvous its peers never
+join — the pod hangs until the watchdog (or the operator) kills it.
+Inside jit the same read is a trace-time Python int, so each rank would
+compile a DIFFERENT program: statically undetectable from any single
+rank's HLO, which is exactly why spmd_check's per-module schedule check
+(the HLO half of this lint) cannot catch it and the AST must.
+
+Findings:
+
+* a branch whose test is rank-tainted and whose arms contain UNMATCHED
+  collective call counts (one arm syncs, the other does not — or a
+  rank-guarded early ``return``/``raise`` skips collectives later in the
+  function);
+* a ``for``/``while`` whose iteration count is rank-tainted with a
+  collective in the body (ranks disagree on how many times they join).
+
+Matched arms are legal and common (every rank syncs, then branches on
+the result) — the reference's own discipline, and gather_metadata's
+"validation is itself a collective" pattern here. ``jax.process_count()``
+is also treated as a rank read (a half-configured launch makes it
+rank-varying), EXCEPT the ubiquitous distributed-at-all guard
+(``process_count() <= 1`` and friends against literal 0/1/2), which is
+uniform whenever a collective could rendezvous at all.
+
+The collective vocabulary reuses R006's axis-primitive set (minus the
+local-only axis queries) plus the host-side comm helpers
+(``process_allgather``, ``sync_barrier``, ``kv_allgather``, ...); the
+schedule framing matches the R005/spmd_check collective inventory.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from .base import (Finding, ModuleInfo, PackageInfo, Rule, call_name,
+                   dotted_name)
+from .r006_axis import _AXIS_CALLS
+
+#: collective rendezvous calls — R006's axis primitives minus the
+#: local-only queries, plus the host-level comm funnels of parallel/
+_COMM_CALLS = (_AXIS_CALLS - {"axis_index", "axis_size"}) | {
+    "all_to_all", "process_allgather", "sync_global_devices",
+    "sync_barrier", "kv_allgather", "wait_at_barrier",
+    "broadcast_one_to_all", "gather_metadata", "pool_bin_sample"}
+
+#: rank-dependent read calls (basename match)
+_RANK_CALLS = {"process_index", "infer_process_id"}
+#: uniform-unless-misconfigured: counted as a rank read, but the
+#: distributed-at-all literal guard is exempt (see _trivial_count_guard)
+_COUNT_CALLS = {"process_count"}
+
+#: env-var name fragments that assign ranks
+_RANK_ENV_MARKERS = ("PROCESS_ID", "RANK", "TASK_INDEX", "TASK_ID",
+                     "WORKER_ID")
+
+
+def _is_rank_env_read(node: ast.Call) -> bool:
+    """``os.environ.get("...RANK...")`` / ``os.getenv(...)`` reads."""
+    cname = call_name(node) or ""
+    if not (cname.endswith("environ.get") or cname.endswith("getenv")):
+        return False
+    return any(isinstance(a, ast.Constant) and isinstance(a.value, str)
+               and any(m in a.value.upper() for m in _RANK_ENV_MARKERS)
+               for a in node.args)
+
+
+def _rank_source_kind(node: ast.AST) -> Optional[str]:
+    """What rank-dependent read an expression node is, if any."""
+    if isinstance(node, ast.Subscript):
+        # os.environ["...RANK..."]
+        base = dotted_name(node.value) or ""
+        if base.endswith("environ") and isinstance(node.slice, ast.Constant) \
+                and isinstance(node.slice.value, str) \
+                and any(m in node.slice.value.upper()
+                        for m in _RANK_ENV_MARKERS):
+            return f"environ[{node.slice.value!r}]"
+        return None
+    if not isinstance(node, ast.Call):
+        return None
+    base = (call_name(node) or "").rsplit(".", 1)[-1]
+    if base in _RANK_CALLS:
+        return f"{base}()"
+    if base in _COUNT_CALLS:
+        return f"{base}()"
+    if _is_rank_env_read(node):
+        key = next((a.value for a in node.args
+                    if isinstance(a, ast.Constant)
+                    and isinstance(a.value, str)), "?")
+        return f"environ.get({key!r})"
+    return None
+
+
+def _count_only(node: ast.AST) -> bool:
+    """True when every rank read under ``node`` is a process_count."""
+    saw = False
+    for n in ast.walk(node):
+        kind = _rank_source_kind(n)
+        if kind is None:
+            continue
+        if not kind.startswith(tuple(_COUNT_CALLS)):
+            return False
+        saw = True
+    return saw
+
+
+def _trivial_count_guard(test: ast.AST, tainted: Set[str]) -> bool:
+    """The distributed-at-all guard: ``process_count() <= 1`` (or a name
+    bound to it) compared against literal 0/1/2, with no OTHER rank
+    taint in the test. Uniform by construction — when ranks could
+    disagree on it, there is no 2-rank rendezvous to deadlock."""
+    if not isinstance(test, ast.Compare) or len(test.comparators) != 1:
+        return False
+    lit = test.comparators[0]
+    if not (isinstance(lit, ast.Constant) and lit.value in (0, 1, 2)):
+        return False
+    left = test.left
+    if _count_only(left):
+        return True
+    return isinstance(left, ast.Name) and left.id in tainted \
+        and tainted_kind(left.id, tainted) == "count"
+
+
+#: marker suffix so taint provenance survives the name set
+def tainted_kind(name: str, tainted: Set[str]) -> str:
+    return "count" if f"{name}\0count" in tainted else "rank"
+
+
+def _collect_taint(fn) -> Tuple[Set[str], List[Tuple[ast.AST, str]]]:
+    """(tainted local names, direct rank-read expression sites).
+
+    Names are tagged with provenance: a ``name\\0count`` twin marks a
+    process_count-only binding (eligible for the trivial-guard
+    exemption); everything else is genuinely rank-varying."""
+    tainted: Set[str] = set()
+    for n in fn.own_nodes():
+        if not isinstance(n, ast.Assign) or not n.targets:
+            continue
+        kinds = {k for sub in ast.walk(n.value)
+                 for k in ([_rank_source_kind(sub)] if
+                           _rank_source_kind(sub) else [])}
+        if not kinds:
+            continue
+        count_only = all(k.startswith(tuple(_COUNT_CALLS)) for k in kinds)
+        for t in n.targets:
+            if isinstance(t, ast.Name):
+                tainted.add(t.id)
+                if count_only:
+                    tainted.add(f"{t.id}\0count")
+    # bounded propagation through local arithmetic
+    for _ in range(4):
+        grew = False
+        for n in fn.own_nodes():
+            if not isinstance(n, ast.Assign) or not n.targets:
+                continue
+            if not any(isinstance(s, ast.Name) and s.id in tainted
+                       for s in ast.walk(n.value)):
+                continue
+            count_only = all(
+                f"{s.id}\0count" in tainted
+                for s in ast.walk(n.value)
+                if isinstance(s, ast.Name) and s.id in tainted)
+            for t in n.targets:
+                if isinstance(t, ast.Name) and t.id not in tainted:
+                    tainted.add(t.id)
+                    if count_only:
+                        tainted.add(f"{t.id}\0count")
+                    grew = True
+        if not grew:
+            break
+    return tainted, []
+
+
+def _references_taint(node: ast.AST, tainted: Set[str]) -> Optional[str]:
+    """The rank source an expression carries, or None."""
+    for n in ast.walk(node):
+        kind = _rank_source_kind(n)
+        if kind is not None:
+            return kind
+        if isinstance(n, ast.Name) and n.id in tainted:
+            return f"'{n.id}' (bound from a rank read)"
+    return None
+
+
+def _collectives_in(nodes: List[ast.AST]) -> List[Tuple[ast.AST, str]]:
+    """Collective call sites in a statement list, NOT descending into
+    nested function definitions (those do not run at branch time)."""
+    out: List[Tuple[ast.AST, str]] = []
+    stack: List[ast.AST] = list(nodes)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        if isinstance(n, ast.Call):
+            base = (call_name(n) or "").rsplit(".", 1)[-1]
+            if base in _COMM_CALLS:
+                out.append((n, base))
+        stack.extend(ast.iter_child_nodes(n))
+    out.sort(key=lambda e: getattr(e[0], "lineno", 0))
+    return out
+
+
+def _exits(nodes: List[ast.AST]) -> bool:
+    """Does a statement list unconditionally leave the function body
+    (top-level return/raise/continue/break)?"""
+    return any(isinstance(n, (ast.Return, ast.Raise, ast.Continue,
+                              ast.Break)) for n in nodes)
+
+
+class CollectiveDivergenceRule(Rule):
+    code = "R010"
+    title = "rank-divergent control flow reaching a collective"
+
+    def check(self, module: ModuleInfo, package: PackageInfo
+              ) -> List[Finding]:
+        out: List[Finding] = []
+        func_of = module.func_of
+        for fn in module.functions.values():
+            tainted, _ = _collect_taint(fn)
+            fn_collectives = _collectives_in(
+                [n for n in ast.iter_child_nodes(fn.node)])
+            for node in fn.own_nodes():
+                if isinstance(node, ast.If):
+                    out.extend(self._check_if(
+                        module, fn, node, tainted, fn_collectives,
+                        func_of))
+                elif isinstance(node, (ast.For, ast.While)):
+                    out.extend(self._check_loop(
+                        module, fn, node, tainted, func_of))
+        return out
+
+    def _check_if(self, module, fn, node: ast.If, tainted: Set[str],
+                  fn_collectives, func_of) -> List[Finding]:
+        if _trivial_count_guard(node.test, tainted):
+            return []
+        src = _references_taint(node.test, tainted)
+        if src is None:
+            return []
+        body = _collectives_in(node.body)
+        orelse = _collectives_in(node.orelse)
+        if [k for _, k in body] != [k for _, k in orelse]:
+            arm = body[0] if body else orelse[0]
+            return [self.finding(
+                module, arm[0], func_of(node),
+                f"collective '{arm[1]}' is guarded by rank-dependent "
+                f"{src}: the branch arms run unmatched collective "
+                f"sequences ({[k for _, k in body]} vs "
+                f"{[k for _, k in orelse]}), so ranks taking different "
+                "arms rendezvous at different collectives and the pod "
+                "deadlocks — every rank must run the SAME schedule "
+                "(sync first, branch on the gathered result; reference "
+                "src/network/ fixed per-rank schedule)")]
+        if (_exits(node.body) != _exits(node.orelse)) or \
+                (_exits(node.body) and not node.orelse):
+            later = [(n, k) for n, k in fn_collectives
+                     if getattr(n, "lineno", 0) >
+                     getattr(node, "end_lineno", node.lineno)]
+            if later and not body:
+                n, k = later[0]
+                return [self.finding(
+                    module, node, func_of(node),
+                    f"rank-dependent {src} guards an early exit, but "
+                    f"collective '{k}' (line {n.lineno}) runs later in "
+                    f"{fn.qualname} — the exiting rank never joins it "
+                    "and its peers block forever; sync before "
+                    "rank-conditional exits (or make the exit "
+                    "collective, like gather_metadata's shape checks)")]
+        return []
+
+    def _check_loop(self, module, fn, node, tainted: Set[str],
+                    func_of) -> List[Finding]:
+        bound = node.iter if isinstance(node, ast.For) else node.test
+        src = _references_taint(bound, tainted)
+        if src is None or (isinstance(node, ast.While)
+                           and _trivial_count_guard(node.test, tainted)):
+            return []
+        body = _collectives_in(node.body)
+        if not body:
+            return []
+        n, k = body[0]
+        what = "iteration count" if isinstance(node, ast.For) \
+            else "loop condition"
+        return [self.finding(
+            module, n, func_of(node),
+            f"collective '{k}' inside a loop whose {what} depends on "
+            f"rank-dependent {src} — ranks disagree on how many times "
+            "they join the rendezvous and the pod deadlocks on the "
+            "extra round; loop bounds that reach a collective must be "
+            "rank-uniform (gather the bound first)")]

@@ -1,0 +1,539 @@
+"""spmd_check — the pod-scale static flight check (no hardware needed).
+
+ROADMAP 2 wants the full 13.2M x 4228 Allstate on >= 8 chips, but r05
+died before a single at-scale program ever ran, and every pod failure
+mode we can actually hit is a *static* property of the lowered HLO under
+a faked mesh:
+
+* **accidental replication** — a row-sharded operand (the bin matrix,
+  the per-row gradient/score vectors) silently lowered as replicated
+  multiplies per-chip HBM by the mesh size and usually OOMs at
+  allocation; after SPMD partitioning the per-chip program's parameter
+  shapes carry the answer (a healthy program only ever sees
+  ``rows/num_shards``);
+* **per-chip HBM overflow** — the reference trains full Allstate in
+  ~1 GB/rank because the bin matrix is the only O(rows) resident
+  (docs/Experiments.rst); our equivalent budget is verified by the
+  buffer-liveness walk in ``analysis/memory.py`` over the SAME per-chip
+  lowering, gated at 16 GiB/chip for the pod shape;
+* **rank-divergent collective schedules** — the reference fixes a
+  per-rank collective schedule at InitTrain (``src/network/``); under
+  GSPMD the schedule is the program's collective instruction sequence,
+  and divergence shows up statically as replica groups that do not
+  cover every partition exactly once, or as unequal per-rank payloads.
+  (Python-level divergence — rank-dependent branches reaching a
+  collective — is R010's half, rules/r010_divergence.py.)
+
+The harness lowers the four distributed learner-mode step programs
+(``data_scatter``, ``voting`` and their ``tpu_hist_overlap`` twins) and
+the GSPMD row-sharded serving dispatch under faked N-chip meshes
+(``tpu_mesh_shape``: 4 / 8 / 32 chips, 1-D row and 2-D row x feature),
+on the CPU backend — exactly how hlo_check captures the native
+contracts. Checked-in facts live in the contract files
+(analysis/contracts/*.json):
+
+    "spmd":   {"<mesh>": {"collectives": [...],       # allowed inventory
+                          "schedule": [[kind, bytes_per_rank], ...]}}
+    "memory": {"<mesh>": {"budget_bytes": ..., "estimate_bytes": ...,
+                          "headroom_bytes": ..., ...}}
+
+``check`` fails on: a replicated row-proportional parameter, a
+collective kind absent from the mesh's inventory (implicit
+all-gather/resharding inserted by a sharding change), replica groups
+that miss or double-count a rank, per-rank schedule drift against the
+recorded sequence, and a memory estimate above the recorded budget.
+``--update`` re-records the spmd/memory blocks (budgets are sticky:
+set once, they only move when edited deliberately).
+
+The pod go/no-go gate itself (``FLIGHT_SHAPES["allstate_pod"]``) trains
+a tiny 512-row booster at the REAL feature width (4228, pack4-nibbled),
+then AOT-relowers the captured step at 13.2M rows via
+``GBDT.aot_lower_program`` — abstract shapes only, so the full-scale
+per-chip program compiles on this host in seconds and its memory walk
+answers the 16 GiB question before a chip is rented.
+
+CLI: ``scripts/tpulint spmd [--mesh NxM] [--update] [mode ...]``;
+tier-1 runs the 4-chip check + the allstate gate in
+tests/test_spmd_check.py (32-chip and 2-D sweeps are slow-lane).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from . import memory
+from .hlo import (collective_bytes, collective_kind,
+                  collective_payload_shapes, entry_computation,
+                  num_partitions, parse_instructions, replica_groups_of,
+                  tensor_bytes)
+from .hlo_check import (MODE_TEMPLATES, ContractFinding, capture_mode,
+                        check_host_ops, contract_path, load_contract)
+
+#: the distributed learner-mode step programs the flight check covers
+FLIGHT_MODES = ("data_scatter", "voting", "data_scatter_overlap",
+                "voting_overlap")
+
+#: fake-mesh matrix: 1-D row meshes and 2-D row x feature folds
+FLIGHT_MESHES = ("4", "8", "32", "4x2", "8x4")
+
+#: the fast-lane default (tier-1 + bare CLI): one non-native mesh size
+DEFAULT_MESHES = ("4",)
+
+#: the pod-run go/no-go shapes, AOT-relowered at full scale.
+#: allstate_pod: the full Allstate claim_prediction matrix
+#: (docs/Experiments.rst:121 — 13.2M rows x 4228 mostly-one-hot
+#: columns), data-parallel compact grower with reduce-scatter histograms
+#: and pack4 nibble bins (one-hot columns realize <= 16 bins, and
+#: WITHOUT pack4 the u8 work+scratch pair alone busts 16 GiB/chip).
+FLIGHT_SHAPES: Dict[str, dict] = {
+    "allstate_pod": {
+        "description": "full-Allstate pod shape: 13.2M x 4228 one-hot, "
+                       "8 chips, data-parallel compact grower, "
+                       "reduce-scatter histograms, pack4 nibble bins — "
+                       "the static go/no-go gate for ROADMAP 2's pod run "
+                       "at 16 GiB/chip",
+        "base_mode": "data_scatter",
+        "extra_params": {"tpu_bin_pack4": True},
+        "program": "compact_step_k0",
+        "rows": 13_200_000,
+        "mesh": "8",
+        "budget_bytes": 16 * (1 << 30),
+        "problem": {"n": 512, "f": 4228, "seed": 0},
+    },
+}
+
+
+def mesh_shape_of(key: str) -> Tuple[int, ...]:
+    return tuple(int(p) for p in key.lower().split("x"))
+
+
+def mesh_devices(key: str) -> int:
+    n = 1
+    for d in mesh_shape_of(key):
+        n *= d
+    return n
+
+
+def is_2d(key: str) -> bool:
+    return len(mesh_shape_of(key)) == 2
+
+
+@dataclasses.dataclass
+class FlightCapture:
+    """One lowered (mode, mesh) program plus the facts checks need."""
+    mode: str
+    mesh_key: str
+    program: str
+    hlo_text: str
+    row_dims: Set[int]       # GLOBAL row-proportional dims (forbidden
+    #                          in per-chip parameter shapes when S > 1)
+    num_shards: int          # row shards (the mesh's data-axis size)
+    gbdt: object = None      # the trained booster (verify_flight reuses
+    #                          the voting one for the serving dispatch)
+
+
+def flight_template(mode: str, mesh_key: str) -> dict:
+    """The mode's MODE_TEMPLATE adjusted to lower under ``mesh_key``.
+
+    2-D meshes run the masked GSPMD grower for the data modes: the
+    compact grower's shard_map physically owns the row axis only, while
+    the masked path's bin matrix shards over BOTH axes
+    (``row_feature_sharding``) — which is the whole point of the 2-D
+    fold for the wide one-hot shape.
+    """
+    t = dict(MODE_TEMPLATES[mode])
+    params = dict(t["params"], tpu_mesh_shape=mesh_key)
+    if is_2d(mesh_key) and params.get("tpu_grower") == "compact":
+        params["tpu_grower"] = "masked"
+        t["program"] = "step"
+    t["params"] = params
+    t["num_devices"] = mesh_devices(mesh_key)
+    return t
+
+
+def _capture_rows(gbdt) -> Tuple[Set[int], int]:
+    """(global row-proportional dims, row shards) of a trained GBDT."""
+    from ..parallel.mesh import mesh_axis_sizes
+    s_rows = mesh_axis_sizes(gbdt.mesh)[0] if gbdt.mesh is not None else 1
+    dims = {int(gbdt.num_data)}
+    c = getattr(gbdt, "_compact", None)
+    if c and c.get("work") is not None:
+        dims.add(int(c["work"].shape[0]))
+    return dims, s_rows
+
+
+def capture_flight(mode: str, mesh_key: str, iterations: int = 2
+                   ) -> FlightCapture:
+    t = flight_template(mode, mesh_key)
+    cap = capture_mode(mode, template=t, iterations=iterations)
+    row_dims, s_rows = _capture_rows(cap.gbdt)
+    return FlightCapture(mode, mesh_key, t["program"], cap.hlo_text,
+                         row_dims, s_rows, gbdt=cap.gbdt)
+
+
+# ---------------------------------------------------------------------------
+# checks (pure text; no jax)
+# ---------------------------------------------------------------------------
+def check_row_replication(hlo_text: str, row_dims: Set[int],
+                          num_shards: int, mode: str, mesh_key: str
+                          ) -> List[ContractFinding]:
+    """A per-chip program parameter carrying a GLOBAL row dimension is a
+    replicated row-proportional operand — the accidental-replication OOM.
+
+    Scoped to entry parameters (the program's resident operands): the
+    bin matrix / gradients / scores arrive as parameters, and fusion
+    bodies may legally flatten per-shard tensors into products that
+    collide with the global row count.
+    """
+    if num_shards <= 1:
+        return []
+    entry = entry_computation(hlo_text)
+    if entry is None:
+        return []
+    out: List[ContractFinding] = []
+    for instr in entry.instructions:
+        if instr.opcode != "parameter":
+            continue
+        bad = sorted(set(instr.result_dims) & row_dims)
+        if bad:
+            out.append(ContractFinding(
+                mode, "spmd-replication",
+                f"mesh {mesh_key}: parameter '{instr.name}' carries the "
+                f"GLOBAL row dimension {bad[0]} in the per-chip program "
+                f"(shapes {instr.result_shapes}) — a row-proportional "
+                f"operand lowered as replicated costs {num_shards}x its "
+                "sharded footprint per chip and OOMs the pod at "
+                "allocation; fix the in_sharding/device_put of this "
+                "operand (parallel/mesh.py row shardings)"))
+    return out
+
+
+def schedule_of(hlo_text: str) -> List[List[Any]]:
+    """The per-rank collective schedule: ``[kind, bytes_per_rank]`` in
+    program order. Under SPMD every rank runs the same sequence; the
+    per-rank payload is the instruction's (already per-shard) result."""
+    out: List[List[Any]] = []
+    for instr in parse_instructions(hlo_text):
+        kind = collective_kind(instr.opcode)
+        if kind is None or instr.opcode.endswith("-done"):
+            continue
+        nbytes = sum(tensor_bytes(d, dims)
+                     for d, dims in collective_payload_shapes(instr))
+        out.append([kind, nbytes])
+    return out
+
+
+def check_rank_schedule(hlo_text: str, mode: str, mesh_key: str
+                        ) -> List[ContractFinding]:
+    """Replica-group sanity of every collective: the groups must cover
+    each partition exactly once and be uniformly sized — a missing rank
+    deadlocks the pod (it never joins), a double-counted rank or ragged
+    group sizes mean the per-rank sequences disagree on bytes."""
+    nparts = num_partitions(hlo_text)
+    out: List[ContractFinding] = []
+    for instr in parse_instructions(hlo_text):
+        kind = collective_kind(instr.opcode)
+        if kind is None or instr.opcode.endswith("-done"):
+            continue
+        groups = replica_groups_of(instr)
+        if not groups:           # absent/empty = one implicit all-ranks group
+            continue
+        seen: Dict[int, int] = {}
+        for grp in groups:
+            for r in grp:
+                seen[r] = seen.get(r, 0) + 1
+        missing = sorted(set(range(nparts)) - set(seen))
+        doubled = sorted(r for r, c in seen.items() if c > 1)
+        if missing or doubled:
+            out.append(ContractFinding(
+                mode, "spmd-schedule",
+                f"mesh {mesh_key}: '{instr.opcode}' at HLO line "
+                f"{instr.line} has replica_groups covering "
+                f"{sorted(seen)} of {nparts} partitions"
+                + (f" (missing {missing})" if missing else "")
+                + (f" (duplicated {doubled})" if doubled else "")
+                + " — a rank outside the groups never joins this "
+                "collective and the pod deadlocks at its first tree"))
+        sizes = {len(g) for g in groups}
+        if len(sizes) > 1:
+            out.append(ContractFinding(
+                mode, "spmd-schedule",
+                f"mesh {mesh_key}: '{instr.opcode}' at HLO line "
+                f"{instr.line} has ragged replica groups (sizes "
+                f"{sorted(sizes)}) — per-rank transfer bytes differ "
+                "across the pod, so the fixed per-rank schedule no "
+                "longer holds"))
+    return out
+
+
+def check_inventory(hlo_text: str, contract: dict, mode: str,
+                    mesh_key: str) -> List[ContractFinding]:
+    """Collective kinds must stay inside the mesh's recorded inventory
+    (falling back to the native ``collectives.allow``): an implicit
+    all-gather/resharding inserted by a sharding change is cross-chip
+    traffic nobody budgeted."""
+    spmd = contract.get("spmd", {}).get(mesh_key)
+    allow = set(spmd["collectives"]) if spmd \
+        else set(contract.get("collectives", {}).get("allow", []))
+    acct = collective_bytes(hlo_text)
+    observed = {k for k, v in acct.items()
+                if k not in ("total", "count") and v > 0}
+    out: List[ContractFinding] = []
+    for kind in sorted(observed - allow):
+        out.append(ContractFinding(
+            mode, "spmd-inventory",
+            f"mesh {mesh_key}: collective '{kind}' "
+            f"({acct[kind]} B) is not in the contract inventory "
+            f"({sorted(allow) or 'none'}) — an implicit "
+            "all-gather/resharding crept into the step program; if the "
+            "sharding change is deliberate, re-record with "
+            "scripts/tpulint spmd --update"))
+    return out
+
+
+def check_schedule_drift(hlo_text: str, contract: dict, mode: str,
+                         mesh_key: str) -> List[ContractFinding]:
+    spmd = contract.get("spmd", {}).get(mesh_key)
+    if not spmd or "schedule" not in spmd:
+        return []
+    fresh = schedule_of(hlo_text)
+    recorded = [list(x) for x in spmd["schedule"]]
+    if fresh == recorded:
+        return []
+    return [ContractFinding(
+        mode, "spmd-schedule",
+        f"mesh {mesh_key}: per-rank collective schedule drifted — "
+        f"recorded {recorded}, lowered {fresh} (kind, bytes-per-rank, "
+        "program order). Comm protocol changes must be re-recorded "
+        "(scripts/tpulint spmd --update) and reviewed")]
+
+
+def check_flight_memory(hlo_text: str, contract: dict, mode: str,
+                        mesh_key: str) -> List[ContractFinding]:
+    """Budget regression: the walk's estimate must stay under the
+    contract's recorded per-chip budget for this mesh."""
+    block = contract.get("memory", {}).get(mesh_key)
+    if not block:
+        return []
+    est = memory.estimate(hlo_text)
+    budget = int(block["budget_bytes"])
+    if est.peak_bytes <= budget:
+        return []
+    top = ", ".join(f"{name}={memory.render_bytes(b)}"
+                    for name, b in est.largest[:3])
+    return [ContractFinding(
+        mode, "memory",
+        f"mesh {mesh_key}: static per-chip peak "
+        f"{memory.render_bytes(est.peak_bytes)} exceeds the "
+        f"{memory.render_bytes(budget)} budget (recorded estimate was "
+        f"{memory.render_bytes(int(block.get('estimate_bytes', 0)))}; "
+        f"largest buffers: {top}) — a pod run at this shape would OOM "
+        "at allocation. Shrink the resident state (pack4/quantized "
+        "bins, smaller mbatch) or raise budget_bytes deliberately in "
+        "the contract's memory block")]
+
+
+def check_flight(cap: FlightCapture, contract: dict
+                 ) -> List[ContractFinding]:
+    """All static checks for one lowered (mode, mesh) program."""
+    return (check_row_replication(cap.hlo_text, cap.row_dims,
+                                  cap.num_shards, cap.mode, cap.mesh_key)
+            + check_rank_schedule(cap.hlo_text, cap.mode, cap.mesh_key)
+            + check_inventory(cap.hlo_text, contract, cap.mode,
+                              cap.mesh_key)
+            + check_schedule_drift(cap.hlo_text, contract, cap.mode,
+                                   cap.mesh_key)
+            + check_flight_memory(cap.hlo_text, contract, cap.mode,
+                                  cap.mesh_key)
+            + check_host_ops(cap.hlo_text,
+                             {"mode": cap.mode, "forbid_host_ops":
+                              contract.get("forbid_host_ops", True)}))
+
+
+# ---------------------------------------------------------------------------
+# recording (--update)
+# ---------------------------------------------------------------------------
+def record_blocks(name: str, mesh_key: str, hlo_text: str,
+                  budget_bytes: Optional[int] = None,
+                  description: Optional[str] = None) -> dict:
+    """Write/refresh one contract file's spmd+memory blocks for a mesh."""
+    path = contract_path(name)
+    data: dict = {}
+    if os.path.exists(path):
+        with open(path) as fh:
+            data = json.load(fh)
+    data.setdefault("mode", name)
+    if description and "description" not in data:
+        data["description"] = description
+    acct = collective_bytes(hlo_text)
+    data.setdefault("spmd", {})[mesh_key] = {
+        "collectives": sorted(k for k, v in acct.items()
+                              if k not in ("total", "count") and v > 0),
+        "schedule": schedule_of(hlo_text),
+    }
+    prior = data.get("memory", {}).get(mesh_key)
+    data.setdefault("memory", {})[mesh_key] = memory.contract_block(
+        hlo_text, budget_bytes, prior)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump(data, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return data
+
+
+# ---------------------------------------------------------------------------
+# the harness passes (import jax lazily through hlo_check.capture_mode)
+# ---------------------------------------------------------------------------
+def verify_flight(modes: Sequence[str] = FLIGHT_MODES,
+                  meshes: Sequence[str] = DEFAULT_MESHES,
+                  update: bool = False,
+                  include_serving: bool = True,
+                  include_shapes: bool = True) -> List[ContractFinding]:
+    """The full flight check: every (mode, mesh) lowering verified (or
+    re-recorded with ``update``), the serving dispatch lowered over the
+    first mesh, and the FLIGHT_SHAPES go/no-go gates AOT-verified."""
+    findings: List[ContractFinding] = []
+    serving_gbdt = None
+    for mode in modes:
+        for mesh_key in meshes:
+            cap = capture_flight(mode, mesh_key)
+            if mode == "voting" and mesh_key == meshes[0] \
+                    and not is_2d(mesh_key):
+                # reuse this booster for the serving dispatch below
+                # instead of training a second identical one
+                serving_gbdt = cap.gbdt
+            if update:
+                record_blocks(mode, mesh_key, cap.hlo_text)
+            contract = load_contract(mode) if os.path.exists(
+                contract_path(mode)) else {}
+            findings += check_flight(cap, contract)
+    if include_serving:
+        findings += verify_serving(meshes[0], update=update,
+                                   gbdt=serving_gbdt)
+    if include_shapes:
+        for name in FLIGHT_SHAPES:
+            findings += verify_flight_shape(name, update=update)
+    return findings
+
+
+def verify_serving(mesh_key: str, update: bool = False,
+                   gbdt=None) -> List[ContractFinding]:
+    """Lower the GSPMD row-sharded serving dispatch under a faked mesh
+    and run the same static checks (its contract file is
+    ``serving_sharded.json`` — spmd/memory blocks only)."""
+    name = "serving_sharded"
+    if is_2d(mesh_key):
+        # serving shards rows only (row_sharding_2d); fold a 2-D key
+        # down to its row factor so the ladder math stays honest
+        mesh_key = str(mesh_shape_of(mesh_key)[0])
+    if gbdt is None:
+        t = flight_template("voting", mesh_key)
+        cap = capture_mode("voting", template=t, iterations=2)
+        gbdt = cap.gbdt
+    from ..parallel.mesh import mesh_axis_sizes
+    s_rows = mesh_axis_sizes(gbdt.mesh)[0]
+    _, ladder, _ = gbdt._predict_cfg()
+    n_rows = int(ladder[-1]) * s_rows        # top rung on every shard
+    lowered = gbdt.aot_lower_sharded_predict(n_rows)
+    text = lowered.compile().as_text()
+    if update:
+        record_blocks(
+            name, mesh_key, text,
+            description="GSPMD row-sharded serving dispatch "
+                        "(predict_raw_device oversize branch): one "
+                        "ladder-rung program per shard, no cross-chip "
+                        "traffic beyond the final score layout")
+    contract = load_contract(name) if os.path.exists(
+        contract_path(name)) else {}
+    cap = FlightCapture(name, mesh_key, "predict_raw_batched", text,
+                        {n_rows}, s_rows)
+    return check_flight(cap, contract)
+
+
+def verify_flight_shape(name: str, update: bool = False
+                        ) -> List[ContractFinding]:
+    """AOT-verify one FLIGHT_SHAPES gate (the pod go/no-go): capture the
+    step at a tiny row count but the REAL feature width, relower at the
+    full row count, then run every static check at scale."""
+    spec = FLIGHT_SHAPES[name]
+    mesh_key = spec["mesh"]
+    base = MODE_TEMPLATES[spec["base_mode"]]
+    t = dict(base)
+    t["params"] = dict(base["params"], tpu_mesh_shape=mesh_key,
+                       **spec.get("extra_params", {}))
+    t["program"] = spec["program"]
+    t["num_devices"] = mesh_devices(mesh_key)
+    t["problem"] = spec["problem"]
+    cap = capture_mode(name, template=t, iterations=2)
+    g = cap.gbdt
+    dim_map = g.flight_row_dims(spec["rows"])
+    text = g.aot_lower_program(spec["program"], dim_map).compile().as_text()
+    if update:
+        record_blocks(name, mesh_key, text,
+                      budget_bytes=int(spec["budget_bytes"]),
+                      description=spec["description"])
+    contract = load_contract(name) if os.path.exists(
+        contract_path(name)) else {}
+    # the go/no-go budget is the spec's even when the file is absent
+    contract.setdefault("memory", {}).setdefault(
+        mesh_key, {"budget_bytes": int(spec["budget_bytes"])})
+    row_dims, s_rows = set(dim_map.values()), _capture_rows(g)[1]
+    fcap = FlightCapture(name, mesh_key, spec["program"], text,
+                         row_dims, s_rows)
+    return check_flight(fcap, contract)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI body for ``scripts/tpulint spmd`` (which sets the CPU
+    platform + virtual device count env BEFORE jax imports)."""
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="tpulint spmd",
+        description="pod-scale static flight check: SPMD sharding, "
+                    "per-chip memory and collective schedules under "
+                    "faked meshes, on the CPU backend")
+    ap.add_argument("modes", nargs="*", default=list(FLIGHT_MODES),
+                    help=f"learner modes (default {list(FLIGHT_MODES)})")
+    ap.add_argument("--mesh", action="append", default=None,
+                    help="mesh key: N (1-D) or RxC (2-D rows x "
+                         f"features); repeatable (default "
+                         f"{list(DEFAULT_MESHES)}, full matrix "
+                         f"{list(FLIGHT_MESHES)})")
+    ap.add_argument("--update", action="store_true",
+                    help="re-record the contracts' spmd/memory blocks "
+                         "from the current lowering")
+    ap.add_argument("--no-serving", action="store_true",
+                    help="skip the sharded serving dispatch")
+    ap.add_argument("--no-shapes", action="store_true",
+                    help="skip the FLIGHT_SHAPES go/no-go gates")
+    args = ap.parse_args(argv)
+    modes = args.modes or list(FLIGHT_MODES)
+    unknown = [m for m in modes if m not in FLIGHT_MODES]
+    if unknown:
+        print(f"spmd_check: unknown mode(s) {unknown}; "
+              f"known: {list(FLIGHT_MODES)}")
+        return 2
+    meshes = tuple(args.mesh) if args.mesh else DEFAULT_MESHES
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    findings = verify_flight(modes, meshes, update=args.update,
+                             include_serving=not args.no_serving,
+                             include_shapes=not args.no_shapes)
+    for f in findings:
+        print(f.render())
+    if not findings:
+        what = f"{len(modes)} mode(s) x {list(meshes)}"
+        print(f"spmd_check: flight check clean ({what}"
+              + ("" if args.no_shapes else
+                 f" + {list(FLIGHT_SHAPES)} go/no-go") + ")")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

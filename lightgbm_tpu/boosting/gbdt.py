@@ -422,9 +422,9 @@ class _ValidSet:
         binned_np = dataset.binned
         pad = 0
         if mesh is not None:
-            from ..parallel.mesh import (class_row_sharding, pad_rows,
-                                         row_sharding_2d)
-            pad = pad_rows(self.n_real, len(mesh.devices.ravel()))
+            from ..parallel.mesh import (class_row_sharding, mesh_axis_sizes,
+                                         pad_rows, row_sharding_2d)
+            pad = pad_rows(self.n_real, mesh_axis_sizes(mesh)[0])
             if pad:
                 binned_np = np.pad(binned_np, ((0, pad), (0, 0)))
             self.binned = jax.device_put(binned_np, row_sharding_2d(mesh))
@@ -495,6 +495,8 @@ class GBDT:
         self._comm_hlo: Dict[str, str] = {}
         self._comm_hlo_history: Dict[str, List[str]] = {}
         self._comm_hlo_sigs: Dict[str, List[tuple]] = {}
+        self._comm_jitted: Dict[str, Any] = {}
+        self._comm_abstract: Dict[str, tuple] = {}
         self._use_compact = False
         self._compact = None
         self.tree_learner = "serial"
@@ -528,8 +530,10 @@ class GBDT:
     # -- training setup ------------------------------------------------------
     def _setup_train(self, train_set: BinnedDataset) -> None:
         cfg = self.config
-        from ..parallel.mesh import (class_row_sharding, make_mesh, pad_rows,
-                                     replicated, row_sharding, row_sharding_2d)
+        from ..parallel.mesh import (class_row_sharding, make_mesh,
+                                     mesh_axis_sizes, pad_rows, parse_mesh_shape,
+                                     replicated, row_feature_sharding,
+                                     row_sharding, row_sharding_2d)
         # multi-host bootstrap before any device queries (reference:
         # Network::Init from config, src/network/linkers_socket.cpp)
         if int(cfg.get("num_machines", 1) or 1) > 1:
@@ -542,8 +546,24 @@ class GBDT:
         distributed = tree_learner in ("data", "voting", "feature") \
             and len(jax.devices()) > 1
         self.tree_learner = tree_learner
-        self.mesh = make_mesh() if distributed else None
+        mesh_shape = parse_mesh_shape(cfg.get("tpu_mesh_shape", ""))
+        self.mesh = make_mesh(mesh_shape=mesh_shape) if distributed else None
         self._multiproc = jax.process_count() > 1
+        if self.mesh is not None and mesh_axis_sizes(self.mesh)[1] > 1:
+            # 2-D rows x features: the masked GSPMD growers shard the bin
+            # matrix over both axes; learners with a physical row layout
+            # (compact's shard_map partitions, feature-parallel's
+            # feature-axis placement) stay row-mesh only
+            if self.tree_learner == "feature":
+                raise ValueError(
+                    "tpu_mesh_shape=RxC (2-D rows x features) does not "
+                    "compose with tree_learner=feature — the feature "
+                    "learner already owns the feature axis; use a 1-D "
+                    "mesh or tree_learner=data/voting")
+            if self._multiproc:
+                raise ValueError(
+                    "tpu_mesh_shape=RxC is single-process only for now; "
+                    "multi-host runs keep the 1-D row mesh")
         if self._multiproc:
             # each process holds only its LOCAL row shard; the global array
             # is assembled below from the per-process pieces (reference:
@@ -562,7 +582,7 @@ class GBDT:
             pad = 0
         else:
             self._n_real = train_set.num_data
-            pad = pad_rows(self._n_real, len(self.mesh.devices.ravel())) \
+            pad = pad_rows(self._n_real, mesh_axis_sizes(self.mesh)[0]) \
                 if self.mesh else 0
         self._pad = pad
         self.num_data = self._n_real + pad
@@ -623,8 +643,17 @@ class GBDT:
                     row_sharding_2d(self.mesh), binned_np)
                 self._valid_row_mask = None
             else:
-                self.binned = jax.device_put(binned_np,
-                                             row_sharding_2d(self.mesh))
+                s_feat = mesh_axis_sizes(self.mesh)[1]
+                if s_feat > 1:
+                    # 2-D mesh: the feature axis shards too — pad it with
+                    # trivial (never-selectable) columns like the
+                    # feature-parallel learner does
+                    self._f_pad = (-binned_np.shape[1]) % s_feat
+                    if self._f_pad:
+                        binned_np = np.pad(binned_np,
+                                           ((0, 0), (0, self._f_pad)))
+                self.binned = jax.device_put(
+                    binned_np, row_feature_sharding(self.mesh))
                 ones = np.ones(self.num_data, np.float32)
                 if pad:
                     ones[self._n_real:] = 0.0
@@ -854,7 +883,7 @@ class GBDT:
             voting_k=(int(cfg.get("top_k", 20))
                       if self.mesh is not None
                       and self.tree_learner == "voting" else 0),
-            voting_shards=(len(self.mesh.devices.ravel())
+            voting_shards=(mesh_axis_sizes(self.mesh)[0]
                            if self.mesh is not None
                            and self.tree_learner == "voting" else 0),
             hist_impl=resolved.hist_impl,
@@ -881,6 +910,7 @@ class GBDT:
         mesh_compact_ok = (
             self.mesh is None
             or (self.tree_learner == "data"
+                and mesh_axis_sizes(self.mesh)[1] == 1
                 and not (self.objective is not None
                          and self.objective.renew_leaves)))
         # exact-count ceiling: histogram count channels ride f32, exact for
@@ -889,7 +919,7 @@ class GBDT:
         # histogram), so the bound applies per shard, not globally. Global
         # psum-ed counts only feed constraints (min_data) and the
         # smaller-side election, where +-2^-24 relative is harmless.
-        n_shards = (len(self.mesh.devices.ravel())
+        n_shards = (mesh_axis_sizes(self.mesh)[0]
                     if self.mesh is not None and self.tree_learner == "data"
                     else 1)
         # non-row-elementwise objectives (lambdarank: gradients couple rows
@@ -984,6 +1014,8 @@ class GBDT:
         self._comm_hlo = {}
         self._comm_hlo_history = {}
         self._comm_hlo_sigs = {}
+        self._comm_jitted = {}
+        self._comm_abstract = {}
 
     def _step_budget_args(self) -> Tuple[jax.Array, jax.Array]:
         """(leaf_budget, depth_budget) — the ACTUAL tree budgets as traced
@@ -1119,6 +1151,15 @@ class GBDT:
             seen = self._comm_hlo_sigs.setdefault(k, [])
             if sig not in seen:
                 seen.append(sig)
+                # AOT re-lowering hook (analysis/spmd_check.py): the jitted
+                # callable + the abstract (shape/dtype/sharding) argument
+                # signature — enough to re-lower this program at a DIFFERENT
+                # row count without data (ShapeDtypeStructs hold no buffers,
+                # so donated args are not retained)
+                self._comm_jitted[k] = jitted
+                self._comm_abstract[k] = (
+                    [self._abstractify(a) for a in args],
+                    {kk: self._abstractify(v) for kk, v in kwargs.items()})
                 text = jitted.lower(*args, **kwargs).compile().as_text()
                 self._comm_hlo.setdefault(k, text)
                 self._comm_hlo_history.setdefault(k, []).append(text)
@@ -1140,6 +1181,120 @@ class GBDT:
                     pass
             return jitted(*args, **kwargs)
         return capture
+
+    @staticmethod
+    def _abstractify(x):
+        """jax.Array leaves -> sharded ShapeDtypeStructs (AOT signature).
+
+        Only NAMED (mesh) shardings are pinned: a single-device placement
+        on an auxiliary arg (e.g. an uncommitted bag vector) must stay
+        unconstrained, or relowering under the mesh reports an
+        incompatible-devices conflict the real call never had."""
+        from jax.sharding import NamedSharding
+
+        def leaf(v):
+            if isinstance(v, jax.Array):
+                sh = v.sharding if isinstance(v.sharding, NamedSharding) \
+                    else None
+                return jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=sh)
+            return v
+        return jax.tree_util.tree_map(leaf, x)
+
+    def aot_lower_program(self, key: str, dim_map: Optional[Dict[int, int]]
+                          = None):
+        """AOT-relower a comm-captured step program at rewritten row dims.
+
+        The spmd flight check's scaling hook: a tiny training run under
+        ``LGBM_TPU_COMM_ACCOUNTING=1`` records the jitted step and its
+        abstract argument signature; this re-lowers the SAME program with
+        every dimension in ``dim_map`` rewritten (e.g. the padded tiny
+        row count -> the full Allstate row count) — shapes only, no data
+        is materialized, so a 13.2M-row program lowers on this CPU host
+        in compile time, not memory. Shardings ride the recorded
+        ShapeDtypeStructs, so the mesh placement is the captured run's.
+        Returns the ``jax.stages.Lowered`` (call ``.compile()`` for the
+        partitioned per-chip HLO text).
+        """
+        if key not in self._comm_jitted:
+            raise KeyError(
+                f"program {key!r} was not comm-captured (have "
+                f"{sorted(self._comm_jitted)}); train at least one "
+                "iteration with LGBM_TPU_COMM_ACCOUNTING=1 first")
+        args, kwargs = self._comm_abstract[key]
+
+        def resize(x):
+            if isinstance(x, jax.ShapeDtypeStruct) and dim_map:
+                shape = tuple(dim_map.get(d, d) for d in x.shape)
+                if shape != tuple(x.shape):
+                    return jax.ShapeDtypeStruct(shape, x.dtype,
+                                                sharding=x.sharding)
+            return x
+
+        args = [jax.tree_util.tree_map(resize, a) for a in args]
+        kwargs = {k: jax.tree_util.tree_map(resize, v)
+                  for k, v in kwargs.items()}
+        return self._comm_jitted[key].lower(*args, **kwargs)
+
+    def flight_row_dims(self, n_rows: int) -> Dict[int, int]:
+        """``dim_map`` for :meth:`aot_lower_program`: every captured
+        row-proportional dimension -> its value at ``n_rows`` real rows.
+
+        Two row dims exist: the mesh-padded global row count
+        (``num_data``) and, for the compact grower, the work/scratch row
+        count ``S * (n/S + pad_rows)`` (each shard's rows plus its own
+        block-overrun pad — see ``_setup_compact_state``)."""
+        from ..parallel.mesh import mesh_axis_sizes, pad_rows
+        s_rows = (mesh_axis_sizes(self.mesh)[0]
+                  if self.mesh is not None else 1)
+        n_pad = n_rows + pad_rows(n_rows, s_rows)
+        dim_map = {int(self.num_data): int(n_pad)}
+        c = getattr(self, "_compact", None)
+        if c and c.get("work") is not None:
+            new_rows = c["S"] * (n_pad // c["S"] + c["pad_rows"])
+            dim_map[int(c["work"].shape[0])] = int(new_rows)
+        return dim_map
+
+    def aot_lower_sharded_predict(self, n_rows: int):
+        """AOT-lower the GSPMD row-sharded serving dispatch (the
+        ``predict_raw_device`` oversize branch) at ``n_rows`` rows over
+        the training mesh — the spmd flight check's serving program.
+        Abstract input only: nothing is featurized or transferred."""
+        if self.mesh is None:
+            raise ValueError(
+                "sharded predict needs a training mesh (tree_learner="
+                "data/voting/feature on >1 device)")
+        from ..parallel.mesh import (mesh_axis_sizes, predict_shard_pad,
+                                     replicated, row_sharding_2d)
+        tb_cfg, ladder, _engine = self._predict_cfg()
+        nan_a, cat_a = self._pred_route_args()
+        st, t_real, depth = self._device_trees_batched(None, 0, tb_cfg)
+        if t_real == 0:
+            raise ValueError("no trees to lower (train first)")
+        num_shards = mesh_axis_sizes(self.mesh)[0]
+        n_pad = predict_shard_pad(n_rows, num_shards, ladder)
+        if n_pad is None:
+            # per-shard share above the ladder: lower at the top rung —
+            # the program the slicing fallback would run per slice
+            n_pad = ladder[-1] * num_shards
+        packed = self._pred_pack4
+        f = self.train_set.num_total_features
+        cols = (f + 1) // 2 if packed else f
+        rep = replicated(self.mesh)
+        shaped = self._abstractify
+        rep_abs = jax.tree_util.tree_map(
+            lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=rep)
+            if isinstance(v, jax.ShapeDtypeStruct) else v, shaped(
+                (st, nan_a, cat_a)))
+        st_a, nan_abs, cat_abs = rep_abs
+        k = self.num_tree_per_iteration
+        ab = jax.ShapeDtypeStruct(
+            (n_pad, cols), self.train_set.binned.dtype,
+            sharding=row_sharding_2d(self.mesh))
+        kk = jax.ShapeDtypeStruct((), jnp.int32, sharding=rep)
+        return predict_raw_batched.lower(
+            ab, st_a, nan_abs, cat_abs, kk, num_class=k,
+            depth=depth_bucket(depth), tbatch=tb_cfg,
+            any_cat=self._pred_any_cat, packed=packed)
 
     # -- compact (physically partitioned) serial path ------------------------
     def _setup_compact_state(self) -> None:
@@ -1382,7 +1537,13 @@ class GBDT:
             if sc_cfg not in ("off", "0", "false") and sc_able:
                 gp = gp._replace(hist_scatter=n_sh)
         k_total = self.num_tree_per_iteration
-        n = self._compact["nl"]          # per-shard rows (serial: all rows)
+        # per-shard rows derive from the work buffer's SHAPE at trace
+        # time (rows = work.shape[0] - the static block-overrun pad), not
+        # from a baked closure int: the spmd flight check AOT-relowers
+        # this same step at the full pod row count (aot_lower_program),
+        # and every row-proportional quantity must follow the abstract
+        # argument shapes
+        pr = self._compact["pad_rows"]   # per-shard overrun pad (static)
         n_real_g = self._n_real
         rid_off = (self._compact["layout"].extra_off + 4 * self._cx_rowid)
         # rung-sized leaf arrays under the step ladder (see _build_step_fn)
@@ -1470,11 +1631,12 @@ class GBDT:
                  if self._cx_weight is not None else None)
 
         def col(work, off):                  # [n] f32 from 4 u8 columns
-            return _u8_to_f32(work[:n, off:off + 4])
+            return _u8_to_f32(work[:work.shape[0] - pr, off:off + 4])
 
         def scores_of(work):                 # [K, n] f32
-            raw = work[:n, sc_off:sc_off + 4 * k_total]
-            return _u8_to_f32(raw.reshape(n, k_total, 4)).T
+            nn = work.shape[0] - pr
+            raw = work[:nn, sc_off:sc_off + 4 * k_total]
+            return _u8_to_f32(raw.reshape(nn, k_total, 4)).T
 
         gx_off = (layout.extra_off + 4 * self._cx_grads
                   if self._cx_grads is not None else None)
@@ -1484,7 +1646,8 @@ class GBDT:
         def step(work, scratch, scores, bag_w, use_stored_bag, feat_mask,
                  shrinkage, bynode_key, cegb_used, quant_key, extra_key,
                  leaf_budget, depth_budget, ext_g=None, ext_h=None, *, k):
-            pad_n = work.shape[0] - n
+            n = work.shape[0] - pr           # per-shard rows (trace-static)
+            pad_n = pr
 
             w_col = jnp.where(use_stored_bag, col(work, layout.cnt_off),
                               bag_w)
@@ -2761,8 +2924,9 @@ class GBDT:
             return predict_raw_batched(dev, st, nan_a, cat_a, kk,
                                        packed=packed, **kwargs)
         if self._can_shard_predict(n, ladder):
-            from ..parallel.mesh import predict_shard_pad, row_sharding_2d
-            num_shards = len(self.mesh.devices.ravel())
+            from ..parallel.mesh import (mesh_axis_sizes, predict_shard_pad,
+                                         row_sharding_2d)
+            num_shards = mesh_axis_sizes(self.mesh)[0]
             n_pad = predict_shard_pad(n, num_shards, ladder)
             mat = np.pad(binned, ((0, n_pad - n), (0, 0)))
             if packed:
@@ -2782,8 +2946,8 @@ class GBDT:
         otherwise callers slice through the largest rung."""
         if self.mesh is None or getattr(self, "_multiproc", False):
             return False
-        from ..parallel.mesh import predict_shard_pad
-        num_shards = len(self.mesh.devices.ravel())
+        from ..parallel.mesh import mesh_axis_sizes, predict_shard_pad
+        num_shards = mesh_axis_sizes(self.mesh)[0]
         return predict_shard_pad(n, num_shards, ladder) is not None
 
     def _average_divisor(self, num_iteration: Optional[int],

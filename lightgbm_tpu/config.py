@@ -247,6 +247,13 @@ PARAMS: Dict[str, Tuple[Any, type, Tuple[str, ...]]] = {
     # axis + best-split all-gather vs full-histogram all-reduce
     # (ops/grower_compact.py hist_scatter)
     "tpu_hist_scatter": ("auto", str, ()),  # auto | on | off
+    # training-mesh shape: "" = all devices on a 1-D row axis (the
+    # default), "N" = first N devices 1-D, "RxC" = 2-D rows x features
+    # (the wide one-hot shape: the masked grower's binned matrix shards
+    # over BOTH axes; compact/feature learners are row-mesh only). The
+    # spmd flight check (analysis/spmd_check.py) lowers every learner
+    # mode under faked values of this knob before a pod is rented.
+    "tpu_mesh_shape": ("", str, ("mesh_shape",)),  # "" | "N" | "RxC"
     # bucketed grower-step ladder (compile-once training): the step
     # program's jit key carries the power-of-two leaf RUNG and the
     # {unlimited, bounded} depth bucket instead of the exact

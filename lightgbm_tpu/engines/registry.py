@@ -86,6 +86,11 @@ class EngineEntry(NamedTuple):
     max_bins: int = 256           # eligibility bound on the bin width
     requires_tpu: bool = False
     sweepable: bool = True
+    #: mesh shapes (spmd_check keys: "1", "8", "4x2") every contract of
+    #: this entry must carry a verified `memory` block for — the
+    #: per-entry slice of the pod flight check (analysis/spmd_check.py);
+    #: hlo_check.registry_contract_findings enumerates the coverage
+    meshes: Tuple[str, ...] = ("1",)
 
 
 ENTRIES: Tuple[EngineEntry, ...] = (

@@ -23,21 +23,67 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
+FEAT_AXIS = "feat"
+
+
+def parse_mesh_shape(spec: str) -> Optional[Tuple[int, ...]]:
+    """``tpu_mesh_shape`` strings: ``""``/``"auto"`` (all devices, 1-D),
+    ``"8"`` (first 8 devices, 1-D), ``"4x2"`` (2-D: 4-way rows x 2-way
+    features). Returns None for the all-devices default."""
+    s = str(spec or "").strip().lower()
+    if s in ("", "auto", "0"):
+        return None
+    parts = [p for p in s.replace("*", "x").split("x") if p]
+    try:
+        dims = tuple(int(p) for p in parts)
+    except ValueError:
+        raise ValueError(
+            f"tpu_mesh_shape={spec!r}: expected 'N' (1-D row mesh) or "
+            "'RxC' (2-D rows x features), e.g. '8' or '4x2'")
+    if not dims or len(dims) > 2 or any(d < 1 for d in dims):
+        raise ValueError(
+            f"tpu_mesh_shape={spec!r}: need 1 or 2 positive factors "
+            "(rows[ x features])")
+    return dims
 
 
 def make_mesh(num_devices: Optional[int] = None,
-              devices: Optional[Sequence] = None) -> Mesh:
-    """1-D mesh over the row (data) axis.
+              devices: Optional[Sequence] = None,
+              mesh_shape: Optional[Tuple[int, ...]] = None) -> Mesh:
+    """Device mesh over the row (data) axis, optionally 2-D rows x features.
 
     The reference's world is ``num_machines`` ranks in a flat TCP/MPI mesh
     (network.h Init); ours is whatever devices JAX exposes (single host: all
-    local chips; multi-host: the global device set).
+    local chips; multi-host: the global device set). ``mesh_shape``
+    (see :func:`parse_mesh_shape`) restricts the device count and, with
+    two factors, folds the mesh to ``(data, feat)`` — the 2-D sharding
+    for the wide one-hot shapes where the feature axis is worth
+    partitioning too (ROADMAP 2; reference analogue: the row-wise vs
+    col-wise histogram dispatch, dataset.h:727).
     """
     if devices is None:
         devices = jax.devices()
-        if num_devices is not None:
+        if mesh_shape is not None:
+            need = 1
+            for d in mesh_shape:
+                need *= d
+            if need > len(devices):
+                raise ValueError(
+                    f"tpu_mesh_shape={'x'.join(map(str, mesh_shape))} "
+                    f"needs {need} devices, have {len(devices)}")
+            devices = devices[:need]
+        elif num_devices is not None:
             devices = devices[:num_devices]
-    return Mesh(np.asarray(devices), (DATA_AXIS,))
+    devices = np.asarray(devices)
+    if mesh_shape is not None and len(mesh_shape) == 2:
+        return Mesh(devices.reshape(mesh_shape), (DATA_AXIS, FEAT_AXIS))
+    return Mesh(devices, (DATA_AXIS,))
+
+
+def mesh_axis_sizes(mesh: Mesh) -> Tuple[int, int]:
+    """(row shards, feature shards) of a training mesh (1-D: feat=1)."""
+    ax = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return ax.get(DATA_AXIS, 1), ax.get(FEAT_AXIS, 1)
 
 
 def row_sharding(mesh: Mesh) -> NamedSharding:
@@ -47,6 +93,15 @@ def row_sharding(mesh: Mesh) -> NamedSharding:
 
 def row_sharding_2d(mesh: Mesh) -> NamedSharding:
     """[N, F] arrays sharded along rows, features replicated."""
+    return NamedSharding(mesh, P(DATA_AXIS, None))
+
+
+def row_feature_sharding(mesh: Mesh) -> NamedSharding:
+    """[N, F] arrays sharded along BOTH axes of a 2-D ``(data, feat)``
+    mesh (the wide one-hot shape: 4228 one-hot columns are worth
+    partitioning too); on a 1-D mesh this is plain row sharding."""
+    if FEAT_AXIS in mesh.axis_names:
+        return NamedSharding(mesh, P(DATA_AXIS, FEAT_AXIS))
     return NamedSharding(mesh, P(DATA_AXIS, None))
 
 

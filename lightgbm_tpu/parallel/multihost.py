@@ -190,17 +190,81 @@ def infer_process_id(machines: List[str]) -> Optional[int]:
     return None
 
 
+_kv_seq = 0
+
+
+def _kv_client():
+    """The coordination-service KV client, or None outside multi-process
+    runs (same access path as mesh.sync_barrier — the KV plane works on
+    every backend, including multiprocess CPU where XLA collectives may
+    not exist)."""
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client
+    except Exception:  # pragma: no cover - jax internals moved
+        return None
+
+
+def kv_allgather(arr, tag: str, timeout_s: float = 600.0):
+    """Allgather a host numpy array across processes over the
+    coordination-service KV store — no XLA collective involved.
+
+    Each rank publishes its (npy-serialized) array under a sequenced,
+    rank-suffixed key, then blocking-reads every peer's key; the
+    sequence number keeps repeated gathers from colliding, and callers
+    must invoke KV gathers in the same program order on every rank
+    (the sync_barrier discipline). Returns the per-rank arrays in rank
+    order — ragged first dimensions are fine, which the padded XLA
+    allgather path cannot say.
+    """
+    import io
+    import jax
+    import numpy as np
+    global _kv_seq
+    _kv_seq += 1
+    client = _kv_client()
+    if client is None:  # pragma: no cover - no coordination service
+        raise RuntimeError(
+            "kv_allgather needs the jax.distributed coordination service "
+            "(call init_distributed first)")
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
+    prefix = f"lgbm_tpu_kvag_{tag}_{_kv_seq}"
+    client.key_value_set_bytes(
+        f"{prefix}/{jax.process_index()}", buf.getvalue())
+    out = []
+    for p in range(jax.process_count()):
+        raw = client.blocking_key_value_get_bytes(
+            f"{prefix}/{p}", int(timeout_s * 1000))
+        out.append(np.load(io.BytesIO(raw), allow_pickle=False))
+    # clean up so repeated gathers (one per Dataset construct) do not
+    # grow coordinator memory forever: a delete is only safe once EVERY
+    # rank has read every key, so fence first, then each rank removes
+    # its own key (no contention; the barrier id rides the same seq)
+    client.wait_at_barrier(f"{prefix}_read", int(timeout_s * 1000))
+    client.key_value_delete(f"{prefix}/{jax.process_index()}")
+    return out
+
+
 def pool_bin_sample(sample):
     """Pool bin-construction samples across processes so every rank builds
     IDENTICAL bin mappers from the global distribution (reference:
     ConstructBinMappersFromTextData gathers per-rank samples and syncs the
     resulting mappers, src/io/dataset_loader.cpp:1070; without this two
     hosts would bin their local shards differently and train a silently
-    wrong model)."""
+    wrong model).
+
+    On multiprocess CPU the gather rides :func:`kv_allgather` — jax's CPU
+    backend has no XLA cross-process collectives unless gloo is compiled
+    in, but the coordination-service KV plane always works there (the
+    sync_barrier pattern), and the one-shot construct-time sample is tiny.
+    """
     import jax
     import numpy as np
     if jax.process_count() <= 1:
         return sample
+    if jax.default_backend() == "cpu":
+        return np.concatenate(kv_allgather(sample, "binsample"), axis=0)
     from jax.experimental import multihost_utils as mu
     counts = mu.process_allgather(
         np.asarray([sample.shape[0]], np.int64)).reshape(-1)
@@ -336,6 +400,41 @@ def maybe_init_distributed(params) -> bool:
     return False
 
 
+def _maybe_enable_cpu_collectives() -> None:
+    """Multiprocess CPU: switch jax's CPU collectives to gloo when built.
+
+    The default CPU backend has NO cross-process XLA collectives
+    (``jax_cpu_collectives_implementation=none``) — every in-jit psum of
+    a 2-process CPU run would abort. When this jaxlib ships the gloo TCP
+    implementation, select it BEFORE the backend client is created; the
+    construct-time sample pooling additionally rides the KV plane
+    (:func:`kv_allgather`), which needs no XLA collectives at all.
+    Respects an explicit user setting; a no-op off-CPU and on builds
+    without gloo."""
+    try:
+        import jax
+        from jax._src import xla_bridge
+        from jax._src.lib import xla_client
+        # skip only under an EXPLICIT non-cpu platform selection (e.g.
+        # the tunneled-TPU box's "axon,cpu"): with jax_platforms unset a
+        # CPU-only host still resolves to the CPU backend, and bailing
+        # there would leave the default num_machines>1 CPU run to abort
+        # at its first in-jit collective. On accelerator runs the flag
+        # only configures the SECONDARY cpu client (construction is
+        # lazy and cheap), so over-enabling is harmless.
+        plats = str(jax.config.jax_platforms
+                    or os.environ.get("JAX_PLATFORMS") or "")
+        if plats and not plats.startswith("cpu"):
+            return
+        current = xla_bridge.CPU_COLLECTIVES_IMPLEMENTATION.value
+        if current in (None, "none") \
+                and hasattr(xla_client._xla, "make_gloo_tcp_collectives"):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            log.info("multiprocess CPU: enabled gloo XLA collectives")
+    except Exception:  # pragma: no cover - config/attr drift across jax
+        pass
+
+
 def init_distributed(config) -> bool:
     """Initialize JAX multi-process training when num_machines > 1.
 
@@ -366,6 +465,7 @@ def init_distributed(config) -> bool:
             "(reference: config.h machines / linkers_socket.cpp)")
     log.info(f"Initializing multi-host training: rank {process_id}/"
              f"{num_machines}, coordinator {coordinator}")
+    _maybe_enable_cpu_collectives()
     # the bootstrap barrier is the first place a preempted/half-up pod
     # hangs: run it under the collective watchdog (deadline + exponential
     # backoff on transient failures) so a dead coordinator surfaces as a

@@ -244,6 +244,21 @@ def test_registry_entry_id_must_be_in_filename():
     assert len(findings) == 1 and "entry id" in findings[0].message
 
 
+def test_registry_entry_missing_mesh_block_fails():
+    """Per-entry mesh enumeration (ISSUE 15): an entry declaring a mesh
+    key its contract has no verified memory block for is a finding —
+    the flight-check coverage cannot silently lag the declaration."""
+    from lightgbm_tpu.engines.registry import EngineEntry
+    wide = EngineEntry("xla_lane", "xla", "lane", False, "declares 4x2",
+                       contracts=("xla_lane",), meshes=("1", "4x2"))
+    findings = hlo_check.registry_contract_findings([wide])
+    assert len(findings) == 1
+    assert "no memory block for declared mesh '4x2'" in findings[0].message
+    # the shipped declaration ("1") is covered by the native block
+    ok = wide._replace(meshes=("1",))
+    assert not hlo_check.registry_contract_findings([ok])
+
+
 def test_xla_lane_entry_contract_is_fully_concretized(captured):
     """The xla_lane entry contract pins the registry-resolved program
     with every engine knob explicit and autotune off; it lowers with no

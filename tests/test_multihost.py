@@ -73,15 +73,12 @@ def _free_port():
 
 
 @pytest.mark.slow
-@pytest.mark.skip(reason="multihost_utils.process_allgather (and the XLA "
-                  "collective under sync_global_devices) is UNIMPLEMENTED "
-                  "on the multiprocess CPU backend in jax 0.4.37 — "
-                  "pool_bin_sample's cross-process gather aborts rank "
-                  "workers. The coordination-service KV barrier "
-                  "(mesh.sync_barrier) covers barriers only, not data "
-                  "gathers; unskip when jax's CPU collectives land or the "
-                  "test moves to a real multi-host backend.")
 def test_two_process_training_identical_models(tmp_path):
+    # construct-time sample pooling rides the coordination-service KV
+    # plane on multiprocess CPU (pool_bin_sample -> kv_allgather), and
+    # init_distributed switches the CPU backend's XLA collectives to
+    # gloo for the in-jit psums — jax 0.4.37's default CPU backend has
+    # no cross-process collectives at all (ISSUE 15)
     port = _free_port()
     worker = tmp_path / "worker.py"
     worker.write_text(_WORKER)

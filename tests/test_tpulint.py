@@ -1479,3 +1479,133 @@ def test_r009c_taxonomy_constant_import_clean(tmp_path):
             return x + len(SPAN_TAXONOMY)
     """)
     assert "R009" not in codes(findings)
+
+
+# ---------------------------------------------------------------- R010
+def test_r010_rank_guarded_collective_flagged(tmp_path):
+    """The canonical pod deadlock: rank 0 joins a rendezvous its peers
+    never enter."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+        from jax.experimental import multihost_utils as mu
+
+        def sync_stats(x):
+            if jax.process_index() == 0:
+                return mu.process_allgather(x)
+            return x
+    """)
+    assert "R010" in codes(findings)
+    (f,) = [f for f in findings if f.rule == "R010"]
+    assert "unmatched collective sequences" in f.message
+
+
+def test_r010_env_rank_loop_bound_flagged(tmp_path):
+    """Rank-var-derived loop trip counts disagree across the pod."""
+    findings = lint_snippet(tmp_path, """
+        import os
+        import jax
+
+        def drain(xs):
+            rank = int(os.environ.get("LIGHTGBM_TPU_PROCESS_ID", "0"))
+            for _ in range(rank):
+                xs = jax.lax.psum(xs, "data")
+            return xs
+    """)
+    assert "R010" in codes(findings)
+    (f,) = [f for f in findings if f.rule == "R010"]
+    assert "iteration count" in f.message
+
+
+def test_r010_rank_guarded_early_exit_flagged(tmp_path):
+    """A rank-conditional early return skips the barrier every other
+    rank blocks in later."""
+    findings = lint_snippet(tmp_path, """
+        import os
+        from lightgbm_tpu.parallel.mesh import sync_barrier
+
+        def checkpoint(state):
+            rank = int(os.environ["LIGHTGBM_TPU_PROCESS_ID"])
+            if rank != 0:
+                return None
+            path = write_snapshot(state)
+            sync_barrier("ckpt")
+            return path
+    """)
+    assert "R010" in codes(findings)
+    (f,) = [f for f in findings if f.rule == "R010"]
+    assert "early exit" in f.message
+
+
+def test_r010_while_on_rank_flagged(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import jax
+
+        def settle(x):
+            budget = jax.process_index() + 1
+            while budget > 0:
+                x = jax.lax.psum(x, "data")
+                budget -= 1
+            return x
+    """)
+    assert "R010" in codes(findings)
+
+
+def test_r010_matched_arms_clean(tmp_path):
+    """Every rank syncs, THEN branches on the gathered result — the
+    reference's fixed-schedule discipline; both arms run the same
+    collective sequence."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+        from jax.experimental import multihost_utils as mu
+
+        def agree(x):
+            r = jax.process_index()
+            if r == 0:
+                flag = mu.process_allgather(x)
+            else:
+                flag = mu.process_allgather(x * 0)
+            return flag
+    """)
+    assert "R010" not in codes(findings)
+
+
+def test_r010_process_count_guard_clean(tmp_path):
+    """The ubiquitous distributed-at-all guard is uniform: when ranks
+    could disagree on it there is no second rank to deadlock with
+    (pool_bin_sample's own shape)."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+        from jax.experimental import multihost_utils as mu
+
+        def pool(sample):
+            if jax.process_count() <= 1:
+                return sample
+            return mu.process_allgather(sample)
+    """)
+    assert "R010" not in codes(findings)
+
+
+def test_r010_nontrivial_process_count_flow_flagged(tmp_path):
+    """process_count is only exempt in the literal distributed-at-all
+    guard — arithmetic flows into a collective-bearing loop still
+    fire (a half-configured launch makes it rank-varying)."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+
+        def ring(x):
+            hops = jax.process_count() - 1
+            for _ in range(hops):
+                x = jax.lax.ppermute(x, "data", [(0, 1)])
+            return x
+    """)
+    assert "R010" in codes(findings)
+
+
+def test_r010_shipped_parallel_layer_needs_only_the_bootstrap_anchor():
+    """The shipped multi-host plane lints R010-clean except the
+    documented pre-bootstrap validation exit in init_distributed."""
+    findings, errors = lint_paths(
+        [os.path.join(PKG_DIR, "parallel"), os.path.join(PKG_DIR, "io")])
+    assert not errors
+    r010 = [f for f in findings if f.rule == "R010"]
+    assert [f.func for f in r010] == ["init_distributed"]
