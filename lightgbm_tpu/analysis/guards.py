@@ -50,8 +50,10 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import os
+import sys
 import threading
-from typing import Iterator, List
+from typing import Dict, Iterator, List, Optional, Tuple
 
 import jax
 from jax import monitoring
@@ -555,3 +557,264 @@ def steady_state_guard(what: str = "guarded region"
         with no_host_transfers():
             yield counts
     counts.assert_no_compiles(what)
+
+
+# ---------------------------------------------------------------------------
+# lock-order witness — the runtime half of tpulint R011
+
+
+class LockOrderError(AssertionError):
+    """A lock-order cycle was observed across threads at runtime."""
+
+
+def _witness_stack(skip: int = 2, depth: int = 12) -> Tuple[str, ...]:
+    """Cheap ``file.py:line`` stack (innermost first), skipping the
+    witness/lock machinery frames — captured on every outer acquisition,
+    so no ``traceback`` formatting."""
+    frames: List[str] = []
+    try:
+        f = sys._getframe(skip)
+    except ValueError:              # pragma: no cover - shallow stack
+        return ()
+    while f is not None and len(frames) < depth:
+        fname = f.f_code.co_filename
+        base = os.path.basename(fname)
+        if base not in ("threading.py", "rwlock.py", "guards.py"):
+            frames.append(f"{base}:{f.f_lineno} in {f.f_code.co_name}")
+        f = f.f_back
+    return tuple(frames)
+
+
+class LockOrderWitness:
+    """Per-thread held-lock stacks merged into a global order graph.
+
+    Locks are identified by their *creation site* name, not instance id:
+    every ``ServeFuture._mu`` is the same node, so a per-request lock
+    family cannot spuriously self-cycle (same-name pairs are skipped —
+    they are either re-entrant or independent instances), while a real
+    A->B / B->A inversion between two lock families is caught no matter
+    which instances exhibit it. Each first-seen edge keeps the acquiring
+    thread's stacks for both locks; a cycle closing in the graph records
+    the full loop with both witness stacks and fails
+    ``assert_no_cycles``.
+    """
+
+    def __init__(self):
+        # a RAW lock, created before lock_witness() patches the factories
+        self._mu = threading.Lock()
+        # thread id -> [(id(obj), name, side, stack), ...]
+        self._held: Dict[int, List[tuple]] = {}
+        # (held name, acquired name) -> (held stack, acquired stack)
+        self.edges: Dict[Tuple[str, str], Tuple[Tuple[str, ...],
+                                                Tuple[str, ...]]] = {}
+        self.cycles: List[str] = []
+        self.acquires = 0
+
+    # -- hooks (called by rwlock + the patched stdlib factories) -------
+    def note_acquire(self, obj, name: str, side: str) -> None:
+        me = threading.get_ident()
+        stack = _witness_stack()
+        with self._mu:
+            self.acquires += 1
+            held = self._held.setdefault(me, [])
+            for _hid, hname, _hside, hstack in held:
+                if hname == name:
+                    continue        # same family: re-entrant/per-instance
+                if (hname, name) not in self.edges:
+                    self.edges[(hname, name)] = (hstack, stack)
+                    if self._reaches(name, hname):
+                        self._record_cycle(hname, name)
+            held.append((id(obj), name, side, stack))
+
+    def note_release(self, obj) -> None:
+        me = threading.get_ident()
+        with self._mu:
+            held = self._held.get(me, ())
+            for i in range(len(held) - 1, -1, -1):
+                if held[i][0] == id(obj):
+                    del held[i]
+                    return
+
+    # -- cycle machinery (callers hold self._mu) -----------------------
+    def _reaches(self, src: str, dst: str) -> bool:
+        seen = {src}
+        frontier = [src]
+        while frontier:
+            node = frontier.pop()
+            for (a, b) in self.edges:
+                if a == node and b not in seen:
+                    if b == dst:
+                        return True
+                    seen.add(b)
+                    frontier.append(b)
+        return False
+
+    def _path(self, src: str, dst: str) -> List[str]:
+        prev: Dict[str, str] = {}
+        frontier = [src]
+        seen = {src}
+        while frontier:
+            node = frontier.pop(0)
+            for (a, b) in self.edges:
+                if a == node and b not in seen:
+                    prev[b] = a
+                    if b == dst:
+                        path = [dst]
+                        while path[-1] != src:
+                            path.append(prev[path[-1]])
+                        return list(reversed(path))
+                    seen.add(b)
+                    frontier.append(b)
+        return [src, dst]           # pragma: no cover - _reaches said yes
+
+    def _record_cycle(self, hname: str, name: str) -> None:
+        loop = [hname] + self._path(name, hname)
+        lines = [f"lock-order cycle observed: "
+                 f"{' -> '.join([hname, name])} closes "
+                 f"{' -> '.join(loop)}"]
+        for a, b in zip(loop, loop[1:]):
+            hstack, astack = self.edges.get((a, b), ((), ()))
+            lines.append(f"  edge {a} -> {b}:")
+            lines.append(f"    {a} held at: "
+                         + (" <- ".join(hstack[:6]) or "<?>"))
+            lines.append(f"    {b} acquired at: "
+                         + (" <- ".join(astack[:6]) or "<?>"))
+        self.cycles.append("\n".join(lines))
+
+    def assert_no_cycles(self, what: str = "guarded region") -> None:
+        if self.cycles:
+            raise LockOrderError(
+                f"{what}: {len(self.cycles)} lock-order cycle(s) "
+                "observed:\n" + "\n".join(self.cycles[:4]))
+
+
+class _WitnessedLock:
+    """threading.Lock wrapper reporting outer acquire/release."""
+
+    def __init__(self, inner, name: str):
+        self._inner = inner
+        self._name = name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            w = _active_lock_witness
+            if w is not None:
+                w.note_acquire(self, self._name, "excl")
+        return ok
+
+    def release(self) -> None:
+        w = _active_lock_witness
+        if w is not None:
+            w.note_release(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        return self.acquire()
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __getattr__(self, attr):
+        # Condition() wires _release_save/_acquire_restore/_is_owned
+        # straight to the inner lock: cv.wait() releases without a
+        # witness note, so the held entry persists while the thread is
+        # BLOCKED in wait — it records no edges there, harmless
+        return getattr(self._inner, attr)
+
+
+class _WitnessedRLock(_WitnessedLock):
+    """Re-entrant variant: only depth 0<->1 transitions are noted."""
+
+    def __init__(self, inner, name: str):
+        super().__init__(inner, name)
+        self._local = threading.local()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            depth = getattr(self._local, "depth", 0)
+            self._local.depth = depth + 1
+            if depth == 0:
+                w = _active_lock_witness
+                if w is not None:
+                    w.note_acquire(self, self._name, "excl")
+        return ok
+
+    def release(self) -> None:
+        depth = getattr(self._local, "depth", 1)
+        self._local.depth = depth - 1
+        if depth == 1:
+            w = _active_lock_witness
+            if w is not None:
+                w.note_release(self)
+        self._inner.release()
+
+
+#: the armed witness; wrappers outliving the block (daemon threads still
+#: holding references) go quiet once this resets to None
+_active_lock_witness: Optional[LockOrderWitness] = None
+
+
+@contextlib.contextmanager
+def lock_witness() -> Iterator[LockOrderWitness]:
+    """Arm the runtime lock-order witness for the ``with`` block.
+
+    Patches the ``threading.Lock``/``threading.RLock`` factories so
+    locks *created inside the block* report outer acquisitions with
+    their creation site as the graph node name (``Condition()`` picks up
+    the patched RLock automatically), and arms the RWLock/Mutex hooks in
+    utils/rwlock.py for the API locks and ``GBDT._trees_mu`` (those
+    report at their own level, so their internals — and any lock created
+    from rwlock.py or this module — stay unwrapped). Pre-existing stdlib
+    locks are invisible; construct the server/registry under the witness.
+
+    Usage::
+
+        with lock_witness() as w:
+            ... threads hammering serve()/deploy()/save_checkpoint() ...
+        w.assert_no_cycles("16-thread serving")
+    """
+    global _active_lock_witness
+    w = LockOrderWitness()
+    saved_lock, saved_rlock = threading.Lock, threading.RLock
+
+    def _site() -> str:
+        f = sys._getframe(2)        # the factory's caller
+        while f is not None and \
+                os.path.basename(f.f_code.co_filename) == "threading.py":
+            f = f.f_back
+        if f is None:               # pragma: no cover - always has one
+            return "<unknown>"
+        return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+
+    def make_lock():
+        site = _site()
+        inner = saved_lock()
+        if site.startswith(("rwlock.py", "guards.py")):
+            return inner            # witnessed at the RWLock/Mutex level
+        return _WitnessedLock(inner, f"Lock@{site}")
+
+    def make_rlock():
+        site = _site()
+        inner = saved_rlock()
+        if site.startswith(("rwlock.py", "guards.py")):
+            return inner
+        return _WitnessedRLock(inner, f"RLock@{site}")
+
+    prev_rw = _rwlock.get_witness()
+    threading.Lock = make_lock
+    threading.RLock = make_rlock
+    _rwlock.set_witness(w)
+    _active_lock_witness = w
+    try:
+        yield w
+    finally:
+        _active_lock_witness = None
+        _rwlock.set_witness(prev_rw)
+        threading.Lock = saved_lock
+        threading.RLock = saved_rlock

@@ -1,0 +1,856 @@
+"""Interprocedural lock-order & blocking-call analyzer (tpulint R011).
+
+The reference serializes its whole public surface behind ONE shared
+mutex at the C API boundary (src/c_api.cpp:163) — lock ordering cannot
+go wrong with a single lock. This port grew many fine-grained locks
+(``@read_locked``/``@write_locked`` RWLocks on Booster/Dataset,
+``GBDT._trees_mu``, the coalescer condition variable,
+``registry._deploy_mu``, module-level observability mutexes), so the
+discipline the reference gets for free must be *proved* here: the
+whole-program lock-acquisition-order graph has to stay acyclic, and
+nothing slow may run while a lock is held.
+
+The analysis (pure AST, no jax import — loads anywhere, like the rest
+of tpulint):
+
+  1. discovers every lock object in the package: ``self.attr = Lock()/
+     RLock()/Condition()/Semaphore()/RWLock()/Mutex()`` class members
+     (keyed ``Class.attr``), module-level ``name = Lock()`` (keyed
+     ``module.name``);
+  2. walks each function in statement order tracking the held-lock set:
+     ``with lock:``, ``with rw.read()/.write():``, bare ``.acquire()``/
+     ``.release()`` (incl. the acquire-then-release-in-finally shape),
+     and the ``@read_locked``/``@write_locked`` decorators (which hold
+     ``Class._api_lock`` for the whole body);
+  3. propagates "this call transitively acquires lock L" and "this call
+     transitively blocks (join/get/result/wait/sleep/fsync, d2h
+     funnels, jitted dispatch)" facts across calls — including
+     functions passed by reference (``run_with_deadline(_commit, ...)``)
+     — via a bounded fixpoint, each fact carrying a witness call chain;
+  4. reports:
+       (a) lock-order cycles, with the witness chain of every edge;
+       (b) blocking calls / d2h transfers / jitted dispatch reached
+           while a lock is held;
+       (c) RWLock read->write upgrade paths (the runtime raises —
+           this finds them before a thread does);
+       (d) ``Condition.wait()`` outside a predicate ``while`` loop.
+
+Deliberate-policy carve-outs (encoded, not allowlisted):
+  * ``cv.wait()`` while holding that same cv is the condition-variable
+    pattern itself, not a blocking-under-lock hazard;
+  * the ``@read_locked``/``@write_locked`` API lock intentionally spans
+    device work — that coarse lock over compute IS the reference's
+    design (c_api.cpp API_BEGIN) — so decorator-granted holds are
+    exempt from the d2h/dispatch categories (NOT from sleep/join/fsync
+    blocking, and NOT from upgrade checks);
+  * re-entrant same-lock re-acquisition is silent (RWLock/Mutex/RLock
+    all nest), except read->write which upgrades (c).
+
+Everything else ships fixed or anchored in analysis/tpulint.allow with
+a justification. CLI: ``scripts/tpulint locks [--dot]``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .rules.base import (Finding, FunctionInfo, JIT_NAMES, ModuleInfo,
+                         PackageInfo, call_name, dotted_name)
+
+#: constructor basename -> lock kind
+LOCK_CTORS = {
+    "Lock": "lock",
+    "RLock": "lock",
+    "Condition": "condition",
+    "Semaphore": "lock",
+    "BoundedSemaphore": "lock",
+    "RWLock": "rwlock",
+    "Mutex": "lock",
+}
+
+#: decorator basename -> rwlock side granted for the whole method body
+LOCKED_DECORATORS = {"read_locked": "read", "write_locked": "write"}
+
+#: time.sleep is blocking even WITH an argument — that is its job
+_SLEEP_NAMES = {"time.sleep", "sleep"}
+_FSYNC_NAMES = {"os.fsync", "fsync"}
+#: explicit device->host funnels / sync points
+_D2H_NAMES = {"jax.device_get", "device_get", "jax.block_until_ready",
+              "np.asarray", "numpy.asarray"}
+
+#: method-call attrs that are lock protocol, never package callees
+_LOCK_PROTOCOL_ATTRS = {
+    "acquire", "release", "acquire_read", "acquire_write", "release_read",
+    "release_write", "read", "write", "locked", "wait", "wait_for",
+    "notify", "notify_all",
+}
+
+#: attr-call basenames too generic to resolve package-wide by basename
+_ATTR_RESOLVE_STOPLIST = _LOCK_PROTOCOL_ATTRS | {
+    "get", "put", "join", "result", "set", "is_set", "clear", "append",
+    "extend", "pop", "popleft", "add", "discard", "remove", "update",
+    "items", "keys", "values", "copy", "split", "strip", "format",
+    "encode", "decode", "flush", "close", "info", "warning", "error",
+    "debug",
+    "exception", "startswith", "endswith", "sort", "index", "count",
+    "todict", "tolist", "astype", "reshape", "sum", "mean", "min", "max",
+}
+
+_MAX_CHAIN = 6          # witness chain hops kept per fact
+_FIXPOINT_ITERS = 10
+
+
+class LockDecl:
+    """One discovered lock object."""
+
+    def __init__(self, key: str, kind: str, path: str, line: int):
+        self.key = key          # "Class.attr" or "module.name"
+        self.kind = kind        # "lock" | "condition" | "rwlock"
+        self.path = path
+        self.line = line
+
+    def __repr__(self):
+        return f"LockDecl({self.key}, {self.kind})"
+
+
+class Held:
+    """One entry of the held-lock stack during traversal."""
+
+    def __init__(self, key: str, side: str, line: int,
+                 via_decorator: bool = False):
+        self.key = key
+        self.side = side        # "excl" | "read" | "write"
+        self.line = line
+        self.via_decorator = via_decorator
+
+
+class Edge:
+    """First witness of a src-held -> dst-acquired order relation."""
+
+    def __init__(self, src: str, dst: str, fn: "FunctionInfo",
+                 held_line: int, chain: List[str]):
+        self.src = src
+        self.dst = dst
+        self.fn = fn
+        self.held_line = held_line
+        self.chain = chain      # call chain from holder to acquisition
+
+    def describe(self) -> str:
+        where = f"{self.fn.module.path}:{self.held_line}"
+        return (f"{self.src} -> {self.dst} [{self.fn.qualname} holds "
+                f"{self.src} at {where}; acquired via "
+                f"{' -> '.join(self.chain)}]")
+
+
+class LockAnalysis:
+    """Package-wide result: declared locks, the order graph, findings."""
+
+    def __init__(self, package: PackageInfo):
+        self.package = package
+        self.locks: Dict[str, LockDecl] = {}
+        self.edges: Dict[Tuple[str, str], Edge] = {}
+        self.findings: List[Finding] = []
+        self.cycles: List[List[str]] = []
+        _Analyzer(package, self).run()
+
+    # -- rendering ------------------------------------------------------
+    def order_graph_lines(self) -> List[str]:
+        out = [f"locks discovered: {len(self.locks)}"]
+        for key in sorted(self.locks):
+            d = self.locks[key]
+            out.append(f"  {key}  ({d.kind}, {d.path}:{d.line})")
+        out.append(f"order edges: {len(self.edges)}")
+        for (src, dst) in sorted(self.edges):
+            out.append(f"  {self.edges[(src, dst)].describe()}")
+        return out
+
+    def to_dot(self) -> str:
+        lines = ["digraph lock_order {", "  rankdir=LR;"]
+        nodes = sorted(set(self.locks)
+                       | {e[0] for e in self.edges}
+                       | {e[1] for e in self.edges})
+        cyc_nodes = {n for cyc in self.cycles for n in cyc}
+        for n in nodes:
+            kind = self.locks[n].kind if n in self.locks else "lock"
+            shape = {"condition": "diamond",
+                     "rwlock": "box"}.get(kind, "ellipse")
+            color = ', color=red' if n in cyc_nodes else ""
+            lines.append(f'  "{n}" [shape={shape}{color}];')
+        for (src, dst), e in sorted(self.edges.items()):
+            label = e.chain[-1].replace('"', "'") if e.chain else ""
+            lines.append(f'  "{src}" -> "{dst}" [label="{label}"];')
+        lines.append("}")
+        return "\n".join(lines)
+
+
+def _basename(cname: Optional[str]) -> Optional[str]:
+    return cname.rsplit(".", 1)[-1] if cname else None
+
+
+def _timeout_is_set(call: ast.Call, first_pos_is_timeout: bool) -> bool:
+    """True when the call carries a non-None timeout (so it cannot block
+    forever). Mirrors R008: for join/result/wait the first positional IS
+    the timeout; for get the first positional is ``block``."""
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return not (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None)
+    if first_pos_is_timeout and call.args:
+        a = call.args[0]
+        return not (isinstance(a, ast.Constant) and a.value is None)
+    return False
+
+
+class _FnFacts:
+    """Per-function interprocedural facts with witness chains."""
+
+    def __init__(self):
+        # (lock key, side) -> call chain to the acquisition site
+        self.acquires: Dict[Tuple[str, str], List[str]] = {}
+        # (category, label) -> call chain;  category in
+        # {"blocking", "d2h", "dispatch"}
+        self.blocking: Dict[Tuple[str, str], List[str]] = {}
+
+
+class _Analyzer:
+    def __init__(self, package: PackageInfo, result: LockAnalysis):
+        self.pkg = package
+        self.res = result
+        # per-module: module-level lock name -> decl
+        self.module_locks: Dict[int, Dict[str, LockDecl]] = {}
+        # class name -> attr -> decl (package-wide; class names are
+        # unique in this package)
+        self.class_locks: Dict[str, Dict[str, LockDecl]] = {}
+        # attr -> decls across all classes (for self.X in un-declaring
+        # classes: unique-match fallback)
+        self.attr_locks: Dict[str, List[LockDecl]] = {}
+        # id(FunctionDef node) -> class name, for methods
+        self.class_of_node: Dict[int, str] = {}
+        self.facts: Dict[int, _FnFacts] = {}
+        self._events: Dict[int, List[tuple]] = {}
+
+    # ==================================================================
+    def _all_fns(self) -> List[FunctionInfo]:
+        # NOT m.functions.values(): method qualnames carry no class
+        # prefix, so same-named methods of two classes collide there;
+        # by_basename keeps every FunctionInfo
+        out: List[FunctionInfo] = []
+        seen: Set[int] = set()
+        for m in self.pkg.modules:
+            for lst in m.by_basename.values():
+                for f in lst:
+                    if id(f) not in seen:
+                        seen.add(id(f))
+                        out.append(f)
+        return out
+
+    def run(self) -> None:
+        for m in self.pkg.modules:
+            self._discover(m)
+        for fn in self._all_fns():
+            self._events[id(fn)] = self._trace(fn)
+        self._fixpoint()
+        self._report()
+        self._find_cycles()
+
+    # -- discovery ------------------------------------------------------
+    def _ctor_kind(self, call: ast.AST) -> Optional[str]:
+        if not isinstance(call, ast.Call):
+            return None
+        return LOCK_CTORS.get(_basename(call_name(call)))
+
+    def _discover(self, m: ModuleInfo) -> None:
+        mod_base = os.path.splitext(os.path.basename(m.path))[0]
+        mlocks: Dict[str, LockDecl] = {}
+        for node in m.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                kind = self._ctor_kind(node.value)
+                if kind:
+                    d = LockDecl(f"{mod_base}.{node.targets[0].id}", kind,
+                                 m.path, node.lineno)
+                    mlocks[node.targets[0].id] = d
+                    self.res.locks[d.key] = d
+        self.module_locks[id(m)] = mlocks
+
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cname = node.name
+            for meth in node.body:
+                if isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    self.class_of_node[id(meth)] = cname
+                    for sub in ast.walk(meth):
+                        d = self._self_lock_assign(sub, cname, m)
+                        if d is not None:
+                            self.class_locks.setdefault(
+                                cname, {})[d.key.split(".", 1)[1]] = d
+                            self.attr_locks.setdefault(
+                                d.key.split(".", 1)[1], []).append(d)
+                            self.res.locks[d.key] = d
+
+    def _self_lock_assign(self, node: ast.AST, cname: str,
+                          m: ModuleInfo) -> Optional[LockDecl]:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            return None
+        t = node.targets[0]
+        if not (isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"):
+            return None
+        kind = self._ctor_kind(node.value)
+        if kind is None:
+            return None
+        return LockDecl(f"{cname}.{t.attr}", kind, m.path, node.lineno)
+
+    def _class_of(self, fn: FunctionInfo) -> Optional[str]:
+        f: Optional[FunctionInfo] = fn
+        while f is not None:
+            c = self.class_of_node.get(id(f.node))
+            if c is not None:
+                return c
+            f = f.parent
+        return None
+
+    # -- lock-expression resolution ------------------------------------
+    def _resolve_lock(self, fn: FunctionInfo, expr: ast.AST
+                      ) -> Optional[LockDecl]:
+        """LockDecl for an expression naming a lock object, else None."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            base, attr = expr.value.id, expr.attr
+            if base == "self":
+                cls = self._class_of(fn)
+                if cls and attr in self.class_locks.get(cls, {}):
+                    return self.class_locks[cls][attr]
+                cands = self.attr_locks.get(attr, [])
+                if len(cands) == 1:
+                    return cands[0]
+                return None
+            # module-alias reference: `mod.LOCK`
+            if base in fn.module.imports:
+                mod_name, symbol = fn.module.imports[base]
+                if symbol is None:
+                    target = self.pkg.by_dotted.get(mod_name)
+                    if target is not None:
+                        return self.module_locks.get(
+                            id(target), {}).get(attr)
+            return None
+        if isinstance(expr, ast.Name):
+            d = self.module_locks.get(id(fn.module), {}).get(expr.id)
+            if d is not None:
+                return d
+            if expr.id in fn.module.imports:
+                mod_name, symbol = fn.module.imports[expr.id]
+                if symbol is not None:
+                    target = self.pkg.by_dotted.get(mod_name)
+                    if target is not None:
+                        return self.module_locks.get(
+                            id(target), {}).get(symbol)
+        return None
+
+    def _acquisition_of(self, fn: FunctionInfo, expr: ast.AST
+                        ) -> Optional[Tuple[LockDecl, str]]:
+        """(decl, side) when ``expr`` acquires a lock as a context
+        manager: ``lock``, ``rw.read()``, ``rw.write()``."""
+        d = self._resolve_lock(fn, expr)
+        if d is not None:
+            return d, "excl"
+        if isinstance(expr, ast.Call) and isinstance(expr.func,
+                                                     ast.Attribute) \
+                and expr.func.attr in ("read", "write"):
+            d = self._resolve_lock(fn, expr.func.value)
+            if d is not None and d.kind == "rwlock":
+                return d, expr.func.attr
+        return None
+
+    # -- per-function ordered event trace ------------------------------
+    # events:  ("acquire", decl_key, side, line, held_snapshot)
+    #          ("call",    call_node, line, held_snapshot, callees)
+    #          ("block",   category, label, line, held_snapshot)
+    #          ("cvwait",  recv_desc, line, in_while)
+    # held_snapshot: tuple of Held (shared objects; snapshot of the list)
+    def _trace(self, fn: FunctionInfo) -> List[tuple]:
+        events: List[tuple] = []
+        held: List[Held] = []
+        cls = self._class_of(fn)
+        for dec in fn.node.decorator_list:
+            side = LOCKED_DECORATORS.get(_basename(dotted_name(dec)))
+            if side:
+                key = f"{cls or '?'}._api_lock"
+                held.append(Held(key, side, fn.node.lineno,
+                                 via_decorator=True))
+        self._walk_body(fn, list(fn.node.body), held, events, in_while=0)
+        return events
+
+    def _walk_body(self, fn: FunctionInfo, stmts: List[ast.stmt],
+                   held: List[Held], events: List[tuple],
+                   in_while: int) -> None:
+        for st in stmts:
+            self._walk_stmt(fn, st, held, events, in_while)
+
+    def _walk_stmt(self, fn: FunctionInfo, st: ast.stmt, held: List[Held],
+                   events: List[tuple], in_while: int) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return                              # analyzed separately
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            pushed = 0
+            for item in st.items:
+                acq = self._acquisition_of(fn, item.context_expr)
+                if acq is not None:
+                    d, side = acq
+                    self._note_acquire(fn, d, side, item.context_expr,
+                                       held, events)
+                    held.append(Held(d.key, side,
+                                     item.context_expr.lineno))
+                    pushed += 1
+                else:
+                    self._scan_expr(fn, item.context_expr, held, events,
+                                    in_while)
+            self._walk_body(fn, st.body, held, events, in_while)
+            for _ in range(pushed):
+                held.pop()
+            return
+        if isinstance(st, ast.While):
+            self._scan_expr(fn, st.test, held, events, in_while)
+            self._walk_body(fn, st.body, held, events, in_while + 1)
+            self._walk_body(fn, st.orelse, held, events, in_while)
+            return
+        if isinstance(st, ast.For):
+            self._scan_expr(fn, st.iter, held, events, in_while)
+            self._walk_body(fn, st.body, held, events, in_while)
+            self._walk_body(fn, st.orelse, held, events, in_while)
+            return
+        if isinstance(st, ast.If):
+            self._scan_expr(fn, st.test, held, events, in_while)
+            self._walk_body(fn, st.body, held, events, in_while)
+            self._walk_body(fn, st.orelse, held, events, in_while)
+            return
+        if isinstance(st, ast.Try):
+            self._walk_body(fn, st.body, held, events, in_while)
+            for h in st.handlers:
+                self._walk_body(fn, h.body, held, events, in_while)
+            self._walk_body(fn, st.orelse, held, events, in_while)
+            self._walk_body(fn, st.finalbody, held, events, in_while)
+            return
+        # generic statement: scan contained expressions in source order
+        for node in ast.iter_child_nodes(st):
+            self._scan_expr(fn, node, held, events, in_while)
+
+    def _scan_expr(self, fn: FunctionInfo, node: ast.AST,
+                   held: List[Held], events: List[tuple],
+                   in_while: int) -> None:
+        """Walk an expression tree, evaluation-ish order, handling calls."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return
+        for child in ast.iter_child_nodes(node):
+            self._scan_expr(fn, child, held, events, in_while)
+        if isinstance(node, ast.Call):
+            self._handle_call(fn, node, held, events, in_while)
+
+    def _note_acquire(self, fn: FunctionInfo, d: LockDecl, side: str,
+                      site: ast.AST, held: List[Held],
+                      events: List[tuple]) -> None:
+        events.append(("acquire", d.key, side, site.lineno, tuple(held)))
+
+    def _handle_call(self, fn: FunctionInfo, node: ast.Call,
+                     held: List[Held], events: List[tuple],
+                     in_while: int) -> None:
+        cname = call_name(node)
+        snapshot = tuple(held)
+
+        # ---- lock protocol on a resolved lock object ----
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            recv = node.func.value
+            if attr in _LOCK_PROTOCOL_ATTRS:
+                d = self._resolve_lock(fn, recv)
+                if d is not None:
+                    if attr in ("acquire", "acquire_read",
+                                "acquire_write"):
+                        side = {"acquire": "excl", "acquire_read": "read",
+                                "acquire_write": "write"}[attr]
+                        self._note_acquire(fn, d, side, node, held,
+                                           events)
+                        held.append(Held(d.key, side, node.lineno))
+                        return
+                    if attr in ("release", "release_read",
+                                "release_write"):
+                        for i in range(len(held) - 1, -1, -1):
+                            if held[i].key == d.key:
+                                del held[i]
+                                break
+                        return
+                    if attr in ("wait", "wait_for"):
+                        held_same = any(h.key == d.key for h in held)
+                        if attr == "wait" and d.kind == "condition" \
+                                and not in_while:
+                            events.append(("cvwait", d.key, node.lineno,
+                                           False))
+                        if held_same:
+                            return      # the cv pattern itself — exempt
+                        if attr == "wait" and \
+                                not _timeout_is_set(node, True):
+                            events.append(("block", "blocking",
+                                           f"{d.key}.wait()",
+                                           node.lineno, snapshot))
+                        return
+                    return              # notify/locked/read()/write()
+            # ---- blocking method calls on arbitrary receivers ----
+            desc = dotted_name(node.func)
+            if attr == "join" and not _timeout_is_set(node, True):
+                events.append(("block", "blocking", f"{desc or attr}()",
+                               node.lineno, snapshot))
+                return
+            if attr == "result" and not _timeout_is_set(node, True):
+                events.append(("block", "blocking", f"{desc or attr}()",
+                               node.lineno, snapshot))
+                return
+            if attr == "wait" and not _timeout_is_set(node, True):
+                events.append(("block", "blocking", f"{desc or attr}()",
+                               node.lineno, snapshot))
+                return
+            if attr == "get" and not node.args \
+                    and not _timeout_is_set(node, False):
+                # zero-arg q.get() with no timeout blocks forever;
+                # dict-style .get(key[, default]) always has positionals
+                if not any(kw.arg == "block" and
+                           isinstance(kw.value, ast.Constant) and
+                           kw.value.value is False
+                           for kw in node.keywords):
+                    events.append(("block", "blocking",
+                                   f"{desc or attr}()", node.lineno,
+                                   snapshot))
+                    return
+            if attr == "block_until_ready":
+                events.append(("block", "d2h", f"{desc or attr}()",
+                               node.lineno, snapshot))
+                return
+
+        if cname in _SLEEP_NAMES and self._is_time_sleep(fn, cname):
+            events.append(("block", "blocking", "time.sleep",
+                           node.lineno, snapshot))
+            return
+        if cname in _FSYNC_NAMES:
+            events.append(("block", "blocking", "os.fsync", node.lineno,
+                           snapshot))
+            return
+        if cname in _D2H_NAMES:
+            events.append(("block", "d2h", cname, node.lineno, snapshot))
+            return
+        if cname in JIT_NAMES:
+            events.append(("block", "dispatch", f"{cname}(...)",
+                           node.lineno, snapshot))
+            return
+
+        # ---- package-internal call edge ----
+        callees = self._callees_of(fn, node, cname)
+        jitted = [c for c in callees if c.jit_decorated]
+        if jitted:
+            events.append(("block", "dispatch",
+                           f"{jitted[0].qualname}()", node.lineno,
+                           snapshot))
+        if callees:
+            events.append(("call", node, node.lineno, snapshot, callees))
+
+    def _is_time_sleep(self, fn: FunctionInfo, cname: str) -> bool:
+        if cname == "time.sleep":
+            return True
+        imp = fn.module.imports.get("sleep")
+        return bool(imp and imp[0] == "time")
+
+    def _callees_of(self, fn: FunctionInfo, node: ast.Call,
+                    cname: Optional[str]) -> List[FunctionInfo]:
+        out: List[FunctionInfo] = []
+        if cname:
+            if "." not in cname:
+                out.extend(self.pkg._callees(fn.module, cname))
+            else:
+                head, _, rest = cname.partition(".")
+                if "." not in rest:
+                    out.extend(self.pkg._resolve_attr(fn.module, head,
+                                                      rest))
+                    if not out and rest not in _ATTR_RESOLVE_STOPLIST:
+                        # method-style call: resolve by basename across
+                        # the package (R008-style), methods only
+                        cands = [f for m in self.pkg.modules
+                                 for f in m.by_basename.get(rest, ())
+                                 if id(f.node) in self.class_of_node]
+                        if len(cands) <= 4:
+                            out.extend(cands)
+        # functions passed by reference: run_with_deadline(_commit, ...)
+        for a in list(node.args) + [k.value for k in node.keywords]:
+            if isinstance(a, ast.Name):
+                out.extend(f for f in
+                           self.pkg._callees(fn.module, a.id))
+        seen: Set[int] = set()
+        uniq = []
+        for f in out:
+            if id(f) not in seen and f.node is not fn.node:
+                seen.add(id(f))
+                uniq.append(f)
+        return uniq
+
+    # -- interprocedural fixpoint --------------------------------------
+    def _chain_site(self, fn: FunctionInfo, line: int) -> str:
+        return f"{fn.qualname} ({fn.module.path}:{line})"
+
+    def _fixpoint(self) -> None:
+        all_fns = self._all_fns()
+        for f in all_fns:
+            self.facts[id(f)] = _FnFacts()
+        # seed with direct facts
+        for f in all_fns:
+            facts = self.facts[id(f)]
+            for ev in self._events[id(f)]:
+                if ev[0] == "acquire":
+                    _, key, side, line, _held = ev
+                    facts.acquires.setdefault(
+                        (key, side), [self._chain_site(f, line)])
+                elif ev[0] == "block":
+                    _, cat, label, line, _held = ev
+                    facts.blocking.setdefault(
+                        (cat, label), [self._chain_site(f, line)])
+            # decorator-granted acquisition is a fact too (drives the
+            # read->write upgrade check across calls)
+            for dec in f.node.decorator_list:
+                side = LOCKED_DECORATORS.get(_basename(dotted_name(dec)))
+                if side:
+                    key = f"{self._class_of(f) or '?'}._api_lock"
+                    facts.acquires.setdefault(
+                        (key, side),
+                        [self._chain_site(f, f.node.lineno)])
+        for _ in range(_FIXPOINT_ITERS):
+            changed = False
+            for f in all_fns:
+                facts = self.facts[id(f)]
+                for ev in self._events[id(f)]:
+                    if ev[0] != "call":
+                        continue
+                    _, _node, line, _held, callees = ev
+                    site = self._chain_site(f, line)
+                    for callee in callees:
+                        sub = self.facts[id(callee)]
+                        for fact_key, chain in sub.acquires.items():
+                            if fact_key not in facts.acquires and \
+                                    len(chain) < _MAX_CHAIN:
+                                facts.acquires[fact_key] = \
+                                    [site] + chain
+                                changed = True
+                        for fact_key, chain in sub.blocking.items():
+                            if fact_key not in facts.blocking and \
+                                    len(chain) < _MAX_CHAIN:
+                                facts.blocking[fact_key] = \
+                                    [site] + chain
+                                changed = True
+            if not changed:
+                break
+
+    # -- reporting ------------------------------------------------------
+    def _report(self) -> None:
+        for fn in self._all_fns():
+            self._report_fn(fn)
+        self.res.findings.sort(key=lambda f: (f.path, f.line, f.message))
+
+    def _find(self, fn: FunctionInfo, line: int, message: str) -> None:
+        self.res.findings.append(Finding(
+            "R011", fn.module.path, line, fn.qualname, message))
+
+    def _edge(self, fn: FunctionInfo, h: Held, key: str,
+              chain: List[str]) -> None:
+        if (h.key, key) not in self.res.edges:
+            self.res.edges[(h.key, key)] = Edge(h.key, key, fn,
+                                                h.line, chain)
+
+    def _check_acquire(self, fn: FunctionInfo, h: Held, key: str,
+                       side: str, line: int, chain: List[str]) -> None:
+        if h.key == key:
+            if h.side == "read" and side == "write":
+                self._find(fn, line,
+                           f"read->write upgrade on {key}: read side "
+                           f"held at line {h.line}, write acquired via "
+                           f"{' -> '.join(chain)} (RWLock raises at "
+                           "runtime)")
+            return                      # re-entrant same-lock: fine
+        self._edge(fn, h, key, chain)
+
+    def _check_block(self, fn: FunctionInfo, h: Held, cat: str,
+                     label: str, line: int, chain: List[str]) -> None:
+        if h.via_decorator and cat in ("d2h", "dispatch"):
+            return      # the coarse API lock spans device work by design
+        what = {"blocking": "blocking call",
+                "d2h": "device transfer",
+                "dispatch": "jitted dispatch"}[cat]
+        self._find(fn, line,
+                   f"{what} under lock: {label} reached while holding "
+                   f"{h.key} ({h.side} side, line {h.line}) via "
+                   f"{' -> '.join(chain)}")
+
+    def _report_fn(self, fn: FunctionInfo) -> None:
+        for ev in self._events[id(fn)]:
+            if ev[0] == "acquire":
+                _, key, side, line, heldsnap = ev
+                chain = [self._chain_site(fn, line)]
+                for h in heldsnap:
+                    self._check_acquire(fn, h, key, side, line, chain)
+            elif ev[0] == "block":
+                _, cat, label, line, heldsnap = ev
+                chain = [self._chain_site(fn, line)]
+                for h in heldsnap:
+                    self._check_block(fn, h, cat, label, line, chain)
+            elif ev[0] == "cvwait":
+                _, key, line, _ = ev
+                self._find(fn, line,
+                           f"condition wait outside a predicate loop: "
+                           f"{key}.wait() must sit in a `while "
+                           "not <predicate>` loop (spurious wakeups, "
+                           "missed-signal races)")
+            elif ev[0] == "call":
+                _, _node, line, heldsnap, callees = ev
+                if not heldsnap:
+                    continue
+                site = self._chain_site(fn, line)
+                for callee in callees:
+                    sub = self.facts.get(id(callee))
+                    if sub is None:
+                        continue
+                    for (key, side), chain in sub.acquires.items():
+                        for h in heldsnap:
+                            self._check_acquire(fn, h, key, side, line,
+                                                [site] + chain)
+                    for (cat, label), chain in sub.blocking.items():
+                        for h in heldsnap:
+                            self._check_block(fn, h, cat, label, line,
+                                              [site] + chain)
+
+    # -- cycles ---------------------------------------------------------
+    def _find_cycles(self) -> None:
+        adj: Dict[str, List[str]] = {}
+        for (src, dst) in self.res.edges:
+            adj.setdefault(src, []).append(dst)
+        seen_cycles: Set[Tuple[str, ...]] = set()
+
+        def dfs(start: str) -> None:
+            stack: List[Tuple[str, List[str]]] = [(start, [start])]
+            while stack:
+                node, path = stack.pop()
+                for nxt in adj.get(node, ()):
+                    if nxt == start and len(path) > 1:
+                        cyc = path[:]
+                        i = cyc.index(min(cyc))
+                        canon = tuple(cyc[i:] + cyc[:i])
+                        if canon not in seen_cycles:
+                            seen_cycles.add(canon)
+                            self._report_cycle(list(canon))
+                    elif nxt not in path and len(path) < 8:
+                        stack.append((nxt, path + [nxt]))
+
+        for n in sorted(adj):
+            dfs(n)
+
+    def _report_cycle(self, cyc: List[str]) -> None:
+        self.res.cycles.append(cyc)
+        parts = []
+        first_edge: Optional[Edge] = None
+        for i, src in enumerate(cyc):
+            dst = cyc[(i + 1) % len(cyc)]
+            e = self.res.edges[(src, dst)]
+            if first_edge is None:
+                first_edge = e
+            parts.append(f"{src} -> {dst} (held at "
+                         f"{e.fn.module.path}:{e.held_line} in "
+                         f"{e.fn.qualname}, acquired via "
+                         f"{' -> '.join(e.chain)})")
+        assert first_edge is not None
+        self.res.findings.append(Finding(
+            "R011", first_edge.fn.module.path, first_edge.held_line,
+            first_edge.fn.qualname,
+            "lock-order cycle (potential deadlock): "
+            + "; ".join(parts)))
+
+
+# ======================================================================
+def analyze_package(package: PackageInfo) -> LockAnalysis:
+    """Run (or fetch the cached) whole-package lock analysis."""
+    cached = getattr(package, "_r011_analysis", None)
+    if cached is None:
+        cached = LockAnalysis(package)
+        package._r011_analysis = cached
+    return cached
+
+
+def analyze_paths(paths: Sequence[str]
+                  ) -> Tuple[LockAnalysis, List[str]]:
+    from . import tpulint as _tl
+    modules: List[ModuleInfo] = []
+    errors: List[str] = []
+    for path in _tl._iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+            modules.append(ModuleInfo(path, source, _tl._dotted_of(path)))
+        except (SyntaxError, OSError, UnicodeDecodeError) as err:
+            errors.append(f"{path}: {err}")
+    return analyze_package(PackageInfo(modules)), errors
+
+
+def _default_package_path() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    from . import tpulint as _tl
+
+    ap = argparse.ArgumentParser(
+        prog="tpulint locks",
+        description="interprocedural lock-order & blocking-call "
+                    "analyzer (R011)")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: the package)")
+    ap.add_argument("--dot", action="store_true",
+                    help="emit the lock-order graph as Graphviz")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--allowlist", default=_tl.DEFAULT_ALLOWLIST)
+    ap.add_argument("--no-allowlist", action="store_true")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or [_default_package_path()]
+    analysis, errors = analyze_paths(paths)
+    findings = list(analysis.findings)
+
+    entries: List[_tl.AllowEntry] = []
+    allow_errors: List[str] = []
+    if not args.no_allowlist:
+        entries, allow_errors = _tl.load_allowlist(args.allowlist)
+        entries = [e for e in entries if e.rule == "R011"]
+        findings = _tl.apply_allowlist(findings, entries)
+
+    if args.dot:
+        print(analysis.to_dot())
+    elif args.as_json:
+        import json
+        print(json.dumps([f.to_json() for f in findings], indent=1))
+    else:
+        for line in analysis.order_graph_lines():
+            print(line)
+        print(f"cycles: {len(analysis.cycles)}")
+        for f in findings:
+            print(f.render())
+        print(f"tpulint locks: {len(findings)} finding(s)",
+              file=sys.stderr)
+    for err in errors + allow_errors:
+        print(f"tpulint locks: error: {err}", file=sys.stderr)
+
+    if errors or allow_errors:
+        return 2
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

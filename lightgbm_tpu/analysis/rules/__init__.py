@@ -11,14 +11,15 @@ from .r007_api_race import ApiRaceRule
 from .r008_serving import ServingContractRule
 from .r009_timing import TimingRule
 from .r010_divergence import CollectiveDivergenceRule
+from .r011_locks import LockOrderRule
 
 ALL_RULES = (HostSyncRule, RecompileRule, DtypeDriftRule,
              PallasContractRule, CollectiveAccountingRule,
              AxisNameRule, ApiRaceRule, ServingContractRule, TimingRule,
-             CollectiveDivergenceRule)
+             CollectiveDivergenceRule, LockOrderRule)
 
 __all__ = ["Finding", "ModuleInfo", "PackageInfo", "Rule", "ALL_RULES",
            "HostSyncRule", "RecompileRule", "DtypeDriftRule",
            "PallasContractRule", "CollectiveAccountingRule",
            "AxisNameRule", "ApiRaceRule", "ServingContractRule",
-           "TimingRule", "CollectiveDivergenceRule"]
+           "TimingRule", "CollectiveDivergenceRule", "LockOrderRule"]

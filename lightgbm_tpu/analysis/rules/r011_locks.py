@@ -1,0 +1,26 @@
+"""R011 — concurrency flight check (lock order & blocking under locks).
+
+Thin rule adapter over :mod:`lightgbm_tpu.analysis.locks`: the whole
+package is analyzed once (cached on the ``PackageInfo``, like the R008
+serving closure), then each module's ``check`` returns the slice of
+findings anchored in that module. See locks.py for the model: discovered
+locks, held-set traversal, interprocedural acquisition/blocking facts
+with witness chains, and the four finding classes (order cycles,
+blocking-under-lock, read->write upgrades, cv-wait-outside-loop).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ..locks import analyze_package
+from .base import Finding, ModuleInfo, PackageInfo, Rule
+
+
+class LockOrderRule(Rule):
+    code = "R011"
+    title = "lock-order & blocking-call concurrency flight check"
+
+    def check(self, module: ModuleInfo, package: PackageInfo
+              ) -> List[Finding]:
+        analysis = analyze_package(package)
+        return [f for f in analysis.findings if f.path == module.path]

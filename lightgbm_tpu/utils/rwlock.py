@@ -30,6 +30,8 @@ No jax import here: the lock is plain threading and loads anywhere.
 from __future__ import annotations
 
 import functools
+import os
+import sys
 import threading
 from typing import Optional
 
@@ -44,6 +46,37 @@ def set_sanitizer(san) -> None:
 
 def get_sanitizer():
     return _sanitizer
+
+
+#: armed by guards.lock_witness(); must expose note_acquire(obj, name,
+#: side) / note_release(obj) — called AFTER acquiring / BEFORE releasing
+#: on outer (depth 0 <-> 1) transitions only, so re-entrant nesting never
+#: shows up as a self-order
+_witness = None
+
+
+def set_witness(w) -> None:
+    global _witness
+    _witness = w
+
+
+def get_witness():
+    return _witness
+
+
+def _creation_site() -> str:
+    """``file.py:line`` of the caller that constructed the lock, used as
+    the lock's name in the witness order graph (skips this module)."""
+    try:
+        f = sys._getframe(1)
+    except ValueError:              # pragma: no cover - shallow stack
+        return "<unknown>"
+    own = __file__
+    while f is not None and f.f_code.co_filename == own:
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
 
 
 class RWLock:
@@ -61,6 +94,7 @@ class RWLock:
         self._writer_depth = 0
         self._waiting_writers = 0
         self._local = threading.local()       # per-thread read depth
+        self._name = f"RWLock@{_creation_site()}"
 
     def __deepcopy__(self, memo):
         return type(self)()
@@ -78,19 +112,26 @@ class RWLock:
     # -- read side ----------------------------------------------------------
     def acquire_read(self) -> None:
         me = threading.get_ident()
+        outer = False
         with self._cond:
             if self._writer == me or self._read_depth() > 0:
                 # nested read under our own write or read: free (already
                 # counted in _readers when the outer read registered)
                 self._set_read_depth(self._read_depth() + 1)
-                return
-            while self._writer is not None or self._waiting_writers:
-                self._cond.wait()
-            self._readers += 1
-            self._set_read_depth(1)
+            else:
+                while self._writer is not None or self._waiting_writers:
+                    self._cond.wait()
+                self._readers += 1
+                self._set_read_depth(1)
+                outer = True
+        # witness note happens OUTSIDE the internal cond so the order
+        # graph never sees <internal cond> -> <this lock>
+        if outer and _witness is not None:
+            _witness.note_acquire(self, self._name, "read")
 
     def release_read(self) -> None:
         me = threading.get_ident()
+        outer = False
         with self._cond:
             depth = self._read_depth()
             if depth <= 0:
@@ -102,28 +143,37 @@ class RWLock:
                 self._readers -= 1
                 if self._readers == 0:
                     self._cond.notify_all()
+                outer = True
+        if outer and _witness is not None:
+            _witness.note_release(self)
 
     # -- write side ---------------------------------------------------------
     def acquire_write(self) -> None:
         me = threading.get_ident()
+        outer = False
         with self._cond:
             if self._writer == me:
                 self._writer_depth += 1
-                return
-            if self._read_depth() > 0:
-                raise RuntimeError(
-                    "read->write lock upgrade: a public read-locked method "
-                    "called a write-locked one; make the caller write_locked")
-            self._waiting_writers += 1
-            try:
-                while self._writer is not None or self._readers:
-                    self._cond.wait()
-            finally:
-                self._waiting_writers -= 1
-            self._writer = me
-            self._writer_depth = 1
+            else:
+                if self._read_depth() > 0:
+                    raise RuntimeError(
+                        "read->write lock upgrade: a public read-locked "
+                        "method called a write-locked one; make the "
+                        "caller write_locked")
+                self._waiting_writers += 1
+                try:
+                    while self._writer is not None or self._readers:
+                        self._cond.wait()
+                finally:
+                    self._waiting_writers -= 1
+                self._writer = me
+                self._writer_depth = 1
+                outer = True
+        if outer and _witness is not None:
+            _witness.note_acquire(self, self._name, "write")
 
     def release_write(self) -> None:
+        outer = False
         with self._cond:
             if self._writer != threading.get_ident():
                 raise RuntimeError("release_write by a non-holder")
@@ -139,6 +189,9 @@ class RWLock:
             if self._writer_depth == 0:
                 self._writer = None
                 self._cond.notify_all()
+                outer = True
+        if outer and _witness is not None:
+            _witness.note_release(self)
 
     # -- context-manager views ---------------------------------------------
     def read(self) -> "_Side":
@@ -181,12 +234,22 @@ class Mutex:
 
     def __init__(self):
         self._lock = threading.RLock()
+        self._local = threading.local()       # per-thread hold depth
+        self._name = f"Mutex@{_creation_site()}"
 
     def __enter__(self):
         self._lock.acquire()
+        depth = getattr(self._local, "depth", 0)
+        self._local.depth = depth + 1
+        if depth == 0 and _witness is not None:
+            _witness.note_acquire(self, self._name, "excl")
         return self
 
     def __exit__(self, *exc):
+        depth = getattr(self._local, "depth", 1)
+        self._local.depth = depth - 1
+        if depth == 1 and _witness is not None:
+            _witness.note_release(self)
         self._lock.release()
         return False
 

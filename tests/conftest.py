@@ -59,6 +59,19 @@ def compile_guard():
 
 
 @pytest.fixture
+def lock_order_witness():
+    """Instrument every lock created inside the test with the runtime
+    lock-order witness (lightgbm_tpu.analysis.guards.lock_witness); at
+    teardown the test fails if any cross-thread lock-order cycle was
+    observed. Arm it by listing the fixture BEFORE constructing servers
+    or boosters so their locks are created instrumented."""
+    from lightgbm_tpu.analysis import guards
+    with guards.lock_witness() as w:
+        yield w
+    w.assert_no_cycles("lock_order_witness fixture")
+
+
+@pytest.fixture
 def no_d2h_guard():
     """Fail the test on any device->host materialization
     (lightgbm_tpu.analysis.guards.no_host_transfers)."""

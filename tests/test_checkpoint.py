@@ -455,10 +455,14 @@ class TestWatchdog:
         assert calls["n"] == 1
 
     def test_injected_step_hang_interrupts_with_final_snapshot(
-            self, tmp_path):
+            self, tmp_path, lock_order_witness):
         """The acceptance path: a hang injected into the distributed step
         surfaces as TrainingInterrupted AND a final snapshot lands, so
-        resume continues to the bit-identical model."""
+        resume continues to the bit-identical model.
+
+        Runs under the lock-order witness: the snapshot path (read lock +
+        fsync) interleaving with the deadline watchdog must keep the
+        observed acquisition graph acyclic."""
         # deadline must clear the compile-heavy early iterations (the
         # watchdog measures wall clock, compiles included) while staying
         # far below the injected 120s hang

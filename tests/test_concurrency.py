@@ -240,45 +240,52 @@ def test_coalescer_hotswap_mixed_sizes_under_sanitizer():
     bst1.warm_predict_ladder()
     bst2.warm_predict_ladder()
 
-    srv = bst1.serve(tick_ms=1.0, queue_max=4096, deadline_ms=5000.0)
-    results, errors = [], []
-    started = threading.Barrier(N_THREADS + 1)
+    # the lock-order witness wraps server CONSTRUCTION too, so the
+    # coalescer cv / registry locks are created instrumented (R011's
+    # runtime half: any cross-thread order inversion fails with stacks)
+    with guards.lock_witness() as lw:
+        srv = bst1.serve(tick_ms=1.0, queue_max=4096, deadline_ms=5000.0)
+        results, errors = [], []
+        started = threading.Barrier(N_THREADS + 1)
 
-    def client(i):
+        def client(i):
+            try:
+                started.wait()
+                for j in range(6):
+                    s = sizes[(i + j) % len(sizes)]
+                    fut = srv.submit(Xq[:s])
+                    results.append((s, fut.result(), fut.version))
+            except Exception as err:  # pragma: no cover - failure path
+                errors.append(err)
+
         try:
-            started.wait()
-            for j in range(6):
-                s = sizes[(i + j) % len(sizes)]
-                fut = srv.submit(Xq[:s])
-                results.append((s, fut.result(), fut.version))
-        except Exception as err:  # pragma: no cover - the failure path
-            errors.append(err)
-
-    try:
-        with guards.api_race_sanitizer() as san, \
-                guards.compile_counter() as cc:
-            threads = [threading.Thread(target=client, args=(i,))
-                       for i in range(N_THREADS)]
-            for t in threads:
-                t.start()
-            started.wait()
-            srv.deploy("v2", bst2)           # hot-swap lands mid-stream
-            for t in threads:
-                t.join()
-        assert not errors, errors[:3]
-        assert len(results) == N_THREADS * 6
-        versions = {v for _, _, v in results}
-        assert versions and versions <= {"v0", "v2"}
-        for s, out, v in results:
-            ref = ref1 if v == "v0" else ref2
-            assert np.array_equal(out, ref[s]), \
-                f"size-{s} response is not version {v}'s prediction — " \
-                "a mixed-model or torn response"
-        san.assert_no_races("16-thread coalesced serving + hot-swap")
-        cc.assert_no_compiles("serving steady state across a hot-swap")
-        assert srv.stats["ticks"] < len(results)   # batching happened
-    finally:
-        srv.close(drain=False, timeout_s=5.0)
+            with guards.api_race_sanitizer() as san, \
+                    guards.compile_counter() as cc:
+                threads = [threading.Thread(target=client, args=(i,))
+                           for i in range(N_THREADS)]
+                for t in threads:
+                    t.start()
+                started.wait()
+                srv.deploy("v2", bst2)       # hot-swap lands mid-stream
+                for t in threads:
+                    t.join()
+            assert not errors, errors[:3]
+            assert len(results) == N_THREADS * 6
+            versions = {v for _, _, v in results}
+            assert versions and versions <= {"v0", "v2"}
+            for s, out, v in results:
+                ref = ref1 if v == "v0" else ref2
+                assert np.array_equal(out, ref[s]), \
+                    f"size-{s} response is not version {v}'s " \
+                    "prediction — a mixed-model or torn response"
+            san.assert_no_races("16-thread coalesced serving + hot-swap")
+            cc.assert_no_compiles(
+                "serving steady state across a hot-swap")
+            assert srv.stats["ticks"] < len(results)  # batching happened
+        finally:
+            srv.close(drain=False, timeout_s=5.0)
+    assert lw.acquires > 0
+    lw.assert_no_cycles("16-thread coalesced serving + hot-swap")
 
 
 # ------------------------------------------------------------- sanitizer

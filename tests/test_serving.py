@@ -271,7 +271,7 @@ def test_transient_request_fault_surfaces_at_submit(server, boosters):
 
 
 # ----------------------------------------------------- hot-swap / rollback
-def test_hot_swap_serves_exactly_one_version(boosters):
+def test_hot_swap_serves_exactly_one_version(boosters, lock_order_witness):
     b1, b2, X = boosters
     ref1, ref2 = b1.predict(X[:20]), b2.predict(X[:20])
     assert not np.array_equal(ref1, ref2)
@@ -309,7 +309,7 @@ def test_hot_swap_serves_exactly_one_version(boosters):
         srv.close(drain=False, timeout_s=5.0)
 
 
-def test_hang_mid_swap_rolls_back(boosters):
+def test_hang_mid_swap_rolls_back(boosters, lock_order_witness):
     """ISSUE 9 acceptance: a swap commit that hangs past its deadline is
     abandoned via the epoch token — SwapFailed, the old model stays
     active, and the abandoned commit can never land later."""

@@ -1609,3 +1609,208 @@ def test_r010_shipped_parallel_layer_needs_only_the_bootstrap_anchor():
     assert not errors
     r010 = [f for f in findings if f.rule == "R010"]
     assert [f.func for f in r010] == ["init_distributed"]
+
+
+# ---------------------------------------------------------------- R011
+def r011(findings):
+    return [f for f in findings if f.rule == "R011"]
+
+
+def test_r011_lock_order_cycle_flagged(tmp_path):
+    """Seed: two functions acquiring the same pair of module locks in
+    opposite orders — the classic AB/BA deadlock, reported once with
+    both witness chains."""
+    findings = lint_snippet(tmp_path, """
+        import threading
+
+        MU_A = threading.Lock()
+        MU_B = threading.Lock()
+
+        def left():
+            with MU_A:
+                with MU_B:
+                    pass
+
+        def right():
+            with MU_B:
+                with MU_A:
+                    pass
+    """)
+    cyc = [f for f in r011(findings) if "lock-order cycle" in f.message]
+    assert len(cyc) == 1, [f.render() for f in findings]
+    assert "left" in cyc[0].message and "right" in cyc[0].message
+
+
+def test_r011_blocking_join_under_lock_flagged(tmp_path):
+    """Seed: an untimed thread join while holding a lock — any other
+    path into that lock now waits on the joined thread too."""
+    findings = lint_snippet(tmp_path, """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._thread = threading.Thread(target=print)
+
+            def stop(self):
+                with self._mu:
+                    self._thread.join()
+    """)
+    hits = [f for f in r011(findings)
+            if "blocking call under lock" in f.message
+            and "join" in f.message]
+    assert hits and hits[0].func == "stop"
+
+
+def test_r011_blocking_reached_through_helper_flagged(tmp_path):
+    """Interprocedural: the sleep sits two calls away from the lock —
+    the finding lands at the holder and carries the call chain."""
+    findings = lint_snippet(tmp_path, """
+        import threading
+        import time
+
+        MU = threading.Lock()
+
+        def backoff():
+            time.sleep(1.0)
+
+        def retry_step():
+            backoff()
+
+        def retry_under_lock():
+            with MU:
+                retry_step()
+    """)
+    hits = [f for f in r011(findings) if "time.sleep" in f.message]
+    assert hits and hits[0].func == "retry_under_lock"
+    assert "backoff" in hits[0].message and "retry_step" in hits[0].message
+
+
+def test_r011_dispatch_under_write_lock_flagged(tmp_path):
+    """Seed: jitted dispatch under an explicitly-taken write lock (the
+    'hold the registry write lock across a device compile' class)."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+        from lightgbm_tpu.utils.rwlock import RWLock
+
+        @jax.jit
+        def kernel(x):
+            return x * 2
+
+        class Holder:
+            def __init__(self):
+                self._lock = RWLock()
+
+            def swap(self, x):
+                with self._lock.write():
+                    return kernel(x)
+    """)
+    hits = [f for f in r011(findings)
+            if "jitted dispatch under lock" in f.message]
+    assert hits and hits[0].func == "swap"
+
+
+def test_r011_read_write_upgrade_flagged(tmp_path):
+    """Seed: a read-locked public method calling a write-locked one —
+    RWLock raises at runtime; R011 finds the path statically."""
+    findings = lint_snippet(tmp_path, """
+        from lightgbm_tpu.utils.rwlock import RWLock, read_locked, \\
+            write_locked
+
+        class Store:
+            def __init__(self):
+                self._api_lock = RWLock()
+                self.v = None
+
+            @write_locked
+            def commit(self, v):
+                self.v = v
+
+            @read_locked
+            def peek(self):
+                self.commit(None)
+                return self.v
+    """)
+    hits = [f for f in r011(findings)
+            if "read->write upgrade" in f.message]
+    assert hits and hits[0].func == "peek"
+    assert "commit" in hits[0].message
+
+
+def test_r011_cv_wait_outside_loop_flagged(tmp_path):
+    """Seed: Condition.wait under `if` instead of a predicate `while`
+    loop — spurious wakeups and missed signals slip through."""
+    findings = lint_snippet(tmp_path, """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self.ready = False
+
+            def take(self):
+                with self._cv:
+                    if not self.ready:
+                        self._cv.wait(1.0)
+                    return self.ready
+    """)
+    hits = [f for f in r011(findings)
+            if "outside a predicate loop" in f.message]
+    assert hits and hits[0].func == "take"
+
+
+def test_r011_clean_patterns_not_flagged(tmp_path):
+    """Negative: while-looped timed cv wait, notify under the cv,
+    consistent AB ordering, and re-entrant same-lock nesting are all
+    the blessed patterns — zero findings."""
+    findings = lint_snippet(tmp_path, """
+        import threading
+
+        class Pipeline:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._mu = threading.Lock()
+                self.items = []
+
+            def produce(self, x):
+                with self._cv:
+                    self.items.append(x)
+                    self._cv.notify()
+
+            def consume(self):
+                with self._cv:
+                    while not self.items:
+                        self._cv.wait(0.1)
+                    return self.items.pop(0)
+
+        MU_A = threading.Lock()
+        MU_B = threading.Lock()
+
+        def first():
+            with MU_A:
+                with MU_B:
+                    pass
+
+        def second():
+            with MU_A:
+                with MU_B:
+                    pass
+    """)
+    assert not r011(findings), [f.render() for f in r011(findings)]
+
+
+def test_r011_anchors_used_and_not_stale():
+    """The new R011 anchors resolve against the shipped tree (the
+    staleness pass accepts them) and every one is exercised."""
+    entries, errs = load_allowlist(DEFAULT_ALLOWLIST)
+    assert not errs, errs
+    r011_entries = [e for e in entries if e.rule == "R011"]
+    assert len(r011_entries) >= 4
+    stale = check_allowlist_staleness(entries, [PKG_DIR],
+                                      DEFAULT_ALLOWLIST)
+    assert not stale, stale
+    findings, errors = lint_paths([PKG_DIR])
+    assert not errors
+    apply_allowlist(findings, entries)
+    unused = [e.render() for e in r011_entries if not e.used]
+    assert not unused, f"unused R011 anchors: {unused}"
