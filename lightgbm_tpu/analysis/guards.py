@@ -53,7 +53,8 @@ import dataclasses
 import os
 import sys
 import threading
-from typing import Dict, Iterator, List, Optional, Tuple
+import time
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 import jax
 from jax import monitoring
@@ -818,3 +819,148 @@ def lock_witness() -> Iterator[LockOrderWitness]:
         _rwlock.set_witness(prev_rw)
         threading.Lock = saved_lock
         threading.RLock = saved_rlock
+
+
+# ======================================================================
+# resource-leak witness — the runtime half of tpulint R012, exactly as
+# lock_witness is the runtime half of R011
+
+class ResourceLeakError(AssertionError):
+    """A guarded scope exited with live resources it did not enter with."""
+
+
+#: thread-name prefixes of deliberate process-lifetime holds (anchored
+#: in tpulint.allow on the static side): the shared device probe and the
+#: multihost deadline watchdog, which outlives its scope BY DESIGN when
+#: a deadline fires
+_WITNESS_THREAD_EXEMPT = ("lgbm-tpu-device-probe", "lgbm-tpu-watchdog")
+
+#: extra jit/program-cache size probes: callables returning an int; the
+#: witness sums them into the ``jit_cache`` delta (drift's accumulator
+#: factories register lazily below — register yours if you add a keyed
+#: program cache, and make it pass R012's bound check first)
+_witness_cache_probes: List[Callable[[], int]] = []
+
+
+def register_witness_cache_probe(probe: Callable[[], int]) -> None:
+    _witness_cache_probes.append(probe)
+
+
+def _witness_threads() -> Dict[int, str]:
+    return {t.ident: t.name for t in threading.enumerate()
+            if t.is_alive() and t.ident is not None
+            and not t.name.startswith(_WITNESS_THREAD_EXEMPT)}
+
+
+def _witness_fds() -> Optional[frozenset]:
+    try:
+        return frozenset(os.listdir("/proc/self/fd"))
+    except OSError:                 # pragma: no cover - non-procfs OS
+        return None
+
+
+def _witness_sessions() -> int:
+    spans = sys.modules.get("lightgbm_tpu.obs.spans")
+    return int(spans.active_sessions()) if spans is not None else 0
+
+
+def _witness_jit_cache() -> int:
+    total = 0
+    # only modules ALREADY imported are probed: the witness must never
+    # be the thing that pulls a subsystem (and its compiles) in
+    drift = sys.modules.get("lightgbm_tpu.obs.drift")
+    if drift is not None:
+        for name in ("_bin_accum_fn", "_score_accum_fn"):
+            fn = getattr(drift, name, None)
+            if fn is not None and hasattr(fn, "cache_info"):
+                total += int(fn.cache_info().currsize)
+    for probe in _witness_cache_probes:
+        try:
+            total += int(probe())
+        except Exception:           # noqa: BLE001 - probes must not kill
+            pass
+    return total
+
+
+class ResourceWitness:
+    """Snapshot of live resources at arm time; ``assert_no_leaks``
+    re-snapshots (polling, releases are asynchronous — a shutdown
+    serve_forever thread takes a poll interval to exit) and raises
+    ResourceLeakError naming every thread/fd/session/cache delta."""
+
+    def __init__(self):
+        self._base_threads = _witness_threads()
+        self._base_fds = _witness_fds()
+        self._base_sessions = _witness_sessions()
+        self._base_jit_cache = _witness_jit_cache()
+
+    def deltas(self) -> Dict[str, object]:
+        """Current growth over the baseline (leaked thread NAMES, new fd
+        count, session and cache-size deltas); empty dict == clean."""
+        out: Dict[str, object] = {}
+        threads = _witness_threads()
+        leaked = [name for ident, name in threads.items()
+                  if ident not in self._base_threads]
+        if leaked:
+            out["threads"] = sorted(leaked)
+        fds = _witness_fds()
+        if fds is not None and self._base_fds is not None:
+            grown = len(fds - self._base_fds) - \
+                len(self._base_fds - fds)
+            if grown > 0:
+                out["fds"] = grown
+        sessions = _witness_sessions() - self._base_sessions
+        if sessions > 0:
+            out["sessions"] = sessions
+        cache = _witness_jit_cache() - self._base_jit_cache
+        if cache > 0:
+            out["jit_cache"] = cache
+        return out
+
+    def assert_no_leaks(self, what: str = "guarded scope",
+                        settle_s: float = 5.0) -> None:
+        deadline = time.monotonic() + float(settle_s)
+        deltas = self.deltas()
+        while deltas and time.monotonic() < deadline:
+            time.sleep(0.05)
+            deltas = self.deltas()
+        if deltas:
+            parts = []
+            if "threads" in deltas:
+                parts.append("live threads not in the baseline: "
+                             + ", ".join(deltas["threads"]))
+            if "fds" in deltas:
+                parts.append(f"{deltas['fds']} more open fd(s)")
+            if "sessions" in deltas:
+                parts.append(f"{deltas['sessions']} still-entered trace "
+                             "session(s)")
+            if "jit_cache" in deltas:
+                parts.append(f"retained-program caches grew by "
+                             f"{deltas['jit_cache']} entries")
+            raise ResourceLeakError(
+                f"resource leak across {what}: " + "; ".join(parts)
+                + ". Every acquisition must release on ALL paths "
+                "(tpulint R012) — close/join/stop in a finally, or fix "
+                "the owner's close() to be release-complete.")
+
+
+@contextlib.contextmanager
+def resource_witness() -> Iterator[ResourceWitness]:
+    """Arm the resource-leak witness for the ``with`` block.
+
+    The dynamic complement of ``scripts/tpulint resources`` (R012):
+    snapshots live threads, open fds, entered trace sessions, and
+    retained-program cache sizes at entry; ``assert_no_leaks`` proves
+    the scope gave everything back. Warm caches and construct
+    long-lived fixtures BEFORE arming — the witness measures the scope,
+    not process history.
+
+    Usage::
+
+        with resource_witness() as w:
+            server = PredictionServer(bst)
+            ... kill/hang chaos ...
+            server.close()
+        w.assert_no_leaks("serving chaos drill")
+    """
+    yield ResourceWitness()

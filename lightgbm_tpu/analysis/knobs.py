@@ -1,0 +1,127 @@
+"""Dead-knob lint: config schema vs. code vs. docs (tpulint ``knobs``).
+
+Every ``tpu_*`` key in config.py's PARAMS table must be (a) READ
+somewhere in the package — a knob nothing consults is dead weight that
+silently no-ops for users who set it — and (b) documented in README's
+knob docs, because config/doc drift is the static-analysis analogue of
+contract drift (the HLO and collective contracts get the same
+treatment from hlo_check/spmd_check). Pure text/AST, jax-free.
+
+The read check matches the literal key string (``"tpu_x"``) anywhere in
+package sources outside config.py: every consumer goes through
+``cfg.get("tpu_x", ...)`` or ``config["tpu_x"]``, so a knob whose name
+appears nowhere else is unread. README must mention the key name
+verbatim (the docs render them in backticks, but any mention counts).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def _package_dir() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def tpu_params(config_path: str) -> Dict[str, int]:
+    """``tpu_*`` keys in PARAMS -> definition line, via AST (no import:
+    the schema is a module-level dict literal by design)."""
+    with open(config_path, encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=config_path)
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == "PARAMS" and \
+                    isinstance(node.value, ast.Dict):
+                out: Dict[str, int] = {}
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str) and \
+                            k.value.startswith("tpu_"):
+                        out[k.value] = k.lineno
+                return out
+    raise RuntimeError(f"no module-level PARAMS dict in {config_path}")
+
+
+def check_knobs(package_dir: Optional[str] = None,
+                readme_path: Optional[str] = None
+                ) -> Tuple[List[str], Dict[str, int]]:
+    """(problem lines, knob->def line). Empty problems == no drift."""
+    pkg = package_dir or _package_dir()
+    config_path = os.path.join(pkg, "config.py")
+    if readme_path is None:
+        readme_path = os.path.join(os.path.dirname(pkg), "README.md")
+    knobs = tpu_params(config_path)
+
+    sources: List[str] = []
+    for root, dirs, files in os.walk(pkg):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for name in files:
+            path = os.path.join(root, name)
+            if name.endswith(".py") and \
+                    os.path.abspath(path) != os.path.abspath(config_path):
+                try:
+                    with open(path, encoding="utf-8") as fh:
+                        sources.append(fh.read())
+                except (OSError, UnicodeDecodeError):
+                    pass
+    code = "\n".join(sources)
+    try:
+        with open(readme_path, encoding="utf-8") as fh:
+            readme = fh.read()
+    except OSError:
+        readme = ""
+
+    problems: List[str] = []
+    rel_config = os.path.relpath(config_path)
+    for knob, line in sorted(knobs.items()):
+        if knob not in code:
+            problems.append(
+                f"{rel_config}:{line}: knob {knob} is never read in the "
+                "package — dead weight that silently no-ops for users "
+                "who set it; read it or drop it from PARAMS")
+        if knob not in readme:
+            problems.append(
+                f"{rel_config}:{line}: knob {knob} is undocumented in "
+                f"{os.path.relpath(readme_path)} — config/doc drift; "
+                "add it to the knob docs")
+    return problems, knobs
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="tpulint knobs",
+        description="tpu_* config keys must be read in the package and "
+                    "documented in README (dead-knob / doc-drift lint)")
+    ap.add_argument("--package", default=None,
+                    help="package directory (default: lightgbm_tpu)")
+    ap.add_argument("--readme", default=None,
+                    help="README path (default: next to the package)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+    try:
+        problems, knobs = check_knobs(args.package, args.readme)
+    except (OSError, RuntimeError, SyntaxError) as err:
+        print(f"tpulint knobs: error: {err}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        import json
+        print(json.dumps({"knobs": len(knobs), "problems": problems},
+                         indent=1))
+    else:
+        for p in problems:
+            print(p)
+        print(f"tpulint knobs: {len(knobs)} tpu_* knob(s), "
+              f"{len(problems)} problem(s)", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

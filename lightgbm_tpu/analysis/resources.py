@@ -1,0 +1,1349 @@
+"""Interprocedural resource-lifecycle & cache-bound analyzer (tpulint R012).
+
+The reference frees every handle through ONE disciplined surface
+(``LGBM_BoosterFree`` / ``Network::Dispose``, src/c_api.cpp) — nothing
+long-lived exists outside it. This port owns dozens of long-lived
+resources: coalescer worker threads, MetricsServer HTTP endpoints,
+profiler ``trace_session``s, checkpoint temp files, monitoring
+listeners, and keyed jit/device caches — and ROADMAP items 2-3
+(multi-tenant fleet, unattended refit daemon) multiply them by
+N tenants x M versions running for weeks. The leak class kept getting
+fixed by hand (the PR 10 pre-try profiler leak, PR 14's float-keyed
+retained program and per-swap /metrics cardinality, PR 5's hand-added
+LRU cap); this makes the class statically checkable, the way locks.py
+(R011) made lock-order inversions checkable.
+
+The analysis (pure AST, no jax import — loads anywhere, like the rest
+of tpulint):
+
+  1. discovers every resource acquisition in the package — stdlib
+     constructors (``threading.Thread``, ``ThreadingHTTPServer``,
+     ``open``/``mkstemp``/``NamedTemporaryFile``, ``jax.profiler.trace``
+     / ``trace_session``, ``jax.monitoring`` listener registrations) AND
+     package classes that *own* such resources (a class with a resource
+     attr becomes a resource constructor itself, transitively — the
+     "registered owner" closure: constructing a PredictionServer
+     acquires its coalescer's worker);
+  2. verifies each acquisition has a guaranteed release on ALL paths:
+     ``with``-managed, released in an enclosing/immediately-following
+     ``finally``, ownership-transferred (returned / stored into a
+     container / passed onward), a daemon thread, or registered on
+     ``self`` with an owner class whose close/stop IS release-complete
+     (checked per class, with ``x = self.attr``-alias and
+     method-calls-method resolution);
+  3. flags the exception edges: a release that straight-line code
+     reaches but a raise in between skips (the PR 10 leak shape), a
+     temp-file cleanup handler narrower than ``BaseException`` (a
+     SimulatedKill or TypeError orphans the file), and an ``__init__``
+     that can raise AFTER acquiring a resource attr (the partially
+     built object is dropped with the resource live and no handle to
+     close it);
+  4. the retained-program bound half: ``functools.lru_cache``/``cache``
+     factories of jitted programs must be bounded or keyed only on
+     small annotated domains (``int``/``bool`` — float or unannotated
+     keys are the PR 14 ``_score_accum_fn`` bug), and dict caches keyed
+     from function arguments holding jitted callables / metric series
+     must carry a statically visible bound (an eviction/prune call, a
+     re-assignment that trims, or a rung/bucket key mapping).
+
+Deliberate holds (the process-lifetime metrics listener, a shared
+probe thread) ship anchored in analysis/tpulint.allow with a
+justification. CLI: ``scripts/tpulint resources [--dot|--json]``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .rules.base import (Finding, FunctionInfo, JIT_NAMES, ModuleInfo,
+                         PackageInfo, call_name, dotted_name)
+
+#: constructor basename -> resource kind (stdlib / jax surface)
+RESOURCE_CTORS = {
+    "Thread": "thread",
+    "Timer": "thread",
+    "HTTPServer": "server",
+    "ThreadingHTTPServer": "server",
+    "TCPServer": "server",
+    "ThreadingTCPServer": "server",
+    "UDPServer": "server",
+    "open": "file",
+    "fdopen": "file",
+    "NamedTemporaryFile": "file",
+    "TemporaryFile": "file",
+    "mkstemp": "tempfile",
+    "start_trace": "profiler",
+    "trace_session": "profiler",
+}
+
+#: release-protocol method names per kind (called on the binding)
+RELEASE_ATTRS: Dict[str, Set[str]] = {
+    "thread": {"join"},
+    "server": {"shutdown", "server_close", "stop", "close"},
+    "file": {"close", "__exit__"},
+    "tempfile": {"close", "__exit__"},
+    "profiler": {"__exit__", "stop", "stop_trace", "close"},
+    "listener": set(),
+    "owner": {"close", "stop", "shutdown", "__exit__", "__del__", "join",
+              "release", "terminate", "cancel", "disconnect", "teardown",
+              "server_close", "final_flush", "cleanup", "dispose",
+              "finalize", "unbind", "kill", "abort", "drain"},
+}
+
+#: an owner class releases an attr only through a method reachable from
+#: one of these surfaces (close() calling _join_worker() counts — the
+#: per-class fixpoint follows self-calls)
+RELEASE_SURFACE = RELEASE_ATTRS["owner"]
+
+#: path-consuming calls that release a mkstemp temp NAME
+_TEMPFILE_FREE = {"unlink", "remove", "replace", "rename", "move", "link"}
+
+#: call basenames treated as non-raising for the exception-edge scan
+#: (logging/printing/introspection — telemetry by contract never raises
+#: into the path it observes)
+_SAFE_CALLS = {"print", "len", "isinstance", "issubclass", "str", "int",
+               "float", "bool", "repr", "min", "max", "round", "format",
+               "getattr", "hasattr", "id", "type", "warn", "warning",
+               "info", "debug", "error", "exception", "critical", "write",
+               "flush", "fileno", "append", "items", "keys", "values",
+               "get", "strip", "split", "join", "startswith", "endswith",
+               "setdefault", "note", "fire", "active_plan", "time",
+               "perf_counter", "monotonic"}
+
+_MAX_CLASS_FIXPOINT = 8
+
+
+def _basename(cname: Optional[str]) -> Optional[str]:
+    return cname.rsplit(".", 1)[-1] if cname else None
+
+
+class ResourceDecl:
+    """One discovered acquisition site and how (or whether) it releases."""
+
+    def __init__(self, kind: str, ctor: str, path: str, line: int,
+                 func: str, binding: Optional[str]):
+        self.kind = kind          # thread|server|file|tempfile|profiler|
+        #                           listener|owner
+        self.ctor = ctor          # constructor basename (or owner class)
+        self.path = path
+        self.line = line
+        self.func = func          # acquiring function qualname
+        self.binding = binding    # local name, "self.attr", or None
+        self.status = "leak"      # with|finally|handler|inline|escape|
+        #                           daemon|owned|module|leak
+        self.detail = ""          # human-readable release description
+        self.daemon = False
+        self.owner: Optional[str] = None   # "Class.attr" for owned attrs
+
+    def describe(self) -> str:
+        where = f"{self.path}:{self.line}"
+        bind = self.binding or "<unbound>"
+        return (f"{self.kind:9s} {where} [{self.func}] {bind} "
+                f"-> {self.status}" + (f" ({self.detail})" if self.detail
+                                       else ""))
+
+
+class ResourceAnalysis:
+    """Package-wide result: acquisitions, the ownership graph, findings."""
+
+    def __init__(self, package: PackageInfo):
+        self.package = package
+        self.resources: List[ResourceDecl] = []
+        #: class name -> {attr: kind} for resource-owning classes
+        self.owner_classes: Dict[str, Dict[str, str]] = {}
+        #: (class, attr) -> releasing surface method name
+        self.owner_release: Dict[Tuple[str, str], str] = {}
+        self.findings: List[Finding] = []
+        _Analyzer(package, self).run()
+
+    # -- rendering ------------------------------------------------------
+    def ownership_lines(self) -> List[str]:
+        out = [f"resources discovered: {len(self.resources)}"]
+        for r in sorted(self.resources, key=lambda r: (r.path, r.line)):
+            out.append(f"  {r.describe()}")
+        out.append(f"owner classes: {len(self.owner_classes)}")
+        for cls in sorted(self.owner_classes):
+            for attr, kind in sorted(self.owner_classes[cls].items()):
+                rel = self.owner_release.get((cls, attr))
+                out.append(f"  {cls}.{attr}  ({kind}, released by "
+                           f"{rel + '()' if rel else 'NOTHING'})")
+        return out
+
+    def to_dot(self) -> str:
+        lines = ["digraph resource_ownership {", "  rankdir=LR;"]
+        for cls in sorted(self.owner_classes):
+            lines.append(f'  "{cls}" [shape=box];')
+            for attr, kind in sorted(self.owner_classes[cls].items()):
+                rel = self.owner_release.get((cls, attr))
+                color = "" if rel else ", color=red"
+                lines.append(f'  "{cls}.{attr}" [shape=ellipse, '
+                             f'label="{attr}\\n({kind})"{color}];')
+                label = f"{rel}()" if rel else "LEAK"
+                lines.append(f'  "{cls}" -> "{cls}.{attr}" '
+                             f'[label="{label}"];')
+        for r in self.resources:
+            if r.binding and r.binding.startswith("self."):
+                continue            # drawn via the owner-class edge
+            node = f"{os.path.basename(r.path)}:{r.line}"
+            color = ", color=red" if r.status == "leak" else ""
+            lines.append(f'  "{node}" [shape=ellipse, '
+                         f'label="{r.kind}\\n{node}\\n{r.status}"{color}];')
+        lines.append("}")
+        return "\n".join(lines)
+
+
+class _Acq:
+    """In-flight acquisition being verified inside one function."""
+
+    def __init__(self, kind: str, ctor: str, node: ast.AST,
+                 binding: Optional[str], daemon: bool):
+        self.kind = kind
+        self.ctor = ctor
+        self.node = node
+        self.binding = binding       # local name / "self.attr" / None
+        self.daemon = daemon
+
+
+class _Analyzer:
+    def __init__(self, package: PackageInfo, result: ResourceAnalysis):
+        self.pkg = package
+        self.res = result
+        # class name -> id(FunctionDef) members; and reverse
+        self.class_of_node: Dict[int, str] = {}
+        self.class_methods: Dict[str, List[FunctionInfo]] = {}
+        # dynamic ctor map: RESOURCE_CTORS + discovered owner classes
+        self.ctors: Dict[str, str] = dict(RESOURCE_CTORS)
+        # ownership candidates to verify: (cls, attr) -> (kind, decl)
+        self.pending_owned: Dict[Tuple[str, str], ResourceDecl] = {}
+
+    # ==================================================================
+    def _all_fns(self) -> List[FunctionInfo]:
+        out: List[FunctionInfo] = []
+        seen: Set[int] = set()
+        for m in self.pkg.modules:
+            for lst in m.by_basename.values():
+                for f in lst:
+                    if id(f) not in seen:
+                        seen.add(id(f))
+                        out.append(f)
+        return out
+
+    def run(self) -> None:
+        self._index_classes()
+        self._discover_owner_classes()
+        for fn in self._all_fns():
+            self._walk_function(fn)
+        self._verify_ownership()
+        for m in self.pkg.modules:
+            _CacheChecker(self.pkg, m, self.res).run()
+        self.res.findings.sort(key=lambda f: (f.path, f.line, f.message))
+
+    # -- class indexing / owner-class closure ---------------------------
+    def _index_classes(self) -> None:
+        for m in self.pkg.modules:
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                for meth in node.body:
+                    if isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self.class_of_node[id(meth)] = node.name
+        for fn in self._all_fns():
+            cls = self.class_of_node.get(id(fn.node))
+            if cls is not None:
+                self.class_methods.setdefault(cls, []).append(fn)
+
+    def _ctor_kind(self, call: ast.AST) -> Optional[Tuple[str, str]]:
+        """(kind, ctor basename) when ``call`` constructs a resource."""
+        if not isinstance(call, ast.Call):
+            return None
+        cname = call_name(call)
+        base = _basename(cname)
+        if base is None:
+            return None
+        if base == "trace":
+            # only the profiler's trace context is a resource — not
+            # str.trace or a package helper named trace
+            if cname and "profiler" in cname:
+                return "profiler", base
+            return None
+        kind = self.ctors.get(base)
+        if kind is None:
+            return None
+        if base in ("open", "fdopen") and cname not in (
+                "open", "io.open", "os.fdopen", "fdopen", "gzip.open"):
+            return None              # image.open(...) etc.: not a file ctor
+        return kind, base
+
+    def _discover_owner_classes(self) -> None:
+        """Classes holding a resource in a ``self.attr`` become resource
+        constructors themselves (transitively): acquiring one acquires
+        everything it owns, and its release surface is its close()."""
+        for _ in range(_MAX_CLASS_FIXPOINT):
+            grew = False
+            for cls, methods in self.class_methods.items():
+                for fn in methods:
+                    for node in fn.own_nodes():
+                        attr = self._self_attr_target(node)
+                        if attr is None:
+                            continue
+                        ck = self._ctor_kind(node.value)
+                        if ck is None:
+                            continue
+                        kind = ck[0]
+                        kind = "owner" if kind == "owner" else kind
+                        owned = self.res.owner_classes.setdefault(cls, {})
+                        if attr not in owned:
+                            owned[attr] = kind
+                            grew = True
+                        if cls not in self.ctors:
+                            self.ctors[cls] = "owner"
+                            grew = True
+            if not grew:
+                break
+
+    @staticmethod
+    def _self_attr_target(node: ast.AST) -> Optional[str]:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            return None
+        t = node.targets[0]
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id == "self":
+            return t.attr
+        return None
+
+    # -- ownership verification (release-complete close) ----------------
+    def _verify_ownership(self) -> None:
+        """Per owner class: which attrs does each method release (direct
+        ``self.attr.close()``, via a local alias, or via ``self.m()``
+        where ``m`` releases it), then require a RELEASE_SURFACE method
+        among the releasers of every owned resource attr."""
+        releases: Dict[Tuple[str, str], Set[str]] = {}
+        for cls, owned in self.res.owner_classes.items():
+            for fn in self.class_methods.get(cls, []):
+                for attr in owned:
+                    if self._method_releases_attr(fn, attr, owned[attr]):
+                        releases.setdefault((cls, fn.basename),
+                                            set()).add(attr)
+        # fixpoint: close() -> self._shutdown() -> joins the worker
+        for _ in range(_MAX_CLASS_FIXPOINT):
+            grew = False
+            for cls, owned in self.res.owner_classes.items():
+                for fn in self.class_methods.get(cls, []):
+                    mine = releases.setdefault((cls, fn.basename), set())
+                    for node in fn.own_nodes():
+                        if isinstance(node, ast.Call) and \
+                                isinstance(node.func, ast.Attribute) and \
+                                isinstance(node.func.value, ast.Name) and \
+                                node.func.value.id == "self":
+                            callee = node.func.attr
+                            extra = releases.get((cls, callee), set())
+                            if extra - mine:
+                                mine |= extra
+                                grew = True
+            if not grew:
+                break
+        for cls, owned in self.res.owner_classes.items():
+            for attr, kind in owned.items():
+                surface = sorted(
+                    meth for (c, meth), attrs in releases.items()
+                    if c == cls and attr in attrs
+                    and meth in RELEASE_SURFACE)
+                if surface:
+                    self.res.owner_release[(cls, attr)] = surface[0]
+        for (cls, attr), decl in sorted(self.pending_owned.items()):
+            rel = self.res.owner_release.get((cls, attr))
+            kind = self.res.owner_classes.get(cls, {}).get(attr, decl.kind)
+            if rel is not None:
+                decl.status = "owned"
+                decl.detail = f"released by {cls}.{rel}()"
+                decl.owner = f"{cls}.{attr}"
+            elif decl.daemon:
+                decl.status = "daemon"
+                decl.detail = "daemon thread (dies with the process)"
+            else:
+                decl.status = "leak"
+                self._find(decl.path, decl.line, decl.func,
+                           f"{cls}.{attr} holds a {kind} acquired here "
+                           f"but no release-surface method of {cls} "
+                           "(close/stop/shutdown/__exit__) ever releases "
+                           "it — every long-lived resource needs a "
+                           "release-complete owner")
+
+    def _method_releases_attr(self, fn: FunctionInfo, attr: str,
+                              kind: str) -> bool:
+        rel_attrs = RELEASE_ATTRS.get(kind, set()) | RELEASE_SURFACE
+        aliases: Set[str] = set()
+        for node in fn.own_nodes():
+            # element-wise tuple assign: ms, self._x = self._x, None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt, val = node.targets[0], node.value
+                pairs = []
+                if isinstance(tgt, ast.Tuple) and \
+                        isinstance(val, ast.Tuple) and \
+                        len(tgt.elts) == len(val.elts):
+                    pairs = list(zip(tgt.elts, val.elts))
+                else:
+                    pairs = [(tgt, val)]
+                for t, v in pairs:
+                    if isinstance(t, ast.Name) and \
+                            self._is_self_attr(v, attr):
+                        aliases.add(t.id)
+        for node in fn.own_nodes():
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            if node.func.attr not in rel_attrs:
+                continue
+            recv = node.func.value
+            if self._is_self_attr(recv, attr):
+                return True
+            if isinstance(recv, ast.Name) and recv.id in aliases:
+                return True
+        return False
+
+    @staticmethod
+    def _is_self_attr(node: ast.AST, attr: str) -> bool:
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self" and node.attr == attr):
+            return True
+        # getattr(self, "attr", default) — the defensive-teardown idiom
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "getattr"
+                and len(node.args) >= 2
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id == "self"
+                and isinstance(node.args[1], ast.Constant)
+                and node.args[1].value == attr)
+
+    # -- per-function acquisition walk ----------------------------------
+    def _find(self, path: str, line: int, func: str, message: str) -> None:
+        self.res.findings.append(Finding("R012", path, line, func, message))
+
+    def _walk_function(self, fn: FunctionInfo) -> None:
+        self._walk_block(fn, list(fn.node.body), frames=[])
+
+    def _walk_block(self, fn: FunctionInfo, stmts: List[ast.stmt],
+                    frames: List[Tuple[List[ast.stmt], int]]) -> None:
+        for i, st in enumerate(stmts):
+            here = frames + [(stmts, i)]
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue            # analyzed separately
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    for sub in ast.walk(item.context_expr):
+                        ck = self._ctor_kind(sub)
+                        if ck:
+                            self._record(fn, ck[0], ck[1], sub, None,
+                                         "with", "context-managed")
+                self._walk_block(fn, st.body, here)
+                continue
+            acq = self._acquisition_in(fn, st)
+            if acq is not None:
+                self._verify(fn, acq, st, here)
+            for body in self._sub_blocks(st):
+                self._walk_block(fn, body, here)
+
+    @staticmethod
+    def _sub_blocks(st: ast.stmt) -> List[List[ast.stmt]]:
+        out = []
+        for field in ("body", "orelse", "finalbody"):
+            blk = getattr(st, field, None)
+            if blk:
+                out.append(blk)
+        for h in getattr(st, "handlers", []) or []:
+            out.append(h.body)
+        return out
+
+    def _record(self, fn: FunctionInfo, kind: str, ctor: str,
+                node: ast.AST, binding: Optional[str], status: str,
+                detail: str) -> ResourceDecl:
+        decl = ResourceDecl(kind, ctor, fn.module.path,
+                            getattr(node, "lineno", 0), fn.qualname,
+                            binding)
+        decl.status = status
+        decl.detail = detail
+        self.res.resources.append(decl)
+        return decl
+
+    def _acquisition_in(self, fn: FunctionInfo,
+                        st: ast.stmt) -> Optional[_Acq]:
+        """An acquisition anchored at statement ``st`` (assign roots,
+        bare constructor expressions, listener registrations)."""
+        if isinstance(st, ast.Assign) and len(st.targets) == 1:
+            value, tgt = st.value, st.targets[0]
+            ck = self._root_ctor(value)
+            if ck is None:
+                return None
+            kind, ctor = ck
+            daemon = self._daemon_flag(value)
+            if isinstance(tgt, ast.Name):
+                return _Acq(kind, ctor, st, tgt.id, daemon)
+            if isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name):
+                if tgt.value.id == "self":
+                    return _Acq(kind, ctor, st, f"self.{tgt.attr}", daemon)
+                self._record(fn, kind, ctor, st, dotted_name(tgt),
+                             "escape", "stored on another object")
+                return None
+            if isinstance(tgt, ast.Subscript):
+                self._record(fn, kind, ctor, st, None, "escape",
+                             "stored into a container")
+                return None
+            if isinstance(tgt, ast.Tuple) and kind == "tempfile" and \
+                    len(tgt.elts) == 2 and \
+                    all(isinstance(e, ast.Name) for e in tgt.elts):
+                # fd, tmp = mkstemp(): track the PATH name (the fd is
+                # consumed by the fdopen the pattern wraps in `with`)
+                return _Acq(kind, ctor, st, tgt.elts[1].id, daemon)
+            return None
+        if isinstance(st, ast.Expr):
+            ck = self._root_ctor(st.value)
+            if ck is None and isinstance(st.value, ast.Call) and \
+                    isinstance(st.value.func, ast.Attribute) and \
+                    st.value.func.attr == "start":
+                ck = self._root_ctor(st.value.func.value)
+            if ck is not None:
+                kind, ctor = ck
+                daemon = self._daemon_flag(
+                    st.value.func.value if isinstance(st.value, ast.Call)
+                    and isinstance(st.value.func, ast.Attribute)
+                    and st.value.func.attr == "start" else st.value)
+                return _Acq(kind, ctor, st, None, daemon)
+            reg = self._listener_registration(st.value)
+            if reg is not None:
+                return _Acq("listener", reg[0], st, reg[1], False)
+        return None
+
+    def _root_ctor(self, value: ast.AST) -> Optional[Tuple[str, str]]:
+        """Constructor at the ROOT of an assigned/expr value (nested-in-
+        call constructions escape into the wrapper); an ``a if c else b``
+        root follows both arms (the nullcontext-or-session idiom)."""
+        if isinstance(value, ast.IfExp):
+            return self._root_ctor(value.body) or \
+                self._root_ctor(value.orelse)
+        return self._ctor_kind(value)
+
+    @staticmethod
+    def _daemon_flag(value: ast.AST) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        for kw in value.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+        return False
+
+    @staticmethod
+    def _listener_registration(value: ast.AST
+                               ) -> Optional[Tuple[str, Optional[str]]]:
+        if not isinstance(value, ast.Call):
+            return None
+        base = _basename(call_name(value))
+        if base and base.startswith("register") and "listener" in base:
+            arg = value.args[0] if value.args else None
+            return base, arg.id if isinstance(arg, ast.Name) else None
+        return None
+
+    # -- release verification -------------------------------------------
+    def _verify(self, fn: FunctionInfo, acq: _Acq, st: ast.stmt,
+                frames: List[Tuple[List[ast.stmt], int]]) -> None:
+        kind, line = acq.kind, getattr(st, "lineno", 0)
+        is_init = fn.basename == "__init__"
+        self_attr = acq.binding.split(".", 1)[1] \
+            if acq.binding and acq.binding.startswith("self.") else None
+
+        if acq.binding is None and acq.kind != "listener":
+            if acq.daemon:
+                self._record(fn, kind, acq.ctor, st, None, "daemon",
+                             "unbound daemon thread")
+            else:
+                decl = self._record(fn, kind, acq.ctor, st, None, "leak",
+                                    "constructed and dropped")
+                self._find(fn.module.path, line, fn.qualname,
+                           f"{acq.ctor}(...) {kind} started at line "
+                           f"{line} without a binding — no handle exists "
+                           "to join/close it (daemon=True, or keep a "
+                           "reference with a release-complete owner)")
+                del decl
+            return
+
+        verdict, detail, hazard = self._scan_release(fn, acq, frames)
+        decl = self._record(fn, kind, acq.ctor, st, acq.binding,
+                            "leak", "")
+        decl.daemon = acq.daemon
+        if verdict == "released":
+            decl.status, decl.detail = "inline", detail
+            if hazard is not None:
+                decl.status = "leak"
+                self._find(fn.module.path, line, fn.qualname,
+                           f"{kind} acquired at line {line} is released "
+                           f"only {detail}, but the call at line "
+                           f"{hazard} in between can raise and skip the "
+                           "release (the PR-10 pre-try profiler leak "
+                           "shape) — move the acquisition next to its "
+                           "try/finally")
+            return
+        if verdict == "protected":
+            decl.status, decl.detail = "finally", detail
+            return
+        if verdict == "narrow-handler":
+            decl.status = "leak"
+            self._find(fn.module.path, line, fn.qualname,
+                       f"temp file from {acq.ctor}() at line {line} is "
+                       f"cleaned up by {detail} — a raise outside those "
+                       "types (SimulatedKill, TypeError from a "
+                       "serializer) orphans the temp file; catch "
+                       "BaseException and re-raise")
+            return
+        if verdict == "escape":
+            decl.status, decl.detail = "escape", detail
+            return
+        if self_attr is not None:
+            cls = self.class_of_node.get(id(fn.node))
+            if cls is not None:
+                decl.owner = f"{cls}.{self_attr}"
+                self.pending_owned.setdefault((cls, self_attr), decl)
+                # owned attrs still leak out of a raising __init__: the
+                # object is dropped before anyone can call close()
+                if is_init and hazard is not None and not acq.daemon:
+                    self._find(
+                        fn.module.path, line, fn.qualname,
+                        f"__init__ acquires self.{self_attr} ({kind}) at "
+                        f"line {line} and the call at line {hazard} "
+                        "after it can raise — the partially built object "
+                        f"is dropped with the {kind} still live and no "
+                        "handle to close it; wrap post-acquisition init "
+                        "in try/except BaseException that releases "
+                        f"self.{self_attr} and re-raises")
+                return
+        if acq.daemon:
+            decl.status, decl.detail = "daemon", "daemon thread"
+            return
+        decl.status = "leak"
+        what = ("listener registered" if kind == "listener"
+                else f"{kind} acquired")
+        self._find(fn.module.path, line, fn.qualname,
+                   f"{what} at line {line} is never released on any "
+                   "path — use `with`, release in a finally, transfer "
+                   "ownership, or anchor a deliberate process-lifetime "
+                   "hold in tpulint.allow with a justification")
+
+    def _scan_release(self, fn: FunctionInfo, acq: _Acq,
+                      frames: List[Tuple[List[ast.stmt], int]]
+                      ) -> Tuple[str, str, Optional[int]]:
+        """Scan enclosing finallys, then the statement remainder, for a
+        guaranteed release of ``acq.binding``.
+
+        Returns (verdict, detail, first_hazard_line): verdict in
+        {"released", "protected", "narrow-handler", "escape", "none"} —
+        "protected" means exception-safe (finally / catch-all handler),
+        "released" means straight-line (caller decides whether a hazard
+        before it makes an exception-edge finding).
+        """
+        binding = acq.binding
+        aliases: Set[str] = set()
+        # mutable scan state shared across nested blocks
+        narrow: List[Optional[str]] = [None]
+        exc_covered: List[bool] = [False]
+        hazard: List[Optional[int]] = [None]
+
+        # enclosing trys: release in a finalbody is guaranteed; a
+        # releasing handler covers (or narrowly covers) the raise edge
+        for outer_stmts, outer_idx in frames:
+            st = outer_stmts[outer_idx]
+            if isinstance(st, ast.Try):
+                if self._block_releases(st.finalbody, acq, aliases):
+                    return ("protected",
+                            f"in the finally at line {st.lineno}", None)
+                cover, nar = self._handler_release(st, acq, aliases)
+                exc_covered[0] = exc_covered[0] or cover
+                narrow[0] = narrow[0] or nar
+
+        def verdict_for(line: int, via_with: bool
+                        ) -> Tuple[str, str, Optional[int]]:
+            # `with session:` on a lazily-entered context manager
+            # (trace_session / jax.profiler.trace) acquires only at
+            # __enter__, inside the with — hazards before it are moot
+            protected = via_with and acq.kind == "profiler"
+            if protected or exc_covered[0]:
+                return "protected", f"at line {line}", None
+            if hazard[0] is not None and acq.kind == "tempfile" and \
+                    narrow[0] is not None:
+                return "narrow-handler", narrow[0], hazard[0]
+            return "released", f"at line {line}", hazard[0]
+
+        def scan(stmts: Sequence[ast.stmt]
+                 ) -> Optional[Tuple[str, str, Optional[int]]]:
+            for st in stmts:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                    continue
+                if isinstance(st, ast.Try):
+                    local = set(aliases)
+                    if self._block_releases(st.finalbody, acq, local):
+                        if hazard[0] is not None:
+                            return ("released",
+                                    f"in the finally at line {st.lineno}",
+                                    hazard[0])
+                        return ("protected",
+                                f"in the finally at line {st.lineno}",
+                                None)
+                    cover, nar = self._handler_release(st, acq, local)
+                    exc_covered[0] = exc_covered[0] or cover
+                    narrow[0] = narrow[0] or nar
+                    r = scan(st.body)
+                    if r is not None:
+                        return r
+                    if st.orelse:
+                        r = scan(st.orelse)
+                        if r is not None:
+                            return r
+                    continue
+                self._collect_aliases(st, binding, aliases)
+                rel = self._stmt_contains_release(st, acq, aliases)
+                if rel is not None:
+                    return verdict_for(rel[0], rel[1])
+                if self._stmt_escapes(st, binding, aliases):
+                    return ("escape",
+                            "ownership transferred (returned / stored / "
+                            "passed onward)", None)
+                h = self._stmt_hazard(st, binding, aliases)
+                if h is not None and hazard[0] is None:
+                    hazard[0] = h
+            return None
+
+        # linear remainder: rest of each block, innermost outward
+        for stmts, idx in reversed(frames):
+            r = scan(stmts[idx + 1:])
+            if r is not None:
+                return r
+        if acq.kind == "tempfile" and narrow[0] is not None and \
+                not exc_covered[0]:
+            return "narrow-handler", narrow[0], hazard[0]
+        # a covering catch-all handler releases on the raise edge: any
+        # hazard is moot (the normal-path release is judged separately —
+        # for owned self-attrs that is the owner's close())
+        return "none", "", None if exc_covered[0] else hazard[0]
+
+    # -- statement predicates -------------------------------------------
+    def _is_binding(self, node: ast.AST, binding: Optional[str],
+                    aliases: Set[str]) -> bool:
+        if binding is None:
+            return False
+        if binding.startswith("self."):
+            return self._is_self_attr(node, binding.split(".", 1)[1])
+        return (isinstance(node, ast.Name)
+                and (node.id == binding or node.id in aliases))
+
+    def _collect_aliases(self, st: ast.stmt, binding: Optional[str],
+                         aliases: Set[str]) -> None:
+        if not isinstance(st, ast.Assign) or len(st.targets) != 1:
+            return
+        tgt, val = st.targets[0], st.value
+        pairs = [(tgt, val)]
+        if isinstance(tgt, ast.Tuple) and isinstance(val, ast.Tuple) \
+                and len(tgt.elts) == len(val.elts):
+            pairs = list(zip(tgt.elts, val.elts))
+        for t, v in pairs:
+            if isinstance(t, ast.Name) and \
+                    self._is_binding(v, binding, aliases):
+                aliases.add(t.id)
+
+    def _expr_releases(self, node: ast.AST, acq: _Acq,
+                       aliases: Set[str]) -> bool:
+        """One expression node releasing the binding."""
+        if not isinstance(node, ast.Call):
+            return False
+        binding = acq.binding
+        if acq.kind == "tempfile":
+            base = _basename(call_name(node))
+            if base in _TEMPFILE_FREE:
+                return any(self._is_binding(a, binding, aliases)
+                           for a in node.args)
+        if acq.kind == "listener":
+            base = _basename(call_name(node)) or ""
+            if "unregister" in base:
+                return binding is None or any(
+                    self._is_binding(a, binding, aliases)
+                    for a in node.args)
+        if isinstance(node.func, ast.Attribute):
+            rel = RELEASE_ATTRS.get(acq.kind, set())
+            if acq.kind == "owner":
+                rel = RELEASE_SURFACE
+            if node.func.attr in rel and \
+                    self._is_binding(node.func.value, acq.binding,
+                                     aliases):
+                return True
+        return False
+
+    def _stmt_contains_release(self, st: ast.AST, acq: _Acq,
+                               aliases: Set[str]
+                               ) -> Optional[Tuple[int, bool]]:
+        """(line, via_with) of a release of the binding inside ``st``."""
+        for node in ast.walk(st):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if self._expr_releases(node, acq, aliases):
+                return (getattr(node, "lineno",
+                                getattr(st, "lineno", 0)), False)
+            # `with binding:` / `with closing(binding):` enters the
+            # context manager — its __exit__ IS the release
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    expr = item.context_expr
+                    if isinstance(expr, ast.Call) and expr.args:
+                        expr = expr.args[0]
+                    if self._is_binding(expr, acq.binding, aliases):
+                        return (getattr(item.context_expr, "lineno",
+                                        node.lineno), True)
+        return None
+
+    def _block_releases(self, stmts: Sequence[ast.stmt], acq: _Acq,
+                        aliases: Set[str]) -> bool:
+        local = set(aliases)
+        for st in stmts:
+            self._collect_aliases(st, acq.binding, local)
+            if self._stmt_contains_release(st, acq, local) is not None:
+                return True
+        return False
+
+    def _handler_release(self, st: ast.Try, acq: _Acq,
+                         aliases: Set[str]) -> Tuple[bool, Optional[str]]:
+        """(catch-all handler releases, narrow-handler description)."""
+        covered, narrow = False, None
+        for h in st.handlers:
+            if not self._block_releases(h.body, acq, aliases):
+                continue
+            tname = dotted_name(h.type) if h.type is not None else None
+            if h.type is None or tname == "BaseException":
+                covered = True
+            else:
+                narrow = (f"an `except {tname or '<...>'}` handler at "
+                          f"line {h.lineno} only")
+        return covered, narrow
+
+    def _stmt_escapes(self, st: ast.stmt, binding: Optional[str],
+                      aliases: Set[str]) -> bool:
+        if binding is None or binding.startswith("self."):
+            return False
+        if isinstance(st, ast.Return) and st.value is not None:
+            return any(isinstance(n, ast.Name) and
+                       (n.id == binding or n.id in aliases)
+                       for n in ast.walk(st.value))
+        for node in ast.walk(st):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, (ast.Subscript, ast.Attribute)) and \
+                            self._value_refs(node.value, binding, aliases):
+                        return True
+                # tuple-assign into subscripts (the shared-probe shape:
+                # d["thread"], d["box"] = thread, box)
+                for t in node.targets:
+                    if isinstance(t, ast.Tuple) and \
+                            isinstance(node.value, ast.Tuple) and \
+                            len(t.elts) == len(node.value.elts):
+                        for te, ve in zip(t.elts, node.value.elts):
+                            if isinstance(te, (ast.Subscript,
+                                               ast.Attribute)) and \
+                                    self._value_refs(ve, binding, aliases):
+                                return True
+            if isinstance(node, ast.Call):
+                recv = node.func.value \
+                    if isinstance(node.func, ast.Attribute) else None
+                for a in list(node.args) + \
+                        [k.value for k in node.keywords]:
+                    if isinstance(a, ast.Name) and \
+                            (a.id == binding or a.id in aliases) and \
+                            not (isinstance(recv, ast.Name)
+                                 and recv.id in {binding} | aliases):
+                        return True
+            if isinstance(node, (ast.Yield, ast.YieldFrom)) and \
+                    node.value is not None and \
+                    self._value_refs(node.value, binding, aliases):
+                return True
+        return False
+
+    @staticmethod
+    def _value_refs(node: ast.AST, binding: Optional[str],
+                    aliases: Set[str]) -> bool:
+        return any(isinstance(n, ast.Name) and
+                   (n.id == binding or n.id in aliases)
+                   for n in ast.walk(node))
+
+    def _stmt_hazard(self, st: ast.stmt, binding: Optional[str],
+                     aliases: Set[str]) -> Optional[int]:
+        """Line of the first can-raise call in ``st`` that is not on the
+        binding itself and not a declared-safe telemetry call."""
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef, ast.Import, ast.ImportFrom)):
+            return None
+        for node in ast.walk(st):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            base = _basename(call_name(node))
+            if base in _SAFE_CALLS:
+                continue
+            if isinstance(node.func, ast.Attribute):
+                recv = node.func.value
+                if self._is_binding(recv, binding, aliases):
+                    continue        # x.start()/x.__enter__(): the
+                #                     resource's own protocol
+                rd = dotted_name(recv)
+                if rd in ("log", "logging", "logger", "warnings",
+                          "flight"):
+                    continue
+            return getattr(node, "lineno", getattr(st, "lineno", 0))
+        return None
+
+
+# ======================================================================
+# retained-program / cache-bound checker (the PR 14 class)
+
+#: decorator basenames that memoize
+_MEMO_DECORATORS = {"lru_cache", "cache"}
+#: annotation names whose key domain is unbounded for a program cache
+_UNBOUNDED_ANNOTATIONS = {"float", "complex"}
+#: value-constructor suffixes that mark a per-key metric series
+_SERIES_SUFFIXES = ("Histogram", "Series", "Window", "Accumulator")
+#: cache-name fragments that mark retained programs/arrays
+_CACHE_NAME_HINTS = ("cache", "jitted", "program", "compiled")
+#: key-mapping basename fragments that bound the key domain
+_BUCKET_HINTS = ("rung", "bucket")
+
+
+class _CacheChecker:
+    def __init__(self, package: PackageInfo, module: ModuleInfo,
+                 result: ResourceAnalysis):
+        self.pkg = package
+        self.m = module
+        self.res = result
+
+    def _find(self, node: ast.AST, func: str, message: str) -> None:
+        self.res.findings.append(Finding(
+            "R012", self.m.path, getattr(node, "lineno", 0), func,
+            message))
+
+    def run(self) -> None:
+        self._check_memo_factories()
+        self._check_dict_caches()
+
+    # -- lru_cache jitted-program factories ------------------------------
+    def _check_memo_factories(self) -> None:
+        for fn in self.m.functions.values():
+            deco = self._memo_decorator(fn.node)
+            if deco is None:
+                continue
+            bounded, label = deco
+            if bounded:
+                continue
+            if not self._body_builds_jit(fn):
+                continue
+            bad = self._unbounded_params(fn)
+            if bad:
+                self._find(
+                    fn.node, fn.qualname,
+                    f"unbounded {label} retains one jitted program per "
+                    f"distinct key, and parameter(s) {', '.join(bad)} "
+                    "have float/unannotated key domains — a long-lived "
+                    "refit loop retains a program per model version "
+                    "forever (the PR 14 _score_accum_fn bug); bound the "
+                    "cache (maxsize=N) or key only on small annotated "
+                    "int/bool domains with the varying floats passed as "
+                    "traced scalars")
+
+    @staticmethod
+    def _memo_decorator(node: ast.AST
+                        ) -> Optional[Tuple[bool, str]]:
+        """(bounded, label) for an lru_cache/functools.cache decorator."""
+        for dec in node.decorator_list:
+            name = dotted_name(dec if not isinstance(dec, ast.Call)
+                               else dec.func)
+            base = _basename(name)
+            if base not in _MEMO_DECORATORS:
+                continue
+            if base == "cache":
+                if name not in ("functools.cache", "cache"):
+                    continue
+                return False, "functools.cache"
+            if not isinstance(dec, ast.Call):
+                return True, "lru_cache"        # bare: default 128
+            maxsize = None
+            has_kw = False
+            for kw in dec.keywords:
+                if kw.arg == "maxsize":
+                    has_kw = True
+                    maxsize = kw.value
+            if not has_kw and dec.args:
+                has_kw, maxsize = True, dec.args[0]
+            if not has_kw:
+                return True, "lru_cache()"      # default 128
+            if isinstance(maxsize, ast.Constant) and \
+                    maxsize.value is None:
+                return False, "lru_cache(maxsize=None)"
+            return True, "lru_cache"
+        return None
+
+    @staticmethod
+    def _body_builds_jit(fn: FunctionInfo) -> bool:
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call) and call_name(node) in JIT_NAMES:
+                return True
+        return False
+
+    @staticmethod
+    def _unbounded_params(fn: FunctionInfo) -> List[str]:
+        out = []
+        a = fn.node.args
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            if p.arg in ("self", "cls"):
+                continue
+            ann = p.annotation
+            if ann is None:
+                out.append(f"{p.arg} (unannotated)")
+                continue
+            name = _basename(dotted_name(ann)) or ""
+            if name in _UNBOUNDED_ANNOTATIONS:
+                out.append(f"{p.arg}: {name}")
+        return out
+
+    # -- dict caches keyed from arguments --------------------------------
+    def _check_dict_caches(self) -> None:
+        caches = self._discover_caches()
+        if not caches:
+            return
+        assigns, prunes = self._bound_evidence(caches)
+        stores = self._keyed_stores(caches)
+        for key, (decl_node, where) in caches.items():
+            sites = [s for s in stores if s[0] == key]
+            if not sites:
+                continue
+            retained = any(s[3] for s in sites)
+            if not retained:
+                continue
+            if prunes.get(key) or len(assigns.get(key, [])) >= 2:
+                continue
+            if all(s[4] for s in sites):
+                continue                 # every store key is bucketed
+            node, func = sites[0][1], sites[0][2]
+            label = key[1] if key[0] == "<module>" else \
+                f"{key[0]}.{key[1]}"
+            self._find(
+                node, func,
+                f"retained-program cache {label} is keyed from function "
+                "arguments and stores jitted programs / per-key metric "
+                "series with no statically visible bound (no eviction "
+                "pop/clear, no pruning re-assignment, no rung/bucket "
+                "key mapping) — a long-lived server grows it per "
+                "version/request forever (the PR 14 /metrics "
+                "cardinality class); add an LRU cap or prune on swap")
+
+    def _discover_caches(self) -> Dict[Tuple[str, str],
+                                       Tuple[ast.AST, str]]:
+        """(scope, name) -> (decl node, init func); scope is the class
+        name for ``self._x`` caches, "<module>" for module-level dicts."""
+        caches: Dict[Tuple[str, str], Tuple[ast.AST, str]] = {}
+        for node in self.m.tree.body:
+            tgt = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                tgt, val = node.targets[0].id, node.value
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name) and node.value:
+                tgt, val = node.target.id, node.value
+            else:
+                continue
+            if self._is_empty_dict(val):
+                caches[("<module>", tgt)] = (node, "<module>")
+        for cls_node in ast.walk(self.m.tree):
+            if not isinstance(cls_node, ast.ClassDef):
+                continue
+            for meth in cls_node.body:
+                if not isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                for sub in ast.walk(meth):
+                    if isinstance(sub, ast.Assign) and \
+                            len(sub.targets) == 1:
+                        t = sub.targets[0]
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self" and \
+                                self._is_empty_dict(sub.value):
+                            caches.setdefault(
+                                (cls_node.name, t.attr),
+                                (sub, meth.name))
+        return caches
+
+    @staticmethod
+    def _is_empty_dict(val: ast.AST) -> bool:
+        if isinstance(val, ast.Dict) and not val.keys:
+            return True
+        return (isinstance(val, ast.Call)
+                and _basename(call_name(val)) in ("dict", "OrderedDict")
+                and not val.args and not val.keywords)
+
+    def _cache_key_of(self, node: ast.AST, caches, cls: Optional[str],
+                      aliases: Dict[str, Tuple[str, str]]
+                      ) -> Optional[Tuple[str, str]]:
+        """Resolve an expression to a discovered cache binding."""
+        if isinstance(node, ast.Name):
+            if node.id in aliases:
+                return aliases[node.id]
+            if ("<module>", node.id) in caches:
+                return ("<module>", node.id)
+            return None
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and cls is not None and \
+                (cls, node.attr) in caches:
+            return (cls, node.attr)
+        return None
+
+    def _class_of_fn(self, fn: FunctionInfo) -> Optional[str]:
+        for cls_node in ast.walk(self.m.tree):
+            if isinstance(cls_node, ast.ClassDef):
+                for meth in cls_node.body:
+                    if meth is fn.node:
+                        return cls_node.name
+        return None
+
+    def _keyed_stores(self, caches):
+        """Every ``cache[key] = value`` / ``cache.setdefault(key, v)``
+        whose key derives from the enclosing function's arguments:
+        (cache key, node, func qualname, retained, bucketed)."""
+        out = []
+        for fn in self.m.functions.values():
+            cls = self._class_of_fn(fn)
+            params = set(fn.pos_params) | set(fn.kwonly_params)
+            params.discard("self")
+            derived = self._derived_names(fn, params)
+            aliases: Dict[str, Tuple[str, str]] = {}
+            for node in fn.own_nodes():
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name):
+                    ck = self._cache_key_of(node.value, caches, cls,
+                                            aliases)
+                    if ck is not None:
+                        aliases[node.targets[0].id] = ck
+            for node in fn.own_nodes():
+                key_expr = value_expr = None
+                target = None
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Subscript):
+                            target, key_expr = t.value, t.slice
+                            value_expr = node.value
+                elif isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "setdefault" and node.args:
+                    target = node.func.value
+                    key_expr = node.args[0]
+                    value_expr = node.args[1] if len(node.args) > 1 \
+                        else None
+                if target is None or key_expr is None:
+                    continue
+                ck = self._cache_key_of(target, caches, cls, aliases)
+                if ck is None:
+                    continue
+                if not self._refs_any(key_expr, derived):
+                    continue
+                retained = self._is_retained(ck, value_expr)
+                bucketed = self._is_bucketed(fn, key_expr)
+                out.append((ck, node, fn.qualname, retained, bucketed))
+        return out
+
+    @staticmethod
+    def _derived_names(fn: FunctionInfo, seed: Set[str]) -> Set[str]:
+        names = set(seed)
+        for _ in range(6):
+            grew = False
+            for n in fn.own_nodes():
+                if isinstance(n, ast.Assign) and \
+                        any(isinstance(x, ast.Name) and x.id in names
+                            for x in ast.walk(n.value)):
+                    for t in n.targets:
+                        for leaf in ast.walk(t):
+                            if isinstance(leaf, ast.Name) and \
+                                    leaf.id not in names:
+                                names.add(leaf.id)
+                                grew = True
+            if not grew:
+                break
+        return names
+
+    @staticmethod
+    def _refs_any(node: ast.AST, names: Set[str]) -> bool:
+        return any(isinstance(n, ast.Name) and n.id in names
+                   for n in ast.walk(node))
+
+    def _is_retained(self, ck: Tuple[str, str],
+                     value_expr: Optional[ast.AST]) -> bool:
+        name = ck[1].lower()
+        if any(h in name for h in _CACHE_NAME_HINTS):
+            return True
+        if value_expr is None:
+            return False
+        for n in ast.walk(value_expr):
+            if isinstance(n, ast.Call):
+                if call_name(n) in JIT_NAMES:
+                    return True
+                base = _basename(call_name(n)) or ""
+                if base.endswith(_SERIES_SUFFIXES):
+                    return True
+        return False
+
+    def _is_bucketed(self, fn: FunctionInfo, key_expr: ast.AST) -> bool:
+        def expr_bucketed(e: ast.AST) -> bool:
+            for n in ast.walk(e):
+                if isinstance(n, ast.Call):
+                    base = (_basename(call_name(n)) or "").lower()
+                    if any(h in base for h in _BUCKET_HINTS):
+                        return True
+            return False
+
+        if expr_bucketed(key_expr):
+            return True
+        # one level of indirection: key = rung_of(n); cache[key] = ...
+        key_names = {n.id for n in ast.walk(key_expr)
+                     if isinstance(n, ast.Name)}
+        for n in fn.own_nodes():
+            if isinstance(n, ast.Assign) and \
+                    any(isinstance(t, ast.Name) and t.id in key_names
+                        for t in n.targets) and expr_bucketed(n.value):
+                return True
+        return False
+
+    def _bound_evidence(self, caches):
+        """Per cache: assignment sites (any value) and prune operations
+        (pop/popitem/clear/del) found anywhere in the module."""
+        assigns: Dict[Tuple[str, str], List[int]] = {}
+        prunes: Dict[Tuple[str, str], bool] = {}
+
+        def note_assign(ck, line):
+            sites = assigns.setdefault(ck, [])
+            if line not in sites:
+                sites.append(line)
+
+        for fn in list(self.m.functions.values()):
+            cls = self._class_of_fn(fn)
+            for node in fn.own_nodes():
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        ck = self._cache_key_of(t, caches, cls, {})
+                        if ck is not None:
+                            note_assign(ck, node.lineno)
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in ("pop", "popitem", "clear"):
+                    ck = self._cache_key_of(node.func.value, caches,
+                                            cls, {})
+                    if ck is not None:
+                        prunes[ck] = True
+                if isinstance(node, ast.Delete):
+                    for t in node.targets:
+                        if isinstance(t, ast.Subscript):
+                            ck = self._cache_key_of(t.value, caches,
+                                                    cls, {})
+                            if ck is not None:
+                                prunes[ck] = True
+        for node in self.m.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                ck = ("<module>", node.targets[0].id)
+                if ck in caches:
+                    note_assign(ck, node.lineno)
+        return assigns, prunes
+
+
+# ======================================================================
+def analyze_package(package: PackageInfo) -> ResourceAnalysis:
+    """Run (or fetch the cached) whole-package resource analysis."""
+    cached = getattr(package, "_r012_analysis", None)
+    if cached is None:
+        cached = ResourceAnalysis(package)
+        package._r012_analysis = cached
+    return cached
+
+
+def analyze_paths(paths: Sequence[str]
+                  ) -> Tuple[ResourceAnalysis, List[str]]:
+    from . import tpulint as _tl
+    modules: List[ModuleInfo] = []
+    errors: List[str] = []
+    for path in _tl._iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+            modules.append(ModuleInfo(path, source, _tl._dotted_of(path)))
+        except (SyntaxError, OSError, UnicodeDecodeError) as err:
+            errors.append(f"{path}: {err}")
+    return analyze_package(PackageInfo(modules)), errors
+
+
+def _default_package_path() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    from . import tpulint as _tl
+
+    ap = argparse.ArgumentParser(
+        prog="tpulint resources",
+        description="interprocedural resource-lifecycle & cache-bound "
+                    "analyzer (R012)")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: the package)")
+    ap.add_argument("--dot", action="store_true",
+                    help="emit the ownership graph as Graphviz")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--allowlist", default=_tl.DEFAULT_ALLOWLIST)
+    ap.add_argument("--no-allowlist", action="store_true")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or [_default_package_path()]
+    analysis, errors = analyze_paths(paths)
+    findings = list(analysis.findings)
+
+    entries: List[_tl.AllowEntry] = []
+    allow_errors: List[str] = []
+    if not args.no_allowlist:
+        entries, allow_errors = _tl.load_allowlist(args.allowlist)
+        entries = [e for e in entries if e.rule == "R012"]
+        findings = _tl.apply_allowlist(findings, entries)
+
+    if args.dot:
+        print(analysis.to_dot())
+    elif args.as_json:
+        import json
+        print(json.dumps([f.to_json() for f in findings], indent=1))
+    else:
+        for line in analysis.ownership_lines():
+            print(line)
+        for f in findings:
+            print(f.render())
+        print(f"tpulint resources: {len(findings)} finding(s)",
+              file=sys.stderr)
+    for err in errors + allow_errors:
+        print(f"tpulint resources: error: {err}", file=sys.stderr)
+
+    if errors or allow_errors:
+        return 2
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,14 +12,17 @@ from .r008_serving import ServingContractRule
 from .r009_timing import TimingRule
 from .r010_divergence import CollectiveDivergenceRule
 from .r011_locks import LockOrderRule
+from .r012_resources import ResourceLifecycleRule
 
 ALL_RULES = (HostSyncRule, RecompileRule, DtypeDriftRule,
              PallasContractRule, CollectiveAccountingRule,
              AxisNameRule, ApiRaceRule, ServingContractRule, TimingRule,
-             CollectiveDivergenceRule, LockOrderRule)
+             CollectiveDivergenceRule, LockOrderRule,
+             ResourceLifecycleRule)
 
 __all__ = ["Finding", "ModuleInfo", "PackageInfo", "Rule", "ALL_RULES",
            "HostSyncRule", "RecompileRule", "DtypeDriftRule",
            "PallasContractRule", "CollectiveAccountingRule",
            "AxisNameRule", "ApiRaceRule", "ServingContractRule",
-           "TimingRule", "CollectiveDivergenceRule", "LockOrderRule"]
+           "TimingRule", "CollectiveDivergenceRule", "LockOrderRule",
+           "ResourceLifecycleRule"]

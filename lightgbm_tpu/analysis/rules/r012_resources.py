@@ -1,0 +1,25 @@
+"""R012 — resource lifecycle: every acquisition releases on all paths,
+every retained-program cache carries a statically visible bound.
+
+Thin adapter over :mod:`..resources` (the interprocedural analyzer):
+the whole-package analysis runs once (cached on the package) and each
+module's check() returns the findings anchored in that module, exactly
+as r011_locks adapts :mod:`..locks`.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ..resources import analyze_package
+from .base import Finding, ModuleInfo, PackageInfo, Rule
+
+
+class ResourceLifecycleRule(Rule):
+    code = "R012"
+    title = ("resource acquired without a guaranteed release / "
+             "unbounded retained-program cache")
+
+    def check(self, module: ModuleInfo,
+              package: PackageInfo) -> List[Finding]:
+        analysis = analyze_package(package)
+        return [f for f in analysis.findings if f.path == module.path]

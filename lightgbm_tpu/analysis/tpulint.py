@@ -291,5 +291,99 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     return 1 if findings else 0
 
 
+#: the `tpulint all` stage table: name -> (argv builder, json argv
+#: builder or None when the stage has no --json mode). The jax-free
+#: stages come first so a broken backend still reports the AST verdicts.
+_ALL_STAGES = ("ast", "locks", "resources", "knobs", "hlo", "spmd")
+#: stages that lower real jax programs (need the real package + backend)
+_JAX_STAGES = ("hlo", "spmd")
+
+
+def _stage_runner(name: str, pkg: str, as_json: bool):
+    """(argv, main) for one aggregate stage — imports lazily so the
+    jax-lowering stages load only when actually run."""
+    if name == "ast":
+        argv = [pkg, "--check-allow"] + (["--json"] if as_json else [])
+        return argv, main
+    if name == "locks":
+        from .locks import main as locks_main
+        return [pkg] + (["--json"] if as_json else []), locks_main
+    if name == "resources":
+        from .resources import main as resources_main
+        return [pkg] + (["--json"] if as_json else []), resources_main
+    if name == "knobs":
+        from .knobs import main as knobs_main
+        return (["--json"] if as_json else []), knobs_main
+    if name == "hlo":
+        from .hlo_check import main as hlo_main
+        return [], hlo_main
+    if name == "spmd":
+        from .spmd_check import main as spmd_main
+        return [], spmd_main
+    raise ValueError(f"unknown tpulint stage {name!r}")
+
+
+def main_all(argv: Optional[Sequence[str]] = None,
+             package_path: Optional[str] = None) -> int:
+    """`scripts/tpulint all`: every analyzer, one exit code.
+
+    With ``--json``, emits ONE machine-readable object
+    ``{"stages": {name: {"exit": rc, "findings": [...]} | {"exit": rc,
+    "report": {...}} | {"exit": rc, "output": "..."}}, "exit": rc}`` —
+    a findings list for the lint stages (ast/locks/resources), an object
+    report for knobs, captured text for the program-lowering ones
+    (hlo/spmd) — so CI and the refit daemon can consume the flight
+    check programmatically."""
+    import contextlib
+    import io
+
+    ap = argparse.ArgumentParser(prog="tpulint all")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--only", default="",
+                    help="comma-separated stage subset of "
+                         + ",".join(_ALL_STAGES))
+    args = ap.parse_args(argv)
+    selected = [s.strip() for s in args.only.split(",") if s.strip()] \
+        or list(_ALL_STAGES)
+    unknown = [s for s in selected if s not in _ALL_STAGES]
+    if unknown:
+        print(f"tpulint all: unknown stage(s) {', '.join(unknown)}",
+              file=sys.stderr)
+        return 2
+
+    pkg = package_path or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    rc = 0
+    stages: Dict[str, Dict[str, object]] = {}
+    for name in _ALL_STAGES:
+        if name not in selected:
+            continue
+        stage_argv, run = _stage_runner(name, pkg, args.as_json)
+        if args.as_json:
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf), \
+                    contextlib.redirect_stderr(buf):
+                stage_rc = run(stage_argv)
+            text = buf.getvalue()
+            entry: Dict[str, object] = {"exit": int(stage_rc)}
+            try:
+                parsed = json.loads(text)
+            except ValueError:
+                entry["output"] = text
+            else:
+                # finding-list stages vs object-report stages (knobs)
+                key = "findings" if isinstance(parsed, list) else "report"
+                entry[key] = parsed
+            stages[name] = entry
+        else:
+            print(f"== tpulint {name} ==", flush=True)
+            stage_rc = run(stage_argv)
+            print(f"== tpulint {name}: exit {stage_rc} ==", flush=True)
+        rc = max(rc, int(stage_rc))
+    if args.as_json:
+        print(json.dumps({"stages": stages, "exit": rc}, indent=1))
+    return rc
+
+
 if __name__ == "__main__":
     sys.exit(main())

@@ -165,7 +165,6 @@ PARAMS: Dict[str, Tuple[Any, type, Tuple[str, ...]]] = {
     "machine_list_filename": ("", str, ("machine_list_file", "machine_list", "mlist")),
     "machines": ("", str, ("workers", "nodes")),
     # tpu-specific (new in this framework; no reference analogue)
-    "tpu_double_hist": (False, bool, ()),   # f64 histogram accumulation (CPU/testing)
     "tpu_hist_impl": ("auto", str, ()),     # auto | xla | pallas
     # serial-learner row storage: 'compact' physically partitions rows into
     # per-leaf segments (O(N*depth)/tree), 'masked' streams all rows per

@@ -133,7 +133,9 @@ def store_decision(path: str, key: str, block: Dict[str, Any]) -> None:
             json.dump(data, fh, indent=1, sort_keys=True)
             fh.write("\n")
         os.replace(tmp, path)
-    except OSError:
+    except BaseException:
+        # BaseException, not OSError: a serializer TypeError or a
+        # SimulatedKill mid-dump must not orphan the temp file (R012)
         try:
             os.unlink(tmp)
         except OSError:

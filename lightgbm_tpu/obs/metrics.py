@@ -287,16 +287,32 @@ class MetricsServer:
 
         self._httpd = http.server.ThreadingHTTPServer((host, int(port)),
                                                       _Handler)
-        self._httpd.daemon_threads = True
-        self.port = int(self._httpd.server_address[1])
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True,
-            name=f"lgbm-tpu-metrics:{self.port}")
-        self._thread.start()
+        try:
+            self._httpd.daemon_threads = True
+            self.port = int(self._httpd.server_address[1])
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True,
+                name=f"lgbm-tpu-metrics:{self.port}")
+            self._thread.start()
+        except BaseException:
+            # a raise after the socket is bound would drop the half-built
+            # server with the port still held and no handle to close it
+            # (R012 constructor exception edge)
+            self._httpd.server_close()
+            raise
 
     def stop(self) -> None:
+        # shutdown and server_close in SEPARATE trys: a shutdown raise
+        # must not skip closing the listening socket (R012), and the
+        # serve thread is joined so stop() really quiesces the process
         try:
             self._httpd.shutdown()
+        except Exception:  # noqa: BLE001 - idempotent shutdown
+            pass
+        try:
             self._httpd.server_close()
         except Exception:  # noqa: BLE001 - idempotent shutdown
             pass
+        thread = getattr(self, "_thread", None)
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=5.0)

@@ -170,6 +170,14 @@ def annotations_enabled() -> bool:
     return bool(_enabled)
 
 
+def active_sessions() -> int:
+    """Currently-entered ``trace_session`` nesting depth. The resource
+    witness (guards.resource_witness) reads this: a scope that exits
+    with a higher depth than it entered leaked a profiler session."""
+    with _mu:
+        return int(_enabled)
+
+
 def enable_annotations() -> None:
     global _enabled
     with _mu:

@@ -93,26 +93,42 @@ class PredictionServer:
             self._serve_batch, tick_ms=tick_ms, queue_max_rows=queue_max,
             max_batch_rows=self._resolve_max_batch(active),
             fault_config=cfg, observer=self._obs)
-        self._attach_obs_model()
-        # metrics plane (obs/metrics.py): pull-based Prometheus text over
-        # stdlib HTTP. None = off; 0 = ephemeral port (.metrics_port tells)
-        self._metrics_server = None
-        if metrics_port is None:
-            port_cfg = int(cfg.get("tpu_metrics_port", 0) or 0)
-            metrics_port = port_cfg if port_cfg > 0 else None
-        if metrics_port is not None:
-            # a taken port must not take down SERVING: the coalescer
-            # worker is already running, and an __init__ raise here would
-            # orphan it with no handle to close() — serve without the
-            # endpoint instead (an explicit serve_metrics() call still
-            # raises, the caller asked for that port specifically)
+        try:
+            self._attach_obs_model()
+            # metrics plane (obs/metrics.py): pull-based Prometheus text
+            # over stdlib HTTP. None = off; 0 = ephemeral port
+            # (.metrics_port tells)
+            self._metrics_server = None
+            if metrics_port is None:
+                port_cfg = int(cfg.get("tpu_metrics_port", 0) or 0)
+                metrics_port = port_cfg if port_cfg > 0 else None
+            if metrics_port is not None:
+                # a taken port must not take down SERVING: the coalescer
+                # worker is already running — serve without the endpoint
+                # instead (an explicit serve_metrics() call still raises,
+                # the caller asked for that port specifically)
+                try:
+                    self.serve_metrics(metrics_port)
+                except OSError as err:
+                    from ..utils import log
+                    log.warning(f"[serving] metrics port {metrics_port} "
+                                f"unavailable ({err}); serving WITHOUT "
+                                "the metrics endpoint")
+        except BaseException:
+            # the coalescer worker is already running: a raise in the
+            # rest of __init__ (drift warm compile, a non-OSError from
+            # serve_metrics) would orphan the thread with no handle to
+            # close() — release everything acquired so far and re-raise
+            # (R012 constructor exception edge)
+            self._closed = True
             try:
-                self.serve_metrics(metrics_port)
-            except OSError as err:
-                from ..utils import log
-                log.warning(f"[serving] metrics port {metrics_port} "
-                            f"unavailable ({err}); serving WITHOUT the "
-                            "metrics endpoint")
+                self._coalescer.close(drain=False)
+            finally:
+                ms = getattr(self, "_metrics_server", None)
+                self._metrics_server = None
+                if ms is not None:
+                    ms.stop()
+            raise
 
     # -- batch bound ---------------------------------------------------------
     def _resolve_max_batch(self, booster, version: Optional[str] = None

@@ -72,6 +72,21 @@ def lock_order_witness():
 
 
 @pytest.fixture
+def resource_leak_witness():
+    """Snapshot live threads / open fds / entered trace sessions /
+    retained-program cache sizes at fixture setup; at teardown the test
+    fails (guards.ResourceLeakError) if the scope did not give
+    everything back — the runtime half of tpulint R012. Warm compiles
+    and long-lived fixtures must happen BEFORE this fixture in the
+    argument list (or inside the test before the chaos region) so cache
+    warms don't read as leaks."""
+    from lightgbm_tpu.analysis import guards
+    with guards.resource_witness() as w:
+        yield w
+    w.assert_no_leaks("resource_leak_witness fixture")
+
+
+@pytest.fixture
 def no_d2h_guard():
     """Fail the test on any device->host materialization
     (lightgbm_tpu.analysis.guards.no_host_transfers)."""

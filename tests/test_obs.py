@@ -617,3 +617,19 @@ def test_training_metrics_endpoint_scrapeable_while_training(tmp_path):
     with pytest.raises(Exception):
         urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
                                timeout=1)
+
+
+# ------------------------------------------- R012 leak regressions
+def test_raising_train_leaves_no_open_trace_session(resource_leak_witness):
+    """engine.py holds the trace session with ``with`` — a SimulatedKill
+    mid-train unwinds the annotation enablement (the runtime complement
+    of tpulint R012's PR-10 exception-edge check)."""
+    X, y = _make_data(300, 6)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "tpu_trace_mode": "annotations"}
+    assert spans.active_sessions() == 0
+    with faultinject.inject("kill@iteration=1"):
+        with pytest.raises(faultinject.SimulatedKill):
+            lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=4)
+    assert spans.active_sessions() == 0
+    assert not spans.annotations_enabled()

@@ -1,0 +1,429 @@
+"""Resource-lifecycle flight check: R012 analyzer unit coverage +
+runtime resource-leak witness.
+
+The static half (lightgbm_tpu/analysis/resources.py) is exercised on
+synthetic modules covering every acquisition spelling, the PR-10
+exception-edge shape, the narrow-tempfile-handler shape, and ownership
+discovery/verification; and on the shipped package (whose ownership
+graph must resolve — that IS the invariant ROADMAP items 2-3 build on).
+The runtime half (guards.resource_witness) is exercised with deliberate
+thread/fd/session/cache leaks and their clean counterparts.
+"""
+import json
+import os
+import textwrap
+import threading
+import time
+
+import pytest
+
+import lightgbm_tpu
+from lightgbm_tpu.analysis import guards
+from lightgbm_tpu.analysis.resources import (analyze_paths,
+                                             main as resources_main)
+from lightgbm_tpu.obs import spans
+
+PKG_DIR = os.path.dirname(lightgbm_tpu.__file__)
+
+
+def analyze_snippet(tmp_path, source, name="mod_under_test.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    analysis, errors = analyze_paths([str(p)])
+    assert not errors, errors
+    return analysis
+
+
+def r012(analysis):
+    return [f.render() for f in analysis.findings]
+
+
+# ------------------------------------------------- acquisition discovery
+def test_discovery_across_spellings(tmp_path):
+    """One module acquiring through every spelling — `with`, try/finally,
+    daemon thread, escape-by-return — discovers every resource with the
+    right kind and verdict, at zero findings."""
+    analysis = analyze_snippet(tmp_path, """
+        import threading
+        from http.server import ThreadingHTTPServer, BaseHTTPRequestHandler
+
+        def scoped_read(path):
+            with open(path) as fh:
+                return fh.read()
+
+        def scoped_thread(work):
+            t = threading.Thread(target=work, name="w")
+            t.start()
+            try:
+                work()
+            finally:
+                t.join()
+
+        def background(work):
+            threading.Thread(target=work, daemon=True).start()
+
+        def serve_once(port):
+            httpd = ThreadingHTTPServer(("127.0.0.1", port),
+                                        BaseHTTPRequestHandler)
+            try:
+                httpd.handle_request()
+            finally:
+                httpd.server_close()
+
+        def stream_for(path):
+            fh = open(path, "a")
+            return fh
+    """)
+    assert not r012(analysis), r012(analysis)
+    by_kind = {}
+    for r in analysis.resources:
+        by_kind.setdefault(r.kind, []).append(r.status)
+    assert "with" in by_kind["file"]
+    assert "escape" in by_kind["file"]
+    assert set(by_kind["thread"]) == {"finally", "daemon"}
+    assert by_kind["server"] == ["finally"]
+
+
+def test_unbound_thread_without_daemon_is_a_finding(tmp_path):
+    analysis = analyze_snippet(tmp_path, """
+        import threading
+
+        def spawn(work):
+            threading.Thread(target=work).start()
+    """)
+    msgs = r012(analysis)
+    assert len(msgs) == 1 and "without a binding" in msgs[0], msgs
+
+
+# ------------------------------------------------- the PR-10 edge shape
+def test_hazard_between_acquire_and_try_is_a_finding(tmp_path):
+    """The exact PR-10 leak: profiler session entered, a raising call,
+    THEN the try/finally — the exception edge skips the release."""
+    analysis = analyze_snippet(tmp_path, """
+        import jax
+
+        def traced_run(log_dir, work):
+            sess = jax.profiler.trace(log_dir)
+            sess.__enter__()
+            prepare_inputs()
+            try:
+                work()
+            finally:
+                sess.__exit__(None, None, None)
+    """)
+    msgs = r012(analysis)
+    assert len(msgs) == 1, msgs
+    assert "can raise and skip the release" in msgs[0]
+    assert "PR-10" in msgs[0]
+
+
+def test_acquire_adjacent_to_try_is_clean(tmp_path):
+    """Same code with the acquisition moved next to its try: clean."""
+    analysis = analyze_snippet(tmp_path, """
+        import jax
+
+        def traced_run(log_dir, work):
+            prepare_inputs()
+            sess = jax.profiler.trace(log_dir)
+            try:
+                sess.__enter__()
+                work()
+            finally:
+                sess.__exit__(None, None, None)
+    """)
+    assert not r012(analysis), r012(analysis)
+
+
+def test_with_by_name_profiler_session_is_clean(tmp_path):
+    """The engine.py idiom: build the session object (construction does
+    not acquire — __enter__ does), hazards in between, then
+    `with sess:` — the lazy acquisition makes this exception-safe."""
+    analysis = analyze_snippet(tmp_path, """
+        import contextlib
+        import jax
+
+        def traced_run(log_dir, work):
+            sess = (jax.profiler.trace(log_dir) if log_dir
+                    else contextlib.nullcontext())
+            prepare_inputs()
+            with sess:
+                work()
+    """)
+    assert not r012(analysis), r012(analysis)
+
+
+# ------------------------------------------- tempfile narrow handlers
+def test_narrow_tempfile_handler_is_a_finding(tmp_path):
+    """The ledger/autotune bug shape: mkstemp cleanup behind
+    `except OSError` — a serializer TypeError or SimulatedKill mid-dump
+    orphans the temp file."""
+    analysis = analyze_snippet(tmp_path, """
+        import os
+        import tempfile
+
+        def persist(directory, final, payload):
+            fd, tmp = tempfile.mkstemp(dir=directory)
+            try:
+                os.write(fd, payload)
+                os.close(fd)
+                os.replace(tmp, final)
+            except OSError:
+                os.unlink(tmp)
+                raise
+    """)
+    msgs = r012(analysis)
+    assert len(msgs) == 1, msgs
+    assert "orphans the temp file" in msgs[0]
+    assert "except OSError" in msgs[0]
+
+
+def test_catchall_tempfile_handler_is_clean(tmp_path):
+    analysis = analyze_snippet(tmp_path, """
+        import os
+        import tempfile
+
+        def persist(directory, final, payload):
+            fd, tmp = tempfile.mkstemp(dir=directory)
+            try:
+                os.write(fd, payload)
+                os.close(fd)
+                os.replace(tmp, final)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+    """)
+    assert not r012(analysis), r012(analysis)
+
+
+# ------------------------------------------------- ownership discovery
+OWNER_CLEAN = """
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self._thread = threading.Thread(target=self._run,
+                                            name="pump")
+            self._thread.start()
+
+        def _run(self):
+            pass
+
+        def close(self):
+            thread, self._thread = self._thread, None
+            if thread is not None:
+                thread.join(timeout=5.0)
+"""
+
+
+def test_owner_class_with_release_complete_close_is_clean(tmp_path):
+    analysis = analyze_snippet(tmp_path, OWNER_CLEAN)
+    assert not r012(analysis), r012(analysis)
+    assert analysis.owner_classes == {"Pump": {"_thread": "thread"}}
+    assert analysis.owner_release[("Pump", "_thread")] == "close"
+    lines = "\n".join(analysis.ownership_lines())
+    assert "Pump._thread" in lines and "released by close()" in lines
+
+
+def test_owner_class_without_release_surface_is_a_finding(tmp_path):
+    analysis = analyze_snippet(tmp_path, """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._thread = threading.Thread(target=self._run)
+                self._thread.start()
+
+            def _run(self):
+                pass
+    """)
+    msgs = r012(analysis)
+    assert len(msgs) == 1, msgs
+    assert "no release-surface method" in msgs[0]
+    dot = analysis.to_dot()
+    assert "LEAK" in dot and dot.startswith("digraph")
+
+
+def test_release_through_self_method_fixpoint(tmp_path):
+    """close() -> self._shutdown() -> join: the release chain resolves
+    through intermediate self-method calls."""
+    analysis = analyze_snippet(tmp_path, """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._thread = threading.Thread(target=self._run)
+                self._thread.start()
+
+            def _run(self):
+                pass
+
+            def _shutdown(self):
+                self._thread.join(timeout=5.0)
+
+            def close(self):
+                self._shutdown()
+    """)
+    assert not r012(analysis), r012(analysis)
+    assert analysis.owner_release[("Pump", "_thread")] == "close"
+
+
+def test_raising_init_after_acquisition_is_a_finding(tmp_path):
+    """The MetricsServer/PredictionServer bug shape: __init__ acquires,
+    then a later init step raises — the partially built object is
+    dropped with the resource live."""
+    analysis = analyze_snippet(tmp_path, """
+        from http.server import ThreadingHTTPServer
+
+        class Exporter:
+            def __init__(self, handler, port):
+                self._httpd = ThreadingHTTPServer(("127.0.0.1", port),
+                                                  handler)
+                self._port = announce(self._httpd.server_address[1])
+
+            def stop(self):
+                self._httpd.shutdown()
+                self._httpd.server_close()
+    """)
+    msgs = r012(analysis)
+    assert len(msgs) == 1, msgs
+    assert "__init__" in msgs[0] and "partially built object" in msgs[0]
+
+
+def test_init_guarded_by_catchall_release_is_clean(tmp_path):
+    analysis = analyze_snippet(tmp_path, """
+        from http.server import ThreadingHTTPServer
+
+        class Exporter:
+            def __init__(self, handler, port):
+                self._httpd = ThreadingHTTPServer(("127.0.0.1", port),
+                                                  handler)
+                try:
+                    self._port = announce(self._httpd.server_address[1])
+                except BaseException:
+                    self._httpd.server_close()
+                    raise
+
+            def stop(self):
+                self._httpd.shutdown()
+                self._httpd.server_close()
+    """)
+    assert not r012(analysis), r012(analysis)
+
+
+# --------------------------------------------------- shipped-tree facts
+def test_shipped_package_ownership_graph_resolves():
+    """The real tree: every owned resource attr has a release-surface
+    method, and the serving/metrics owners the chaos tests rely on are
+    in the graph."""
+    analysis, errors = analyze_paths([PKG_DIR])
+    assert not errors, errors
+    owners = analysis.owner_classes
+    assert "PredictionServer" in owners
+    assert "MetricsServer" in owners
+    assert "MicroBatchCoalescer" in owners
+    for cls, owned in owners.items():
+        for attr in owned:
+            assert (cls, attr) in analysis.owner_release, \
+                f"{cls}.{attr} has no releasing surface method"
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    leaky = tmp_path / "leaky.py"
+    leaky.write_text(textwrap.dedent("""
+        import threading
+
+        def spawn(work):
+            threading.Thread(target=work).start()
+    """))
+    clean = tmp_path / "clean.py"
+    clean.write_text(textwrap.dedent("""
+        def read(path):
+            with open(path) as fh:
+                return fh.read()
+    """))
+    assert resources_main([str(clean)]) == 0
+    assert resources_main([str(leaky), "--no-allowlist"]) == 1
+    capsys.readouterr()
+    rc = resources_main([str(leaky), "--no-allowlist", "--json"])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload and payload[0]["rule"] == "R012"
+
+
+# =============================================== runtime leak witness
+def test_witness_names_leaked_thread_and_clears_after_join():
+    stop = threading.Event()
+    with guards.resource_witness() as w:
+        t = threading.Thread(target=stop.wait, name="unit-leaky-thread",
+                             daemon=True)
+        t.start()
+        with pytest.raises(guards.ResourceLeakError,
+                           match="unit-leaky-thread"):
+            w.assert_no_leaks("thread unit", settle_s=0.2)
+        stop.set()
+        t.join(timeout=5.0)
+    w.assert_no_leaks("thread unit")
+
+
+def test_witness_exempts_deliberate_process_lifetime_threads():
+    stop = threading.Event()
+    t = threading.Thread(target=stop.wait, name="lgbm-tpu-watchdog-unit",
+                         daemon=True)
+    try:
+        w = guards.ResourceWitness()
+        t.start()
+        time.sleep(0.05)
+        assert "threads" not in w.deltas()
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+
+
+def test_witness_counts_fd_growth_and_clears_after_close():
+    if guards._witness_fds() is None:
+        pytest.skip("no /proc/self/fd on this platform")
+    with guards.resource_witness() as w:
+        r, wfd = os.pipe()
+        assert w.deltas().get("fds", 0) >= 2
+        os.close(r)
+        os.close(wfd)
+    w.assert_no_leaks("fd unit")
+
+
+def test_witness_counts_open_trace_sessions():
+    w = guards.ResourceWitness()
+    ctx = spans.trace_session(None, "annotations")
+    ctx.__enter__()
+    try:
+        assert w.deltas().get("sessions") == 1
+    finally:
+        ctx.__exit__(None, None, None)
+    w.assert_no_leaks("session unit")
+
+
+def test_witness_sums_registered_cache_probes():
+    size = [0]
+    probe = lambda: size[0]                      # noqa: E731
+    guards.register_witness_cache_probe(probe)
+    try:
+        w = guards.ResourceWitness()
+        size[0] = 3
+        assert w.deltas().get("jit_cache") == 3
+        size[0] = 0
+        w.assert_no_leaks("cache unit")
+    finally:
+        guards._witness_cache_probes.remove(probe)
+
+
+def test_witness_fixture_is_wired(resource_leak_witness):
+    """The pytest fixture arms the witness around the test body; a
+    balanced scope passes (the assert runs in fixture teardown)."""
+    stop = threading.Event()
+    t = threading.Thread(target=stop.wait, name="fixture-balanced",
+                         daemon=True)
+    t.start()
+    stop.set()
+    t.join(timeout=5.0)

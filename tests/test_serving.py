@@ -452,3 +452,20 @@ def test_cli_probe_reports_ready(tmp_path, capsys):
     assert rc == 0
     health = json.loads(capsys.readouterr().out)
     assert health["ready"] and health["warm_rungs"] == [32, 256]
+
+
+# ------------------------------------------- R012 leak regressions
+def test_close_after_hung_tick_leaves_no_worker_thread(
+        boosters, resource_leak_witness):
+    """Closing the server while a tick is hung must still join the
+    coalescer worker and stop the metrics plane — the runtime complement
+    of tpulint R012's ownership check on PredictionServer."""
+    b1, _, X = boosters
+    srv = b1.serve(tick_ms=1.0, deadline_ms=3000.0)
+    try:
+        with faultinject.inject("hang@coalesce_tick=1:seconds=0.3"):
+            srv.submit(X[:1])
+            time.sleep(0.05)
+    finally:
+        srv.close(drain=False, timeout_s=5.0)
+    assert not srv.health()["worker_alive"]

@@ -1814,3 +1814,177 @@ def test_r011_anchors_used_and_not_stale():
     apply_allowlist(findings, entries)
     unused = [e.render() for e in r011_entries if not e.used]
     assert not unused, f"unused R011 anchors: {unused}"
+
+
+# ====================================================== R012 (resources)
+def r012(findings):
+    return [f for f in findings if f.rule == "R012"]
+
+
+def test_r012_thread_without_join_vs_daemon(tmp_path):
+    """Seed: a named, started thread nobody joins is a finding; the
+    daemon spelling of the same thread is a deliberate non-finding."""
+    findings = lint_snippet(tmp_path, """
+        import threading
+
+        def spawn(work):
+            t = threading.Thread(target=work, name="leak")
+            t.start()
+
+        def background(work):
+            t = threading.Thread(target=work, daemon=True)
+            t.start()
+    """)
+    bad = r012(findings)
+    assert len(bad) == 1, [f.render() for f in bad]
+    assert "never released" in bad[0].message
+    assert bad[0].func == "spawn"
+
+
+def test_r012_open_outside_with_on_exception_edge(tmp_path):
+    """Seed: file opened, a raising call, THEN the try/finally — the
+    PR-10 shape with a plain fd instead of a profiler."""
+    findings = lint_snippet(tmp_path, """
+        def dump(path, payload):
+            fh = open(path, "w")
+            encoded = encode(payload)
+            try:
+                fh.write(encoded)
+            finally:
+                fh.close()
+    """)
+    bad = r012(findings)
+    assert len(bad) == 1, [f.render() for f in bad]
+    assert "can raise and skip the release" in bad[0].message
+
+
+def test_r012_listener_registered_never_unregistered(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        import jax
+
+        def install(on_event):
+            jax.monitoring.register_event_listener(on_event)
+    """)
+    bad = r012(findings)
+    assert len(bad) == 1, [f.render() for f in bad]
+    assert "listener registered" in bad[0].message
+
+
+def test_r012_unbounded_float_keyed_jitted_cache(tmp_path):
+    """Seed: the PR 14 _score_accum_fn bug — lru_cache(maxsize=None)
+    over unannotated/float keys retaining one jitted program per model
+    version forever. The int/bool-annotated twin is clean."""
+    findings = lint_snippet(tmp_path, """
+        import functools
+        import jax
+
+        @functools.lru_cache(maxsize=None)
+        def accum_fn(lo, hi, bins):
+            return jax.jit(lambda x: x * (hi - lo))
+
+        @functools.lru_cache(maxsize=None)
+        def accum_fn_keyed(bins: int, weighted: bool):
+            return jax.jit(lambda x: x)
+
+        @functools.lru_cache(maxsize=32)
+        def accum_fn_bounded(lo, hi):
+            return jax.jit(lambda x: x * (hi - lo))
+    """)
+    bad = r012(findings)
+    assert len(bad) == 1, [f.render() for f in bad]
+    assert bad[0].func == "accum_fn"
+    assert "PR 14" in bad[0].message
+
+
+def test_r012_unbounded_per_version_metric_series(tmp_path):
+    findings = lint_snippet(tmp_path, """
+        _SERIES = {}
+
+        def record(version, value):
+            series = _SERIES.setdefault(version, ScoreHistogram())
+            series.add(value)
+    """)
+    bad = r012(findings)
+    assert len(bad) == 1, [f.render() for f in bad]
+    assert "no statically visible bound" in bad[0].message
+
+
+def test_r012_pruned_program_cache_is_clean(tmp_path):
+    """An eviction call anywhere in the module is the statically visible
+    bound the checker wants."""
+    findings = lint_snippet(tmp_path, """
+        import jax
+
+        _PROGRAM_CACHE = {}
+
+        def program_for(rows):
+            if rows not in _PROGRAM_CACHE:
+                while len(_PROGRAM_CACHE) >= 32:
+                    _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
+                _PROGRAM_CACHE[rows] = jax.jit(lambda x: x)
+            return _PROGRAM_CACHE[rows]
+    """)
+    assert not r012(findings), [f.render() for f in r012(findings)]
+
+
+def test_r012_rung_keyed_series_is_clean(tmp_path):
+    """Keys mapped through a rung/bucket ladder have a bounded domain
+    even without an eviction call."""
+    findings = lint_snippet(tmp_path, """
+        _BY_RUNG = {}
+
+        def window_for(rows):
+            rung = rung_of(rows)
+            if rung not in _BY_RUNG:
+                _BY_RUNG[rung] = LatencyWindow()
+            return _BY_RUNG[rung]
+    """)
+    assert not r012(findings), [f.render() for f in r012(findings)]
+
+
+def test_r012_anchors_used_and_not_stale():
+    """The R012 anchor resolves against the shipped tree and is
+    exercised (the process-lifetime jax.monitoring listener latch)."""
+    entries, errs = load_allowlist(DEFAULT_ALLOWLIST)
+    assert not errs, errs
+    r012_entries = [e for e in entries if e.rule == "R012"]
+    assert 1 <= len(r012_entries) <= 8
+    stale = check_allowlist_staleness(entries, [PKG_DIR],
+                                      DEFAULT_ALLOWLIST)
+    assert not stale, stale
+    findings, errors = lint_paths([PKG_DIR])
+    assert not errors
+    apply_allowlist(findings, entries)
+    unused = [e.render() for e in r012_entries if not e.used]
+    assert not unused, f"unused R012 anchors: {unused}"
+
+
+# ==================================================== knob-drift lint
+def test_knobs_lint_package_is_clean():
+    """Every tpu_* knob in config.PARAMS is read somewhere in the
+    package AND documented in README.md — dead knobs and doc drift are
+    findings (satellite 2)."""
+    from lightgbm_tpu.analysis import knobs
+    problems, found = knobs.check_knobs()
+    assert not problems, problems
+    assert len(found) > 30      # sanity: the parse actually saw PARAMS
+
+
+# ================================================= aggregate all --json
+def test_main_all_json_aggregate_schema(tmp_path, capsys):
+    """`scripts/tpulint all --json` (satellite 3): one parseable object
+    with per-stage exits/findings and a max-exit summary, over the
+    jax-free stage subset."""
+    import json
+    from lightgbm_tpu.analysis.tpulint import main_all
+    rc = main_all(["--json", "--only", "ast,resources,knobs"], PKG_DIR)
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert set(payload) == {"stages", "exit"}
+    assert payload["exit"] == 0
+    assert set(payload["stages"]) == {"ast", "resources", "knobs"}
+    for stage in payload["stages"].values():
+        assert stage["exit"] == 0
+    assert isinstance(payload["stages"]["ast"]["findings"], list)
+    assert isinstance(payload["stages"]["resources"]["findings"], list)
+    assert payload["stages"]["knobs"]["report"]["problems"] == []
