@@ -514,17 +514,69 @@ def _run_layout_sweep(jax, dev, n, f, reps):
             if best_q else None}
 
 
+_CONTRIB_CPU_BASELINE_QPS = 18.0  # single-row pred_contrib on the CPU
+                                  # LightGBM reference (ISSUE 20)
+
+
+def _contrib_qps_row(g, binned_all):
+    """pred_contrib throughput row for BENCH_SHAPES["predict_micro"]:
+    the per-row UNWIND loop kernel (tpu_shap_tables=off) raced against
+    the precomputed-table kernel (tpu_shap_tables=on), both through the
+    real serving entry (predict_contrib_padded). Rows/s is the QPS of
+    row-sized requests, compared against the 18 QPS CPU baseline. A
+    failure emits the structured stub and returns the error row rather
+    than sinking the whole predict stage."""
+    n = int(float(os.environ.get("BENCH_CONTRIB_ROWS", 1000)))
+    req = binned_all[:n]
+    row = {"rows": n, "cpu_baseline_qps": _CONTRIB_CPU_BASELINE_QPS}
+    try:
+        for label, mode in (("loop", "off"), ("tables", "on")):
+            g.config.set({"tpu_shap_tables": mode})
+            g._shap_tables_cache = None
+            fn = (lambda: np.asarray(
+                g.predict_contrib_padded(req)).sum())
+            t1 = time.time()
+            fn()  # warm: table build + compile land here
+            once = time.time() - t1
+            reps = max(1, min(5, int(2.0 / max(once, 1e-9))))
+            t1 = time.time()
+            for _ in range(reps):
+                fn()
+            dt = (time.time() - t1) / reps
+            row[label + "_s"] = round(dt, 4)
+            row[label + "_rows_per_sec"] = round(n / dt, 1)
+            sys.stderr.write(
+                f"[bench-predict] contrib/{label} N={n}: "
+                f"{dt * 1e3:.1f}ms ({n / dt:.0f} rows/s)\n")
+    except Exception as err:  # noqa: BLE001 - keep the predict row
+        row["error"] = f"{type(err).__name__}: {err}"
+        _emit_failure_stub("predict-contrib", err)
+    finally:
+        g.config.set({"tpu_shap_tables": "auto"})
+        g._shap_tables_cache = None
+    if row.get("tables_rows_per_sec") and row.get("loop_rows_per_sec"):
+        row["tables_speedup"] = round(
+            row["tables_rows_per_sec"] / row["loop_rows_per_sec"], 2)
+        row["qps_vs_cpu_baseline"] = round(
+            row["tables_rows_per_sec"] / _CONTRIB_CPU_BASELINE_QPS, 1)
+    return row
+
+
 def run_predict_microbench(print_json=True):
-    """BENCH_PREDICT=1: serving throughput of the depth-batched inference
-    engine vs the pre-change serial tree scan (ops/predict.py), measured
-    end to end at the gbdt serving entry on already-binned requests.
+    """BENCH_PREDICT=1: races every serving engine per shape — the
+    depth-batched walk ("batched"), the pre-change serial tree scan
+    ("scan"), the level-order heap relayout ("level"), and the level
+    engine over int8 quantized leaf slabs ("qleaf") — measured end to
+    end at the gbdt serving entry on already-binned requests.
 
     Sweeps batch sizes {1k, 10k, 100k, 1M} x tree counts {100, 500}
-    (255-leaf trees) and records, per cell, rows/s for both paths plus
-    the compile events each path spent across its whole sweep — the old
-    path compiles one program per (T, N) shape, the bucketed engine one
-    per (row rung, tree bucket). Acceptance (ISSUE 5): >= 5x rows/s at
-    T=500, N=100k on the CPU backend. Results land in
+    (255-leaf trees) and records, per cell, rows/s for every engine
+    plus the compile events each leg spent across its whole sweep — the
+    old path compiles one program per (T, N) shape, the bucketed
+    engines one per (row rung, tree bucket). Acceptance (ISSUE 5):
+    >= 5x rows/s at T=500, N=100k on the CPU backend. A pred_contrib
+    QPS row (UNWIND loop kernel vs precomputed tables, vs the 18 QPS
+    CPU baseline) rides along. Results land in
     BENCH_SHAPES.json["predict_micro"].
 
     Trees are real (trained on a Higgs-like shape); larger tree counts
@@ -584,53 +636,81 @@ def run_predict_microbench(print_json=True):
         dt = (time.time() - t1) / reps
         return dt, n_rows / dt
 
+    # Engine legs raced per shape cell. "batched" is the depth-batched
+    # walk, "scan" the pre-change serial tree loop, "level" the
+    # breadth-first heap relayout, "qleaf" the level engine over int8
+    # quantized leaf slabs (the compiled-forest serving stack). A leg
+    # that dies records a structured per-engine error and the others
+    # keep racing — the row is never silently absent.
+    engine_legs = (
+        ("batched", {"tpu_predict_engine": "batched"}),
+        ("scan", {"tpu_predict_engine": "scan"}),
+        ("level", {"tpu_predict_engine": "level"}),
+        ("qleaf", {"tpu_predict_engine": "level",
+                   "tpu_leaf_quant": "int8"}),
+    )
     cells = {}
-    compile_events = {"scan": 0, "batched": 0}
-    for engine in ("batched", "scan"):
-        g.config.set({"tpu_predict_engine": engine})
-        with guards.compile_counter() as cc:
-            for t_count in tree_sweep:
-                g.models = base_models * (t_count // base_trees)
-                g._device_trees_cache = None
-                skip_rest = False
-                for n in sorted(rows_sweep):
-                    key = f"t{t_count}_n{n}"
-                    cell = cells.setdefault(key, {"trees": t_count,
-                                                  "rows": n})
-                    if skip_rest:
-                        cell[engine + "_s"] = None
-                        continue
-                    req = binned_all[:n]
-                    fn = (lambda: np.asarray(
-                        g.predict_raw_device(req)).sum())
-                    dt, rps = timed(fn, n)
-                    cell[engine + "_s"] = round(dt, 4)
-                    cell[engine + "_rows_per_sec"] = round(rps)
-                    sys.stderr.write(
-                        f"[bench-predict] {engine} T={t_count} N={n}: "
-                        f"{dt * 1e3:.1f}ms ({rps / 1e6:.2f} Mrows/s)\n")
-                    # the serial scan is O(T*L*N); stop a sweep leg that
-                    # would blow the budget and record the gap honestly
-                    if dt * 10 > budget_s:
-                        skip_rest = True
-        compile_events[engine] = cc.lowerings
-    g.config.set({"tpu_predict_engine": "batched"})
+    compile_events = {}
+    engine_errors = {}
+    for engine, overrides in engine_legs:
+        g.config.set(dict({"tpu_leaf_quant": "off"}, **overrides))
+        try:
+            with guards.compile_counter() as cc:
+                for t_count in tree_sweep:
+                    g.models = base_models * (t_count // base_trees)
+                    g._invalidate_device_trees()
+                    skip_rest = False
+                    for n in sorted(rows_sweep):
+                        key = f"t{t_count}_n{n}"
+                        cell = cells.setdefault(key, {"trees": t_count,
+                                                      "rows": n})
+                        if skip_rest:
+                            cell[engine + "_s"] = None
+                            continue
+                        req = binned_all[:n]
+                        fn = (lambda: np.asarray(
+                            g.predict_raw_device(req)).sum())
+                        dt, rps = timed(fn, n)
+                        cell[engine + "_s"] = round(dt, 4)
+                        cell[engine + "_rows_per_sec"] = round(rps)
+                        sys.stderr.write(
+                            f"[bench-predict] {engine} T={t_count} "
+                            f"N={n}: {dt * 1e3:.1f}ms "
+                            f"({rps / 1e6:.2f} Mrows/s)\n")
+                        # the serial scan is O(T*L*N); stop a sweep leg
+                        # that would blow the budget and record the gap
+                        # honestly
+                        if dt * 10 > budget_s:
+                            skip_rest = True
+            compile_events[engine] = cc.lowerings
+        except Exception as err:  # noqa: BLE001 - race the other legs
+            engine_errors[engine] = f"{type(err).__name__}: {err}"
+            _emit_failure_stub(f"predict-{engine}", err)
+    g.config.set({"tpu_predict_engine": "batched",
+                  "tpu_leaf_quant": "off"})
     g.models = base_models
-    g._device_trees_cache = None
+    g._invalidate_device_trees()
 
     for cell in cells.values():
         if cell.get("scan_s") and cell.get("batched_s"):
             cell["speedup"] = round(cell["scan_s"] / cell["batched_s"], 2)
+        for eng in ("level", "qleaf"):
+            if cell.get(eng + "_s") and cell.get("batched_s"):
+                cell[eng + "_vs_batched"] = round(
+                    cell["batched_s"] / cell[eng + "_s"], 3)
     t_top = max(tree_sweep)
     accept = cells.get(f"t{t_top}_n100000", {}).get("speedup")
     sys.stderr.write(
-        f"[bench-predict] compile events: scan={compile_events['scan']} "
-        f"batched={compile_events['batched']}; T={t_top} N=100k "
-        f"speedup={accept}x\n")
+        f"[bench-predict] compile events: "
+        + " ".join(f"{k}={v}" for k, v in compile_events.items())
+        + f"; T={t_top} N=100k speedup={accept}x\n")
+    contrib = _contrib_qps_row(g, binned_all)
     _record_shape("predict_micro", {
         "platform": dev.platform, "leaves": leaves,
         "train_rows": train_rows, "features": feats,
         "cells": cells, "compile_events": compile_events,
+        "engine_errors": engine_errors or None,
+        "contrib": contrib,
         "t500_n100k_speedup": accept,
     })
     if print_json:

@@ -218,6 +218,45 @@ MODE_TEMPLATES: Dict[str, dict] = {
 
 MODES = tuple(MODE_TEMPLATES)
 
+# ---------------------------------------------------------------------------
+# serving-engine contracts (engines/registry.SERVING_ENTRIES): the predict
+# program each serving engine compiles, lowered AOT at a ladder rung
+# (GBDT.aot_lower_serving) instead of comm-captured from a training step.
+# One file per non-exempt serving entry, the entry id in the filename —
+# registry_contract_findings enumerates the coverage exactly like the
+# histogram entries. serve_qleaf is exempt: it shares these two programs'
+# shapes (only the leaf-slab dtype narrows) and is pinned by its RECORDED
+# error bound + tests/test_level_engine.py instead.
+# ---------------------------------------------------------------------------
+_SERVE_BASE = dict(_BASE, tpu_autotune="off", max_depth=5)
+
+SERVING_TEMPLATES: Dict[str, dict] = {
+    "serve_walk": {
+        "description": "serving engine serve_walk: the depth-batched "
+                       "pointer walk (predict_raw_batched) at the "
+                       "smallest ladder rung — per depth step one packed "
+                       "node-record gather + one bin gather, no "
+                       "collectives, no host traffic",
+        "engine": "walk",
+        "params": dict(_SERVE_BASE, tpu_predict_engine="walk"),
+        "program": "predict_raw_batched",
+        "problem": {"n": 509, "f": 8, "seed": 0},
+    },
+    "serve_level": {
+        "description": "serving engine serve_level: the level-order heap "
+                       "relayout (predict_raw_level) at the smallest "
+                       "ladder rung — depth step d reads the contiguous "
+                       "[Tb, 2^d] slab of the complete-binary-heap "
+                       "records, unrolled over the exact tree depth",
+        "engine": "level",
+        "params": dict(_SERVE_BASE, tpu_predict_engine="level"),
+        "program": "predict_raw_level",
+        "problem": {"n": 509, "f": 8, "seed": 0},
+    },
+}
+
+SERVING_MODES = tuple(SERVING_TEMPLATES)
+
 
 def contract_path(mode: str) -> str:
     return os.path.join(CONTRACTS_DIR, f"{mode}.json")
@@ -226,6 +265,30 @@ def contract_path(mode: str) -> str:
 def load_contract(mode: str) -> dict:
     with open(contract_path(mode)) as fh:
         return json.load(fh)
+
+
+# The XLA memory estimate for the same program differs across XLA builds
+# and host layouts (padding/fusion decisions shift estimate_bytes by
+# ~30%), so the drift fingerprint keeps only the *contracted* quantities
+# — the sticky budget and the exact argument/output byte counts — and
+# drops the estimate-derived fields. check_memory still enforces
+# estimate <= budget against the LIVE lowering, so a real regression
+# fails the gate; it just no longer fails tier-1 on a host change.
+_MEM_ESTIMATE_KEYS = ("estimate_bytes", "headroom_bytes")
+
+
+def drift_fingerprint(contract: dict) -> dict:
+    """A copy of ``contract`` with host-dependent memory-estimate fields
+    normalized out, for byte-exact drift comparison."""
+    out = dict(contract)
+    mem = contract.get("memory")
+    if isinstance(mem, dict):
+        out["memory"] = {
+            nd: {k: v for k, v in blk.items()
+                 if k not in _MEM_ESTIMATE_KEYS}
+            if isinstance(blk, dict) else blk
+            for nd, blk in mem.items()}
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -411,28 +474,45 @@ def check_hlo(hlo_text: str, contract: dict) -> List[ContractFinding]:
             + check_memory(hlo_text, contract))
 
 
-def registry_contract_findings(entries=None) -> List[ContractFinding]:
+def registry_contract_findings(entries=None,
+                               serving_entries=None
+                               ) -> List[ContractFinding]:
     """Per-registry-entry contract coverage (engines/registry.py).
 
     Every engine entry must either name contracts — known modes with a
     checked-in file, at least one filename carrying the entry id — or
-    carry a ``contract_exempt`` justification, which is only admissible
-    for TPU-only engines (``requires_tpu``): the CPU contract harness
-    cannot lower Mosaic kernels, everything else MUST be pinned. A new
-    engine cannot land without one or the other (tier-1 runs this via
+    carry a ``contract_exempt`` justification. For histogram entries the
+    exemption is only admissible for TPU-only engines (``requires_tpu``):
+    the CPU contract harness cannot lower Mosaic kernels, everything
+    else MUST be pinned. Serving entries (SERVING_ENTRIES) additionally
+    admit an exemption that names the parity test pinning them (a
+    ``tests/`` path in the justification) — serve_qleaf shares the
+    walk/level program shapes and is pinned by its recorded error bound
+    instead of a third identical contract. A new engine cannot land
+    without one or the other (tier-1 runs this via
     scripts/verify_contracts.py and tests/test_hlo_check.py)."""
     if entries is None:
         from ..engines.registry import ENTRIES as entries
+        if serving_entries is None:
+            from ..engines.registry import \
+                SERVING_ENTRIES as serving_entries
+    serving = tuple(serving_entries or ())
+    known_modes = set(MODE_TEMPLATES) | set(SERVING_TEMPLATES)
     out: List[ContractFinding] = []
-    for entry in entries:
+    for entry in tuple(entries) + serving:
+        is_serving = entry in serving
         if entry.contract_exempt:
-            if not entry.requires_tpu:
+            admissible = entry.requires_tpu or (
+                is_serving and "tests/" in entry.contract_exempt)
+            if not admissible:
                 out.append(ContractFinding(
                     entry.id, "registry",
                     "contract_exempt is only admissible for TPU-only "
                     "engines (the CPU harness cannot lower Mosaic "
-                    "kernels); a CPU-lowerable engine must check in a "
-                    "contract (scripts/verify_contracts.py --update)"))
+                    "kernels) or for serving entries whose exemption "
+                    "names the tests/ parity file pinning them; "
+                    "otherwise check in a contract "
+                    "(scripts/verify_contracts.py --update)"))
             continue
         if not entry.contracts:
             out.append(ContractFinding(
@@ -450,11 +530,12 @@ def registry_contract_findings(entries=None) -> List[ContractFinding]:
                 "the entry id in the filename — per-entry enumeration "
                 "needs the id visible in analysis/contracts/"))
         for mode in entry.contracts:
-            if mode not in MODE_TEMPLATES:
+            if mode not in known_modes:
                 out.append(ContractFinding(
                     entry.id, "registry",
-                    f"contract mode '{mode}' has no MODE_TEMPLATE — "
-                    "the harness cannot regenerate or verify it"))
+                    f"contract mode '{mode}' has no MODE_TEMPLATE or "
+                    "SERVING_TEMPLATE — the harness cannot regenerate "
+                    "or verify it"))
             elif not os.path.exists(contract_path(mode)):
                 out.append(ContractFinding(
                     entry.id, "registry",
@@ -627,6 +708,101 @@ def build_contract(mode: str, captured: Optional[CapturedMode] = None
     return contract
 
 
+def capture_serving(mode: str) -> str:
+    """Train a tiny Booster and AOT-lower ``mode``'s serving-engine
+    predict program at the smallest ladder rung (GBDT.aot_lower_serving
+    — abstract inputs, nothing transferred). Returns the compiled HLO
+    text. CPU-backend only, like :func:`capture_mode`."""
+    import jax
+
+    import lightgbm_tpu as lgb
+
+    t = SERVING_TEMPLATES[mode]
+    platform = jax.devices()[0].platform
+    if platform != "cpu":
+        raise RuntimeError(
+            f"serving contracts are CPU-backend lowerings, but this "
+            f"process's jax backend is '{platform}' — run via "
+            "scripts/tpulint hlo")
+    X, y = _tiny_problem(**t["problem"])
+    bst = lgb.Booster(dict(t["params"]), lgb.Dataset(X, label=y))
+    for _ in range(4):
+        bst.update()
+    return bst._gbdt.aot_lower_serving(t["engine"]).compile().as_text()
+
+
+def build_serving_contract(mode: str, hlo_text: Optional[str] = None
+                           ) -> dict:
+    """Measure a serving engine's program and emit its contract dict.
+
+    Same checking schema as the step-program contracts (collectives
+    inventory — empty: a single-chip serving dispatch must move zero
+    cross-chip bytes — host ops, int-dot accumulators, sticky memory
+    budget); ``stable_fingerprint`` is off because the program is
+    lowered AOT once, not captured across iterations."""
+    t = SERVING_TEMPLATES[mode]
+    hlo_text = hlo_text if hlo_text is not None else capture_serving(mode)
+    acct = collective_bytes(hlo_text)
+    prior: dict = {}
+    if os.path.exists(contract_path(mode)):
+        prior = load_contract(mode)
+    return {
+        "mode": mode,
+        "description": t["description"],
+        "params": t["params"],
+        "engine": t["engine"],
+        "num_devices": 1,
+        "program": t["program"],
+        "collectives": {"allow": [], "require": [], "max_bytes": {}},
+        "forbid_host_ops": True,
+        "int_dot_s32": True,
+        "require_integer_dot": False,
+        "stable_fingerprint": False,
+        "measured": {k: v for k, v in sorted(acct.items())},
+        "memory": {"1": memory.contract_block(
+            hlo_text, prior=prior.get("memory", {}).get("1"))},
+    }
+
+
+def verify_serving_contracts(modes: Sequence[str] = SERVING_MODES,
+                             update: bool = False,
+                             check_drift: bool = True
+                             ) -> List[ContractFinding]:
+    """The serving half of the contract gate: every serving engine's
+    program re-lowered and verified (or re-recorded with ``update``)
+    against ``analysis/contracts/serve_*.json``."""
+    findings: List[ContractFinding] = []
+    for mode in modes:
+        hlo_text = capture_serving(mode)
+        fresh = build_serving_contract(mode, hlo_text)
+        if update:
+            os.makedirs(CONTRACTS_DIR, exist_ok=True)
+            with open(contract_path(mode), "w") as fh:
+                json.dump(fresh, fh, indent=1, sort_keys=True)
+                fh.write("\n")
+        if not os.path.exists(contract_path(mode)):
+            findings.append(ContractFinding(
+                mode, "missing",
+                f"no checked-in contract at {contract_path(mode)} — run "
+                "scripts/verify_contracts.py --update"))
+            continue
+        contract = load_contract(mode)
+        findings += check_hlo(hlo_text, contract)
+        fresh_fp = drift_fingerprint(fresh)
+        contract_fp = drift_fingerprint(contract)
+        if check_drift and not update and fresh_fp != contract_fp:
+            drift = sorted(k for k in set(fresh_fp) | set(contract_fp)
+                           if fresh_fp.get(k) != contract_fp.get(k))
+            findings.append(ContractFinding(
+                mode, "drift",
+                f"regenerated serving contract differs from the "
+                f"checked-in file in {drift} — the engine's program "
+                "shape drifted; if intended, rerun "
+                "scripts/verify_contracts.py --update and review the "
+                "diff"))
+    return findings
+
+
 def verify_contracts(modes: Sequence[str] = MODES, update: bool = False,
                      check_drift: bool = True) -> List[ContractFinding]:
     """The full gate: every registry entry covered, every mode verified,
@@ -650,15 +826,21 @@ def verify_contracts(modes: Sequence[str] = MODES, update: bool = False,
             continue
         contract = load_contract(mode)
         findings += verify_mode(mode, contract, captured)
-        if check_drift and not update and fresh != contract:
-            drift = sorted(k for k in set(fresh) | set(contract)
-                           if fresh.get(k) != contract.get(k))
+        fresh_fp = drift_fingerprint(fresh)
+        contract_fp = drift_fingerprint(contract)
+        if check_drift and not update and fresh_fp != contract_fp:
+            drift = sorted(k for k in set(fresh_fp) | set(contract_fp)
+                           if fresh_fp.get(k) != contract_fp.get(k))
             findings.append(ContractFinding(
                 mode, "drift",
                 f"regenerated contract differs from the checked-in file "
                 f"in {drift} — comm/program shape drifted; if intended, "
                 "rerun scripts/verify_contracts.py --update and review "
                 "the diff"))
+    # the serving-engine programs ride the same gate (their modes are
+    # SERVING_TEMPLATES, captured via aot_lower_serving)
+    findings += verify_serving_contracts(update=update,
+                                         check_drift=check_drift)
     # per-registry-entry coverage AFTER the update loop, so --update can
     # create a new entry's contract file in the same invocation
     findings += registry_contract_findings()

@@ -63,6 +63,23 @@ the caller (ops/fused_split.py module docstring):
     (allowlist-anchored): the trace-time per-call-width dispatch that
     still runs when the registry hands ``"auto"`` through
     (``tpu_autotune=off`` / no cached decision).
+  * serving-engine contract coverage (round 20): every serving
+    ``EngineEntry`` (``id`` starting with ``serve``) must either name an
+    HLO contract id (``contracts=("serve_walk",)`` — verified by
+    hlo_check.verify_serving_contracts against
+    analysis/contracts/<mode>.json) or carry a non-empty
+    ``contract_exempt`` justification that names the pinning test
+    (``tests/...``). An uncovered serving entry ships a compiled
+    program nothing re-verifies — host callbacks or stray collectives
+    in the serving path would land silently.
+  * quantized-leaf scales must ship their recorded bound (round 20):
+    the quantized slab is only safe to serve because
+    ``quantize_leaves`` returns an exact max-score-error bound next to
+    the scale. An unpack that discards the bound
+    (``slab, scale = quantize_leaves(...)`` or a ``_`` third target),
+    or a hand-rolled symmetric int8 scale (``amax / 127``) in a
+    function that never assigns a ``bound``/``err`` value, serves
+    quantized scores with no recorded accuracy contract.
 """
 from __future__ import annotations
 
@@ -140,6 +157,8 @@ class PallasContractRule(Rule):
         for node in ast.walk(module.tree):
             if isinstance(node, ast.Call):
                 out.extend(self._check_call(module, node, func_of))
+                out.extend(self._check_serving_entry(
+                    module, node, func_of))
                 if not _is_registry_module(module):
                     out.extend(self._check_engine_kwargs(
                         module, node, func_of))
@@ -147,13 +166,132 @@ class PallasContractRule(Rule):
                         module, node, func_of))
             elif isinstance(node, ast.Assign):
                 out.extend(self._check_env_assign(module, node, func_of))
+                out.extend(self._check_quant_unpack(module, node, func_of))
         for fn in module.functions.values():
             out.extend(self._check_defaults(module, fn))
+            out.extend(self._check_quant_scale(module, fn))
             if not _is_registry_module(module):
                 out.extend(self._check_engine_chooser(module, fn))
         out.extend(self._check_ring_drain(module))
         out.extend(self._check_nibble_masks(module, func_of))
         return out
+
+    # -- serving-engine contract coverage (round 20) --------------------
+    def _check_serving_entry(self, module, node: ast.Call, func_of
+                             ) -> List[Finding]:
+        """A serving ``EngineEntry`` (id starting with "serve") must name
+        an HLO contract id or carry a contract_exempt justification that
+        points at the pinning test (a ``tests/`` path); otherwise the
+        entry ships a compiled serving program nothing re-verifies."""
+        name = (call_name(node) or "").rsplit(".", 1)[-1]
+        if name != "EngineEntry":
+            return []
+        eid = None
+        for kw in node.keywords:
+            if kw.arg == "id" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                eid = kw.value.value
+        if node.args and eid is None \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            eid = node.args[0].value
+        if not eid or not eid.startswith("serve"):
+            return []
+        contracts_ok = exempt_ok = exempt_present = False
+        for kw in node.keywords:
+            if kw.arg == "contracts":
+                if isinstance(kw.value, (ast.Tuple, ast.List)) \
+                        and kw.value.elts:
+                    contracts_ok = True
+                elif not isinstance(kw.value, (ast.Tuple, ast.List)):
+                    contracts_ok = True     # computed value: trust it
+            elif kw.arg == "contract_exempt":
+                if isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    exempt_present = bool(kw.value.value.strip())
+                    exempt_ok = "tests/" in kw.value.value
+                else:
+                    exempt_present = exempt_ok = True  # computed: trust
+        if contracts_ok or exempt_ok:
+            return []
+        what = ("its contract_exempt justification does not name the "
+                "pinning test (a tests/ path)") if exempt_present else \
+               ("it names no HLO contract id and carries no "
+                "contract_exempt justification")
+        return [self.finding(
+            module, node, func_of(node),
+            f"serving EngineEntry {eid!r}: {what} — every serving "
+            "engine either ships a verified HLO contract "
+            "(analysis/contracts/<mode>.json, checked by "
+            "verify_serving_contracts) or a contract_exempt string "
+            "naming the parity test that pins its output")]
+
+    # -- quantized-leaf recorded bound (round 20) -----------------------
+    def _check_quant_unpack(self, module, node: ast.Assign, func_of
+                            ) -> List[Finding]:
+        """``quantize_leaves`` returns (slab, scale, bound); an unpack
+        that drops or discards the bound serves quantized scores with no
+        recorded accuracy contract."""
+        if not (isinstance(node.value, ast.Call)
+                and (call_name(node.value) or "").rsplit(".", 1)[-1]
+                == "quantize_leaves"):
+            return []
+        if len(node.targets) != 1 \
+                or not isinstance(node.targets[0], ast.Tuple):
+            return []                      # whole-tuple capture: bound kept
+        elts = node.targets[0].elts
+        dropped = len(elts) < 3 or (
+            isinstance(elts[2], ast.Name) and elts[2].id == "_")
+        if not dropped:
+            return []
+        return [self.finding(
+            module, node, func_of(node),
+            "quantize_leaves unpack discards the recorded "
+            "max-score-error bound — the bound is the accuracy contract "
+            "the quantized slab ships (leaf_quant_bound); keep it next "
+            "to the scale instead of serving quantized scores blind")]
+
+    def _check_quant_scale(self, module, fn) -> List[Finding]:
+        """A hand-rolled symmetric int8 leaf scale (an assignment to a
+        ``*scale*`` name whose value divides by 127) in a function that
+        never assigns a ``bound``/``err`` value has no recorded error
+        bound at all — the seed shape quantize_leaves exists to
+        prevent."""
+        site = None
+        records_bound = False
+        for n in fn.own_nodes():
+            if not isinstance(n, ast.Assign):
+                continue
+            names = [t.id for t in n.targets if isinstance(t, ast.Name)]
+            names += [e.id for t in n.targets
+                      if isinstance(t, ast.Tuple)
+                      for e in t.elts if isinstance(e, ast.Name)]
+            if any("bound" in m.lower() or "err" in m.lower()
+                   for m in names):
+                records_bound = True
+            if site is None and any("scale" in m.lower() for m in names) \
+                    and self._divides_by_127(n.value):
+                site = n
+        if site is None or records_bound:
+            return []
+        return [self.finding(
+            module, site, fn.qualname,
+            "symmetric int8 leaf scale computed without a recorded "
+            "error bound: nothing in this function assigns a "
+            "bound/err value, so the quantized slab ships with no "
+            "accuracy contract — use quantize_leaves (slab, scale, "
+            "bound) or record the per-tree worst-case dequantization "
+            "error next to the scale")]
+
+    @staticmethod
+    def _divides_by_127(value: ast.AST) -> bool:
+        for n in ast.walk(value):
+            if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Div) \
+                    and isinstance(n.right, ast.Constant) \
+                    and isinstance(n.right.value, (int, float)) \
+                    and float(n.right.value) == 127.0:
+                return True
+        return False
 
     # -- engine-registry ownership (round 12) ---------------------------
     def _check_engine_kwargs(self, module, node: ast.Call, func_of

@@ -20,6 +20,8 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
+import weakref
 
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -34,10 +36,13 @@ from ..ops.compact import RowLayout, pack_rows, segments_to_leaf_vectors
 from ..ops.grower import (GrowerParams, TreeArrays, depth_rung, grow_tree,
                           leaf_rung)
 from ..ops.grower_compact import grow_tree_compact
-from ..ops.predict import (StackedTrees, bucket_rows, depth_bucket,
+from ..ops.predict import (DEFAULT_LEVEL_DEPTH_CAP, StackedTrees,
+                           bucket_rows, build_level_layout, depth_bucket,
                            early_stop_tbatch, parse_bucket_ladder,
-                           predict_leaf_batched, predict_raw_batched,
-                           predict_raw_scan, route_one_tree, tree_bucket)
+                           predict_leaf_batched, predict_leaf_level,
+                           predict_raw_batched, predict_raw_level,
+                           predict_raw_scan, quantize_leaves,
+                           route_one_tree, tree_bucket)
 from ..parallel.multihost import to_host as _to_host
 from ..ops.renew import renew_leaf_quantile
 from ..utils import log
@@ -45,6 +50,28 @@ from ..utils.rwlock import Mutex
 from .sample_strategy import GOSSStrategy, create_sample_strategy
 
 _EPS = 1e-35
+
+#: boosters whose UNWIND-table cache is live — probed (entry count) by the
+#: resource witness; WeakSet so a dropped booster stops being counted
+_shap_table_boosters: "weakref.WeakSet" = weakref.WeakSet()
+_shap_probe_lock = threading.Lock()
+_shap_probe_registered = False
+
+
+def _register_shap_table_probe(booster) -> None:
+    """R012 hook: the UNWIND-table cache is a keyed retained-data cache,
+    so its live entry count feeds ``guards.resource_witness``'s
+    jit_cache delta (one module-level probe, registered on first use)."""
+    global _shap_probe_registered
+    with _shap_probe_lock:
+        _shap_table_boosters.add(booster)
+        if _shap_probe_registered:
+            return
+        from ..analysis import guards
+        guards.register_witness_cache_probe(
+            lambda: sum(len(getattr(b, "_shap_tables_cache", None) or {})
+                        for b in list(_shap_table_boosters)))
+        _shap_probe_registered = True
 
 
 def _bound_gradients(obj, k_total: int, scores, label, weight):
@@ -1291,6 +1318,40 @@ class GBDT:
             (n_pad, cols), self.train_set.binned.dtype,
             sharding=row_sharding_2d(self.mesh))
         kk = jax.ShapeDtypeStruct((), jnp.int32, sharding=rep)
+        return predict_raw_batched.lower(
+            ab, st_a, nan_abs, cat_abs, kk, num_class=k,
+            depth=depth_bucket(depth), tbatch=tb_cfg,
+            any_cat=self._pred_any_cat, packed=packed)
+
+    def aot_lower_serving(self, engine: str, n_rows: Optional[int] = None):
+        """AOT-lower one serving engine's predict program ("walk" or
+        "level") at a ladder rung with abstract inputs — the
+        serving-contract harness (analysis/hlo_check
+        verify_serving_contracts). Nothing is featurized or
+        transferred; returns the ``jax.stages.Lowered``."""
+        tb_cfg, ladder, _ = self._predict_cfg()
+        nan_a, cat_a = self._pred_route_args()
+        st, t_real, depth, c = self._device_trees_entry(None, 0, tb_cfg)
+        if t_real == 0:
+            raise ValueError("no trees to lower (train first)")
+        rung = int(ladder[0]) if n_rows is None \
+            else bucket_rows(n_rows, ladder)
+        packed = self._pred_pack4
+        f = self.train_set.num_total_features
+        cols = (f + 1) // 2 if packed else f
+        ab = jax.ShapeDtypeStruct((rung, cols), self.train_set.binned.dtype)
+        kk = jax.ShapeDtypeStruct((), jnp.int32)
+        k = self.num_tree_per_iteration
+        if engine == "level":
+            lvt_a, lv_a = self._abstractify(
+                (self._level_state(c, depth), st.leaf_value))
+            return predict_raw_level.lower(
+                ab, lvt_a, lv_a, kk, num_class=k, depth=max(1, depth),
+                tbatch=tb_cfg, any_cat=self._pred_any_cat, packed=packed)
+        if engine != "walk":
+            raise ValueError(f"unknown serving engine {engine!r} "
+                             "(walk|level)")
+        st_a, nan_abs, cat_abs = self._abstractify((st, nan_a, cat_a))
         return predict_raw_batched.lower(
             ab, st_a, nan_abs, cat_abs, kk, num_class=k,
             depth=depth_bucket(depth), tbatch=tb_cfg,
@@ -2780,6 +2841,8 @@ class GBDT:
         self._device_trees_cache = None
         self._shap_paths_cache = None
         self._drift_score_host = None
+        self._serve_engine_memo = None
+        self._shap_tables_cache = None
 
     def _device_trees_batched(self, num_iteration: Optional[int] = None,
                               start_iteration: int = 0, tbatch: int = 16):
@@ -2838,6 +2901,11 @@ class GBDT:
                     c.update(st=st, t_real=t, t_bucket=t_bkt,
                              depth=max(c["depth"],
                                        self._models_max_depth(models[t0:])))
+                    # derived serving slabs (level heap, quantized
+                    # leaves) were built from the pre-append stack —
+                    # drop them; the next serving predict rebuilds
+                    for derived in ("level", "level_depth", "quant"):
+                        c.pop(derived, None)
                 return c["st"], c["t_real"], c["depth"]
             depth = self._models_max_depth(models)
             st = stack_trees(models, max_lv - 1, max_lv, cat_w=cat_w,
@@ -2848,6 +2916,151 @@ class GBDT:
             while len(cache) > self._DTC_SLOTS:
                 cache.pop(next(k for k in cache if k != key))
             return st, t, depth
+
+    def _device_trees_entry(self, num_iteration: Optional[int],
+                            start_iteration: int, tbatch: int):
+        """(st, t_real, depth, cache-slot dict) — the slot carries the
+        derived serving slabs (level heap / quantized leaves) next to
+        the padded stack they were built from."""
+        st, t_real, depth = self._device_trees_batched(
+            num_iteration, start_iteration, tbatch)
+        key = (tbatch, start_iteration,
+               num_iteration if num_iteration is not None
+               and num_iteration > 0 else None)
+        with self._trees_mu:
+            c = (self._device_trees_cache or {}).get(key)
+        return st, t_real, depth, c
+
+    # -- serving engines (ROADMAP 4: level-order relayout + leaf quant) ------
+    def _level_cap(self) -> int:
+        try:
+            cap = int(self.config.get("tpu_level_depth_cap",
+                                      DEFAULT_LEVEL_DEPTH_CAP)
+                      or DEFAULT_LEVEL_DEPTH_CAP)
+        except (TypeError, ValueError):
+            cap = DEFAULT_LEVEL_DEPTH_CAP
+        return max(1, cap)
+
+    def _level_state(self, c: Dict[str, Any], depth: int):
+        """The LevelTrees heap relayout for a device-tree cache slot,
+        built once at stack time per (stack, depth) and cached in the
+        slot (the _device_trees_cache half of the level engine)."""
+        depth = max(1, depth)
+        with self._trees_mu:
+            lv = c.get("level")
+            if lv is not None and c.get("level_depth") == depth:
+                return lv
+        nan_a, cat_a = self._pred_route_args()
+        lv = build_level_layout(c["st"], nan_a, cat_a, depth)
+        with self._trees_mu:
+            c["level"], c["level_depth"] = lv, depth
+        return lv
+
+    def _quant_mode(self) -> Optional[str]:
+        """Validated ``tpu_leaf_quant`` (None = off)."""
+        m = str(self.config.get("tpu_leaf_quant", "off") or "off").lower()
+        if m in ("", "off", "0", "false", "none"):
+            return None
+        if m not in ("int8", "f16"):
+            if not getattr(self, "_warned_leaf_quant", False):
+                log.warning(f"tpu_leaf_quant={m!r} is not one of "
+                            "off|int8|f16; serving f32 leaves")
+                self._warned_leaf_quant = True
+            return None
+        return m
+
+    def _quant_state(self, c: Dict[str, Any], mode: str):
+        """(slab, scale, recorded bound) for a cache slot: the
+        quantized serving leaf values with per-tree scales and the
+        RECORDED max-score-error bound, computed once at stack time and
+        shipped in the slot next to the stack."""
+        with self._trees_mu:
+            q = c.get("quant")
+            if q is not None and q[0] == mode:
+                return q[1], q[2], q[3]
+        k = max(self.num_tree_per_iteration, 1)
+        t_total = c["st"].leaf_value.shape[0]
+        class_ids = jnp.arange(t_total, dtype=jnp.int32) % k
+        slab, scale, bound = quantize_leaves(
+            c["st"].leaf_value, class_ids, mode, num_class=k)
+        q = (mode, slab, scale, float(bound))
+        with self._trees_mu:
+            c["quant"] = q
+        return q[1], q[2], q[3]
+
+    def leaf_quant_bound(self, num_iteration: Optional[int] = None,
+                         start_iteration: int = 0) -> Optional[float]:
+        """The recorded max-score-error bound the quantized model stack
+        ships: an exact upper bound on |quantized raw score - f32 raw
+        score| for ANY row (per-tree worst-case dequantization error,
+        summed per class, maxed over classes). None when
+        ``tpu_leaf_quant`` is off."""
+        mode = self._quant_mode()
+        if mode is None:
+            return None
+        tb = self._predict_cfg()[0]
+        _, t_real, _, c = self._device_trees_entry(
+            num_iteration, start_iteration, tb)
+        if t_real == 0 or c is None:
+            return 0.0
+        return self._quant_state(c, mode)[2]
+
+    def _resolve_serving_engine(self, engine: str, depth: int,
+                                tbatch: int, t_bkt: int,
+                                c: Optional[Dict[str, Any]] = None) -> str:
+        """``walk`` or ``level`` via the registry's serving resolve
+        order (user > env > autotune cache > depth heuristic), memoized
+        per (engine knob, depth, tree bucket, K)."""
+        from ..engines import registry as engreg
+        cap = self._level_cap()
+        k = max(self.num_tree_per_iteration, 1)
+        memo = getattr(self, "_serve_engine_memo", None)
+        if memo is None:
+            memo = self._serve_engine_memo = {}
+        key = (engine, depth, t_bkt, k, cap)
+        hit = memo.get(key)
+        if hit is not None:
+            return hit
+        racer = None
+        if c is not None and engine == "auto":
+            racer = lambda: self._serving_race_runners(c, depth, tbatch)
+        res = engreg.resolve_serving_engine(
+            self.config, depth=depth, level_cap=cap, tree_bucket=t_bkt,
+            num_class=k, quant=self._quant_mode() or "off", racer=racer)
+        memo[key] = res.engine
+        if res.source != "user":
+            log.info(f"serving engine: {res.entry_id} "
+                     f"({res.source}; depth={depth}, cap={cap})")
+        return res.engine
+
+    def _serving_race_runners(self, c: Dict[str, Any], depth: int,
+                              tbatch: int):
+        """(runners dict, rows) for the autotuner's serving race: walk
+        vs level (vs their quantized-slab twins when tpu_leaf_quant is
+        on), each a zero-arg dispatch of the REAL stacked trees over a
+        small rung — timed by engines/autotune.serving_decision_for."""
+        st = c["st"]
+        n = 2048
+        f = self.train_set.num_total_features
+        dev = jnp.zeros((n, f), self.train_set.binned.dtype)
+        nan_a, cat_a = self._pred_route_args()
+        k = max(self.num_tree_per_iteration, 1)
+        kk = np.int32(k)
+        qmode = self._quant_mode()
+        slab, scale = ((self._quant_state(c, qmode)[:2])
+                       if qmode else (st.leaf_value, None))
+        walk_st = st._replace(leaf_value=slab) if qmode else st
+        runners = {"walk": lambda: predict_raw_batched(
+            dev, walk_st, nan_a, cat_a, kk, num_class=k,
+            depth=depth_bucket(depth), tbatch=tbatch,
+            any_cat=self._pred_any_cat, leaf_scale=scale)}
+        if depth <= self._level_cap():
+            lvt = self._level_state(c, depth)
+            runners["level"] = lambda: predict_raw_level(
+                dev, lvt, slab, kk, num_class=k, depth=max(1, depth),
+                tbatch=tbatch, any_cat=self._pred_any_cat,
+                leaf_scale=scale)
+        return runners, n
 
     def _pad_request_to_bucket(self, mat: np.ndarray, rung: int,
                                packed: bool) -> jax.Array:
@@ -2898,16 +3111,36 @@ class GBDT:
         # with early stopping the tree chunk must land on the reference's
         # exact iteration-multiple-of-freq checkpoints
         tbatch = early_stop_tbatch(k, freq, tb_cfg) if use_stop else tb_cfg
-        st, t_real, depth = self._device_trees_batched(
+        st, t_real, depth, c = self._device_trees_entry(
             num_iteration, start_iteration, tbatch)
         if t_real == 0:
             return jnp.zeros((k, n), jnp.float32)
         kwargs = dict(
-            num_class=k, depth=depth_bucket(depth), tbatch=tbatch,
+            num_class=k, tbatch=tbatch,
             early_stop_margin=float(margin) if use_stop else 0.0,
             early_stop_freq=int(freq) if use_stop else 0,
             any_cat=self._pred_any_cat)
         kk = np.int32(k)
+        eng = self._resolve_serving_engine(engine, depth, tbatch,
+                                           st.num_trees, c)
+        qmode = self._quant_mode()
+        slab, scale = ((self._quant_state(c, qmode)[:2])
+                       if qmode else (st.leaf_value, None))
+        if eng == "level":
+            lvt = self._level_state(c, depth)
+
+            def run(dev, packed_flag):
+                return predict_raw_level(
+                    dev, lvt, slab, kk, depth=max(1, depth),
+                    packed=packed_flag, leaf_scale=scale, **kwargs)
+        else:
+            walk_st = st._replace(leaf_value=slab) if qmode else st
+
+            def run(dev, packed_flag):
+                return predict_raw_batched(
+                    dev, walk_st, nan_a, cat_a, kk,
+                    depth=depth_bucket(depth), packed=packed_flag,
+                    leaf_scale=scale, **kwargs)
         if not isinstance(binned, np.ndarray):
             # device-array input (the serving device-featurize path hands
             # an already-rung-padded — possibly nibble-packed — matrix;
@@ -2915,14 +3148,12 @@ class GBDT:
             rung = bucket_rows(n, ladder)
             if rung is not None and rung != n:
                 binned = jnp.pad(binned, ((0, rung - n), (0, 0)))
-            return predict_raw_batched(binned, st, nan_a, cat_a, kk,
-                                       packed=device_packed, **kwargs)
+            return run(binned, device_packed)
         packed = self._pred_pack4
         rung = bucket_rows(n, ladder)
         if rung is not None:
             dev = self._pad_request_to_bucket(binned, rung, packed)
-            return predict_raw_batched(dev, st, nan_a, cat_a, kk,
-                                       packed=packed, **kwargs)
+            return run(dev, packed)
         if self._can_shard_predict(n, ladder):
             from ..parallel.mesh import (mesh_axis_sizes, predict_shard_pad,
                                          row_sharding_2d)
@@ -2933,8 +3164,7 @@ class GBDT:
                 from ..io.dataset import pack4_matrix
                 mat = pack4_matrix(mat)
             dev = jax.device_put(mat, row_sharding_2d(self.mesh))
-            return predict_raw_batched(dev, st, nan_a, cat_a, kk,
-                                       packed=packed, **kwargs)
+            return run(dev, packed)
         raise ValueError(
             f"request of {n} rows overflows the serving ladder "
             f"(max {ladder[-1]}) and cannot be row-sharded here; slice it "
@@ -3135,6 +3365,78 @@ class GBDT:
                 cache.pop(next(k for k in cache if k != key))
             return st, paths, t_real, depth
 
+    def _shap_table_mode(self) -> str:
+        raw = str(self.config.get("tpu_shap_tables", "auto")).strip().lower()
+        if raw in ("auto", "on", "off"):
+            return raw
+        if not getattr(self, "_warned_shap_tables", False):
+            self._warned_shap_tables = True
+            log.warning(f"tpu_shap_tables={raw!r} unknown (auto|on|off); "
+                        "using auto")
+        return "auto"
+
+    def _device_shap_tables_bucketed(self, st, paths, t_real: int,
+                                     depth: int,
+                                     num_iteration: Optional[int],
+                                     start_iteration: int, tbatch: int):
+        """ShapTables at the window's (tree bucket, depth bucket), or
+        None when gated off / over the ``tpu_shap_table_mb`` budget (the
+        loop kernel then serves).
+
+        Built once per (window, model length) at deploy time — never on
+        the serving path — and cached next to the path arrays (bounded
+        by the same ``_SHAP_SLOTS``; the negative decision is cached too
+        so the budget check costs one host sync total). Same
+        invalidation as every device-tree cache
+        (``_invalidate_device_trees``). The build (one host sync for
+        mask_bits + the jitted table construction) runs OUTSIDE
+        ``_trees_mu`` — concurrent first builders race benignly (same
+        inputs, last writer wins), and an invalidation mid-build drops
+        the store instead of resurrecting a stale cache."""
+        from ..ops.treeshap_device import build_shap_tables, shap_table_bytes
+        mode = self._shap_table_mode()
+        if mode == "off" or t_real == 0:
+            return None
+        d_bkt = depth_bucket(depth)
+        key = (tbatch, start_iteration,
+               num_iteration if num_iteration is not None
+               and num_iteration > 0 else None)
+        with self._trees_mu:
+            cache = getattr(self, "_shap_tables_cache", None)
+            if cache is None:
+                cache = self._shap_tables_cache = {}
+                _register_shap_table_probe(self)
+            c = cache.get(key)
+            if c is not None and c["t_real"] == t_real \
+                    and c["d_bkt"] == d_bkt and c["mode"] == mode:
+                return c["tables"]
+        mask_bits = int(jax.device_get(jnp.max(paths.ulen)))
+        budget_mb = max(int(self.config.get("tpu_shap_table_mb", 64)), 0)
+        need = shap_table_bytes(st.num_trees, st.leaf_value.shape[1],
+                                mask_bits, d_bkt)
+        if need > budget_mb << 20:
+            if mode == "on":
+                raise ValueError(
+                    f"tpu_shap_tables=on but the UNWIND tables need "
+                    f"{need / 2**20:.1f} MiB "
+                    f"(> tpu_shap_table_mb={budget_mb}); raise the "
+                    "budget or use tpu_shap_tables=auto")
+            log.info(f"shap tables skipped: {need / 2**20:.1f} MiB over "
+                     f"the {budget_mb} MiB budget (loop kernel serves "
+                     "pred_contrib)")
+            tables = None
+        else:
+            tables = build_shap_tables(paths, st.leaf_value,
+                                       mask_bits=mask_bits, depth=d_bkt)
+        with self._trees_mu:
+            cache = getattr(self, "_shap_tables_cache", None)
+            if cache is not None:
+                cache[key] = {"tables": tables, "t_real": t_real,
+                              "d_bkt": d_bkt, "mode": mode}
+                while len(cache) > self._SHAP_SLOTS:
+                    cache.pop(next(k for k in cache if k != key))
+        return tables
+
     def _serving_rung(self, n: int) -> int:
         """Bucket rung for one serving batch, or a structural error when
         the request overflows the ladder — THE one bounds check shared
@@ -3166,7 +3468,7 @@ class GBDT:
         predict_serving — the ``pred_contrib`` serving endpoint's one
         device dispatch. Matches ops/treeshap.py's numpy reference
         within f32 tolerance and sums to the raw score per row."""
-        from ..ops.treeshap_device import shap_batched
+        from ..ops.treeshap_device import shap_batched, shap_batched_tables
         k = self.num_tree_per_iteration
         tb_cfg, _, _ = self._predict_cfg()
         f = self.train_set.num_total_features
@@ -3174,12 +3476,21 @@ class GBDT:
             num_iteration, start_iteration, tb_cfg)
         if t_real == 0:
             return np.zeros((binned.shape[0], k * (f + 1)), np.float32)
+        tables = self._device_shap_tables_bucketed(
+            st, paths, t_real, depth, num_iteration, start_iteration,
+            tb_cfg)
         dev, packed = self._serving_device_request(binned, device_packed)
         nan_a, cat_a = self._pred_route_args()
-        out = shap_batched(dev, st, paths, nan_a, cat_a, np.int32(k),
-                           num_class=k, depth=depth_bucket(depth),
-                           tbatch=tb_cfg, any_cat=self._pred_any_cat,
-                           packed=packed, num_features=f)
+        if tables is not None:
+            out = shap_batched_tables(
+                dev, st, tables, nan_a, cat_a, np.int32(k), num_class=k,
+                depth=depth_bucket(depth), tbatch=tb_cfg,
+                any_cat=self._pred_any_cat, packed=packed, num_features=f)
+        else:
+            out = shap_batched(dev, st, paths, nan_a, cat_a, np.int32(k),
+                               num_class=k, depth=depth_bucket(depth),
+                               tbatch=tb_cfg, any_cat=self._pred_any_cat,
+                               packed=packed, num_features=f)
         arr = np.asarray(out)                     # [K, rung, F+1]
         return arr.transpose(1, 0, 2).reshape(arr.shape[1], -1)
 
@@ -3192,16 +3503,23 @@ class GBDT:
         The walk already computes the final node ids for every predict;
         this returns them rung-padded so per-request slicing stays on
         the host (the coalescer's zero-recompile contract)."""
-        tb, _, _ = self._predict_cfg()
-        st, t_real, depth = self._device_trees_batched(
+        tb, _, engine = self._predict_cfg()
+        st, t_real, depth, c = self._device_trees_entry(
             num_iteration, start_iteration, tb)
         if t_real == 0:
             return np.zeros((binned.shape[0], 0), np.int32)
         dev, packed = self._serving_device_request(binned, device_packed)
         nan_a, cat_a = self._pred_route_args()
-        lv = predict_leaf_batched(
-            dev, st, nan_a, cat_a, depth=depth_bucket(depth), tbatch=tb,
-            any_cat=self._pred_any_cat, packed=packed)
+        eng = self._resolve_serving_engine(engine, depth, tb,
+                                           st.num_trees, c)
+        if eng == "level":
+            lv = predict_leaf_level(
+                dev, self._level_state(c, depth), depth=max(1, depth),
+                tbatch=tb, any_cat=self._pred_any_cat, packed=packed)
+        else:
+            lv = predict_leaf_batched(
+                dev, st, nan_a, cat_a, depth=depth_bucket(depth),
+                tbatch=tb, any_cat=self._pred_any_cat, packed=packed)
         return np.asarray(lv)[:t_real].T          # [rung, t_real]
 
     def predict_raw_matrix(self, arr: np.ndarray,
@@ -3246,20 +3564,28 @@ class GBDT:
                                                 start_iteration)
             return np.asarray(predict_leaf_index(
                 jnp.asarray(binned), trees, nan_a, cat_a)).T
-        st, t_real, depth = self._device_trees_batched(
+        st, t_real, depth, c = self._device_trees_entry(
             num_iteration, start_iteration, tb)
         if t_real == 0 or n == 0:
             return np.zeros((n, t_real), np.int32)
         packed = self._pred_pack4
+        eng = self._resolve_serving_engine(engine, depth, tb,
+                                           st.num_trees, c)
         top = ladder[-1]
         parts = []
         for a in range(0, n, top):
             sl = binned[a:a + top]
             rung = bucket_rows(sl.shape[0], ladder)
             dev = self._pad_request_to_bucket(sl, rung, packed)
-            lv = predict_leaf_batched(
-                dev, st, nan_a, cat_a, depth=depth_bucket(depth),
-                tbatch=tb, any_cat=self._pred_any_cat, packed=packed)
+            if eng == "level":
+                lv = predict_leaf_level(
+                    dev, self._level_state(c, depth),
+                    depth=max(1, depth), tbatch=tb,
+                    any_cat=self._pred_any_cat, packed=packed)
+            else:
+                lv = predict_leaf_batched(
+                    dev, st, nan_a, cat_a, depth=depth_bucket(depth),
+                    tbatch=tb, any_cat=self._pred_any_cat, packed=packed)
             parts.append(np.asarray(lv)[:t_real, :sl.shape[0]])
         return np.concatenate(parts, axis=1).T
 

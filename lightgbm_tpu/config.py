@@ -285,9 +285,22 @@ PARAMS: Dict[str, Tuple[Any, type, Tuple[str, ...]]] = {
     # geometric rung ("auto" = x2 from 1k to 1M) and the jitted predict
     # program is keyed on (row rung, tree bucket, depth bucket, num_class)
     "tpu_predict_buckets": ("auto", str, ("predict_buckets",)),
-    # escape hatch: "scan" restores the pre-engine serial tree scan
+    # serving-engine selector (engines/registry.py serving entries):
+    # "batched"/"walk" = the depth-batched pointer walk, "level" = the
+    # level-order heap relayout (contiguous per-depth slabs; falls back
+    # to the walk past tpu_level_depth_cap), "auto" = registry resolve
+    # order (user > env LGBM_TPU_PREDICT_ENGINE > autotune cache >
+    # depth heuristic), "scan" = the pre-engine serial tree scan
     # (recompiles per batch shape; parity/bench reference)
     "tpu_predict_engine": ("batched", str, ()),
+    # level-engine heap depth cap: per-level slab memory is O(2^D) per
+    # tree, so buckets deeper than this keep the pointer walk
+    "tpu_level_depth_cap": (10, int, ()),
+    # opt-in serving leaf-value quantization ("off" | "int8" | "f16"):
+    # narrower leaf slabs for the score gather, with a RECORDED
+    # max-score-error bound shipped in the model stack
+    # (GBDT.leaf_quant_bound); pred_leaf/pred_contrib stay exact f32
+    "tpu_leaf_quant": ("off", str, ()),
     # 4-bit nibble packing of served request matrices when every feature
     # has <= 16 bins (io/dataset.py pack4_matrix; halves request HBM)
     "tpu_bin_pack4": (False, bool, ("bin_pack4",)),
@@ -322,6 +335,20 @@ PARAMS: Dict[str, Tuple[Any, type, Tuple[str, ...]]] = {
     # opt-in; submitting to an unlisted endpoint raises structurally
     # (serving it cold would compile in the request path)
     "tpu_serve_endpoints": ("predict", str, ("serve_endpoints",)),
+    # background-tier coalescer lanes: a comma list of request kinds
+    # (e.g. "contrib") whose batches only cut when NO foreground
+    # (predict/leaf) rows are queued — explanation throughput must not
+    # touch predict p99. "" (default) keeps every kind foreground FIFO.
+    "tpu_serve_background_kinds": ("", str, ("serve_background_kinds",)),
+    # precomputed TreeSHAP UNWIND tables (ops/treeshap_device.py):
+    # "auto" (default) builds the per-leaf mask tables at deploy time
+    # when they fit tpu_shap_table_mb and collapses the per-row kernel
+    # to agreement-bits + table lookups; "off" keeps the EXTEND/UNWIND
+    # loops; "on" forces tables (errors when over budget)
+    "tpu_shap_tables": ("auto", str, ()),
+    # HBM budget (MiB) for the deploy-time UNWIND table cache — the
+    # R012 bound the witness cache probe reports against
+    "tpu_shap_table_mb": (64, int, ()),
     # serving drift monitors (obs/drift.py): every served batch's binned
     # matrix folds into a device-resident [F, B] bin-occupancy
     # accumulator (plus a fixed-edge histogram of raw margins) with pure

@@ -336,3 +336,71 @@ def decision_for(cfg, shape: registry.DatasetShape, platform: str,
                     f"{path} ({err}); this run still uses the measured "
                     "winner, the next run will re-bench")
     return winner, True
+
+
+def serving_decision_for(cfg, sclass: str, platform: Optional[str] = None,
+                         runners_provider=None, allow_sweep: bool = True
+                         ) -> Tuple[Optional[Dict[str, Any]], bool]:
+    """The autotuner's serving half (registry.resolve_serving_engine's
+    ``auto`` rung): ``(winner or None, raced_now)``.
+
+    ``runners_provider()`` returns ``({engine_id: zero-arg dispatch},
+    rows)`` — each dispatch runs the REAL stacked trees over a small
+    rung (gbdt._serving_race_runners), so the race measures the actual
+    serving programs, not a synthetic proxy. Decisions persist to the
+    same atomic autotune cache under the ``serve-*`` shape class; the
+    arming rules mirror :func:`decision_for` (explicit ``tpu_autotune``
+    arms everywhere, TPU platforms arm implicitly, multi-process never
+    races locally)."""
+    global SWEEPS_RUN
+    mode = resolve_mode(cfg)
+    if mode == "off":
+        return None, False
+    platform = platform or registry.current_platform()
+    armed = registry._explicit(cfg, "tpu_autotune") \
+        or platform in registry.TPU_PLATFORMS
+    if not armed:
+        return None, False
+    path = cache_path(cfg)
+    key = cache_key(platform, sclass)
+    cached = load_cache(path).get("entries", {}).get(key)
+    if cached is not None and mode != "always":
+        return cached.get("winner"), False
+    if not allow_sweep or runners_provider is None or _multiproc():
+        return (cached or {}).get("winner"), False
+    runners, rows = runners_provider()
+    if not runners:
+        return None, False
+    from ..analysis.guards import compile_phase
+    from ..obs.spans import span
+    SWEEPS_RUN += 1
+    table: List[Dict[str, Any]] = []
+    with span("autotune"), compile_phase("autotune"):
+        for eng, fn in runners.items():
+            row: Dict[str, Any] = {"candidate": f"serve_{eng}",
+                                   "serve_engine": eng}
+            try:
+                dt = _time_candidate(fn)
+            except Exception as err:  # noqa: BLE001 - record, move on
+                row["error"] = str(err).splitlines()[0][:200]
+                table.append(row)
+                continue
+            row["ms"] = round(dt * 1e3, 4)
+            row["rows_per_sec"] = round(rows / max(dt, 1e-12))
+            table.append(row)
+    timed = [r for r in table if "ms" in r]
+    if not timed:
+        log.warning("tpu_autotune: every serving-engine candidate "
+                    "failed; keeping the depth heuristic")
+        return None, True
+    best = min(timed, key=lambda r: r["ms"])
+    winner = {"serve_engine": best["serve_engine"]}
+    block = decision_block(winner, table, platform, sclass, rows,
+                           SWEEP_REPS)
+    try:
+        store_decision(path, key, block)
+    except OSError as err:
+        log.warning(f"tpu_autotune: cannot persist the serving "
+                    f"decision to {path} ({err}); this run still uses "
+                    "the measured winner, the next run will re-race")
+    return winner, True

@@ -135,6 +135,40 @@ ENTRIES: Tuple[EngineEntry, ...] = (
 )
 
 
+#: serving-only inference engines (ROADMAP 4): same registry contract
+#: as the histogram entries — an HLO contract id in the filename or a
+#: justified exemption (tpulint R004 enforces it), selected through the
+#: same resolve order by :func:`resolve_serving_engine`.
+SERVING_ENTRIES: Tuple[EngineEntry, ...] = (
+    EngineEntry(
+        "serve_walk", "walk", "lane", False,
+        "depth-batched pointer walk (ops/predict.py "
+        "predict_raw_batched): one packed node-record gather over "
+        "[Tb, L-1] per depth step",
+        contracts=("serve_walk",), sweepable=True),
+    EngineEntry(
+        "serve_level", "level", "lane", False,
+        "level-order heap relayout (predict_raw_level): depth step d "
+        "reads the contiguous [Tb, 2^d] per-level slab; buckets deeper "
+        "than tpu_level_depth_cap keep the walk",
+        contracts=("serve_level",), sweepable=True),
+    EngineEntry(
+        "serve_qleaf", "qleaf", "lane", False,
+        "quantized leaf slab (tpu_leaf_quant=int8|f16) over the "
+        "resolved walk/level router: narrow leaf gather + per-tree "
+        "dequant scale, with a recorded max-score-error bound",
+        contract_exempt="shares the serve_walk/serve_level step "
+                        "program shape (only the leaf-slab dtype "
+                        "narrows); score deviation is pinned by the "
+                        "RECORDED bound and "
+                        "tests/test_level_engine.py",
+        sweepable=True),
+)
+
+#: tpu_predict_engine spellings the serving resolver accepts
+SERVING_ENGINE_VALUES = ("batched", "walk", "level", "scan", "auto")
+
+
 class Candidate(NamedTuple):
     """One autotune sweep cell: an engine entry at a batched-M depth."""
     entry: EngineEntry
@@ -557,3 +591,114 @@ def resolve(cfg, shape: Optional[DatasetShape] = None,
             f"(layout={layout}, mbatch={mbatch}, impl={impl}; "
             f"{'measured now' if swept else 'autotune cache'})")
     return res
+
+
+# ---------------------------------------------------------------------------
+# serving-engine resolution (ROADMAP 4)
+# ---------------------------------------------------------------------------
+class ServingResolution(NamedTuple):
+    """The registry's serving answer: which per-row router runs.
+
+    ``engine`` is the resolved router (``walk`` | ``level``);
+    ``entry_id`` the registry entry it maps to (``serve_qleaf`` when a
+    quantized leaf slab rides the router); ``source`` the resolve-order
+    rung that produced it (user / env / autotune / default).
+    """
+    engine: str
+    entry_id: str
+    source: str
+    shape_class: Optional[str] = None
+    decision: Optional[Dict[str, Any]] = None
+
+
+def serving_shape_class(tree_bucket: int, depth: int, num_class: int,
+                        quant: str = "off") -> str:
+    """Autotune cache key for one serving shape: tree bucket + depth +
+    class count (+ quant mode), the jit-key axes a frozen model's
+    serving programs are compiled on. Distinct from the training shape
+    classes by the ``serve-`` prefix."""
+    tag = "" if quant in ("", "off", None) else f"-q{quant}"
+    return f"serve-t{int(tree_bucket)}-d{int(depth)}-k{int(num_class)}{tag}"
+
+
+def _serving_entry_id(engine: str, quant: str) -> str:
+    if quant not in ("", "off", None):
+        return "serve_qleaf"
+    return f"serve_{engine}"
+
+
+def resolve_serving_engine(cfg, depth: int, level_cap: int,
+                           tree_bucket: int = 0, num_class: int = 1,
+                           quant: str = "off",
+                           platform: Optional[str] = None,
+                           racer=None) -> ServingResolution:
+    """Resolve ``tpu_predict_engine`` to a serving router.
+
+    The same per-knob order as :func:`resolve`::
+
+        user explicit > env LGBM_TPU_PREDICT_ENGINE > autotune cache
+        > heuristic default
+
+    ``level`` demotes to ``walk`` (with a warning) when the stack is
+    deeper than ``level_cap`` — the per-level slab is O(2^depth) per
+    tree, so deep/ragged buckets keep the walk. ``auto`` consults the
+    autotune cache (shape class :func:`serving_shape_class`) and, when
+    armed with a ``racer``, times the candidate engines on the real
+    stacked trees (engines/autotune.serving_decision_for); unarmed it
+    falls to the depth heuristic. ``scan`` never reaches here (callers
+    branch to the reference path first).
+    """
+    platform = platform or current_platform()
+    sclass = serving_shape_class(tree_bucket, depth, num_class, quant)
+
+    def norm(value: str, source: str) -> Optional[ServingResolution]:
+        if value in ("batched", "walk"):
+            return ServingResolution("walk", _serving_entry_id(
+                "walk", quant), source, sclass)
+        if value == "level":
+            if depth > level_cap:
+                log.warning(
+                    f"tpu_predict_engine=level: stacked depth {depth} "
+                    f"exceeds tpu_level_depth_cap={level_cap}; the "
+                    "bucket keeps the pointer walk")
+                return ServingResolution("walk", _serving_entry_id(
+                    "walk", quant), source, sclass)
+            return ServingResolution("level", _serving_entry_id(
+                "level", quant), source, sclass)
+        if value not in ("", "auto"):
+            log.warning(f"tpu_predict_engine={value!r} is not one of "
+                        f"{'|'.join(SERVING_ENGINE_VALUES)}; using the "
+                        "depth-batched walk")
+            return ServingResolution("walk", _serving_entry_id(
+                "walk", quant), source, sclass)
+        return None
+
+    raw = str(_get(cfg, "tpu_predict_engine", "batched")
+              or "batched").lower()
+    if _explicit(cfg, "tpu_predict_engine"):
+        res = norm(raw, "user")
+        if res is not None:
+            return res
+    env = os.environ.get("LGBM_TPU_PREDICT_ENGINE", "").strip().lower()
+    if env:
+        res = norm(env, "env")
+        if res is not None:
+            return res
+    if raw != "auto":
+        # unset knob keeps its heuristic default spelling ("batched")
+        res = norm(raw, "default")
+        if res is not None:
+            return res
+    # auto: measured decision when armed, depth heuristic otherwise
+    from . import autotune
+    decision, _swept = autotune.serving_decision_for(
+        cfg, sclass, platform, runners_provider=racer)
+    eng = (decision or {}).get("serve_engine")
+    if eng in ("walk", "level"):
+        if eng == "level" and depth > level_cap:
+            eng = "walk"
+        return ServingResolution(eng, _serving_entry_id(eng, quant),
+                                 "autotune", sclass, decision)
+    eng = "level" if depth <= level_cap else "walk"
+    return ServingResolution(eng, _serving_entry_id(eng, quant),
+                             "default", sclass)

@@ -27,6 +27,20 @@ Three stacked designs live here:
     num_class) and steady-state serving hits a warm jit cache: mixed
     request sizes compile once per rung, then never again.
 
+A fourth, serving-only design (ROADMAP item 4) is the LEVEL-ORDER
+engine (``build_level_layout`` / ``predict_raw_level``): at stack time
+each tree is re-numbered breadth-first into a complete-binary-tree heap
+so depth step ``d`` reads the contiguous ``[Tb, 2^d]`` per-level slab
+``heap[:, 2^d-1 : 2^(d+1)-1]`` instead of gathering from the scattered
+``[Tb, L-1]`` node array; rows carry their in-level position and move
+``p -> 2p + (1 - go_left)``. Slots under an already-reached leaf hold a
+pass-through record (threshold ``INT32_MAX`` routes every row left), so
+the final position at the padded depth maps through a per-tree
+``slot_leaf`` table to the exact leaf the walk lands on — bit identity
+by construction. Deep/ragged buckets (max depth over the heap cap)
+keep the walk. Leaf-value slabs may be int8/f16-quantized for serving
+(``quantize_leaves``) with a recorded max-score-error bound.
+
 All rows move in lockstep; there is no data-dependent control flow, so
 prediction compiles to one XLA program with zero host syncs.
 """
@@ -426,6 +440,7 @@ def predict_raw_batched(
     any_cat: bool = False,
     packed: bool = False,
     col_of: Optional[jax.Array] = None,
+    leaf_scale: Optional[jax.Array] = None,   # [T] f32 for int8 leaf slabs
 ) -> jax.Array:
     """Raw scores [num_class, N] via the tree-batched depth walk.
 
@@ -444,16 +459,19 @@ def predict_raw_batched(
 
     rec = _pack_node_records(trees, nan_bin_arr, is_cat_arr, col_of)
     class_ids = (jnp.arange(t_total, dtype=jnp.int32) % k_it)
+    scale = (jnp.ones((t_total,), jnp.float32)
+             if leaf_scale is None else leaf_scale)
     xs = (_chunked(rec, chunks), _chunked(trees.cat_bitset, chunks),
           _chunked(trees.num_nodes, chunks),
-          _chunked(trees.leaf_value, chunks), _chunked(class_ids, chunks))
+          _chunked(trees.leaf_value, chunks), _chunked(scale, chunks),
+          _chunked(class_ids, chunks))
 
     def chunk_step(carry, x):
         scores, done, t_idx = carry
-        rec_b, cat_b, nn_b, lv_b, cid_b = x
+        rec_b, cat_b, nn_b, lv_b, sc_b, cid_b = x
         leaf = _walk_chunk(binned, rec_b, cat_b, nn_b, depth, any_cat,
                            packed)
-        add = jnp.take_along_axis(lv_b, leaf, axis=1)             # [Tb, N]
+        add = _leaf_add(lv_b, leaf, sc_b)                         # [Tb, N]
         if use_stop:
             add = jnp.where(done[None, :], 0.0, add)
         if num_class == 1:
@@ -505,3 +523,283 @@ def predict_leaf_batched(
 
     _, leaves = lax.scan(chunk_step, 0, xs)
     return leaves.reshape(t_total, binned.shape[0])
+
+
+# ---------------------------------------------------------------------------
+# serving engine: level-order (breadth-first heap) relayout
+# ---------------------------------------------------------------------------
+
+#: heap depth cap for the level engine: slab memory is O(2^D) per tree,
+#: so ragged/deep buckets beyond this keep the pointer walk
+#: (tpu_level_depth_cap overrides).
+DEFAULT_LEVEL_DEPTH_CAP = 10
+
+#: pass-through record for heap slots below an already-reached leaf:
+#: threshold INT32_MAX makes ``fcol <= bin`` true for every row, so dead
+#: slots deterministically route LEFT and the final position stays
+#: ``p * 2^(D-d)`` — exactly the slot the leaf table was scattered to.
+_PASS_BIN = 2**31 - 1
+
+
+class LevelTrees(NamedTuple):
+    """Breadth-first complete-binary-heap relayout of a tree stack.
+
+    ``rec`` holds the same 7-lane packed node record as the walk, but
+    indexed by heap position ``(2^d - 1) + p`` instead of creation
+    order: depth step ``d`` reads the contiguous ``[T, 2^d]`` slab
+    ``rec[:, 2^d-1 : 2^(d+1)-1]``. ``slot_leaf`` maps the final
+    position at the padded depth back to the creation-order leaf id, so
+    leaf values (and pred_leaf output) stay bit-identical to the walk.
+    """
+    rec: jax.Array        # [T, 2^D - 1, 7] i32 heap node records
+    cat_bitset: jax.Array  # [T, 2^D - 1, W] u32 heap cat bitsets
+    slot_leaf: jax.Array  # [T, 2^D] i32: final slot -> leaf id
+
+    @property
+    def depth(self) -> int:
+        return int(self.slot_leaf.shape[1]).bit_length() - 1
+
+
+@functools.partial(jax.jit, static_argnames=("depth",))
+def build_level_layout(
+    trees: StackedTrees,
+    nan_bin_arr: jax.Array,
+    is_cat_arr: jax.Array,
+    depth: int,
+    col_of: Optional[jax.Array] = None,
+) -> LevelTrees:
+    """Re-number a tree stack breadth-first into per-depth heap slabs.
+
+    Children always carry a larger creation-order id than their parent
+    (grower invariant — the same one ``route_one_tree`` sweeps on), so
+    one in-order pass over nodes propagates (level, in-level position)
+    from the root: node ``k`` at ``(d, p)`` puts its left child at
+    ``(d+1, 2p)`` and its right child at ``(d+1, 2p+1)``. A leaf child
+    reached at ``(d, p)`` owns the final slot ``p << (D - d)`` (dead
+    slots below it all route left). Runs on device at stack time; the
+    caller gates on the stack's max depth <= ``depth`` (deeper buckets
+    keep the walk, so the clip guards below never fire for used
+    layouts).
+    """
+    rec = _pack_node_records(trees, nan_bin_arr, is_cat_arr, col_of)
+    t_total, lm1 = rec.shape[0], rec.shape[1]
+    heap_n = (1 << depth) - 1
+    t_idx = jnp.arange(t_total, dtype=jnp.int32)
+
+    # (level, position) per creation-order node; -1 level = not present
+    lvl0 = jnp.full((t_total, lm1), -1, jnp.int32)
+    lvl0 = lvl0.at[:, 0].set(jnp.where(trees.num_nodes > 0, 0, -1))
+    pos0 = jnp.zeros((t_total, lm1), jnp.int32)
+    slot0 = jnp.zeros((t_total, 1 << depth), jnp.int32)
+
+    def body(k, st):
+        lvl, pos, slot_leaf = st
+        plvl, ppos = lvl[:, k], pos[:, k]
+        live = plvl >= 0
+        clvl = plvl + 1
+        for child, cpos in ((trees.left_child[:, k], 2 * ppos),
+                            (trees.right_child[:, k], 2 * ppos + 1)):
+            is_int = live & (child >= 0)
+            safe_c = jnp.clip(child, 0, lm1 - 1)
+            lvl = lvl.at[t_idx, safe_c].set(
+                jnp.where(is_int, clvl, lvl[t_idx, safe_c]))
+            pos = pos.at[t_idx, safe_c].set(
+                jnp.where(is_int, cpos, pos[t_idx, safe_c]))
+            is_leaf = live & (child < 0) & (clvl <= depth)
+            fslot = jnp.clip(cpos << jnp.maximum(depth - clvl, 0),
+                             0, (1 << depth) - 1)
+            slot_leaf = slot_leaf.at[t_idx, fslot].set(
+                jnp.where(is_leaf, -(child + 1),
+                          slot_leaf[t_idx, fslot]))
+        return lvl, pos, slot_leaf
+
+    lvl, pos, slot_leaf = lax.fori_loop(0, lm1, body, (lvl0, pos0, slot0))
+
+    # scatter creation-order records into heap order (+1 dump row for
+    # absent/overflow nodes)
+    valid = (lvl >= 0) & (lvl < depth)
+    hidx = jnp.where(valid, (1 << jnp.maximum(lvl, 0)) - 1 + pos, heap_n)
+    fill = jnp.array([0, _PASS_BIN, 0, 0, 0, -1, 0], jnp.int32)
+    heap = jnp.broadcast_to(fill, (t_total, heap_n + 1, 7))
+    heap = heap.at[t_idx[:, None], hidx].set(rec)[:, :heap_n]
+    w = trees.cat_bitset.shape[-1]
+    cat_h = jnp.zeros((t_total, heap_n + 1, w), jnp.uint32)
+    cat_h = cat_h.at[t_idx[:, None], hidx].set(trees.cat_bitset)[:, :heap_n]
+    return LevelTrees(rec=heap, cat_bitset=cat_h, slot_leaf=slot_leaf)
+
+
+def _level_chunk(binned, rec_h, cat_h, depth: int, any_cat: bool,
+                 packed: bool) -> jax.Array:
+    """Final heap position [Tb, N] for one chunk of Tb trees.
+
+    The depth loop is unrolled (depth <= the heap cap), so the per-level
+    slab slice is STATIC: step ``d`` reads ``rec_h[:, 2^d-1:2^(d+1)-1]``
+    — a contiguous [Tb, 2^d, 7] window — and the position gather stays
+    inside it. Same routing predicate as ``_walk_chunk`` bit-for-bit.
+    """
+    tb, n = rec_h.shape[0], binned.shape[0]
+    pos = jnp.zeros((tb, n), jnp.int32)
+    rows = jnp.arange(n, dtype=jnp.int32)[None, :]
+    for d in range(depth):
+        base = (1 << d) - 1
+        slab = rec_h[:, base:base + (1 << d)]                     # [Tb, 2^d, 7]
+        r = jnp.take_along_axis(slab, pos[..., None], axis=1)
+        fcol = gather_bin(binned, rows, r[..., _REC_COL], packed)
+        bin_ = r[..., _REC_BIN]
+        go_left = (fcol <= bin_) | ((r[..., _REC_DL] != 0)
+                                    & (fcol == r[..., _REC_NAN]))
+        if any_cat:
+            w = cat_h.shape[-1]
+            cslab = cat_h[:, base:base + (1 << d)]                # [Tb, 2^d, W]
+            idx = jnp.broadcast_to(pos[..., None], (tb, n, w))
+            words = jnp.take_along_axis(cslab, idx, axis=1)
+            word_id = (fcol // 32).astype(jnp.uint32)
+            sel = jnp.zeros_like(fcol, dtype=jnp.uint32)
+            for j in range(w):
+                sel = jnp.where(word_id == j, words[..., j], sel)
+            in_set = ((sel >> (fcol.astype(jnp.uint32) % 32)) & 1) != 0
+            go_left = jnp.where(r[..., _REC_CAT] != 0, in_set, go_left)
+        pos = 2 * pos + (1 - go_left.astype(jnp.int32))
+    return pos
+
+
+def _leaf_add(lv_b: jax.Array, leaf: jax.Array,
+              scale_b: Optional[jax.Array]) -> jax.Array:
+    """Gather per-row leaf values [Tb, N] from a (possibly quantized)
+    leaf slab and dequantize: int8 slabs scale by the per-tree factor,
+    f16 slabs widen — the serving-bandwidth half of the pack4 story."""
+    add = jnp.take_along_axis(lv_b, leaf, axis=1)
+    if add.dtype == jnp.int8:
+        add = add.astype(jnp.float32) * scale_b[:, None]
+    elif add.dtype != jnp.float32:
+        add = add.astype(jnp.float32)
+    return add
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "num_class", "depth", "tbatch", "early_stop_margin", "early_stop_freq",
+    "any_cat", "packed"))
+def predict_raw_level(
+    binned: jax.Array,          # [N, F] u8/u16, or packed
+    level: LevelTrees,          # T padded to a multiple of tbatch
+    leaf_value: jax.Array,      # [T, L] f32 | f16 | int8
+    num_model_per_iteration: jax.Array,
+    num_class: int = 1,
+    depth: int = 8,
+    tbatch: int = 16,
+    early_stop_margin: float = 0.0,
+    early_stop_freq: int = 0,
+    any_cat: bool = False,
+    packed: bool = False,
+    leaf_scale: Optional[jax.Array] = None,   # [T] f32 for int8 slabs
+) -> jax.Array:
+    """Raw scores [num_class, N] via the level-order engine.
+
+    Same chunking, class scatter and early-stop semantics as
+    ``predict_raw_batched``; only the per-chunk router differs. Leaf
+    indices are bit-identical to the walk (LevelTrees invariant), so
+    with an f32 slab the scores match bit-for-bit; quantized slabs stay
+    within the recorded bound shipped next to them.
+    """
+    n = binned.shape[0]
+    t_total = level.rec.shape[0]
+    chunks = t_total // tbatch
+    use_stop = early_stop_freq > 0 and early_stop_margin > 0.0
+    k_it = jnp.maximum(num_model_per_iteration, 1)
+
+    class_ids = (jnp.arange(t_total, dtype=jnp.int32) % k_it)
+    scale = (jnp.ones((t_total,), jnp.float32)
+             if leaf_scale is None else leaf_scale)
+    xs = (_chunked(level.rec, chunks), _chunked(level.cat_bitset, chunks),
+          _chunked(level.slot_leaf, chunks), _chunked(leaf_value, chunks),
+          _chunked(scale, chunks), _chunked(class_ids, chunks))
+
+    def chunk_step(carry, x):
+        scores, done, t_idx = carry
+        rec_b, cat_b, slot_b, lv_b, sc_b, cid_b = x
+        fpos = _level_chunk(binned, rec_b, cat_b, depth, any_cat, packed)
+        leaf = jnp.take_along_axis(slot_b, fpos, axis=1)
+        add = _leaf_add(lv_b, leaf, sc_b)
+        if use_stop:
+            add = jnp.where(done[None, :], 0.0, add)
+        if num_class == 1:
+            scores = scores + add.sum(axis=0)[None, :]
+        else:
+            scores = scores.at[cid_b].add(add)
+        t_idx = t_idx + tbatch
+        if use_stop:
+            at_boundary = t_idx % k_it == 0
+            it_done = t_idx // k_it
+            check = at_boundary & (it_done % early_stop_freq == 0)
+            done = done | (check & (_margin_of(scores, num_class)
+                                    > early_stop_margin))
+        return (scores, done, t_idx), None
+
+    scores0 = jnp.zeros((num_class, n), jnp.float32)
+    done0 = jnp.zeros((n,), bool)
+    (scores, _, _), _ = lax.scan(
+        chunk_step, (scores0, done0, jnp.asarray(0, jnp.int32)), xs)
+    return scores
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "depth", "tbatch", "any_cat", "packed"))
+def predict_leaf_level(
+    binned: jax.Array,
+    level: LevelTrees,
+    depth: int = 8,
+    tbatch: int = 16,
+    any_cat: bool = False,
+    packed: bool = False,
+) -> jax.Array:
+    """Per-tree leaf index [T, N] via the level engine (bit-identical to
+    ``predict_leaf_batched`` — the slot_leaf table restores creation-
+    order leaf ids)."""
+    t_total = level.rec.shape[0]
+    chunks = t_total // tbatch
+    xs = (_chunked(level.rec, chunks), _chunked(level.cat_bitset, chunks),
+          _chunked(level.slot_leaf, chunks))
+
+    def chunk_step(_, x):
+        rec_b, cat_b, slot_b = x
+        fpos = _level_chunk(binned, rec_b, cat_b, depth, any_cat, packed)
+        return _, jnp.take_along_axis(slot_b, fpos, axis=1)
+
+    _, leaves = lax.scan(chunk_step, 0, xs)
+    return leaves.reshape(t_total, binned.shape[0])
+
+
+# ---------------------------------------------------------------------------
+# serving leaf-value quantization (tpu_leaf_quant)
+# ---------------------------------------------------------------------------
+
+def quantize_leaves(leaf_value: jax.Array, class_ids: jax.Array,
+                    mode: str, num_class: int = 1
+                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Quantize the [T, L] leaf slab for serving; returns
+    ``(slab, scale[T], bound)``.
+
+    ``mode`` is ``"int8"`` (per-tree symmetric scale ``max|v| / 127``)
+    or ``"f16"`` (cast; scale stays 1). ``bound`` is the RECORDED
+    max-score-error bound the model stack ships: per-tree worst-case
+    dequantization error, summed per class (trees are iteration-major)
+    and maxed over classes — an exact bound on ``|quantized_score -
+    f32_score|`` for any row, because each row receives exactly one leaf
+    per tree. Padding trees quantize to 0 exactly, contributing 0.
+    """
+    v = leaf_value.astype(jnp.float32)
+    if mode == "f16":
+        slab = v.astype(jnp.float16)
+        scale = jnp.ones((v.shape[0],), jnp.float32)
+        err_t = jnp.max(jnp.abs(slab.astype(jnp.float32) - v), axis=1)
+    elif mode == "int8":
+        amax = jnp.max(jnp.abs(v), axis=1)
+        scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+        q = jnp.clip(jnp.round(v / scale[:, None]), -127, 127)
+        slab = q.astype(jnp.int8)
+        err_t = jnp.max(jnp.abs(q * scale[:, None] - v), axis=1)
+    else:
+        raise ValueError(f"tpu_leaf_quant={mode!r}: expected int8|f16")
+    per_class = jax.ops.segment_sum(err_t, class_ids.astype(jnp.int32),
+                                    num_segments=max(num_class, 1))
+    return slab, scale, jnp.max(per_class)

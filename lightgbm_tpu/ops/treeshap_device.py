@@ -139,18 +139,15 @@ def _chunked(arr: jax.Array, chunks: int) -> jax.Array:
     return arr.reshape(chunks, arr.shape[0] // chunks, *arr.shape[1:])
 
 
-def _leaf_phi(binned, rec_b, cat_b, leaf, depth: int, any_cat: bool,
-              packed: bool):
-    """SHAP contributions of ONE leaf across a tree chunk: [Tb, N, D+1]
-    per-slot weights ``w * (one - zero) * leaf_value`` plus the slot
-    feature ids to scatter them with."""
-    node, went, slot, zfrac, feat, ulen, lval = leaf
-    tb, n = node.shape[0], binned.shape[0]
-
-    # -- row agreement with each path step (go_left bit-parity with the
-    #    predict walk: same records, same predicate) -----------------------
+def _path_agreement(binned, rec_b, cat_b, node, went, slot, depth: int,
+                    any_cat: bool, packed: bool) -> jax.Array:
+    """Per-slot ``one`` fractions [Tb, N, D+1] in {0, 1}: a slot is 1
+    when the row agrees with EVERY occurrence of its feature on the
+    path (go_left bit-parity with the predict walk: same records, same
+    predicate). Padded steps land on slot 0 with forced agreement."""
     nd = jnp.maximum(node, 0)                                  # [Tb, D]
     r = jnp.take_along_axis(rec_b, nd[:, :, None], axis=1)     # [Tb, D, 7]
+    n = binned.shape[0]
     rows = jnp.arange(n, dtype=jnp.int32)[None, None, :]
     col = r[..., _REC_COL][:, :, None]
     fcol = gather_bin(binned, rows, col, packed)               # [Tb, D, N]
@@ -170,7 +167,6 @@ def _leaf_phi(binned, rec_b, cat_b, leaf, depth: int, any_cat: bool,
                             go_left)
     agree = (go_left == went[:, :, None]) | (node[:, :, None] < 0)
 
-    # -- merged one fractions per unique slot ------------------------------
     # a slot's one is the AND of its occurrences' agreements; padded steps
     # land on slot 0 with forced agreement, so slot 0 stays (1, 1)
     onehot_slot = (slot[:, :, None]
@@ -178,17 +174,28 @@ def _leaf_phi(binned, rec_b, cat_b, leaf, depth: int, any_cat: bool,
     disagree = (~agree).astype(jnp.float32)                    # [Tb, D, N]
     cnt = jnp.einsum("tdn,tdj->tnj", disagree,
                      onehot_slot.astype(jnp.float32))
-    one = (cnt == 0).astype(jnp.float32)                       # [Tb, N, D+1]
+    return (cnt == 0).astype(jnp.float32)                      # [Tb, N, D+1]
+
+
+def _extend_unwind(one, zfrac, ulen, depth: int) -> jax.Array:
+    """The row-dependent EXTEND/UNWIND recurrences: per-slot UNWIND sums
+    [Tb, B, D+1] from the agreement fractions ``one`` [Tb, B, D+1].
+
+    ``B`` is any batch axis — rows in the serving kernel, enumerated
+    agreement masks in the deploy-time table builder
+    (:func:`build_shap_tables`): the arithmetic depends on the row ONLY
+    through ``one``, which is what makes the tables row-independent."""
+    tb, b = one.shape[0], one.shape[1]
     zero = zfrac[:, None, :]                                   # [Tb, 1, D+1]
 
     # -- EXTEND: vectorized pweight recurrence over slots 1..u -------------
     karr = jnp.arange(depth + 1, dtype=jnp.float32)
-    p0 = jnp.zeros((tb, n, depth + 1), jnp.float32).at[..., 0].set(1.0)
+    p0 = jnp.zeros((tb, b, depth + 1), jnp.float32).at[..., 0].set(1.0)
 
     def ext_body(j, p):
         jf = j.astype(jnp.float32)
         z = jnp.take(zfrac, j, axis=1)[:, None, None]          # [Tb, 1, 1]
-        o = jnp.take(one, j, axis=2)[..., None]                # [Tb, N, 1]
+        o = jnp.take(one, j, axis=2)[..., None]                # [Tb, B, 1]
         pshift = jnp.pad(p, ((0, 0), (0, 0), (1, 0)))[..., :-1]
         newp = (z * p * (jf - karr) + o * pshift * karr) / (jf + 1.0)
         return jnp.where((j <= ulen)[:, None, None], newp, p)
@@ -197,7 +204,7 @@ def _leaf_phi(binned, rec_b, cat_b, leaf, depth: int, any_cat: bool,
 
     # -- UNWIND sums for every slot (masked descent i = u-1 .. 0) ----------
     uf = ulen.astype(jnp.float32)[:, None, None]               # [Tb, 1, 1]
-    pu = jnp.take_along_axis(p, ulen[:, None, None], axis=2)   # [Tb, N, 1]
+    pu = jnp.take_along_axis(p, ulen[:, None, None], axis=2)   # [Tb, B, 1]
     next_one = jnp.broadcast_to(pu, p.shape)
     total = jnp.zeros_like(p)
 
@@ -207,7 +214,7 @@ def _leaf_phi(binned, rec_b, cat_b, leaf, depth: int, any_cat: bool,
         valid = (i >= 0)[:, None, None]
         iq = jnp.maximum(i, 0)
         i_f = iq.astype(jnp.float32)[:, None, None]
-        pi = jnp.take_along_axis(p, iq[:, None, None], axis=2)  # [Tb, N, 1]
+        pi = jnp.take_along_axis(p, iq[:, None, None], axis=2)  # [Tb, B, 1]
         safe_one = jnp.where(one != 0, one, 1.0)
         tmp = next_one * (uf + 1.0) / ((i_f + 1.0) * safe_one)
         frac = zero * (uf - i_f) / (uf + 1.0)
@@ -218,7 +225,19 @@ def _leaf_phi(binned, rec_b, cat_b, leaf, depth: int, any_cat: bool,
                 jnp.where(valid, nn, next_one))
 
     total, _ = lax.fori_loop(0, depth, unwind_body, (total, next_one))
+    return total
 
+
+def _leaf_phi(binned, rec_b, cat_b, leaf, depth: int, any_cat: bool,
+              packed: bool):
+    """SHAP contributions of ONE leaf across a tree chunk: [Tb, N, D+1]
+    per-slot weights ``w * (one - zero) * leaf_value`` plus the slot
+    feature ids to scatter them with."""
+    node, went, slot, zfrac, feat, ulen, lval = leaf
+    one = _path_agreement(binned, rec_b, cat_b, node, went, slot, depth,
+                          any_cat, packed)
+    total = _extend_unwind(one, zfrac, ulen, depth)
+    zero = zfrac[:, None, :]                                   # [Tb, 1, D+1]
     # padded slots carry (one, zero) == (1, 1) so their weight is exactly
     # 0; slot 0 likewise — no masking needed beyond the fractions
     return total * (one - zero) * lval[:, None, None], feat
@@ -286,6 +305,149 @@ def shap_batched(
             phi0 = jnp.zeros((tb, n, fdim), jnp.float32)
             phi, _ = lax.scan(leaf_step, phi0, leaf_xs)
             # the tree's expected value lands in the bias slot once
+            phi = phi.at[..., -1].add(ev_b[:, None])
+            if num_class == 1:
+                return scores + phi.sum(axis=0)[None], None
+            return scores.at[cid_b].add(phi), None
+
+        scores0 = jnp.zeros((num_class, n, fdim), jnp.float32)
+        scores, _ = lax.scan(chunk_step, scores0, xs)
+        return scores
+
+
+class ShapTables(NamedTuple):
+    """Precomputed per-leaf UNWIND tables (the deploy-time half of the
+    tabled contrib kernel).
+
+    The EXTEND/UNWIND arithmetic of :func:`_extend_unwind` depends on
+    the row ONLY through the binary agreement pattern ``one`` over the
+    leaf's <= ``mask_bits`` unique slots (slot 0 and padded slots are
+    forced to 1). Enumerating all ``2^mask_bits`` patterns at deploy
+    time collapses the per-row kernel to agreement bits + one table
+    gather + the feature scatter: ``table[t, l, m]`` already carries
+    ``unwind_total * (one - zero) * leaf_value`` per slot.
+    """
+
+    node: jax.Array       # [T, L, D] i32 internal node per step, -1 pad
+    went_left: jax.Array  # [T, L, D] bool — direction the PATH takes
+    slot: jax.Array       # [T, L, D] i32 unique-feature slot (1-based)
+    feat: jax.Array       # [T, L, D+1] i32 feature id per slot (0 pad)
+    table: jax.Array      # [T, L, 2^mask_bits, D+1] f32 final weights
+    ev: jax.Array         # [T] f32 cover-weighted expected value
+
+    @property
+    def mask_bits(self) -> int:
+        return max(int(self.table.shape[2]).bit_length() - 1, 0)
+
+
+def shap_table_bytes(tree_bucket: int, max_leaves: int, mask_bits: int,
+                     depth: int) -> int:
+    """f32 footprint of a :class:`ShapTables.table` slab — the budget
+    gate (``tpu_shap_table_mb``) checks this BEFORE building."""
+    return tree_bucket * max_leaves * (1 << mask_bits) * (depth + 1) * 4
+
+
+@functools.partial(jax.jit, static_argnames=("mask_bits", "depth"))
+def build_shap_tables(paths: ShapPaths, leaf_value: jax.Array,
+                      mask_bits: int, depth: int) -> ShapTables:
+    """Enumerate every agreement mask through EXTEND/UNWIND once, at
+    deploy time (row-independent — runs on model (hot-)swap, never on
+    the serving path).
+
+    ``mask_bits`` must cover the longest unique path
+    (``paths.ulen.max()``); build peak memory is ~4x the final table, so
+    the caller gates on :func:`shap_table_bytes` first. Bit ``j-1`` of a
+    mask is slot ``j``'s agreement; slots past a leaf's ``ulen`` are
+    forced to agree, matching what :func:`_path_agreement` yields for
+    real rows (no step maps to a slot past ``ulen``), so every reachable
+    mask row is exact — table-vs-loop parity is bit-level per leaf.
+    """
+    t, l, d1 = paths.zfrac.shape
+    m = 1 << mask_bits
+    zfrac = paths.zfrac.reshape(t * l, d1)
+    ulen = paths.ulen.reshape(t * l)
+    lval = leaf_value.astype(jnp.float32).reshape(t * l)
+    j = jnp.arange(d1, dtype=jnp.int32)
+    bits = (jnp.arange(m, dtype=jnp.int32)[:, None]
+            >> jnp.maximum(j - 1, 0)[None, :]) & 1              # [M, D+1]
+    forced = (j[None, None, :] == 0) | (j[None, None, :]
+                                        > ulen[:, None, None])  # [TL,1,D+1]
+    one = jnp.where(forced, 1.0, bits[None].astype(jnp.float32))
+    total = _extend_unwind(one, zfrac, ulen, depth)             # [TL,M,D+1]
+    wgt = total * (one - zfrac[:, None, :]) * lval[:, None, None]
+    return ShapTables(
+        node=paths.node, went_left=paths.went_left, slot=paths.slot,
+        feat=paths.feat, table=wgt.reshape(t, l, m, d1), ev=paths.ev)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "num_class", "depth", "tbatch", "any_cat", "packed", "num_features"))
+def shap_batched_tables(
+    binned: jax.Array,         # [N, F] u8/u16, or [N, ceil(F/2)] u8 packed
+    trees: StackedTrees,       # T padded to the tree bucket
+    tables: ShapTables,
+    nan_bin_arr: jax.Array,    # [F] i32
+    is_cat_arr: jax.Array,     # [F] bool
+    num_model_per_iteration: jax.Array,  # scalar i32
+    num_class: int = 1,
+    depth: int = 8,            # depth bucket (paths are built at it)
+    tbatch: int = 16,
+    any_cat: bool = False,
+    packed: bool = False,
+    num_features: int = 0,
+    col_of: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Tabled twin of :func:`shap_batched`: [num_class, N, F+1].
+
+    Per (row, leaf) the EXTEND and UNWIND recurrences are replaced by a
+    mask-integer reduction over the agreement bits and ONE gather from
+    the precomputed table — same jit keys, same output (bit-identical to
+    the loop kernel on every reachable mask, see
+    :func:`build_shap_tables`)."""
+    from ..obs.spans import span
+    with span("contrib"):
+        n = binned.shape[0]
+        t_total = trees.num_trees
+        chunks = t_total // tbatch
+        k_it = jnp.maximum(num_model_per_iteration, 1)
+        rec = _pack_node_records(trees, nan_bin_arr, is_cat_arr, col_of)
+        class_ids = (jnp.arange(t_total, dtype=jnp.int32) % k_it)
+        mask_bits = tables.mask_bits
+        xs = (_chunked(rec, chunks), _chunked(trees.cat_bitset, chunks),
+              _chunked(tables.node, chunks),
+              _chunked(tables.went_left, chunks),
+              _chunked(tables.slot, chunks), _chunked(tables.feat, chunks),
+              _chunked(tables.table, chunks), _chunked(tables.ev, chunks),
+              _chunked(class_ids, chunks))
+        fdim = num_features + 1
+        farange = jnp.arange(fdim, dtype=jnp.int32)
+        pw2 = jnp.left_shift(
+            jnp.int32(1), jnp.arange(mask_bits, dtype=jnp.int32))
+
+        def chunk_step(scores, x):
+            (rec_b, cat_b, node_b, went_b, slot_b, feat_b, tab_b, ev_b,
+             cid_b) = x
+            tb = rec_b.shape[0]
+
+            def leaf_step(phi, leaf_x):
+                node, went, slot, feat, tab = leaf_x    # tab [Tb, M, D+1]
+                one = _path_agreement(binned, rec_b, cat_b, node, went,
+                                      slot, depth, any_cat, packed)
+                bits = (one[..., 1:mask_bits + 1] != 0).astype(jnp.int32)
+                midx = jnp.sum(bits * pw2[None, None, :], axis=-1)  # [Tb,N]
+                wgt = jnp.take_along_axis(
+                    tab, jnp.broadcast_to(midx[:, :, None],
+                                          (tb, n, tab.shape[2])), axis=1)
+                onehot_f = (feat[:, :, None] == farange[None, None, :]
+                            ).astype(jnp.float32)              # [Tb,D+1,Fd]
+                return phi + jnp.einsum("tnj,tjf->tnf", wgt, onehot_f), None
+
+            leaf_xs = (
+                node_b.transpose(1, 0, 2), went_b.transpose(1, 0, 2),
+                slot_b.transpose(1, 0, 2), feat_b.transpose(1, 0, 2),
+                tab_b.transpose(1, 0, 2, 3))
+            phi0 = jnp.zeros((tb, n, fdim), jnp.float32)
+            phi, _ = lax.scan(leaf_step, phi0, leaf_xs)
             phi = phi.at[..., -1].add(ev_b[:, None])
             if num_class == 1:
                 return scores + phi.sum(axis=0)[None], None

@@ -168,7 +168,8 @@ class MicroBatchCoalescer:
     def __init__(self, serve_batch: Callable[[List[ServeFuture]], None],
                  *, tick_ms: float, queue_max_rows: int,
                  max_batch_rows: int, fault_config=None,
-                 name: str = "serve", observer=None):
+                 name: str = "serve", observer=None,
+                 background_kinds=()):
         if queue_max_rows < 1:
             raise ValueError("tpu_serve_queue_max must be >= 1 row")
         if max_batch_rows < 1:
@@ -184,6 +185,11 @@ class MicroBatchCoalescer:
         # never fail serving (_notify swallows + warns once)
         self._observer = observer
         self._observer_warned = False
+        # background-tier request kinds (tpu_serve_background_kinds):
+        # a background request only cuts a tick's batch when NO live
+        # foreground request is queued — explanation (contrib) traffic
+        # soaks idle ticks without touching predict/leaf latency
+        self._background_kinds = frozenset(background_kinds)
         self._cv = threading.Condition()
         # each request holds >= 1 row and admission rejects past the row
         # bound first, so maxlen (a hard REQUEST cap) is never the
@@ -294,6 +300,12 @@ class MicroBatchCoalescer:
         with self._cv:
             self._max_batch_rows = int(rows)
 
+    def set_background_kinds(self, kinds) -> None:
+        """Re-point the background lane after a model swap (the new
+        active model's ``tpu_serve_background_kinds``)."""
+        with self._cv:
+            self._background_kinds = frozenset(kinds)
+
     def set_fault_config(self, config) -> None:
         """Re-point the coalesce_tick fault site at the new active
         model's config after a swap — a candidate carrying
@@ -337,10 +349,21 @@ class MicroBatchCoalescer:
             now = time.monotonic()
             batch: List[ServeFuture] = []
             rows = 0
+            bg = self._background_kinds
+            # a background request only cuts a batch when no LIVE
+            # foreground request is queued (expired ones sweep this pass
+            # and must not pin the background lane another tick)
+            has_fg = any(r.kind not in bg
+                         and (r.deadline is None or now < r.deadline)
+                         for r in self._q)
+            kept: List[ServeFuture] = []
+            stop = False
             while self._q:
-                r = self._q[0]
+                r = self._q.popleft()
+                if stop:
+                    kept.append(r)
+                    continue
                 if r.deadline is not None and now >= r.deadline:
-                    self._q.popleft()
                     self._rows -= r.n
                     self.stats["timeouts"] += 1
                     self._kstats(r.kind)["timeouts"] += 1
@@ -352,7 +375,6 @@ class MicroBatchCoalescer:
                     # admitted before a hot-swap shrank the warmed-rung
                     # bound: serving it now would compile in the request
                     # path — fail structurally instead
-                    self._q.popleft()
                     self._rows -= r.n
                     self.stats["errors"] += 1
                     self._kstats(r.kind)["errors"] += 1
@@ -363,19 +385,30 @@ class MicroBatchCoalescer:
                         "resubmit in smaller slices"))
                     swept.append(r)
                     continue
+                if bg and has_fg and r.kind in bg:
+                    # background lane: skipped (in place, order kept)
+                    # while foreground traffic is queued — it serves on
+                    # the first tick with an empty foreground queue
+                    kept.append(r)
+                    continue
                 if batch and r.kind != batch[0].kind:
                     # one endpoint per tick: a batch is ONE device
                     # dispatch, and predict/leaf/contrib are distinct
                     # programs — mixed traffic serves FIFO on
                     # consecutive ticks instead of splitting a tick
-                    break
+                    kept.append(r)
+                    stop = True
+                    continue
                 if batch and rows + r.n > self._max_batch_rows:
-                    break                   # next tick's batch
-                self._q.popleft()
+                    kept.append(r)
+                    stop = True             # next tick's batch
+                    continue
                 self._rows -= r.n
                 r.popped_at = now
                 batch.append(r)
                 rows += r.n
+            for r in reversed(kept):
+                self._q.appendleft(r)
             return batch
 
     def _drain_loop(self) -> None:

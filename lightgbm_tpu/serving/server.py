@@ -92,7 +92,8 @@ class PredictionServer:
         self._coalescer = MicroBatchCoalescer(
             self._serve_batch, tick_ms=tick_ms, queue_max_rows=queue_max,
             max_batch_rows=self._resolve_max_batch(active),
-            fault_config=cfg, observer=self._obs)
+            fault_config=cfg, observer=self._obs,
+            background_kinds=self._background_kinds(cfg))
         try:
             self._attach_obs_model()
             # metrics plane (obs/metrics.py): pull-based Prometheus text
@@ -129,6 +130,23 @@ class PredictionServer:
                 if ms is not None:
                     ms.stop()
             raise
+
+    @staticmethod
+    def _background_kinds(cfg) -> frozenset:
+        """Resolved ``tpu_serve_background_kinds``: request kinds demoted
+        to the background tier (they only cut a coalescer tick when no
+        foreground request is queued). ``predict`` can never be demoted
+        — it is THE latency-path endpoint the tier protects."""
+        from ..utils import log
+        raw = str(cfg.get("tpu_serve_background_kinds", "") or "")
+        kinds = {k.strip().lower() for k in raw.split(",") if k.strip()}
+        unknown = kinds - {"leaf", "contrib"}
+        if unknown:
+            log.warning(f"unknown tpu_serve_background_kinds "
+                        f"{sorted(unknown)}; valid: leaf, contrib "
+                        "(predict cannot be demoted)")
+            kinds -= unknown
+        return frozenset(kinds)
 
     # -- batch bound ---------------------------------------------------------
     def _resolve_max_batch(self, booster, version: Optional[str] = None
@@ -327,6 +345,8 @@ class PredictionServer:
             self._coalescer.set_fault_config(active._gbdt.config)
             self._coalescer.set_max_batch_rows(
                 self._resolve_max_batch(active))
+            self._coalescer.set_background_kinds(
+                self._background_kinds(active._gbdt.config))
         self._attach_obs_model()
 
     def _attach_obs_model(self) -> None:

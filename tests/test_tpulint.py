@@ -773,6 +773,92 @@ def test_r004_pack4_nibble_mask_detector(tmp_path):
     assert not unrelated
 
 
+def test_r004_serving_entry_contract_coverage(tmp_path):
+    """Serving-engine contract coverage seed (round 20): a serving
+    EngineEntry must name an HLO contract id or a contract_exempt
+    justification that points at the pinning test."""
+    findings = lint_snippet(tmp_path, """
+        SERVING_ENTRIES = (
+            EngineEntry(id="serve_fast", impl="level", layout="heap",
+                        description="no contract, no exemption"),
+        )
+    """)
+    r4 = [f for f in findings if f.rule == "R004"
+          and "serving EngineEntry" in f.message]
+    assert len(r4) == 1 and "serve_fast" in r4[0].message
+    vague = lint_snippet(tmp_path, """
+        SERVING_ENTRIES = (
+            EngineEntry(id="serve_q", impl="level", layout="heap",
+                        contract_exempt="trust me"),
+        )
+    """, name="vague_exempt.py")
+    r4 = [f for f in vague if f.rule == "R004"
+          and "serving EngineEntry" in f.message]
+    assert len(r4) == 1 and "pinning test" in r4[0].message
+    clean = lint_snippet(tmp_path, """
+        SERVING_ENTRIES = (
+            EngineEntry(id="serve_walk", impl="walk", layout="packed",
+                        contracts=("serve_walk",)),
+            EngineEntry(id="serve_qleaf", impl="level", layout="heap",
+                        contract_exempt="output pinned by the recorded "
+                        "bound + tests/test_level_engine.py"),
+            EngineEntry(id="xla_lane", impl="xla", layout="lane"),
+        )
+    """, name="clean_serving.py")
+    assert not [f for f in clean if "serving EngineEntry" in f.message]
+
+
+def test_r004_quant_bound_discarded(tmp_path):
+    """Quantized-leaf recorded-bound seed (round 20): an unpack that
+    drops quantize_leaves' bound, or a hand-rolled /127 scale with no
+    bound/err assignment, serves quantized scores with no accuracy
+    contract."""
+    findings = lint_snippet(tmp_path, """
+        def stack_quant(leaf_value, class_ids):
+            slab, scale = quantize_leaves(leaf_value, class_ids, "int8")
+            return slab, scale
+    """)
+    r4 = [f for f in findings if f.rule == "R004" and "bound" in f.message]
+    assert len(r4) == 1
+    underscore = lint_snippet(tmp_path, """
+        def stack_quant(leaf_value, class_ids):
+            slab, scale, _ = quantize_leaves(leaf_value, class_ids,
+                                             "int8")
+            return slab, scale
+    """, name="underscore_bound.py")
+    assert [f for f in underscore
+            if f.rule == "R004" and "bound" in f.message]
+    handrolled = lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def quantize(v):
+            amax = jnp.max(jnp.abs(v), axis=1)
+            scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+            slab = jnp.round(v / scale[:, None]).astype(jnp.int8)
+            return slab, scale
+    """, name="handrolled_scale.py")
+    r4 = [f for f in handrolled
+          if f.rule == "R004" and "bound" in f.message]
+    assert len(r4) == 1 and "127" not in r4[0].message.split(":")[0]
+    clean = lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def quantize(v):
+            amax = jnp.max(jnp.abs(v), axis=1)
+            scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+            q = jnp.clip(jnp.round(v / scale[:, None]), -127, 127)
+            err_t = jnp.max(jnp.abs(q * scale[:, None] - v), axis=1)
+            return q.astype(jnp.int8), scale, jnp.max(err_t)
+
+        def stack_quant(leaf_value, class_ids):
+            slab, scale, bound = quantize_leaves(leaf_value, class_ids,
+                                                 "int8")
+            return slab, scale, float(bound)
+    """, name="clean_quant.py")
+    assert not [f for f in clean
+                if f.rule == "R004" and "bound" in f.message]
+
+
 # ---------------------------------------------------------------- R005
 def test_r005_operand_shape_counting(tmp_path):
     """The seed case: parallel/comm_accounting.py:65 pre-fix (ADVICE r5
